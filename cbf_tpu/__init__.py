@@ -1,0 +1,24 @@
+"""cbf_tpu — a TPU-native (JAX/XLA) multi-agent CBF safety-filter simulation framework.
+
+Re-designed from scratch with the capabilities of the reference CBF repo
+(YilunAllenChen/CBF): a Control Barrier Function safety filter that
+post-processes nominal multi-robot controls through per-agent quadratic
+programs, plus Robotarium-style scenario simulation — rebuilt TPU-first:
+
+- agent parallelism  -> ``jax.vmap`` over batched fixed-shape QPs
+- time               -> ``jax.lax.scan`` (whole rollout = one XLA program)
+- ensemble/data par. -> ``jax.sharding.Mesh`` + ``shard_map`` over ICI/DCN
+- agent sharding     -> ring pairwise exchange via ``lax.ppermute``
+- hot ops            -> Pallas kernels (pairwise distances / neighbor gating)
+
+Layer map (mirrors SURVEY.md §1, rebuilt functionally) — see the repo tree
+for the subpackages currently shipped:
+
+- ``cbf_tpu.core``      barrier construction + QP assembly   (ref: cbf.py:38-76)
+- ``cbf_tpu.solvers``   batched exact / ADMM QP solvers      (ref: cvxopt backend, cbf.py:81)
+- ``cbf_tpu.oracle``    pure-numpy reference oracle (float64) for parity tests
+"""
+
+__version__ = "0.1.0"
+
+from cbf_tpu.core.filter import CBFParams, safe_control, safe_controls  # noqa: F401

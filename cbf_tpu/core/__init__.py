@@ -1,0 +1,2 @@
+from cbf_tpu.core.barrier import barrier_rows, box_rows, assemble_qp  # noqa: F401
+from cbf_tpu.core.filter import CBFParams, safe_control, safe_controls  # noqa: F401

@@ -1,0 +1,4 @@
+from cbf_tpu.oracle.reference_filter import (  # noqa: F401
+    OracleCBF,
+    solve_qp_slsqp,
+)

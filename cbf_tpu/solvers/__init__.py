@@ -1,0 +1,2 @@
+from cbf_tpu.solvers.exact2d import QPInfo, project_polyhedron_2d, solve_qp_2d  # noqa: F401
+from cbf_tpu.solvers.admm import ADMMSettings, solve_box_qp_admm  # noqa: F401

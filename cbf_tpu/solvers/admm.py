@@ -1,0 +1,73 @@
+"""Fixed-iteration OSQP-style ADMM for general dense QPs, batched under vmap.
+
+Solves ``min 1/2 x^T P x + q^T x  s.t.  l <= A x <= u`` with a *fixed*
+iteration count — no data-dependent early exit — so an entire batch of QPs
+compiles to one XLA program and the per-iteration linear solve (a dense
+Cholesky of ``P + sigma I + rho A^T A``, factored once per problem) runs on
+the MXU.
+
+Used for the joint all-agent barrier certificate — the rps
+``create_single_integrator_barrier_certificate_with_boundary`` equivalent
+(reference usage: cross_and_rescue.py:72,163; meet_at_center.py:58) — whose QP
+has 2N variables and O(N^2) pairwise rows, too big for the 2-D enumeration
+solver in :mod:`cbf_tpu.solvers.exact2d`.
+
+Algorithm (standard OSQP splitting, fixed rho/sigma/alpha):
+    x+ = (P + sigma I + rho A^T A)^{-1} (sigma x - q + A^T (rho z - y))
+    z+ = clip(A x+ + y / rho, l, u)
+    y+ = y + rho (A x+ - z+)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.scipy.linalg import cho_factor, cho_solve
+
+
+class ADMMSettings(NamedTuple):
+    rho: float = 1.0
+    sigma: float = 1e-6
+    alpha: float = 1.6       # over-relaxation
+    iters: int = 200
+
+
+class ADMMInfo(NamedTuple):
+    primal_residual: jax.Array
+    dual_residual: jax.Array
+
+
+@functools.partial(jax.jit, static_argnames=("settings",))
+def solve_box_qp_admm(P, q, A, l, u, settings: ADMMSettings = ADMMSettings()):
+    """Solve one QP; vmap for batches. Returns (x, ADMMInfo)."""
+    n = q.shape[0]
+    m = l.shape[0]
+    dtype = jnp.result_type(P, q, A)
+    rho, sigma, alpha = settings.rho, settings.sigma, settings.alpha
+
+    K = P + sigma * jnp.eye(n, dtype=dtype) + rho * (A.T @ A)
+    cf = cho_factor(K)
+
+    def step(_, carry):
+        x, z, y = carry
+        rhs = sigma * x - q + A.T @ (rho * z - y)
+        x_new = cho_solve(cf, rhs)
+        Ax = A @ x_new
+        Ax_relaxed = alpha * Ax + (1.0 - alpha) * z
+        z_new = jnp.clip(Ax_relaxed + y / rho, l, u)
+        y_new = y + rho * (Ax_relaxed - z_new)
+        return (x_new, z_new, y_new)
+
+    x0 = jnp.zeros((n,), dtype)
+    z0 = jnp.zeros((m,), dtype)
+    y0 = jnp.zeros((m,), dtype)
+    x, z, y = lax.fori_loop(0, settings.iters, step, (x0, z0, y0))
+
+    Ax = A @ x
+    primal = jnp.max(jnp.abs(Ax - jnp.clip(Ax, l, u)))
+    dual = jnp.max(jnp.abs(P @ x + q + A.T @ y))
+    return x, ADMMInfo(primal, dual)

@@ -1,0 +1,172 @@
+"""Exact, branch-free, batched solver for 2-variable inequality QPs.
+
+The reference solves ``min ||du||^2 s.t. A du <= b`` (2 decision variables,
+m+8 rows) with cvxopt's dense interior-point solver, once per endangered agent
+per timestep, inside an unbounded exception-driven relax-retry loop
+(reference: cbf.py:61-87). Interior-point code — data-dependent iteration
+counts, early exits, exceptions — is exactly what does NOT map to XLA/TPU.
+
+TPU-native replacement: the minimizer of ||du||^2 over a 2-D polyhedron is the
+Euclidean projection of the origin onto it, and in 2-D the optimal active set
+has at most two linearly independent rows. So instead of iterating, we
+*enumerate* every KKT candidate in fixed shape:
+
+- the origin (empty active set),
+- M single-row projections,
+- M*(M-1)/2 two-row intersections,
+
+check primal feasibility and dual sign (lambda >= 0) for each, and select the
+valid candidate of minimum norm with one ``argmin``. This is exact (up to
+floating point), completely branch-free, O(M^2) with a tiny constant, and
+``vmap``s over thousands of agents into pure VPU work — no MXU needed, no
+iteration-count tuning, bit-identical across batch lanes.
+
+Infeasibility handling: if no candidate is valid the polyhedron is empty
+(in 2-D the projection of the origin onto a nonempty polyhedron always has a
+candidate representation). We then reproduce the reference's recovery policy
+(cbf.py:78-87) — add +1 to every *real CBF row's* RHS and retry — as a
+*bounded* ``lax.while_loop`` that typically runs one iteration, with the
+relax count surfaced as a diagnostic instead of an exception.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_BIG = 1e30
+
+
+class QPInfo(NamedTuple):
+    feasible: jax.Array      # bool — a valid KKT point was found
+    relax_rounds: jax.Array  # float — how many +1 relaxations were applied
+    max_violation: jax.Array # float — residual max(A x - b) at the solution
+
+
+def _feas_tol(dtype) -> float:
+    return 1e-6 if dtype == jnp.float64 else 1e-4
+
+
+@functools.partial(jax.jit, static_argnames=("feas_tol",))
+def project_polyhedron_2d(A, b, feas_tol=None):
+    """Project the origin onto {x in R^2 : A x <= b} by KKT enumeration.
+
+    Args:
+      A: (M, 2) rows; all-zero rows are treated as inactive padding.
+      b: (M,) RHS.
+    Returns:
+      (x, valid_found, max_violation): x is the exact minimizer when
+      ``valid_found``; otherwise the least-violating candidate (the
+      polyhedron is empty).
+    """
+    dtype = jnp.result_type(A, b)
+    tol = _feas_tol(dtype) if feas_tol is None else feas_tol
+    M = A.shape[0]
+    norms2 = jnp.sum(A * A, axis=1)                      # (M,)
+    row_ok = norms2 > 1e-12
+
+    # --- candidate 0: the origin -------------------------------------------
+    x_zero = jnp.zeros((1, 2), dtype)
+    dual_zero = jnp.ones((1,), bool)
+
+    # --- single-row candidates: x = a_i * b_i / ||a_i||^2 ------------------
+    safe_n2 = jnp.where(row_ok, norms2, 1.0)
+    x_single = A * (b / safe_n2)[:, None]                # (M, 2)
+    # lambda_i = -b_i/||a_i||^2 >= 0  <=>  b_i <= 0
+    dual_single = row_ok & (b <= tol)
+
+    # --- two-row candidates: a_i x = b_i, a_j x = b_j ----------------------
+    I, J = np.triu_indices(M, k=1)                       # static index sets
+    ai, aj = A[I], A[J]                                  # (P, 2)
+    bi, bj = b[I], b[J]
+    det = ai[:, 0] * aj[:, 1] - ai[:, 1] * aj[:, 0]
+    det_ok = jnp.abs(det) > 1e-10
+    safe_det = jnp.where(det_ok, det, 1.0)
+    x_pair = jnp.stack(
+        [(aj[:, 1] * bi - ai[:, 1] * bj) / safe_det,
+         (ai[:, 0] * bj - aj[:, 0] * bi) / safe_det],
+        axis=-1,
+    )                                                    # (P, 2)
+    # Dual: solve Gram @ lambda = -b_pair, need lambda >= 0.
+    gii, gjj = norms2[I], norms2[J]
+    gij = jnp.sum(ai * aj, axis=1)
+    detG = gii * gjj - gij * gij
+    safe_detG = jnp.where(jnp.abs(detG) > 1e-14, detG, 1.0)
+    lam_i = (-bi * gjj + bj * gij) / safe_detG
+    lam_j = (-bj * gii + bi * gij) / safe_detG
+    dual_pair = det_ok & row_ok[I] & row_ok[J] & (lam_i >= -tol) & (lam_j >= -tol)
+
+    # --- select ------------------------------------------------------------
+    X = jnp.concatenate([x_zero, x_single, x_pair], axis=0)       # (C, 2)
+    dual_ok = jnp.concatenate([dual_zero, dual_single, dual_pair])
+    AX = jnp.einsum("cd,md->cm", X, A, precision=lax.Precision.HIGHEST)
+    viol = jnp.max(AX - b[None, :], axis=1)                       # (C,)
+    feas = viol <= tol
+    valid = feas & dual_ok
+    score = jnp.sum(X * X, axis=1) + jnp.where(valid, 0.0, _BIG)
+    # Tie-break toward *least violation* when nothing is valid, so the
+    # fallback output is still sensible.
+    score = jnp.where(jnp.any(valid), score, viol)
+    idx = jnp.argmin(score)
+    return X[idx], jnp.any(valid), viol[idx]
+
+
+@functools.partial(jax.jit, static_argnames=("max_relax", "unroll_relax", "feas_tol"))
+def solve_qp_2d(A, b, relax_mask=None, *, max_relax: int = 64,
+                unroll_relax: int = 0, feas_tol=None):
+    """``min ||x||^2 s.t. A x <= b`` with reference-equivalent relaxation.
+
+    Args:
+      A: (M, 2), b: (M,).
+      relax_mask: (M,) 1.0 on rows whose RHS is relaxed by +1 per round on
+        infeasibility (the reference relaxes exactly the CBF rows —
+        cbf.py:85-87). None disables relaxation.
+      max_relax: bound on relax rounds (the reference loops unboundedly;
+        we bound and surface the count).
+      unroll_relax: if > 0, use a fixed unrolled number of relax rounds with
+        ``where``-selects instead of ``lax.while_loop`` — fully reverse-mode
+        differentiable (for learned-parameter pipelines).
+
+    Returns (x, QPInfo).
+    """
+    dtype = jnp.result_type(A, b)
+    if relax_mask is None:
+        relax_mask = jnp.zeros(b.shape, dtype)
+    relax_mask = relax_mask.astype(dtype)
+
+    def attempt(t):
+        return project_polyhedron_2d(A, b + t * relax_mask, feas_tol=feas_tol)
+
+    if unroll_relax > 0:
+        x, found, viol = attempt(jnp.asarray(0.0, dtype))
+        t = jnp.asarray(0.0, dtype)
+        for r in range(1, unroll_relax + 1):
+            x2, found2, viol2 = attempt(jnp.asarray(float(r), dtype))
+            take = (~found) & found2
+            x = jnp.where(take, x2, x)
+            viol = jnp.where(take, viol2, viol)
+            t = jnp.where(take, float(r), t)
+            found = found | found2
+        return x, QPInfo(found, t, viol)
+
+    x0, found0, viol0 = attempt(jnp.asarray(0.0, dtype))
+
+    def cond(c):
+        t, _, found, _ = c
+        return (~found) & (t < max_relax)
+
+    def body(c):
+        t, _, _, _ = c
+        t = t + 1.0
+        x, found, viol = attempt(t)
+        return (t, x, found, viol)
+
+    t, x, found, viol = lax.while_loop(
+        cond, body, (jnp.asarray(0.0, dtype), x0, found0, viol0)
+    )
+    return x, QPInfo(found, t, viol)

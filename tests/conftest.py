@@ -1,0 +1,44 @@
+"""Test configuration: CPU backend with a virtual 8-device mesh.
+
+Multi-chip sharding tests run against `--xla_force_host_platform_device_count=8`
+(SURVEY.md §4) so no TPU hardware is needed; parity tests optionally enable
+x64 via the `x64` fixture for strict float64 comparison against the numpy
+oracle.
+
+Must set env vars before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# Some TPU PJRT plugins ignore the JAX_PLATFORMS env var; the config update
+# before first backend initialization does force the CPU client (with the 8
+# virtual devices from XLA_FLAGS above) as default.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def x64():
+    """Enable float64 within a test (strict oracle parity)."""
+    import jax
+
+    with jax.enable_x64(True):
+        yield
+
+
+@pytest.fixture(scope="session")
+def rng():
+    import numpy as np
+
+    return np.random.default_rng(0)

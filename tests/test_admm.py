@@ -1,0 +1,65 @@
+"""Unit tests for the fixed-iteration ADMM box-QP solver."""
+
+import numpy as np
+
+from cbf_tpu.oracle.reference_filter import solve_qp_slsqp
+
+
+def test_projection_qp_matches_slsqp(x64, rng):
+    import jax.numpy as jnp
+    from cbf_tpu.solvers.admm import ADMMSettings, solve_box_qp_admm
+
+    n, m = 4, 10
+    for trial in range(10):
+        A = rng.normal(size=(m, n))
+        b = rng.normal(size=m) + 1.0
+        P = np.eye(n)
+        q = rng.normal(size=n)
+        x, info = solve_box_qp_admm(
+            jnp.asarray(P), jnp.asarray(q), jnp.asarray(A),
+            jnp.full(m, -np.inf), jnp.asarray(b),
+            ADMMSettings(iters=400),
+        )
+        # SLSQP comparison: min 1/2 x^T x + q^T x  s.t. Ax <= b
+        from scipy.optimize import minimize
+        res = minimize(
+            lambda z: 0.5 * z @ z + q @ z, np.zeros(n), jac=lambda z: z + q,
+            constraints=[{"type": "ineq", "fun": lambda z: b - A @ z}],
+            method="SLSQP", tol=1e-12,
+        )
+        assert res.success
+        np.testing.assert_allclose(np.asarray(x), res.x, atol=2e-4,
+                                   err_msg=f"trial={trial}")
+        assert float(info.primal_residual) < 1e-4
+
+
+def test_equality_like_tight_box(x64):
+    """l == u rows act as equalities."""
+    import jax.numpy as jnp
+    from cbf_tpu.solvers.admm import ADMMSettings, solve_box_qp_admm
+
+    # min ||x||^2 s.t. x0 + x1 == 1 -> x = (0.5, 0.5)
+    P = jnp.eye(2)
+    q = jnp.zeros(2)
+    A = jnp.array([[1.0, 1.0]])
+    x, info = solve_box_qp_admm(P, q, A, jnp.array([1.0]), jnp.array([1.0]),
+                                ADMMSettings(iters=400))
+    np.testing.assert_allclose(np.asarray(x), [0.5, 0.5], atol=1e-5)
+
+
+def test_vmap_batch(x64, rng):
+    import jax
+    import jax.numpy as jnp
+    from cbf_tpu.solvers.admm import ADMMSettings, solve_box_qp_admm
+
+    B, n, m = 16, 3, 6
+    A = rng.normal(size=(B, m, n))
+    b = rng.normal(size=(B, m)) + 1.0
+    q = rng.normal(size=(B, n))
+    P = np.broadcast_to(np.eye(n), (B, n, n)).copy()
+    settings = ADMMSettings(iters=300)
+    xs, infos = jax.vmap(
+        lambda Pb, qb, Ab, bb: solve_box_qp_admm(
+            Pb, qb, Ab, jnp.full(m, -jnp.inf), bb, settings)
+    )(jnp.asarray(P), jnp.asarray(q), jnp.asarray(A), jnp.asarray(b))
+    assert np.asarray(infos.primal_residual).max() < 1e-3

@@ -1,0 +1,119 @@
+"""Unit tests for the exact 2-D QP enumeration solver.
+
+Cross-checked against scipy SLSQP (an independent algorithm) on random
+polyhedra — the oracle relationship prescribed by SURVEY.md §7 step 0.
+"""
+
+import numpy as np
+import pytest
+
+from cbf_tpu.oracle.reference_filter import solve_qp_slsqp
+
+
+def _solve_jax(A, b, relax_mask=None, **kw):
+    import jax.numpy as jnp
+    from cbf_tpu.solvers.exact2d import solve_qp_2d
+
+    x, info = solve_qp_2d(jnp.asarray(A), jnp.asarray(b),
+                          None if relax_mask is None else jnp.asarray(relax_mask),
+                          **kw)
+    return np.asarray(x), info
+
+
+def test_unconstrained_origin(x64):
+    A = np.array([[1.0, 0.0], [0.0, 1.0]])
+    b = np.array([5.0, 5.0])  # origin strictly feasible
+    x, info = _solve_jax(A, b)
+    assert bool(info.feasible)
+    np.testing.assert_allclose(x, 0.0, atol=1e-12)
+
+
+def test_single_active_halfspace(x64):
+    # x1 <= -2  ->  projection is (-2, 0)
+    A = np.array([[1.0, 0.0]])
+    b = np.array([-2.0])
+    x, info = _solve_jax(A, b)
+    assert bool(info.feasible)
+    np.testing.assert_allclose(x, [-2.0, 0.0], atol=1e-10)
+
+
+def test_two_active_rows(x64):
+    # x1 <= -1, x2 <= -1 -> projection (-1, -1)
+    A = np.array([[1.0, 0.0], [0.0, 1.0]])
+    b = np.array([-1.0, -1.0])
+    x, info = _solve_jax(A, b)
+    assert bool(info.feasible)
+    np.testing.assert_allclose(x, [-1.0, -1.0], atol=1e-10)
+
+
+def test_masked_zero_rows_ignored(x64):
+    A = np.array([[1.0, 0.0], [0.0, 0.0], [0.0, 0.0]])
+    b = np.array([-2.0, 1e6, 1e6])
+    x, info = _solve_jax(A, b)
+    assert bool(info.feasible)
+    np.testing.assert_allclose(x, [-2.0, 0.0], atol=1e-10)
+
+
+def test_infeasible_detection(x64):
+    # x1 <= -1 and -x1 <= -1 (x1 >= 1): empty.
+    A = np.array([[1.0, 0.0], [-1.0, 0.0]])
+    b = np.array([-1.0, -1.0])
+    x, info = _solve_jax(A, b)
+    assert not bool(info.feasible)
+
+
+def test_relaxation_recovers_feasibility(x64):
+    # Infeasible by margin 2; relaxing both rows by +1 makes it feasible
+    # (x1 <= 0 and x1 >= 0 -> x = 0).
+    A = np.array([[1.0, 0.0], [-1.0, 0.0]])
+    b = np.array([-1.0, -1.0])
+    relax = np.array([1.0, 1.0])
+    x, info = _solve_jax(A, b, relax)
+    assert bool(info.feasible)
+    assert float(info.relax_rounds) == 1.0
+    np.testing.assert_allclose(x, [0.0, 0.0], atol=1e-10)
+
+
+def test_unrolled_relax_matches_while(x64):
+    A = np.array([[1.0, 0.0], [-1.0, 0.0], [0.0, 1.0]])
+    b = np.array([-1.5, -1.5, 3.0])
+    relax = np.array([1.0, 1.0, 0.0])
+    x_w, info_w = _solve_jax(A, b, relax)
+    x_u, info_u = _solve_jax(A, b, relax, unroll_relax=8)
+    assert bool(info_w.feasible) and bool(info_u.feasible)
+    np.testing.assert_allclose(x_w, x_u, atol=1e-10)
+    assert float(info_w.relax_rounds) == float(info_u.relax_rounds)
+
+
+@pytest.mark.parametrize("m", [1, 3, 8, 16])
+def test_random_polyhedra_vs_slsqp(x64, rng, m):
+    for trial in range(30):
+        A = rng.normal(size=(m, 2))
+        b = rng.normal(size=(m,)) + 0.5  # bias toward feasible
+        x_ref, feas_ref = solve_qp_slsqp(A, b)
+        x, info = _solve_jax(A, b)
+        if feas_ref and bool(info.feasible):
+            np.testing.assert_allclose(x, x_ref, atol=1e-5,
+                                       err_msg=f"m={m} trial={trial}")
+        # If the enumerator says feasible, its point must actually satisfy
+        # the constraints.
+        if bool(info.feasible):
+            assert np.max(A @ x - b) <= 1e-6
+
+
+def test_batched_vmap(x64, rng):
+    import jax
+    import jax.numpy as jnp
+    from cbf_tpu.solvers.exact2d import solve_qp_2d
+
+    B, M = 64, 10
+    A = rng.normal(size=(B, M, 2))
+    b = rng.normal(size=(B, M)) + 0.5
+    xs, infos = jax.vmap(lambda a, bb: solve_qp_2d(a, bb))(
+        jnp.asarray(A), jnp.asarray(b)
+    )
+    xs = np.asarray(xs)
+    for i in range(B):
+        x_ref, feas_ref = solve_qp_slsqp(A[i], b[i])
+        if feas_ref and bool(infos.feasible[i]):
+            np.testing.assert_allclose(xs[i], x_ref, atol=1e-5)

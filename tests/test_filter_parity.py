@@ -1,0 +1,146 @@
+"""Golden parity: JAX batched filter vs the float64 numpy oracle.
+
+The oracle (cbf_tpu.oracle) replicates the reference ControlBarrierFunction
+(cbf.py:18-92) with an independent SLSQP QP backend; here we check the
+TPU-native fixed-shape masked path produces the same filtered controls.
+"""
+
+import numpy as np
+import pytest
+
+from cbf_tpu.oracle.reference_filter import OracleCBF
+
+# Scenario dynamics (reference: meet_at_center.py:26-27): single-integrator
+# carried in a 4-D state, scaled by 0.1.
+FX = 0.1 * np.zeros((4, 4))
+GX = 0.1 * np.array([[1.0, 0], [0, 1.0], [0, 0], [0, 0]])
+
+
+def _jax_filter(robot_state, obs_states, obs_mask, u0, K, **params):
+    import jax.numpy as jnp
+    from cbf_tpu.core.filter import CBFParams, safe_control
+
+    pad = K - obs_states.shape[0]
+    obs_pad = np.vstack([obs_states, np.zeros((pad, 4))]) if pad else obs_states
+    mask = np.concatenate([obs_mask, np.zeros(pad, bool)]) if pad else obs_mask
+    u, info = safe_control(
+        jnp.asarray(robot_state), jnp.asarray(obs_pad), jnp.asarray(mask),
+        jnp.asarray(FX), jnp.asarray(GX), jnp.asarray(u0),
+        CBFParams(**params) if params else CBFParams(),
+    )
+    return np.asarray(u), info
+
+
+def test_corrected_selftest_scenario(x64):
+    """The reference self-test (cbf.py:94-108) corrected to 4-D states.
+
+    The shipped demo is broken (2-state inputs against 4-state code —
+    SURVEY.md §2.2); this is the working 4-state version serving as the unit
+    fixture SURVEY.md prescribes.
+    """
+    oracle = OracleCBF(max_speed=0.2, dmin=0.2)
+    robot_state = np.array([0.1, 0.1, -0.01, 0.03])
+    obs = np.array(
+        [
+            [0.08, 0.14, 0.0, 0.0],
+            [0.12, 0.09, 0.0, 0.0],
+            [0.12, 0.12, 0.0, 0.0],
+        ]
+    )
+    fx = np.zeros((4, 4))
+    gx = np.array([[1.0, 0], [0, 1.0], [0, 0], [0, 0]])
+    u0 = np.array([-0.01, 0.03])
+    u_ref = oracle.get_safe_control(robot_state, obs, fx, gx, u0)
+
+    import jax.numpy as jnp
+    from cbf_tpu.core.filter import CBFParams, safe_control
+
+    u, info = safe_control(
+        jnp.asarray(robot_state), jnp.asarray(obs),
+        jnp.ones(3, bool), jnp.asarray(fx), jnp.asarray(gx), jnp.asarray(u0),
+        CBFParams(max_speed=0.2),
+    )
+    np.testing.assert_allclose(np.asarray(u), u_ref, atol=1e-6)
+
+
+@pytest.mark.parametrize("n_obs", [1, 2, 5, 9])
+def test_random_states_parity_x64(x64, rng, n_obs):
+    oracle = OracleCBF(max_speed=15.0)
+    for trial in range(20):
+        robot_state = rng.uniform(-1.5, 1.5, size=4)
+        robot_state[2:] = rng.uniform(-0.3, 0.3, size=2)
+        # obstacles near the robot (danger-gated in the reference)
+        obs = np.tile(robot_state, (n_obs, 1))
+        obs[:, :2] += rng.uniform(-0.2, 0.2, size=(n_obs, 2))
+        obs[:, 2:] = rng.uniform(-0.3, 0.3, size=(n_obs, 2))
+        u0 = rng.uniform(-0.5, 0.5, size=2)
+
+        u_ref = oracle.get_safe_control(robot_state, obs, FX, GX, u0)
+        u, info = _jax_filter(robot_state, obs, np.ones(n_obs, bool), u0, K=n_obs)
+        assert bool(info.feasible)
+        np.testing.assert_allclose(
+            u, u_ref, atol=1e-6,
+            err_msg=f"n_obs={n_obs} trial={trial} relax={oracle.last_relax_rounds}",
+        )
+
+
+def test_mask_padding_equivalence(x64, rng):
+    """K-padded masked slots must not change the solution."""
+    oracle = OracleCBF(max_speed=15.0)
+    robot_state = np.array([0.3, -0.2, 0.05, 0.1])
+    obs = np.array([[0.35, -0.15, 0.0, -0.1], [0.2, -0.3, 0.1, 0.0]])
+    u0 = np.array([0.2, -0.1])
+    u_ref = oracle.get_safe_control(robot_state, obs, FX, GX, u0)
+    for K in (2, 4, 8, 16):
+        u, info = _jax_filter(robot_state, obs, np.ones(2, bool), u0, K=K)
+        np.testing.assert_allclose(u, u_ref, atol=1e-6, err_msg=f"K={K}")
+
+
+def test_float32_parity_tolerance(rng):
+    """The TPU dtype path stays within a loose band of the oracle."""
+    oracle = OracleCBF(max_speed=15.0)
+    worst = 0.0
+    for trial in range(20):
+        robot_state = rng.uniform(-1.0, 1.0, size=4)
+        obs = np.tile(robot_state, (3, 1))
+        obs[:, :2] += rng.uniform(-0.18, 0.18, size=(3, 2))
+        u0 = rng.uniform(-0.5, 0.5, size=2)
+        u_ref = oracle.get_safe_control(robot_state, obs, FX, GX, u0)
+        u, _ = _jax_filter(robot_state.astype(np.float32),
+                           obs.astype(np.float32), np.ones(3, bool),
+                           u0.astype(np.float32), K=4)
+        worst = max(worst, float(np.max(np.abs(u - u_ref))))
+    assert worst < 5e-3, worst
+
+
+def test_no_obstacles_identity(x64):
+    """All-masked slab => u == u0 (reference skips the QP entirely —
+    meet_at_center.py:136)."""
+    robot_state = np.array([0.0, 0.0, 0.0, 0.0])
+    u0 = np.array([0.3, -0.2])
+    u, info = _jax_filter(robot_state, np.zeros((0, 4)), np.zeros(0, bool), u0, K=4)
+    assert bool(info.feasible)
+    np.testing.assert_allclose(u, u0, atol=1e-9)
+
+
+def test_batched_safe_controls_matches_loop(x64, rng):
+    import jax.numpy as jnp
+    from cbf_tpu.core.filter import CBFParams, safe_controls
+
+    N, K = 12, 6
+    states = rng.uniform(-1, 1, size=(N, 4))
+    obs = rng.uniform(-1, 1, size=(N, K, 4))
+    mask = rng.uniform(size=(N, K)) < 0.5
+    u0 = rng.uniform(-0.5, 0.5, size=(N, 2))
+    u_batch, infos = safe_controls(
+        jnp.asarray(states), jnp.asarray(obs), jnp.asarray(mask),
+        jnp.asarray(FX), jnp.asarray(GX), jnp.asarray(u0), CBFParams()
+    )
+    oracle = OracleCBF(max_speed=15.0)
+    for i in range(N):
+        if mask[i].any():
+            u_ref = oracle.get_safe_control(states[i], obs[i][mask[i]], FX, GX, u0[i])
+        else:
+            u_ref = u0[i]
+        np.testing.assert_allclose(np.asarray(u_batch[i]), u_ref, atol=1e-6,
+                                   err_msg=f"agent {i}")
