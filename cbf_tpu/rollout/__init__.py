@@ -1,0 +1,2 @@
+from cbf_tpu.rollout.gating import danger_slab, knn_gating  # noqa: F401
+from cbf_tpu.rollout.engine import StepOutputs, rollout  # noqa: F401

@@ -1,0 +1,65 @@
+"""Rollout engine: whole simulations as one compiled XLA program.
+
+The reference runs a Python ``for k in range(iterations)`` host loop calling
+the simulator and per-agent QPs serially (meet_at_center.py:76,
+cross_and_rescue.py:97). Here time is a ``lax.scan`` over a pure step
+function, so a 10k-step, 4096-agent rollout is a single device program with
+constant memory in T — the "long axis" treatment SURVEY.md §5 prescribes in
+place of sequence parallelism.
+
+A scenario is any pair ``(state0, step_fn)`` where
+``step_fn(state, t) -> (state, StepOutputs)``. Metrics ride along as scan
+outputs (per-step min pairwise distance, filter activity, QP health) — the
+framework's observability story (SURVEY.md §5) — and trajectories are
+recorded optionally to bound memory at large N.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class StepOutputs(NamedTuple):
+    """Per-step observability record emitted by every scenario step.
+
+    Leaves may be () for scenarios that don't track a field.
+    """
+    min_pairwise_distance: Any    # scalar — collision margin time series
+    filter_active_count: Any      # scalar — agents whose CBF filter engaged
+    infeasible_count: Any         # scalar — agents whose QP hit the relax cap
+    max_relax_rounds: Any         # scalar — worst relaxation this step
+    trajectory: Any               # optional (.., N)-shaped position snapshot
+
+
+@functools.partial(jax.jit, static_argnames=("step_fn", "steps", "unroll"))
+def rollout(step_fn: Callable, state0, steps: int, *, unroll: int = 1):
+    """Run ``steps`` iterations of ``step_fn`` under ``lax.scan``.
+
+    Returns (final_state, StepOutputs stacked over time).
+    """
+    def body(state, t):
+        state, out = step_fn(state, t)
+        return state, out
+
+    return lax.scan(body, state0, jnp.arange(steps), unroll=unroll)
+
+
+def min_pairwise_distance(positions):
+    """Min inter-point distance of a (2, N) position set (column layout, as
+    everywhere in the sim layer — a (N, 2) input would be silently
+    misinterpreted for N == 2, so the layout is fixed, not guessed).
+
+    The scenario-level safety metric (SURVEY.md §4: regression on
+    min-pairwise-distance time series).
+    """
+    P = positions.T                                  # (N, 2)
+    diff = P[:, None, :] - P[None, :, :]
+    d2 = jnp.sum(diff * diff, axis=-1)
+    n = P.shape[0]
+    d2 = d2 + jnp.eye(n, dtype=d2.dtype) * 1e9
+    return jnp.sqrt(jnp.min(d2))

@@ -1,0 +1,72 @@
+"""Neighbor/danger gating: fixed-shape replacements for the reference's
+O(N*M) Python danger scans.
+
+The reference gathers, per agent, a variable-length list of "danger" states:
+obstacles within a 0.2 m Euclidean radius, and fellow agents within the
+radius excluding self via ``distance > 0`` (meet_at_center.py:118-133,
+cross_and_rescue.py:135-150). Two fixed-shape equivalents:
+
+- :func:`danger_slab` — exact at small N: every agent carries ALL M candidate
+  states plus a boolean mask. Masked QP rows are null, so with K = M this is
+  behaviorally identical to the reference's list (QP solutions are row-order
+  invariant).
+
+- :func:`knn_gating` — the scaling path (SURVEY.md §7 hard part #3): keep only
+  the K nearest in-radius candidates via ``lax.top_k``. At N >> 10 this is a
+  deliberate, documented deviation: agents with more than K in-radius
+  neighbors see only the K closest (the K+1-th nearest is strictly farther
+  and its constraint is almost always dominated).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def danger_slab(agent_states, candidate_states, radius, exclude_self_row=None):
+    """All-candidate gating, exact reference semantics.
+
+    Args:
+      agent_states: (N, 4) — rows (x, y, vx, vy); positions are the *actual*
+        poses, velocities the commanded controls (meet_at_center.py:114).
+      candidate_states: (M, 4) shared candidate pool (obstacles ++ agents).
+      radius: Euclidean danger radius (0.2 in both scenarios).
+      exclude_self_row: (M,) bool — True for candidate rows subject to the
+        reference's ``distance > 0`` self-exclusion (the fellow-agent block;
+        meet_at_center.py:132). None = no exclusion anywhere.
+
+    Returns: (obs: (N, M, 4), mask: (N, M) bool).
+    """
+    diff = agent_states[:, None, :2] - candidate_states[None, :, :2]
+    dist = jnp.sqrt(jnp.sum(diff * diff, axis=-1))            # (N, M)
+    mask = dist < radius
+    if exclude_self_row is not None:
+        mask = mask & (~exclude_self_row[None, :] | (dist > 0))
+    obs = jnp.broadcast_to(candidate_states[None], (agent_states.shape[0],) +
+                           candidate_states.shape)
+    return obs, mask
+
+
+def knn_gating(agent_states, candidate_states, radius, k: int,
+               exclude_self_row=None, dist=None):
+    """Top-k nearest in-radius gating for large swarms.
+
+    Same contract as :func:`danger_slab` but returns a (N, k, 4) slab of the
+    k nearest candidates and their validity mask. Ineligible candidates are
+    pushed to +inf distance before the top-k. ``k`` is clamped to the
+    candidate count. ``dist`` may pass a precomputed (N, M) distance matrix
+    (e.g. when the caller also derives metrics from it).
+    """
+    if dist is None:
+        diff = agent_states[:, None, :2] - candidate_states[None, :, :2]
+        dist = jnp.sqrt(jnp.sum(diff * diff, axis=-1))        # (N, M)
+    k = min(k, candidate_states.shape[0])
+    eligible = dist < radius
+    if exclude_self_row is not None:
+        eligible = eligible & (~exclude_self_row[None, :] | (dist > 0))
+    keyed = jnp.where(eligible, dist, jnp.inf)
+    neg_d, idx = lax.top_k(-keyed, k)                          # (N, k)
+    mask = jnp.isfinite(-neg_d)
+    obs = jnp.take(candidate_states, idx, axis=0)              # (N, k, 4)
+    return obs, mask

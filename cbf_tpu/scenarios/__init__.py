@@ -1,0 +1,1 @@
+from cbf_tpu.scenarios import meet_at_center, cross_and_rescue, swarm  # noqa: F401

@@ -1,0 +1,197 @@
+"""Scenario 2: leader-follower crossing of a rotating obstacle ring.
+
+TPU-native rebuild of the reference ``cross_and_rescue.py`` (181 LoC,
+SURVEY.md §2.5): 4 simulated robots cross a ring of 6 *virtual* obstacles
+(pure state, not robots) cyclic-pursuing around the origin, toward a goal at
+(1.5, 0), with a two-layer safety stack: the custom CBF filter followed by
+the joint barrier certificate. Rendering is decoupled — the reference grabs a
+matplotlib frame per step into simulation.mp4 (:96-98); here the recorded
+trajectory replays through cbf_tpu.render.
+
+Faithful details (citations into /root/reference/cross_and_rescue.py):
+- robots start on a 0.6*0.6-diameter circle at x - 1.15 (:51-53); obstacles
+  on a 0.6-diameter ring (:48-50)
+- obstacle law: ring consensus rotated by -pi/6, scaled 0.05 (:107-118),
+  integrated by explicit Euler with T = 1/30 (:68,173)
+- goal-column trick: the goal is a virtual 5th consensus node wired by a
+  hand-written directed Laplacian; its zero row keeps it static (:89-95,102)
+- a static virtual obstacle at the origin joins the obstacle set every step
+  (:130-131) and is trimmed back off before integration (:173)
+- CBF gating identical to scenario 1 (0.2 m radius, self-exclusion) over
+  obstacles ++ robots (:134-150); then the joint certificate on the robots
+  (:162-163)
+- 3000 iterations (:67)
+
+Run headless: ``python -m cbf_tpu.scenarios.cross_and_rescue``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from cbf_tpu.core.filter import CBFParams, safe_controls
+from cbf_tpu.rollout.engine import StepOutputs, min_pairwise_distance, rollout
+from cbf_tpu.rollout.gating import danger_slab
+from cbf_tpu.sim import (
+    CertificateParams,
+    SimParams,
+    adjacency_from_laplacian,
+    consensus_velocities,
+    cycle_gl,
+    cyclic_pursuit_velocities,
+    si_barrier_certificate,
+    si_to_uni_dyn,
+    uni_to_si_states,
+    unicycle_step,
+)
+
+# The reference's hand-written directed Laplacian wiring robot 0 to the goal
+# (node 4) and robots 1-3 leader-follower (:89-95). Kept verbatim as data.
+L2_GOAL = np.array(
+    [
+        [-1, 0, 0, 0, 1],
+        [1, -2, 0, 1, 0],
+        [1, 1, -2, 0, 0],
+        [1, 0, 1, -2, 0],
+        [0, 0, 0, 0, 0],
+    ],
+    dtype=np.float64,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    n_robots: int = 4
+    n_obstacles: int = 6
+    iterations: int = 3000
+    diameter: float = 0.6
+    goal: tuple = (1.5, 0.0)
+    obs_speed_scale: float = 0.05      # (:118)
+    obs_dt: float = 1.0 / 30.0         # (:68)
+    safety_distance: float = 0.2       # (:134)
+    max_speed: float = 15.0            # (:30)
+    dyn_scale: float = 0.1             # (:31-32)
+    record_trajectory: bool = True
+    dtype: type = jnp.float32
+
+
+class State(NamedTuple):
+    poses: jnp.ndarray     # (3, n_robots)
+    obs_pos: jnp.ndarray   # (2, n_obstacles)
+
+
+def initial_state(cfg: Config) -> State:
+    """Reference initial conditions (:43-57)."""
+    robots = np.zeros((cfg.n_robots, 3))
+    for i in range(cfg.n_robots):
+        th = i * (2 * np.pi / cfg.n_robots)
+        robots[i] = [0.6 * cfg.diameter * np.cos(th) - 1.15,
+                     0.6 * cfg.diameter * np.sin(th), th + 2 / 3 * np.pi]
+    obs = np.zeros((cfg.n_obstacles, 2))
+    for i in range(cfg.n_obstacles):
+        th = i * (2 * np.pi / cfg.n_obstacles)
+        obs[i] = [cfg.diameter * np.cos(th), cfg.diameter * np.sin(th)]
+    return State(
+        poses=jnp.asarray(robots.T, cfg.dtype),
+        obs_pos=jnp.asarray(obs.T, cfg.dtype),
+    )
+
+
+def make(cfg: Config = Config(), sim: SimParams = SimParams(),
+         cbf: CBFParams | None = None,
+         cert: CertificateParams = CertificateParams()):
+    if cbf is None:
+        cbf = CBFParams(max_speed=cfg.max_speed)
+    nR, nO = cfg.n_robots, cfg.n_obstacles
+    dt = cfg.dtype
+
+    A_ring = adjacency_from_laplacian(cycle_gl(nO)).astype(dt)
+    A_goal = adjacency_from_laplacian(L2_GOAL).astype(dt)
+    theta_obs = -np.pi / nO
+
+    f = cfg.dyn_scale * jnp.zeros((4, 4), dt)
+    g = cfg.dyn_scale * jnp.array([[1, 0], [0, 1], [0, 0], [0, 0]], dt)
+    goal_col = jnp.asarray(np.array(cfg.goal).reshape(2, 1), dt)
+
+    # Candidate pool per step: [6 ring obstacles, 1 static origin obstacle,
+    # 4 robots] — self-exclusion applies to the robot block only (:141-150).
+    M = nO + 1 + nR
+    exclude_self = jnp.concatenate([jnp.zeros(nO + 1, bool), jnp.ones(nR, bool)])
+
+    state0 = initial_state(cfg)
+
+    def step(state: State, t):
+        poses, obs_pos = state.poses, state.obs_pos
+        x_si = uni_to_si_states(poses, sim.projection_distance)       # (2, nR)
+        x_si_goal = jnp.concatenate([x_si, goal_col], axis=1)         # (2, nR+1)
+
+        # Obstacle ring law (:107-118) and robot consensus incl. goal
+        # column (:121-125; row 4 of L2 is zero so the goal stays put).
+        obs_vel = cfg.obs_speed_scale * cyclic_pursuit_velocities(
+            obs_pos, A_ring, theta_obs
+        )
+        v_all = consensus_velocities(x_si_goal, A_goal)               # (2, nR+1)
+        si_velocities = v_all[:, :nR]                                 # (2, nR)
+
+        # Obstacle 4-D states: positions ++ commanded velocities, with the
+        # static origin obstacle appended (:130-132).
+        obs_pos_aug = jnp.concatenate([obs_pos, jnp.zeros((2, 1), dt)], axis=1)
+        obs_vel_aug = jnp.concatenate([obs_vel, jnp.zeros((2, 1), dt)], axis=1)
+        obstacle_states = jnp.concatenate([obs_pos_aug, obs_vel_aug], axis=0).T
+        agent_states = jnp.concatenate([poses[:2], si_velocities], axis=0).T
+        pool = jnp.concatenate([obstacle_states, agent_states], axis=0)  # (M,4)
+
+        obs_slab, mask = danger_slab(
+            agent_states, pool, cfg.safety_distance, exclude_self
+        )
+        u0 = si_velocities.T
+        u_safe, info = safe_controls(agent_states, obs_slab, mask, f, g, u0, cbf)
+        engaged = jnp.any(mask, axis=1)
+        u_final = jnp.where(engaged[:, None], u_safe, u0)
+        si_velocities = u_final.T
+
+        # Second safety layer: the joint certificate (:162-163).
+        si_velocities = si_barrier_certificate(si_velocities, x_si, cert)
+
+        dxu = si_to_uni_dyn(si_velocities, poses, sim.projection_distance)
+        new_poses = unicycle_step(poses, dxu, sim)
+        new_obs = obs_pos + cfg.obs_dt * obs_vel                      # (:173)
+
+        # Safety margin across robots AND virtual obstacles.
+        everyone = jnp.concatenate([poses[:2], obs_pos_aug], axis=1)
+        out = StepOutputs(
+            min_pairwise_distance=min_pairwise_distance(everyone),
+            filter_active_count=jnp.sum(engaged),
+            infeasible_count=jnp.sum(~info.feasible & engaged),
+            max_relax_rounds=jnp.max(info.relax_rounds),
+            trajectory=(poses[:2], obs_pos) if cfg.record_trajectory else (),
+        )
+        return State(poses=new_poses, obs_pos=new_obs), out
+
+    return state0, step
+
+
+def run(cfg: Config = Config(), **kw):
+    state0, step = make(cfg, **kw)
+    return rollout(step, state0, cfg.iterations)
+
+
+def main():
+    cfg = Config()
+    final, outs = run(cfg)
+    goal = np.array(cfg.goal)
+    dists = np.linalg.norm(np.asarray(final.poses[:2]).T - goal, axis=1)
+    print(f"cross_and_rescue: {cfg.iterations} steps")
+    print(f"  robot distances to goal: {np.round(dists, 3)}")
+    print(f"  min pairwise distance over run: "
+          f"{float(np.asarray(outs.min_pairwise_distance).min()):.4f} m")
+    print(f"  filter engaged on {int(np.asarray(outs.filter_active_count).sum())} "
+          f"agent-steps; infeasible {int(np.asarray(outs.infeasible_count).sum())}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,165 @@
+"""Scenario 1: rendezvous through a ring of cyclic-pursuit obstacles.
+
+TPU-native rebuild of the reference ``meet_at_center.py`` (159 LoC, SURVEY.md
+§2.4): 10 robots — agents 0-4 cyclic-pursuit on a circle (the moving
+obstacles), agents 5-9 rendezvous by complete-graph consensus, each free
+agent's control passed through the CBF filter against all in-radius obstacles
+and fellow agents. The reference's per-step Python loops become one fused
+step function; the 1000-iteration loop becomes ``lax.scan``.
+
+Faithful details (citations into /root/reference/meet_at_center.py):
+- initial circles: obstacles on a 0.7-diameter circle, free agents 1.5x out,
+  headings theta + 2/3 pi (:37-48)
+- obstacle law: ring-Laplacian consensus rotated by -pi/5 (:65-71, :89-96)
+- free law: complete-graph consensus (:74, :99-103)
+- CBF inputs: 4-D states = [pose positions ; commanded velocities] (:114),
+  f = 0.1*0, g = 0.1*[[1,0],[0,1],[0,0],[0,0]] (:26-27), danger radius 0.2
+  with self-exclusion via distance > 0 (:117-133), filter applied only to
+  free agents and only when the danger set is non-empty (:118,136-143)
+- the official joint barrier certificate is created but NOT applied (:108-109)
+- loop tail: si-to-uni map, actuator saturation, unicycle step (:148-153)
+
+Run headless: ``python -m cbf_tpu.scenarios.meet_at_center``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from cbf_tpu.core.filter import CBFParams, safe_controls
+from cbf_tpu.rollout.engine import StepOutputs, min_pairwise_distance, rollout
+from cbf_tpu.rollout.gating import danger_slab
+from cbf_tpu.sim import (
+    SimParams,
+    adjacency_from_laplacian,
+    complete_gl,
+    consensus_velocities,
+    cycle_gl,
+    cyclic_pursuit_velocities,
+    si_to_uni_dyn,
+    uni_to_si_states,
+    unicycle_step,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Scenario knobs (the reference hard-codes all of these — SURVEY.md §5)."""
+    n_obstacles: int = 5
+    n_free: int = 5
+    iterations: int = 1000
+    diameter: float = 0.7
+    safety_distance: float = 0.2       # danger gating radius (:117)
+    max_speed: float = 15.0            # (:25)
+    dyn_scale: float = 0.1             # the 0.1 factor on f, g (:26-27)
+    record_trajectory: bool = True
+    dtype: type = jnp.float32
+
+    @property
+    def n(self) -> int:
+        return self.n_obstacles + self.n_free
+
+
+class State(NamedTuple):
+    poses: jnp.ndarray   # (3, N)
+
+
+def initial_poses(cfg: Config) -> np.ndarray:
+    """Reference initial conditions (:37-48), transposed to (3, N)."""
+    ic = np.zeros((cfg.n, 3))
+    for i in range(cfg.n_obstacles):
+        th = i * (2 * np.pi / cfg.n_obstacles)
+        ic[i] = [cfg.diameter * np.cos(th), cfg.diameter * np.sin(th),
+                 th + 2 / 3 * np.pi]
+    for i in range(cfg.n_obstacles, cfg.n):
+        th = i * (2 * np.pi / cfg.n_obstacles) + np.pi / cfg.n_obstacles
+        ic[i] = [1.5 * cfg.diameter * np.cos(th),
+                 1.5 * cfg.diameter * np.sin(th), th + 2 / 3 * np.pi]
+    return ic.T
+
+
+def make(cfg: Config = Config(), sim: SimParams = SimParams(),
+         cbf: CBFParams | None = None):
+    """Build (state0, step_fn) for the rollout engine."""
+    if cbf is None:
+        cbf = CBFParams(max_speed=cfg.max_speed)
+    n_obs, n_free, N = cfg.n_obstacles, cfg.n_free, cfg.n
+    dt = cfg.dtype
+
+    A_ring = adjacency_from_laplacian(cycle_gl(n_obs)).astype(dt)
+    A_full = adjacency_from_laplacian(complete_gl(n_free)).astype(dt)
+    theta = -np.pi / n_obs
+
+    f = cfg.dyn_scale * jnp.zeros((4, 4), dt)
+    g = cfg.dyn_scale * jnp.array([[1, 0], [0, 1], [0, 0], [0, 0]], dt)
+
+    # Candidate pool rows subject to the reference's `distance > 0`
+    # self-exclusion: the fellow-agent block, not the obstacle block (:124-133).
+    exclude_self = jnp.concatenate(
+        [jnp.zeros(n_obs, bool), jnp.ones(n_free, bool)]
+    )
+    free = jnp.arange(n_obs, N)
+
+    state0 = State(poses=jnp.asarray(initial_poses(cfg), dt))
+
+    def step(state: State, t):
+        poses = state.poses
+        x_si = uni_to_si_states(poses, sim.projection_distance)
+
+        # Nominal control laws (:86-103).
+        v_obs = cyclic_pursuit_velocities(x_si[:, :n_obs], A_ring, theta)
+        v_free = consensus_velocities(x_si[:, n_obs:], A_full)
+        si_velocities = jnp.concatenate([v_obs, v_free], axis=1)  # (2, N)
+
+        # CBF filtering of the free agents (:112-143). 4-D states pair the
+        # *pose* positions with the *commanded* velocities (:114).
+        states4 = jnp.concatenate([poses[:2], si_velocities], axis=0).T  # (N,4)
+        agent_states = states4[n_obs:]
+        obs_slab, mask = danger_slab(
+            agent_states, states4, cfg.safety_distance, exclude_self
+        )
+        u0 = si_velocities[:, n_obs:].T                            # (n_free, 2)
+        u_safe, info = safe_controls(agent_states, obs_slab, mask, f, g, u0, cbf)
+        engaged = jnp.any(mask, axis=1)                            # (n_free,)
+        u_final = jnp.where(engaged[:, None], u_safe, u0)          # skip-QP parity
+        si_velocities = si_velocities.at[:, free].set(u_final.T)
+
+        # Loop tail (:148-153).
+        dxu = si_to_uni_dyn(si_velocities, poses, sim.projection_distance)
+        new_poses = unicycle_step(poses, dxu, sim)
+
+        out = StepOutputs(
+            min_pairwise_distance=min_pairwise_distance(poses[:2]),
+            filter_active_count=jnp.sum(engaged),
+            infeasible_count=jnp.sum(~info.feasible & engaged),
+            max_relax_rounds=jnp.max(info.relax_rounds),
+            trajectory=poses[:2] if cfg.record_trajectory else (),
+        )
+        return State(poses=new_poses), out
+
+    return state0, step
+
+
+def run(cfg: Config = Config(), **kw):
+    state0, step = make(cfg, **kw)
+    return rollout(step, state0, cfg.iterations)
+
+
+def main():
+    cfg = Config()
+    final, outs = run(cfg)
+    md = np.asarray(outs.min_pairwise_distance)
+    print(f"meet_at_center: {cfg.iterations} steps, N={cfg.n}")
+    print(f"  min pairwise distance over run: {md.min():.4f} m")
+    print(f"  final free-agent spread: "
+          f"{float(np.asarray(min_pairwise_distance(final.poses[:2, cfg.n_obstacles:]))):.4f} m")
+    print(f"  filter engaged on {int(np.asarray(outs.filter_active_count).sum())} "
+          f"agent-steps; infeasible {int(np.asarray(outs.infeasible_count).sum())}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,177 @@
+"""Scaling scenario: N-agent rendezvous with pairwise-collision CBFs.
+
+The benchmark ladder's flagship (BASELINE.md: 256-agent single chip ->
+4096-agent, 10k steps; north-star metric agent-QP-steps/sec/chip). There is
+no reference counterpart at this scale — the reference demonstrates 10 agents
+in serial Python (SURVEY.md §6) — so this scenario is the framework's
+raison d'etre: every agent runs the same CBF-QP filter as the reference
+scenarios (same barrier math, same relax policy), gated on its k nearest
+in-radius neighbors (fixed-K sparsification of the O(N^2) danger scan —
+SURVEY.md §7 hard part #3), with the whole T-step rollout one ``lax.scan``.
+
+Dynamics use the reference's affine form f = 0.1*0, g = 0.1*[[I],[0]]
+(meet_at_center.py:26-27) with one deliberate deviation: the velocity slots
+of the 4-D states carry the *actual* (previous filtered) velocities, not the
+commanded ones. The reference's commanded-velocity convention
+(meet_at_center.py:114) does not scale: with hundreds of agents all
+commanding toward the centroid, the barrier's approach-velocity term drives
+h < 0 swarm-wide, every interior QP goes infeasible, the +1 relax policy
+neuters the constraints, and the crowd collapses to a point (reproduced
+empirically). Actual velocities vanish at equilibrium, so the crowd packs at
+h ~ 0 instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from cbf_tpu.core.filter import CBFParams, safe_controls
+from cbf_tpu.rollout.engine import StepOutputs, rollout
+from cbf_tpu.rollout.gating import knn_gating
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    n: int = 256
+    steps: int = 1000
+    k_neighbors: int = 8
+    # Gating radius for the k-NN danger scan. Deliberately wider than dmin
+    # (0.2): constraints must activate *before* the barrier boundary, or
+    # closing agents arrive at h < 0 already violating (the reference's
+    # radius == dmin works only at its 10-agent, slow-speed scale).
+    safety_distance: float = 0.4
+    consensus_gain: float = 1.0
+    # Rendezvous to a *packed disk*, not a point: N agents with a hard 0.2 m
+    # separation cannot all reach the centroid — point-rendezvous drives
+    # every interior QP infeasible and the relax policy then disables the
+    # constraints (the reference's 5 free agents never hit this regime).
+    # The stand-off radius scales with sqrt(N) to keep target density below
+    # the packing limit; agents inside it idle.
+    pack_spacing: float = 0.14
+    dt: float = 0.033
+    # Actuator-style magnitude cap applied to the *nominal* command before
+    # the filter — the swarm stand-in for the Robotarium wheel saturation.
+    # Saturating after the filter instead would rescale away the evasive
+    # component the QP just guaranteed (verified: the swarm collapses to
+    # zero pairwise distance that way).
+    speed_limit: float = 0.2
+    max_speed: float = 15.0
+    dyn_scale: float = 0.1
+    seed: int = 0
+    record_trajectory: bool = False
+    dtype: type = jnp.float32
+
+    @property
+    def spawn_half_width(self) -> float:
+        # Scale the spawn box with sqrt(N) to keep initial density safe
+        # (grid spacing ~0.4 m > the 0.2 m danger radius), spawning outside
+        # the packing radius so agents must migrate inward.
+        return max(1.5, 0.2 * float(np.sqrt(self.n)))
+
+    @property
+    def pack_radius(self) -> float:
+        return self.pack_spacing * float(np.sqrt(self.n))
+
+
+class State(NamedTuple):
+    x: jnp.ndarray   # (N, 2) positions
+    v: jnp.ndarray   # (N, 2) last applied velocities
+
+
+def initial_state(cfg: Config) -> State:
+    """Jittered-grid spawn: collision-free start at any N."""
+    side = int(np.ceil(np.sqrt(cfg.n)))
+    half = cfg.spawn_half_width
+    lin = np.linspace(-half, half, side)
+    gx, gy = np.meshgrid(lin, lin)
+    grid = np.stack([gx.ravel(), gy.ravel()], axis=1)[: cfg.n]
+    spacing = 2 * half / max(side - 1, 1)
+    key = jax.random.PRNGKey(cfg.seed)
+    jitter = jax.random.uniform(
+        key, (cfg.n, 2), minval=-0.25 * spacing, maxval=0.25 * spacing
+    )
+    x0 = jnp.asarray(grid, cfg.dtype) + jitter.astype(cfg.dtype)
+    return State(x=x0, v=jnp.zeros_like(x0))
+
+
+def make(cfg: Config = Config(), cbf: CBFParams | None = None):
+    if cbf is None:
+        # k=0: position-only barrier h = |dx|+|dy| - dmin. At crowd scale the
+        # reference's k=1 approach-velocity term is a positive feedback loop —
+        # evasive outputs enter the next step's h, demanding ever-larger
+        # evasion until QPs go infeasible. With k=0 the discrete-time closing
+        # rate is bounded by gamma*h per step, so h contracts geometrically
+        # to 0 and never crosses it: no infeasibility, hard separation.
+        cbf = CBFParams(max_speed=cfg.max_speed, k=0.0)
+    dt_ = cfg.dtype
+    f = cfg.dyn_scale * jnp.zeros((4, 4), dt_)
+    g = cfg.dyn_scale * jnp.array([[1, 0], [0, 1], [0, 0], [0, 0]], dt_)
+    K = cfg.k_neighbors
+
+    state0 = initial_state(cfg)
+
+    def step(state: State, t):
+        x = state.x                                            # (N, 2)
+        to_c = jnp.mean(x, axis=0)[None] - x                   # (N, 2)
+        d_c = jnp.linalg.norm(to_c, axis=1, keepdims=True)
+        # Pull toward the centroid only while outside the packing disk.
+        pull = jnp.maximum(d_c - cfg.pack_radius, 0.0)
+        u0 = cfg.consensus_gain * pull * to_c / jnp.maximum(d_c, 1e-9)
+        # Pre-filter actuator saturation (see Config.speed_limit).
+        speed = jnp.linalg.norm(u0, axis=1, keepdims=True)
+        u0 = u0 * jnp.minimum(1.0, cfg.speed_limit / jnp.maximum(speed, 1e-9))
+
+        states4 = jnp.concatenate([x, state.v], axis=1)        # (N, 4)
+
+        # One pairwise-distance computation feeds both the k-NN gating and
+        # the min-distance safety metric.
+        diff = x[:, None, :] - x[None, :, :]
+        dist = jnp.sqrt(jnp.sum(diff * diff, axis=-1))         # (N, N)
+        obs_slab, mask = knn_gating(
+            states4, states4, cfg.safety_distance, K,
+            exclude_self_row=jnp.ones(x.shape[0], bool), dist=dist,
+        )
+
+        u_safe, info = safe_controls(states4, obs_slab, mask, f, g, u0, cbf)
+        engaged = jnp.any(mask, axis=1)
+        u = jnp.where(engaged[:, None], u_safe, u0)
+
+        x_new = x + cfg.dt * u
+        v_new = u
+
+        off = dist + jnp.where(jnp.eye(x.shape[0], dtype=bool), jnp.inf, 0.0)
+        out = StepOutputs(
+            min_pairwise_distance=jnp.min(off),
+            filter_active_count=jnp.sum(engaged),
+            infeasible_count=jnp.sum(~info.feasible & engaged),
+            max_relax_rounds=jnp.max(info.relax_rounds),
+            trajectory=x if cfg.record_trajectory else (),
+        )
+        return State(x=x_new, v=v_new), out
+
+    return state0, step
+
+
+def run(cfg: Config = Config(), **kw):
+    state0, step = make(cfg, **kw)
+    return rollout(step, state0, cfg.steps)
+
+
+def main():
+    cfg = Config()
+    final, outs = run(cfg)
+    md = np.asarray(outs.min_pairwise_distance)
+    spread = float(jnp.max(jnp.linalg.norm(final.x - jnp.mean(final.x, 0), axis=1)))
+    print(f"swarm: N={cfg.n}, {cfg.steps} steps, K={cfg.k_neighbors}")
+    print(f"  min pairwise distance over run: {md.min():.4f} m")
+    print(f"  final max spread from centroid: {spread:.4f} m")
+    print(f"  infeasible agent-steps: {int(np.asarray(outs.infeasible_count).sum())}")
+
+
+if __name__ == "__main__":
+    main()

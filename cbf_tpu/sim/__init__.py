@@ -1,0 +1,13 @@
+from cbf_tpu.sim.robotarium import SimParams, saturate_unicycle, unicycle_step  # noqa: F401
+from cbf_tpu.sim.transformations import si_to_uni_dyn, uni_to_si_states  # noqa: F401
+from cbf_tpu.sim.graph import (  # noqa: F401
+    adjacency_from_laplacian,
+    complete_gl,
+    consensus_velocities,
+    cycle_gl,
+    cyclic_pursuit_velocities,
+)
+from cbf_tpu.sim.certificates import (  # noqa: F401
+    CertificateParams,
+    si_barrier_certificate,
+)

@@ -1,0 +1,88 @@
+"""Joint all-agent barrier certificate — the second QP in the stack.
+
+Equivalent of rps ``create_single_integrator_barrier_certificate_with_boundary``
+(created meet_at_center.py:58; applied cross_and_rescue.py:163)
+[external — inferred from usage; SURVEY.md §2.6]: a *joint* minimum-deviation
+QP over all agents' single-integrator velocities enforcing (a) pairwise
+distance >= safety_radius via cubic-margin CBF rows and (b) arena-boundary
+rows, after pre-limiting command magnitudes.
+
+    min_u ||u - u_nom||^2
+    s.t.  -2 (x_i - x_j)^T (u_i - u_j) <= gain * h_ij^3,   h_ij = ||x_i - x_j||^2 - r^2
+          +-u_{k,axis} <= 0.4 * gain * (wall margin)^3
+
+Solved with the fixed-iteration batched ADMM backend (cbf_tpu.solvers.admm)
+— 2N variables, N(N-1)/2 + 4N rows — so it vmaps across ensembles and stays
+inside one XLA program (the rps original calls a host QP solver per step).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from cbf_tpu.sim.robotarium import ARENA
+from cbf_tpu.solvers.admm import ADMMSettings, solve_box_qp_admm
+
+
+class CertificateParams(NamedTuple):
+    barrier_gain: float = 100.0
+    safety_radius: float = 0.12     # scenarios pass 0.12 (meet_at_center.py:58)
+    magnitude_limit: float = 0.2
+
+
+def si_barrier_certificate(dxi, x, params: CertificateParams = CertificateParams(),
+                           settings: ADMMSettings = ADMMSettings(iters=250)):
+    """Filter joint single-integrator velocities. Args: dxi (2, N), x (2, N).
+
+    Returns certified velocities (2, N).
+    """
+    N = x.shape[1]
+    dtype = jnp.result_type(dxi, x)
+
+    # Magnitude pre-limit (threshold to magnitude_limit, preserving direction).
+    norms = jnp.linalg.norm(dxi, axis=0)
+    scale = jnp.maximum(1.0, norms / params.magnitude_limit)
+    dxi = dxi / scale[None, :]
+
+    # Pairwise rows (static index sets — fixed shape for jit).
+    I, J = np.triu_indices(N, k=1)
+    err = x[:, I] - x[:, J]                                  # (2, P)
+    h = jnp.sum(err * err, axis=0) - params.safety_radius**2 # (P,)
+    P_rows = len(I)
+    A_pair = jnp.zeros((P_rows, 2 * N), dtype)
+    rows = jnp.arange(P_rows)
+    A_pair = A_pair.at[rows, 2 * I].set(-2.0 * err[0])
+    A_pair = A_pair.at[rows, 2 * I + 1].set(-2.0 * err[1])
+    A_pair = A_pair.at[rows, 2 * J].set(2.0 * err[0])
+    A_pair = A_pair.at[rows, 2 * J + 1].set(2.0 * err[1])
+    b_pair = params.barrier_gain * h**3
+
+    # Boundary rows: keep each agent r/2 inside the arena walls.
+    xmin, xmax, ymin, ymax = ARENA
+    r2 = params.safety_radius / 2.0
+    k = jnp.arange(N)
+    A_bnd = jnp.zeros((4 * N, 2 * N), dtype)
+    A_bnd = A_bnd.at[4 * k + 0, 2 * k + 1].set(1.0)    #  u_y <= ...
+    A_bnd = A_bnd.at[4 * k + 1, 2 * k + 1].set(-1.0)   # -u_y <= ...
+    A_bnd = A_bnd.at[4 * k + 2, 2 * k + 0].set(1.0)    #  u_x <= ...
+    A_bnd = A_bnd.at[4 * k + 3, 2 * k + 0].set(-1.0)   # -u_x <= ...
+    gb = 0.4 * params.barrier_gain
+    b_bnd = jnp.zeros((4 * N,), dtype)
+    b_bnd = b_bnd.at[4 * k + 0].set(gb * (ymax - r2 - x[1]) ** 3)
+    b_bnd = b_bnd.at[4 * k + 1].set(gb * (x[1] - ymin - r2) ** 3)
+    b_bnd = b_bnd.at[4 * k + 2].set(gb * (xmax - r2 - x[0]) ** 3)
+    b_bnd = b_bnd.at[4 * k + 3].set(gb * (x[0] - xmin - r2) ** 3)
+
+    A = jnp.concatenate([A_pair, A_bnd], axis=0)
+    b = jnp.concatenate([b_pair, b_bnd])
+
+    u_nom = dxi.T.reshape(-1)                                # [ux0, uy0, ux1, ...]
+    Pmat = jnp.eye(2 * N, dtype=dtype)
+    q = -u_nom
+    m = A.shape[0]
+    u, info = solve_box_qp_admm(Pmat, q, A, jnp.full((m,), -jnp.inf, dtype), b,
+                                settings)
+    return u.reshape(N, 2).T

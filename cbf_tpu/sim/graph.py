@@ -1,0 +1,58 @@
+"""Graph-Laplacian utilities and consensus/pursuit control laws.
+
+Replaces the rps ``completeGL`` / ``topological_neighbors`` surface
+(meet_at_center.py:74,88,101) and the scenarios' per-agent Python loops
+(meet_at_center.py:86-103) with batched masked-matrix forms: neighbors are an
+N x N 0/1 adjacency derived from any Laplacian's off-diagonal nonzeros —
+matching ``topological_neighbors``' value-agnostic "nonzero" semantics — and
+the consensus law sum_j (x_j - x_i) over neighbors becomes one matmul.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def complete_gl(n: int) -> np.ndarray:
+    """Complete-graph Laplacian (rps completeGL equivalent)."""
+    return n * np.eye(n) - np.ones((n, n))
+
+
+def cycle_gl(n: int) -> np.ndarray:
+    """Directed ring Laplacian, the shape both scenarios hand-write for
+    cyclic pursuit (meet_at_center.py:65-71, cross_and_rescue.py:79-86):
+    -1 on the diagonal, +1 on the successor."""
+    L = -np.eye(n)
+    L += np.eye(n, k=1)
+    L[-1, 0] = 1.0
+    return L
+
+
+def adjacency_from_laplacian(L) -> jnp.ndarray:
+    """0/1 adjacency from off-diagonal nonzeros (topological_neighbors
+    semantics: any nonzero off-diagonal entry of row i marks a neighbor)."""
+    L = jnp.asarray(L)
+    n = L.shape[0]
+    off = jnp.ones_like(L) - jnp.eye(n, dtype=L.dtype)
+    return ((L != 0) & (off != 0)).astype(jnp.float32)
+
+
+def consensus_velocities(X, A):
+    """sum_{j in N(i)} (x_j - x_i) for every agent at once.
+
+    Args: X (2, N) positions; A (N, N) 0/1 adjacency (row i = neighbors of i).
+    Returns (2, N). Batched form of meet_at_center.py:99-103.
+    """
+    deg = jnp.sum(A, axis=1)                       # (N,)
+    return X @ A.T - X * deg[None, :]
+
+
+def cyclic_pursuit_velocities(X, A, theta):
+    """Consensus rotated by theta — the obstacle ring's control law
+    (meet_at_center.py:89-96: ``sum(...) @ rotation`` with rotation =
+    [[cos, sin], [-sin, cos]], i.e. v -> R(theta) v)."""
+    cons = consensus_velocities(X, A)
+    c, s = jnp.cos(theta), jnp.sin(theta)
+    rot = jnp.array([[c, -s], [s, c]], dtype=cons.dtype)
+    return rot @ cons

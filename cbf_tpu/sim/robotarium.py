@@ -1,0 +1,80 @@
+"""Functional Robotarium-equivalent simulator core.
+
+The reference drives the external ``rps`` Robotarium simulator (cloned at
+install time — install.sh:1-2; consumed API surface catalogued in SURVEY.md
+§2.6): ``get_poses`` / ``set_velocities`` / ``step`` with 3xN unicycle poses,
+2xN (v, omega) commands, actuator saturation, and a 0.033 s timestep
+(meet_at_center.py:53,79,151,153). ``rps`` is stateful and matplotlib-bound;
+here the same contract is a pure function ``unicycle_step(poses, dxu) ->
+poses`` over fixed-shape arrays so a whole rollout fuses into one
+``lax.scan``. Rendering is fully decoupled (see cbf_tpu.render) — the sim
+never touches a figure.
+
+Physical parameters are Robotarium-plausible defaults (GRITSBot-X scale:
+0.2 m/s max linear speed via wheel saturation, 3.2 m x 2 m arena); the rps
+source is not on disk, so exact values are config, not gospel
+[external — inferred from usage].
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class SimParams(NamedTuple):
+    """Simulator constants. All dynamic leaves (sweepable under vmap/jit)."""
+    dt: float = 0.033                 # step period (meet_at_center.py:53)
+    projection_distance: float = 0.05 # si<->uni near-identity point offset
+    wheel_radius: float = 0.016       # m
+    base_length: float = 0.105        # m (wheel separation)
+    max_wheel_speed: float = 12.5     # rad/s -> 0.2 m/s max linear speed
+
+
+# Arena bounds (x_min, x_max, y_min, y_max) — the Robotarium testbed extent.
+ARENA = (-1.6, 1.6, -1.0, 1.0)
+
+
+def saturate_unicycle(dxu, params: SimParams = SimParams()):
+    """Actuator saturation in wheel space, proportional scaling.
+
+    Maps (v, omega) to differential-drive wheel speeds, scales both wheels
+    down together when either exceeds the limit (preserving the commanded
+    arc), and maps back. Equivalent of the rps step()'s actuator-limit stage
+    [external — inferred from usage; SURVEY.md §2.6].
+
+    Args: dxu (2, N). Returns (2, N).
+    """
+    v, w = dxu[0], dxu[1]
+    R, L = params.wheel_radius, params.base_length
+    wr = (2.0 * v + w * L) / (2.0 * R)
+    wl = (2.0 * v - w * L) / (2.0 * R)
+    peak = jnp.maximum(jnp.abs(wr), jnp.abs(wl))
+    scale = jnp.maximum(1.0, peak / params.max_wheel_speed)
+    wr, wl = wr / scale, wl / scale
+    v = R / 2.0 * (wr + wl)
+    w = R / L * (wr - wl)
+    return jnp.stack([v, w])
+
+
+def unicycle_step(poses, dxu, params: SimParams = SimParams()):
+    """One 0.033 s unicycle Euler step with actuator saturation.
+
+    Equivalent of ``r.set_velocities(...); r.step()`` (meet_at_center.py:
+    151-153) minus rendering/wall-clock pacing.
+
+    Args: poses (3, N) = (x, y, theta); dxu (2, N) = (v, omega).
+    Returns new poses (3, N).
+    """
+    dxu = saturate_unicycle(dxu, params)
+    v, w = dxu[0], dxu[1]
+    theta = poses[2]
+    new = jnp.stack(
+        [
+            poses[0] + params.dt * v * jnp.cos(theta),
+            poses[1] + params.dt * v * jnp.sin(theta),
+            poses[2] + params.dt * w,
+        ]
+    )
+    return new

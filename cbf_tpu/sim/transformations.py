@@ -1,0 +1,32 @@
+"""Single-integrator <-> unicycle mappings.
+
+Equivalent of the rps ``create_si_to_uni_mapping()`` pair consumed at
+meet_at_center.py:61,80,148 [external — inferred from usage; SURVEY.md §2.6]:
+a near-identity diffeomorphism through a point at ``projection_distance`` l
+ahead of the wheel axis. Forward: p = x[:2] + l*[cos th, sin th]. Velocity
+map: dxu = [[cos, sin], [-sin/l, cos/l]] @ dxi.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def uni_to_si_states(poses, projection_distance: float = 0.05):
+    """(3, N) unicycle poses -> (2, N) single-integrator point positions."""
+    th = poses[2]
+    return jnp.stack(
+        [
+            poses[0] + projection_distance * jnp.cos(th),
+            poses[1] + projection_distance * jnp.sin(th),
+        ]
+    )
+
+
+def si_to_uni_dyn(dxi, poses, projection_distance: float = 0.05):
+    """(2, N) single-integrator velocities -> (2, N) unicycle (v, omega)."""
+    th = poses[2]
+    c, s = jnp.cos(th), jnp.sin(th)
+    v = c * dxi[0] + s * dxi[1]
+    w = (-s * dxi[0] + c * dxi[1]) / projection_distance
+    return jnp.stack([v, w])

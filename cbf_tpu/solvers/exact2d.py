@@ -147,10 +147,14 @@ def solve_qp_2d(A, b, relax_mask=None, *, max_relax: int = 64,
         t = jnp.asarray(0.0, dtype)
         for r in range(1, unroll_relax + 1):
             x2, found2, viol2 = attempt(jnp.asarray(float(r), dtype))
-            take = (~found) & found2
-            x = jnp.where(take, x2, x)
-            viol = jnp.where(take, viol2, viol)
-            t = jnp.where(take, float(r), t)
+            # While still unsolved, always advance to the latest (most
+            # relaxed, least violating) attempt — matching the while-loop
+            # path, which ends on the last attempt with t at the cap when
+            # nothing is ever feasible.
+            upd = ~found
+            x = jnp.where(upd, x2, x)
+            viol = jnp.where(upd, viol2, viol)
+            t = jnp.where(upd, float(r), t)
             found = found | found2
         return x, QPInfo(found, t, viol)
 

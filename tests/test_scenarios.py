@@ -1,0 +1,122 @@
+"""Scenario-level behavior + end-to-end oracle parity tests (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+
+def test_meet_at_center_rendezvous_behavior(x64):
+    from cbf_tpu.scenarios import meet_at_center as mac
+
+    cfg = mac.Config(iterations=600)
+    final, outs = mac.run(cfg)
+    md = np.asarray(outs.min_pairwise_distance)
+    # Free agents must converge to a tight cluster (rendezvous) without the
+    # global min distance collapsing (CBF active).
+    free = np.asarray(final.poses[:2, cfg.n_obstacles:])
+    spread = np.max(np.linalg.norm(free - free.mean(axis=1, keepdims=True), axis=0))
+    assert spread < 0.35, spread
+    assert md.min() > 0.05, md.min()
+    assert int(np.asarray(outs.infeasible_count).sum()) == 0
+
+
+def test_meet_at_center_filter_engages(x64):
+    from cbf_tpu.scenarios import meet_at_center as mac
+
+    cfg = mac.Config(iterations=400)
+    _, outs = mac.run(cfg)
+    assert int(np.asarray(outs.filter_active_count).sum()) > 100
+
+
+def test_cross_and_rescue_reaches_goal(x64):
+    from cbf_tpu.scenarios import cross_and_rescue as car
+
+    cfg = car.Config(iterations=2500)
+    final, outs = car.run(cfg)
+    goal = np.array(cfg.goal)
+    dists = np.linalg.norm(np.asarray(final.poses[:2]).T - goal, axis=1)
+    # Leader-follower formation gathers around the goal.
+    assert dists.min() < 0.15, dists
+    assert dists.max() < 0.6, dists
+    # Two-layer safety stack holds a meaningful margin.
+    assert float(np.asarray(outs.min_pairwise_distance).min()) > 0.1
+
+
+def test_swarm_packs_safely(x64):
+    from cbf_tpu.scenarios import swarm
+
+    cfg = swarm.Config(n=64, steps=800)
+    final, outs = swarm.run(cfg)
+    md = np.asarray(outs.min_pairwise_distance)
+    # Hard separation: the k=0 L1 barrier floor is 0.2/sqrt(2) ~ 0.1414.
+    assert md.min() > 0.13, md.min()
+    assert int(np.asarray(outs.infeasible_count).sum()) == 0
+    # Agents actually migrate into the packing disk.
+    x = np.asarray(final.x)
+    r = np.linalg.norm(x - x.mean(0), axis=1)
+    assert np.percentile(r, 50) < 1.25 * cfg.pack_radius
+
+
+def test_meet_at_center_trace_oracle_parity(x64):
+    """End-to-end golden-trace parity (SURVEY.md §7 step 0): replay the
+    scenario's per-step filtering in float64 numpy with the SLSQP oracle and
+    compare the filtered velocity commands for the first steps."""
+    import jax.numpy as jnp
+    from cbf_tpu.oracle import OracleCBF
+    from cbf_tpu.scenarios import meet_at_center as mac
+    from cbf_tpu.sim import (
+        SimParams, adjacency_from_laplacian, complete_gl, cycle_gl,
+        si_to_uni_dyn, uni_to_si_states, unicycle_step,
+    )
+
+    cfg = mac.Config(iterations=5)
+    sim = SimParams()
+    state0, step = mac.make(cfg, sim)
+
+    # --- numpy replication of the step semantics with the oracle filter ---
+    oracle = OracleCBF(max_speed=cfg.max_speed)
+    fx = cfg.dyn_scale * np.zeros((4, 4))
+    gx = cfg.dyn_scale * np.array([[1.0, 0], [0, 1.0], [0, 0], [0, 0]])
+    nO, N = cfg.n_obstacles, cfg.n
+    A_ring = np.asarray(adjacency_from_laplacian(cycle_gl(nO)), dtype=np.float64)
+    A_full = np.asarray(adjacency_from_laplacian(complete_gl(cfg.n_free)),
+                        dtype=np.float64)
+    theta = -np.pi / nO
+    rot = np.array([[np.cos(theta), -np.sin(theta)],
+                    [np.sin(theta), np.cos(theta)]])
+
+    poses = np.asarray(mac.initial_poses(cfg), dtype=np.float64)
+    state = state0
+    for t in range(cfg.iterations):
+        # JAX step
+        state, out = step(state, t)
+
+        # numpy step
+        th = poses[2]
+        x_si = poses[:2] + sim.projection_distance * np.stack(
+            [np.cos(th), np.sin(th)])
+        vo = x_si[:, :nO] @ A_ring.T - x_si[:, :nO] * A_ring.sum(1)
+        vo = rot @ vo
+        vf = x_si[:, nO:] @ A_full.T - x_si[:, nO:] * A_full.sum(1)
+        si_vel = np.concatenate([vo, vf], axis=1)
+        states4 = np.concatenate([poses[:2], si_vel], axis=0).T
+        for i in range(nO, N):
+            danger = []
+            for j in range(N):
+                dist = np.linalg.norm(states4[j, :2] - states4[i, :2])
+                if j < nO:
+                    if dist < cfg.safety_distance:
+                        danger.append(states4[j])
+                elif dist < cfg.safety_distance and dist > 0:
+                    danger.append(states4[j])
+            if danger:
+                si_vel[:, i] = oracle.get_safe_control(
+                    states4[i], np.array(danger), fx, gx, si_vel[:, i])
+        # unicycle tail (reuse the framework's sim in f64 — tested separately)
+        dxu = np.asarray(si_to_uni_dyn(jnp.asarray(si_vel), jnp.asarray(poses),
+                                       sim.projection_distance))
+        poses = np.asarray(unicycle_step(jnp.asarray(poses), jnp.asarray(dxu),
+                                         sim))
+
+        np.testing.assert_allclose(
+            np.asarray(state.poses), poses, atol=5e-5,
+            err_msg=f"trajectory diverged from oracle replay at step {t}")

@@ -1,0 +1,149 @@
+"""Simulator-layer tests: transforms, graph laws, integration, certificate."""
+
+import numpy as np
+
+
+def test_si_uni_roundtrip_small_angle(x64):
+    import jax.numpy as jnp
+    from cbf_tpu.sim import si_to_uni_dyn, uni_to_si_states
+
+    poses = jnp.array([[0.0, 1.0], [0.0, -0.5], [0.0, np.pi / 2]])
+    p = uni_to_si_states(poses)
+    np.testing.assert_allclose(np.asarray(p[:, 0]), [0.05, 0.0], atol=1e-12)
+    np.testing.assert_allclose(np.asarray(p[:, 1]), [1.0, -0.45], atol=1e-12)
+
+    # A pure +x SI velocity for a robot facing +x maps to pure forward motion.
+    dxi = jnp.array([[0.1, 0.0], [0.0, 0.1]]).T  # agent0: +x, agent1: +y
+    dxu = si_to_uni_dyn(jnp.array([[0.1, 0.0]]).T, poses[:, :1])
+    np.testing.assert_allclose(np.asarray(dxu[:, 0]), [0.1, 0.0], atol=1e-12)
+    # Facing +y (agent 1), an SI +y velocity is also pure forward.
+    dxu2 = si_to_uni_dyn(jnp.array([[0.0, 0.1]]).T, poses[:, 1:])
+    np.testing.assert_allclose(np.asarray(dxu2[:, 0]), [0.1, 0.0], atol=1e-12)
+
+
+def test_unicycle_step_straight_line(x64):
+    import jax.numpy as jnp
+    from cbf_tpu.sim import SimParams, unicycle_step
+
+    poses = jnp.array([[0.0], [0.0], [0.0]])
+    dxu = jnp.array([[0.1], [0.0]])
+    p = unicycle_step(poses, dxu, SimParams())
+    np.testing.assert_allclose(np.asarray(p[:, 0]), [0.1 * 0.033, 0.0, 0.0],
+                               atol=1e-9)
+
+
+def test_saturation_limits_speed(x64):
+    import jax.numpy as jnp
+    from cbf_tpu.sim import SimParams, saturate_unicycle
+
+    params = SimParams()
+    vmax = params.wheel_radius * params.max_wheel_speed  # 0.2 m/s
+    dxu = jnp.array([[10.0], [0.0]])
+    sat = saturate_unicycle(dxu, params)
+    assert abs(float(sat[0, 0]) - vmax) < 1e-6
+    # Arc preserved: ratio v/omega unchanged when both nonzero.
+    dxu2 = jnp.array([[1.0], [5.0]])
+    sat2 = saturate_unicycle(dxu2, params)
+    np.testing.assert_allclose(float(sat2[0, 0]) / float(sat2[1, 0]), 0.2,
+                               rtol=1e-6)
+
+
+def test_laplacian_utilities_match_reference_shapes(x64):
+    import numpy as np
+    from cbf_tpu.sim import adjacency_from_laplacian, complete_gl, cycle_gl
+
+    # The reference's hand-written ring Laplacian (meet_at_center.py:65-71).
+    L1_ref = np.array([
+        [-1, 1, 0, 0, 0],
+        [0, -1, 1, 0, 0],
+        [0, 0, -1, 1, 0],
+        [0, 0, 0, -1, 1],
+        [1, 0, 0, 0, -1],
+    ])
+    np.testing.assert_array_equal(cycle_gl(5), L1_ref)
+    A = np.asarray(adjacency_from_laplacian(L1_ref))
+    # each agent has exactly its successor as neighbor
+    np.testing.assert_array_equal(A.sum(1), np.ones(5))
+    assert A[0, 1] == 1 and A[4, 0] == 1
+
+    Lc = complete_gl(5)
+    Ac = np.asarray(adjacency_from_laplacian(Lc))
+    np.testing.assert_array_equal(Ac.sum(1), 4 * np.ones(5))
+
+
+def test_consensus_matches_loop(x64, rng):
+    import jax.numpy as jnp
+    from cbf_tpu.sim import adjacency_from_laplacian, complete_gl, consensus_velocities
+
+    N = 6
+    X = rng.normal(size=(2, N))
+    A = adjacency_from_laplacian(complete_gl(N))
+    V = np.asarray(consensus_velocities(jnp.asarray(X), A))
+    for i in range(N):
+        expect = sum(X[:, j] - X[:, i] for j in range(N) if j != i)
+        np.testing.assert_allclose(V[:, i], expect, atol=1e-9)
+
+
+def test_cyclic_pursuit_rotation_semantics(x64, rng):
+    """Must equal the reference's ``sum(...) @ rotation`` convention
+    (meet_at_center.py:92-96)."""
+    import jax.numpy as jnp
+    from cbf_tpu.sim import adjacency_from_laplacian, cycle_gl, cyclic_pursuit_velocities
+
+    N = 5
+    X = rng.normal(size=(2, N))
+    theta = -np.pi / N
+    A = adjacency_from_laplacian(cycle_gl(N))
+    V = np.asarray(cyclic_pursuit_velocities(jnp.asarray(X), A, theta))
+
+    rotation = np.array([[np.cos(theta), np.sin(theta)],
+                         [-np.sin(theta), np.cos(theta)]])
+    for i in range(N):
+        j = (i + 1) % N
+        expect = (X[:, j] - X[:, i]) @ rotation
+        np.testing.assert_allclose(V[:, i], expect, atol=1e-9)
+
+
+def test_certificate_idle_when_far_apart(x64):
+    import jax.numpy as jnp
+    from cbf_tpu.sim import CertificateParams, si_barrier_certificate
+
+    x = jnp.array([[-1.0, 1.0], [0.0, 0.0]])   # 2 agents 2 m apart
+    dxi = jnp.array([[0.1, -0.1], [0.0, 0.0]])
+    out = si_barrier_certificate(dxi, x, CertificateParams())
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dxi), atol=1e-4)
+
+
+def test_certificate_stops_head_on_collision(x64):
+    import jax.numpy as jnp
+    from cbf_tpu.sim import CertificateParams, si_barrier_certificate
+
+    # Two agents closing head-on just outside the safety radius.
+    x = jnp.array([[-0.08, 0.08], [0.0, 0.0]])
+    dxi = jnp.array([[0.2, -0.2], [0.0, 0.0]])
+    out = np.asarray(si_barrier_certificate(dxi, x, CertificateParams()))
+    # Closing speed along x must be strongly reduced.
+    closing_nominal = 0.2 - (-0.2)
+    closing_cert = float(out[0, 0] - out[0, 1])
+    assert closing_cert < 0.25 * closing_nominal, (closing_nominal, closing_cert)
+
+
+def test_certificate_magnitude_limit(x64):
+    import jax.numpy as jnp
+    from cbf_tpu.sim import CertificateParams, si_barrier_certificate
+
+    x = jnp.array([[-1.0, 1.0], [0.0, 0.0]])
+    dxi = jnp.array([[5.0, 0.0], [0.0, 0.0]])  # way over the 0.2 limit
+    out = np.asarray(si_barrier_certificate(dxi, x, CertificateParams()))
+    assert np.linalg.norm(out[:, 0]) <= 0.2 + 1e-3
+
+
+def test_certificate_boundary_rows(x64):
+    """An agent pushed toward a wall from just inside gets braked."""
+    import jax.numpy as jnp
+    from cbf_tpu.sim import CertificateParams, si_barrier_certificate
+
+    x = jnp.array([[1.55], [0.0]])            # near x_max = 1.6
+    dxi = jnp.array([[0.2], [0.0]])           # accelerating into the wall
+    out = np.asarray(si_barrier_certificate(dxi, x, CertificateParams()))
+    assert out[0, 0] < 0.1
