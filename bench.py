@@ -1,0 +1,73 @@
+"""Benchmark: swarm-scenario throughput on one chip.
+
+Runs the flagship swarm rollout (N agents, k-NN gated batched CBF-QP filter
+per agent per step, one fused XLA program via lax.scan) on the default
+accelerator and reports the north-star metric from BASELINE.json:
+**agent-QP-steps/sec/chip**.
+
+Baseline: the reference publishes no numbers (BASELINE.md — it is a serial
+Python/cvxopt loop paced to real time at 10 agents, i.e. ~300 agent-steps/s).
+The target from BASELINE.json is "4096 agents x 10k steps < 60 s on a v4-8",
+i.e. 4096*10000/60/4 chips ~= 170,667 agent-QP-steps/sec/chip;
+``vs_baseline`` is measured against that target rate (>1 = beating it).
+
+Prints exactly ONE JSON line to stdout. Knobs via env: BENCH_N (default
+4096), BENCH_STEPS (default 500).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+
+TARGET_RATE_PER_CHIP = 4096 * 10_000 / 60.0 / 4.0   # BASELINE.json ladder
+
+
+def main():
+    from cbf_tpu.rollout.engine import rollout
+    from cbf_tpu.scenarios import swarm
+
+    n = int(os.environ.get("BENCH_N", "4096"))
+    steps = int(os.environ.get("BENCH_STEPS", "500"))
+
+    cfg = swarm.Config(n=n, steps=steps, record_trajectory=False)
+    state0, step = swarm.make(cfg)
+
+    print(f"bench: swarm N={n}, steps={steps}, devices={jax.devices()}",
+          file=sys.stderr)
+
+    # Warmup: compile + one full run (also validates safety invariants).
+    t0 = time.time()
+    final, outs = rollout(step, state0, steps)
+    jax.block_until_ready(final)
+    compile_and_first = time.time() - t0
+
+    # Timed run.
+    t0 = time.time()
+    final, outs = rollout(step, state0, steps)
+    jax.block_until_ready(final)
+    wall = time.time() - t0
+
+    min_dist = float(np.asarray(outs.min_pairwise_distance).min())
+    infeasible = int(np.asarray(outs.infeasible_count).sum())
+    rate = n * steps / wall
+
+    print(f"bench: wall={wall:.3f}s (first run incl. compile "
+          f"{compile_and_first:.1f}s), min_dist={min_dist:.4f}, "
+          f"infeasible={infeasible}", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "agent-QP-steps/sec/chip (swarm N=%d)" % n,
+        "value": round(rate, 1),
+        "unit": "agent_qp_steps_per_sec_per_chip",
+        "vs_baseline": round(rate / TARGET_RATE_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,135 @@
+"""Mesh-sharded ensemble rollouts: dp x sp execution of the swarm scenario.
+
+The BASELINE.md ladder's distributed rungs: Monte-Carlo ensembles of
+independent swarms sharded over the ``dp`` mesh axis (the reference's
+"distributed execution" equivalent — SURVEY.md §2.7: swarm instances are
+embarrassingly parallel), and each swarm's agents optionally sharded over
+``sp`` with the ppermute ring of cbf_tpu.parallel.ring doing the pairwise
+neighbor search. The only cross-device traffic is the ring permute (ICI),
+the per-step psum for the global centroid, and pmin metric reductions.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+try:  # JAX >= 0.6 stable location, fall back to experimental
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map_exp(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs)
+
+from cbf_tpu.core.filter import CBFParams, safe_controls
+from cbf_tpu.parallel.ring import ring_knn
+from cbf_tpu.scenarios import swarm as swarm_scenario
+from cbf_tpu.utils.math import safe_norm
+
+
+class EnsembleMetrics(NamedTuple):
+    nearest_distance: jax.Array    # (E, steps) min over agents of nearest-neighbor dist
+    engaged_count: jax.Array       # (E, steps)
+    infeasible_count: jax.Array    # (E, steps)
+
+
+def ensemble_initial_states(cfg: swarm_scenario.Config, seeds):
+    """(E, N, 2) positions + (E, N, 2) zero velocities, one jittered grid
+    per seed (vmap of the scenario's canonical spawn)."""
+    keys = jnp.stack([jax.random.PRNGKey(int(s)) for s in seeds])
+    x0 = jax.vmap(lambda k: swarm_scenario.spawn_positions(cfg, k))(keys)
+    return x0, jnp.zeros_like(x0)
+
+
+def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
+                      axis_name: str, unroll_relax: int = 0,
+                      compute_metrics: bool = True):
+    """One agent-sharded swarm step. x, v: (n_local, 2). Differentiable when
+    ``unroll_relax > 0`` (see solvers.exact2d) and ``compute_metrics=False``
+    (the metric reductions use pmin, which has no differentiation rule).
+
+    Returns (x_new, u, metrics_or_None, nearest_d_local).
+    """
+    dt_ = x.dtype
+    f = cfg.dyn_scale * jnp.zeros((4, 4), dt_)
+    g = cfg.dyn_scale * jnp.array([[1, 0], [0, 1], [0, 0], [0, 0]], dt_)
+    K = min(cfg.k_neighbors, cfg.n - 1)
+
+    mean = lax.psum(jnp.sum(x, axis=0), axis_name) / cfg.n
+    to_c = mean[None] - x
+    d_c = safe_norm(to_c, keepdims=True)
+    pull = jnp.maximum(d_c - cfg.pack_radius, 0.0)
+    u0 = cfg.consensus_gain * pull * to_c / jnp.maximum(d_c, 1e-9)
+    speed = safe_norm(u0, keepdims=True)
+    u0 = u0 * jnp.minimum(1.0, cfg.speed_limit / jnp.maximum(speed, 1e-9))
+
+    states4 = jnp.concatenate([x, v], axis=1)
+    obs_slab, mask, nearest_d = ring_knn(
+        states4, K, cfg.safety_distance, axis_name, return_distances=True)
+
+    u_safe, info = safe_controls(states4, obs_slab, mask, f, g, u0, cbf,
+                                 unroll_relax=unroll_relax)
+    engaged = jnp.any(mask, axis=1)
+    u = jnp.where(engaged[:, None], u_safe, u0)
+
+    x_new = x + cfg.dt * u
+    metrics = None
+    if compute_metrics:
+        metrics = (
+            lax.pmin(jnp.min(nearest_d[:, 0]), axis_name),
+            lax.psum(jnp.sum(engaged), axis_name),
+            lax.psum(jnp.sum(~info.feasible & engaged), axis_name),
+        )
+    return x_new, u, metrics, nearest_d[:, 0]
+
+
+def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
+                          steps: int | None = None,
+                          cbf: CBFParams | None = None):
+    """Run len(seeds) independent swarms over the (dp, sp) mesh.
+
+    Returns ((x_final, v_final) with (E, N, 2) global shape, EnsembleMetrics).
+    """
+    steps = cfg.steps if steps is None else steps
+    if cbf is None:
+        cbf = CBFParams(max_speed=cfg.max_speed, k=0.0)
+    E = len(seeds)
+    n_dp, n_sp = mesh.shape["dp"], mesh.shape["sp"]
+    if E % n_dp or cfg.n % n_sp:
+        raise ValueError(
+            f"E={E} must divide by dp={n_dp} and N={cfg.n} by sp={n_sp}")
+
+    x0, v0 = ensemble_initial_states(cfg, seeds)
+
+    def local_rollout(x0l, v0l):
+        def one(x0i, v0i):
+            def body(carry, t):
+                x, v = carry
+                x2, v2, met, _ = _local_swarm_step(x, v, cfg, cbf, "sp")
+                return (x2, v2), met
+
+            (xf, vf), mets = lax.scan(body, (x0i, v0i), jnp.arange(steps))
+            return xf, vf, mets
+
+        return jax.vmap(one)(x0l, v0l)
+
+    spec_state = P("dp", "sp", None)
+    spec_metric = P("dp", None)
+    fn = shard_map(
+        local_rollout, mesh,
+        in_specs=(spec_state, spec_state),
+        out_specs=(spec_state, spec_state,
+                   (spec_metric, spec_metric, spec_metric)),
+    )
+    xf, vf, mets = jax.jit(fn)(x0, v0)
+    return (xf, vf), EnsembleMetrics(*mets)

@@ -1,0 +1,45 @@
+"""Device-mesh helpers: the framework's distributed-communication backend.
+
+The reference has no distributed story at all (SURVEY.md §2.7); the TPU-native
+equivalent of a NCCL/MPI backend is a ``jax.sharding.Mesh`` with XLA
+collectives compiled over ICI/DCN (SURVEY.md §5). Two mesh axes:
+
+- ``dp`` (data/ensemble parallel): independent swarm instances — Monte-Carlo
+  seeds, parameter sweeps — are embarrassingly parallel; only metric
+  all-reduces and gradient psums cross this axis.
+- ``sp`` (agent/spatial parallel): one swarm's agents sharded across devices;
+  pairwise interactions cross this axis via a ``ppermute`` ring
+  (cbf_tpu.parallel.ring) — the framework's counterpart to ring attention
+  for long sequences.
+
+On multi-host TPU pods, initialize with ``jax.distributed.initialize()``
+before building the mesh; ``jax.devices()`` then spans all hosts and the
+same mesh code scales from 1 chip to a pod (collectives ride ICI within a
+slice, DCN across slices).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(n_dp: int | None = None, n_sp: int = 1, devices=None,
+              axis_names=("dp", "sp")) -> Mesh:
+    """Build a (dp, sp) mesh over the available devices.
+
+    Args:
+      n_dp: data-parallel extent; None = all remaining devices.
+      n_sp: agent-parallel extent (must divide the device count).
+    """
+    devices = list(jax.devices() if devices is None else devices)
+    n = len(devices)
+    if n % n_sp != 0:
+        raise ValueError(f"n_sp={n_sp} must divide device count {n}")
+    if n_dp is None:
+        n_dp = n // n_sp
+    if n_dp * n_sp > n:
+        raise ValueError(f"mesh {n_dp}x{n_sp} exceeds {n} devices")
+    grid = np.array(devices[: n_dp * n_sp]).reshape(n_dp, n_sp)
+    return Mesh(grid, axis_names)

@@ -1,0 +1,87 @@
+"""Ring pairwise exchange: agent-sharded neighbor search via ppermute.
+
+At N=4096 the dense pairwise-distance matrix is 16M entries per step
+(SURVEY.md §7 hard part #3). When one swarm's agents are sharded across
+devices (mesh axis ``sp``), no device can hold all positions at once without
+an all-gather; instead — exactly the ring-attention pattern for long
+sequences — each device keeps its block of agents resident and the *candidate*
+blocks rotate around the ring with ``lax.ppermute``. After n_sp hops every
+agent has streamed past every candidate, maintaining a running top-k of its
+nearest in-radius neighbors in O(N/n_sp) memory per device, with each hop's
+compute overlapping the next hop's ICI transfer (XLA schedules the
+ppermute asynchronously).
+
+Use inside ``shard_map`` with a named mesh axis, e.g.::
+
+    shard_map(lambda s: ring_knn(s, k=8, radius=0.4, axis_name="sp"),
+              mesh=mesh, in_specs=P("sp", None), out_specs=...)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cbf_tpu.utils.math import safe_norm
+
+
+def ring_knn(states4_local, k: int, radius, axis_name: str,
+             return_distances: bool = False):
+    """Top-k in-radius neighbors of each local agent over ALL shards.
+
+    Args:
+      states4_local: (n_local, 4) this shard's agent states (x, y, vx, vy).
+      k: neighbor slots per agent.
+      radius: gating radius; coincident points (distance exactly 0 — self)
+        are excluded, matching the reference's ``distance > 0`` rule.
+      axis_name: the mesh axis to ring over.
+      return_distances: also return the sorted (n_local, k) neighbor
+        distances (inf where masked) for metric reuse.
+
+    Returns (obs: (n_local, k, 4), mask: (n_local, k) bool)[, distances],
+    aligned with the single-device
+    :func:`cbf_tpu.rollout.gating.knn_gating` contract.
+    """
+    n_shards = lax.axis_size(axis_name)
+    n_local = states4_local.shape[0]
+    dtype = states4_local.dtype
+
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+
+    def hop(_, carry):
+        best_d, best_s, block = carry
+        diff = states4_local[:, None, :2] - block[None, :, :2]
+        dist = safe_norm(diff)                                 # (n_local, m)
+        eligible = (dist < radius) & (dist > 0)
+        keyed = jnp.where(eligible, dist, jnp.inf)
+        cat_d = jnp.concatenate([best_d, keyed], axis=1)       # (n_local, k+m)
+        cat_s = jnp.concatenate(
+            [best_s,
+             jnp.broadcast_to(block[None], (n_local,) + block.shape)],
+            axis=1,
+        )                                                      # (n_local, k+m, 4)
+        neg_d, idx = lax.top_k(-cat_d, k)
+        best_d = -neg_d
+        best_s = jnp.take_along_axis(cat_s, idx[:, :, None], axis=1)
+        block = lax.ppermute(block, axis_name, perm)
+        return best_d, best_s, block
+
+    best_d0 = jnp.full((n_local, k), jnp.inf, dtype)
+    best_s0 = jnp.zeros((n_local, k, 4), dtype)
+    # The scan carry must enter with the same device-varying type it leaves
+    # with (JAX tracks manual-axes variance through shard_map loops).
+    if hasattr(lax, "pcast"):
+        if hasattr(jax, "typeof"):
+            axes = tuple(jax.typeof(states4_local).vma)
+        else:
+            axes = (axis_name,)
+        best_d0 = lax.pcast(best_d0, axes, to="varying")
+        best_s0 = lax.pcast(best_s0, axes, to="varying")
+    best_d, best_s, _ = lax.fori_loop(
+        0, n_shards, hop, (best_d0, best_s0, states4_local)
+    )
+    mask = jnp.isfinite(best_d)
+    if return_distances:
+        return best_s, mask, best_d
+    return best_s, mask

@@ -119,7 +119,6 @@ def make(cfg: Config = Config(), sim: SimParams = SimParams(),
 
     # Candidate pool per step: [6 ring obstacles, 1 static origin obstacle,
     # 4 robots] — self-exclusion applies to the robot block only (:141-150).
-    M = nO + 1 + nR
     exclude_self = jnp.concatenate([jnp.zeros(nO + 1, bool), jnp.ones(nR, bool)])
 
     state0 = initial_state(cfg)

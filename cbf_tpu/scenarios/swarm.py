@@ -83,19 +83,32 @@ class State(NamedTuple):
     v: jnp.ndarray   # (N, 2) last applied velocities
 
 
-def initial_state(cfg: Config) -> State:
-    """Jittered-grid spawn: collision-free start at any N."""
+def spawn_positions(cfg: Config, seed) -> jnp.ndarray:
+    """Jittered-grid spawn: collision-free (N, 2) start at any N.
+
+    The single source of spawn truth — ensemble/training paths vmap this
+    over seeds so sharded runs start from exactly the same distribution as
+    the single-device scenario.
+    """
     side = int(np.ceil(np.sqrt(cfg.n)))
     half = cfg.spawn_half_width
     lin = np.linspace(-half, half, side)
     gx, gy = np.meshgrid(lin, lin)
     grid = np.stack([gx.ravel(), gy.ravel()], axis=1)[: cfg.n]
     spacing = 2 * half / max(side - 1, 1)
-    key = jax.random.PRNGKey(cfg.seed)
+    is_key = hasattr(seed, "dtype") and (
+        jax.dtypes.issubdtype(seed.dtype, jax.dtypes.prng_key)
+        or (seed.dtype == jnp.uint32 and jnp.ndim(seed) == 1)  # legacy key
+    )
+    key = seed if is_key else jax.random.PRNGKey(seed)
     jitter = jax.random.uniform(
         key, (cfg.n, 2), minval=-0.25 * spacing, maxval=0.25 * spacing
     )
-    x0 = jnp.asarray(grid, cfg.dtype) + jitter.astype(cfg.dtype)
+    return jnp.asarray(grid, cfg.dtype) + jitter.astype(cfg.dtype)
+
+
+def initial_state(cfg: Config) -> State:
+    x0 = spawn_positions(cfg, cfg.seed)
     return State(x=x0, v=jnp.zeros_like(x0))
 
 

@@ -95,11 +95,17 @@ def project_polyhedron_2d(A, b, feas_tol=None):
     # Dual: solve Gram @ lambda = -b_pair, need lambda >= 0.
     gii, gjj = norms2[I], norms2[J]
     gij = jnp.sum(ai * aj, axis=1)
+    # In 2-D the Gram determinant equals det^2, so its degeneracy threshold
+    # must be det_ok's threshold squared — a larger cutoff would leave a dead
+    # zone where det_ok passes but the duals are computed against a dummy
+    # denominator and silently corrupt the vertex test.
     detG = gii * gjj - gij * gij
-    safe_detG = jnp.where(jnp.abs(detG) > 1e-14, detG, 1.0)
+    detG_ok = jnp.abs(detG) > 1e-20
+    safe_detG = jnp.where(detG_ok, detG, 1.0)
     lam_i = (-bi * gjj + bj * gij) / safe_detG
     lam_j = (-bj * gii + bi * gij) / safe_detG
-    dual_pair = det_ok & row_ok[I] & row_ok[J] & (lam_i >= -tol) & (lam_j >= -tol)
+    dual_pair = (det_ok & detG_ok & row_ok[I] & row_ok[J]
+                 & (lam_i >= -tol) & (lam_j >= -tol))
 
     # --- select ------------------------------------------------------------
     X = jnp.concatenate([x_zero, x_single, x_pair], axis=0)       # (C, 2)

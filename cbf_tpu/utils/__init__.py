@@ -1,0 +1,1 @@
+from cbf_tpu.utils.math import safe_norm, safe_sqrt  # noqa: F401

@@ -1,0 +1,22 @@
+"""Numerics helpers shared across the framework."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def safe_sqrt(x):
+    """sqrt with a NaN-free reverse mode at x == 0.
+
+    ``sqrt`` has an infinite derivative at 0; when the 0-entry is masked out
+    downstream (e.g. self-distances excluded by a ``where``), reverse mode
+    still forms 0 * inf = NaN. Evaluating sqrt at a guarded argument and
+    re-selecting kills the bad branch cleanly.
+    """
+    positive = x > 0
+    return jnp.where(positive, jnp.sqrt(jnp.where(positive, x, 1.0)), 0.0)
+
+
+def safe_norm(x, axis=-1, keepdims=False):
+    """L2 norm along ``axis`` with a NaN-free gradient at 0."""
+    return safe_sqrt(jnp.sum(x * x, axis=axis, keepdims=keepdims))

@@ -1,0 +1,112 @@
+"""Multi-device tests on the virtual 8-device CPU mesh (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+
+def _mesh(n_dp, n_sp):
+    import jax
+    from cbf_tpu.parallel import make_mesh
+
+    devs = jax.devices()
+    if len(devs) < n_dp * n_sp:
+        pytest.skip(f"needs {n_dp * n_sp} devices, have {len(devs)}")
+    return make_mesh(n_dp=n_dp, n_sp=n_sp, devices=devs[: n_dp * n_sp])
+
+
+def test_ring_knn_matches_single_device(rng):
+    """Agent-sharded ring neighbor search == dense single-device gating."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from cbf_tpu.parallel.ensemble import shard_map
+    from cbf_tpu.parallel.ring import ring_knn
+    from cbf_tpu.rollout.gating import knn_gating
+
+    mesh = _mesh(1, 4)
+    N, K, radius = 64, 6, 0.5
+    states = rng.uniform(-1, 1, size=(N, 4)).astype(np.float32)
+    s = jnp.asarray(states)
+
+    obs_ref, mask_ref = knn_gating(
+        s, s, radius, K, exclude_self_row=jnp.ones(N, bool))
+
+    fn = shard_map(
+        lambda sl: ring_knn(sl, K, radius, "sp"),
+        mesh, in_specs=P(("dp", "sp"), None),
+        out_specs=(P(("dp", "sp")), P(("dp", "sp"))),
+    )
+    obs_ring, mask_ring = jax.jit(fn)(s)
+
+    np.testing.assert_array_equal(np.asarray(mask_ring), np.asarray(mask_ref))
+    # Same neighbor *sets*: compare sorted masked distances per agent (state
+    # order within ties may differ between dense top_k and ring merge).
+    def dists(obs, mask):
+        d = np.linalg.norm(np.asarray(obs)[:, :, :2] - states[:, None, :2],
+                           axis=-1)
+        d[~np.asarray(mask)] = np.inf
+        return np.sort(d, axis=1)
+
+    np.testing.assert_allclose(dists(obs_ring, mask_ring),
+                               dists(obs_ref, mask_ref), atol=1e-5)
+
+
+def test_sharded_swarm_rollout_dp_sp():
+    import jax
+    from cbf_tpu.parallel.ensemble import sharded_swarm_rollout
+    from cbf_tpu.scenarios import swarm
+
+    mesh = _mesh(2, 4)
+    cfg = swarm.Config(n=32, steps=60)
+    (xf, vf), mets = sharded_swarm_rollout(cfg, mesh, seeds=list(range(4)))
+    assert xf.shape == (4, 32, 2)
+    near = np.asarray(mets.nearest_distance)
+    assert near.shape == (4, 60)
+    # Separation holds in every ensemble member once gating engages.
+    assert np.nanmin(np.where(np.isinf(near), np.nan, near)) > 0.13
+    assert np.asarray(mets.infeasible_count).sum() == 0
+
+
+def test_sharded_rollout_matches_unsharded():
+    """Same seeds, 1x1 mesh vs 2x4 mesh: identical final states (the ring
+    and psum reductions must not change the math, only its placement)."""
+    from cbf_tpu.parallel.ensemble import sharded_swarm_rollout
+    from cbf_tpu.scenarios import swarm
+
+    cfg = swarm.Config(n=16, steps=40)
+    m1 = _mesh(1, 1)
+    m8 = _mesh(2, 4)
+    (x1, _), met1 = sharded_swarm_rollout(cfg, m1, seeds=[0, 1])
+    (x8, _), met8 = sharded_swarm_rollout(cfg, m8, seeds=[0, 1])
+    np.testing.assert_allclose(np.asarray(x1), np.asarray(x8), atol=2e-5)
+
+
+def test_train_step_runs_and_descends():
+    import jax.numpy as jnp
+    from cbf_tpu.learn import TrainConfig, init_params, make_train_step
+    from cbf_tpu.parallel.ensemble import ensemble_initial_states
+    from cbf_tpu.scenarios import swarm
+
+    mesh = _mesh(2, 2)
+    # Point-rendezvous (tiny pack radius) from a crowded grid start (0.25 m
+    # spacing < 0.4 gating radius) so constraints bind within the horizon
+    # and the loss actually depends on the barrier parameters.
+    cfg = swarm.Config(n=16, steps=6, pack_spacing=0.01)
+    tc = TrainConfig(steps=10, learning_rate=5e-2)
+    train_step, _ = make_train_step(cfg, mesh, tc)
+    lin = np.linspace(-0.375, 0.375, 4)
+    gx, gy = np.meshgrid(lin, lin)
+    grid = np.stack([gx.ravel(), gy.ravel()], axis=1).astype(np.float32)
+    x0 = jnp.asarray(np.stack([grid, grid * 1.01]))          # (2, 16, 2)
+    v0 = jnp.zeros_like(x0)
+
+    import optax
+    params = init_params()
+    opt_state = optax.adam(tc.learning_rate).init(params)
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = train_step(params, opt_state, x0, v0)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all(), losses
+    # Gradients are real: params moved.
+    assert abs(float(params.gamma_raw) - float(init_params().gamma_raw)) > 0
