@@ -34,6 +34,24 @@ _HI = lax.Precision.HIGHEST
 MASKED_ROW_RHS = 1e6
 
 
+def barrier_rhs(d, hs, f, gu0, *, dmin, k, gamma):
+    """The CBF constraint RHS b = gamma*(hs@d - dmin) + hs@(f@d) + hs@(g@u0)
+    (cbf.py:58-59), shape-agnostic over leading batch axes.
+
+    Single source of truth for the barrier RHS — both the per-agent row
+    assembly and the batched direction-dedup assembly call this, so a change
+    to the barrier definition cannot silently break their documented exact
+    equivalence.
+
+    Args: d (..., K, 4) relative states, hs (..., K, 4) sign vectors,
+    f (4, 4), gu0 (..., 4) = g @ u0.
+    """
+    h = jnp.sum(hs * d, axis=-1) - dmin                       # hs @ d - dmin
+    fd = jnp.einsum("...j,lj->...l", d, f, precision=_HI)     # (f @ d)
+    L_f = jnp.sum(hs * fd, axis=-1)
+    return gamma * h + L_f + jnp.sum(hs * gu0[..., None, :], axis=-1)
+
+
 def barrier_rows(robot_state, obs_states, obs_mask, f, g, u0, *, dmin, k, gamma):
     """CBF rows for one agent against K (masked) obstacles.
 
@@ -57,11 +75,9 @@ def barrier_rows(robot_state, obs_states, obs_mask, f, g, u0, *, dmin, k, gamma)
     sy = jnp.where(d[:, 1] < 0, -1.0, 1.0)
     hs = jnp.stack([sx, sy, k * sx, k * sy], axis=-1)         # (K, 4)
 
-    h = jnp.einsum("kj,kj->k", hs, d, precision=_HI) - dmin   # hs_p @ d - dmin
-    L_f = jnp.einsum("kj,jl,kl->k", hs, f, d, precision=_HI)  # hs_p @ (f @ d)
     gu0 = jnp.einsum("jl,l->j", g, u0, precision=_HI)         # (4,)
     A = -jnp.einsum("kj,jl->kl", hs, g, precision=_HI)        # (K, 2)
-    b = gamma * h + L_f + jnp.einsum("kj,j->k", hs, gu0, precision=_HI)
+    b = barrier_rhs(d, hs, f, gu0, dmin=dmin, k=k, gamma=gamma)
 
     A = jnp.where(obs_mask[:, None], A, 0.0)
     b = jnp.where(obs_mask, b, MASKED_ROW_RHS)
@@ -121,6 +137,81 @@ def box_rows(robot_state, u0, max_speed, *, reference_layout: bool = True):
             ]
         )
     return G, S
+
+
+def assemble_qp_dedup(robot_states, obs_states, obs_mask, f, g, u0, *, dmin,
+                      k, gamma, max_speed, reference_layout=True):
+    """Batched QP assembly with direction deduplication: K+8 rows -> 8.
+
+    Key structural fact: every CBF row is ``A_i = -(sx*u + sy*w)`` with
+    ``u = g[0] + k*g[2]``, ``w = g[1] + k*g[3]`` and signs in {+-1}^2
+    (from hs_p = [sx, sy, k*sx, k*sy] — cbf.py:47-53). So no matter how many
+    obstacles an agent has, its CBF rows fall into 4 parallel classes, and
+    within a class only the smallest RHS binds. Collapsing to 4 canonical
+    CBF rows (min-b per sign class; empty classes get MASKED_ROW_RHS) plus 4
+    deduped box rows leaves the feasible region — hence the exact QP optimum,
+    infeasibility detection, and the +1 relaxation semantics (all rows in a
+    class shift together) — identical, while shrinking the enumeration
+    solver's work ~7x.
+
+    Args: robot_states (N, 4), obs_states (N, K, 4), obs_mask (N, K),
+    f (4,4), g (4,2), u0 (N, 2).
+    Returns (A (N, 8, 2), b (N, 8), relax_mask (N, 8)).
+    """
+    N = robot_states.shape[0]
+    dtype = jnp.result_type(robot_states, obs_states, u0)
+
+    d = robot_states[:, None, :] - obs_states                 # (N, K, 4)
+    sx = jnp.where(d[..., 0] < 0, -1.0, 1.0)                  # (N, K)
+    sy = jnp.where(d[..., 1] < 0, -1.0, 1.0)
+    hs = jnp.stack([sx, sy, k * sx, k * sy], axis=-1)         # (N, K, 4)
+
+    gu0 = jnp.einsum("jl,nl->nj", g, u0, precision=_HI)       # (N, 4)
+    b_all = barrier_rhs(d, hs, f, gu0, dmin=dmin, k=k, gamma=gamma)
+
+    u_vec = g[0] + k * g[2]                                   # (2,)
+    w_vec = g[1] + k * g[3]
+
+    signs = jnp.array(
+        [[1.0, 1.0], [1.0, -1.0], [-1.0, 1.0], [-1.0, -1.0]], dtype)
+    A_cbf = -(signs[:, 0:1] * u_vec[None] + signs[:, 1:2] * w_vec[None])
+    A_cbf = jnp.broadcast_to(A_cbf[None], (N, 4, 2))          # (N, 4, 2)
+
+    b_cbf = []
+    for s1, s2 in ((1.0, 1.0), (1.0, -1.0), (-1.0, 1.0), (-1.0, -1.0)):
+        member = obs_mask & (sx == s1) & (sy == s2)
+        b_cbf.append(jnp.min(
+            jnp.where(member, b_all, MASKED_ROW_RHS), axis=1))
+    b_cbf = jnp.stack(b_cbf, axis=1)                          # (N, 4)
+
+    # Box rows deduped by direction (min of the two RHS per direction, in
+    # the reference's exact pairing — see box_rows).
+    ms = max_speed
+    vx, vy = robot_states[:, 2], robot_states[:, 3]
+    u0x, u0y = u0[:, 0], u0[:, 1]
+    A_box = jnp.broadcast_to(
+        jnp.array([[1, 0], [0, 1], [-1, 0], [0, -1]], dtype)[None],
+        (N, 4, 2))
+    if reference_layout:
+        b_box = jnp.stack(
+            [jnp.minimum(ms - u0x, ms - vx - u0x),
+             jnp.minimum(ms + u0x, ms - vy - u0y),
+             jnp.minimum(ms - u0y, ms + vx + u0x),
+             jnp.minimum(ms + u0y, ms + vy + u0y)],
+            axis=1)
+    else:
+        b_box = jnp.stack(
+            [jnp.minimum(ms - u0x, ms - vx - u0x),
+             jnp.minimum(ms - u0y, ms - vy - u0y),
+             jnp.minimum(ms + u0x, ms + vx + u0x),
+             jnp.minimum(ms + u0y, ms + vy + u0y)],
+            axis=1)
+
+    A = jnp.concatenate([A_cbf, A_box], axis=1)               # (N, 8, 2)
+    b = jnp.concatenate([b_cbf, b_box], axis=1)               # (N, 8)
+    relax_mask = jnp.concatenate(
+        [jnp.ones((N, 4), dtype), jnp.zeros((N, 4), dtype)], axis=1)
+    return A, b, relax_mask
 
 
 def assemble_qp(robot_state, obs_states, obs_mask, f, g, u0, *, dmin, k, gamma,

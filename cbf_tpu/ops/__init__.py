@@ -1,0 +1,1 @@
+from cbf_tpu.ops.pairwise import pairwise_distances, pairwise_sq_distances  # noqa: F401

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from cbf_tpu.utils.math import safe_norm
+from cbf_tpu.utils.math import match_vma, safe_norm
 
 
 def ring_knn(states4_local, k: int, radius, axis_name: str,
@@ -67,17 +67,10 @@ def ring_knn(states4_local, k: int, radius, axis_name: str,
         block = lax.ppermute(block, axis_name, perm)
         return best_d, best_s, block
 
-    best_d0 = jnp.full((n_local, k), jnp.inf, dtype)
-    best_s0 = jnp.zeros((n_local, k, 4), dtype)
-    # The scan carry must enter with the same device-varying type it leaves
+    # The loop carry must enter with the same device-varying type it leaves
     # with (JAX tracks manual-axes variance through shard_map loops).
-    if hasattr(lax, "pcast"):
-        if hasattr(jax, "typeof"):
-            axes = tuple(jax.typeof(states4_local).vma)
-        else:
-            axes = (axis_name,)
-        best_d0 = lax.pcast(best_d0, axes, to="varying")
-        best_s0 = lax.pcast(best_s0, axes, to="varying")
+    best_d0 = match_vma(jnp.full((n_local, k), jnp.inf, dtype), states4_local)
+    best_s0 = match_vma(jnp.zeros((n_local, k, 4), dtype), states4_local)
     best_d, best_s, _ = lax.fori_loop(
         0, n_shards, hop, (best_d0, best_s0, states4_local)
     )

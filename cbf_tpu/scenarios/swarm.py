@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from cbf_tpu.core.filter import CBFParams, safe_controls
+from cbf_tpu.ops.pairwise import pairwise_distances
 from cbf_tpu.rollout.engine import StepOutputs, rollout
 from cbf_tpu.rollout.gating import knn_gating
 
@@ -142,9 +143,8 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
         states4 = jnp.concatenate([x, state.v], axis=1)        # (N, 4)
 
         # One pairwise-distance computation feeds both the k-NN gating and
-        # the min-distance safety metric.
-        diff = x[:, None, :] - x[None, :, :]
-        dist = jnp.sqrt(jnp.sum(diff * diff, axis=-1))         # (N, N)
+        # the min-distance safety metric (MXU matmul form — see ops.pairwise).
+        dist = pairwise_distances(x)                           # (N, N)
         obs_slab, mask = knn_gating(
             states4, states4, cfg.safety_distance, K,
             exclude_self_row=jnp.ones(x.shape[0], bool), dist=dist,

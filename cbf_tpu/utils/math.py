@@ -20,3 +20,26 @@ def safe_sqrt(x):
 def safe_norm(x, axis=-1, keepdims=False):
     """L2 norm along ``axis`` with a NaN-free gradient at 0."""
     return safe_sqrt(jnp.sum(x * x, axis=axis, keepdims=keepdims))
+
+
+def match_vma(x, ref):
+    """Give ``x`` the same varying-manual-axes type as ``ref``.
+
+    Inside ``shard_map``, loop carries must enter with the device-varying
+    type they leave with; freshly created constants (zeros/full) are
+    'invariant' and need an explicit pcast. No-op outside shard_map or on
+    JAX versions without vma tracking.
+    """
+    import jax
+    from jax import lax
+
+    if not (hasattr(jax, "typeof") and hasattr(lax, "pcast")):
+        return x
+    ref_vma = getattr(jax.typeof(ref), "vma", None)
+    cur_vma = getattr(jax.typeof(x), "vma", None) or frozenset()
+    if not ref_vma:
+        return x
+    need = tuple(a for a in ref_vma if a not in cur_vma)
+    if need:
+        x = lax.pcast(x, need, to="varying")
+    return x
