@@ -1,0 +1,168 @@
+"""CLI frontend: ``python -m cbf_tpu <command>``.
+
+The reference's "CLI" is ``python <script>.py`` with every parameter
+hard-coded (SURVEY.md §5 config/flag row). Here scenarios are dataclass
+configs (the config system) and this module is the thin frontend over them:
+
+    python -m cbf_tpu list
+    python -m cbf_tpu run meet_at_center --steps 200 --video out.gif
+    python -m cbf_tpu run swarm --set n=512 --set k_neighbors=8 \
+        --checkpoint-dir ckpt --chunk 1000 --profile-dir prof
+    python -m cbf_tpu bench
+
+``--set field=value`` overrides any config dataclass field (typed via the
+field's default); ``--steps`` maps onto whichever field the scenario calls
+its horizon (steps/iterations). Results print as one JSON summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+
+def _scenarios():
+    from cbf_tpu.render import (render_cross_and_rescue, render_meet_at_center,
+                                render_swarm)
+    from cbf_tpu.scenarios import cross_and_rescue, meet_at_center, swarm
+
+    return {
+        "meet_at_center": (meet_at_center, "iterations",
+                           lambda outs, cfg, path: render_meet_at_center(
+                               outs.trajectory, path,
+                               n_obstacles=cfg.n_obstacles)),
+        "cross_and_rescue": (cross_and_rescue, "iterations",
+                             lambda outs, cfg, path: render_cross_and_rescue(
+                                 outs.trajectory, path, goal=cfg.goal)),
+        "swarm": (swarm, "steps",
+                  lambda outs, cfg, path: render_swarm(outs.trajectory, path)),
+    }
+
+
+def _apply_overrides(cfg, pairs: list[str], steps: int | None,
+                     steps_field: str, need_trajectory: bool):
+    fields = {f.name: f for f in dataclasses.fields(cfg)}
+    updates = {}
+    if steps is not None:
+        updates[steps_field] = steps
+    if need_trajectory:
+        updates["record_trajectory"] = True
+    for pair in pairs:
+        key, _, raw = pair.partition("=")
+        if key not in fields:
+            raise SystemExit(
+                f"unknown config field {key!r}; have {sorted(fields)}")
+        current = getattr(cfg, key)
+        if isinstance(current, bool):
+            val = raw.lower() in ("1", "true", "yes")
+        elif isinstance(current, int):
+            val = int(raw)
+        elif isinstance(current, float):
+            val = float(raw)
+        elif isinstance(current, tuple):
+            val = tuple(float(x) for x in raw.split(","))
+        else:
+            val = raw
+        updates[key] = val
+    return dataclasses.replace(cfg, **updates)
+
+
+def cmd_run(args) -> int:
+    import contextlib
+
+    from cbf_tpu.rollout.engine import rollout, rollout_chunked
+    from cbf_tpu.utils import profiling
+    from cbf_tpu.utils.debug import checked_rollout, summarize
+
+    module, steps_field, renderer = _scenarios()[args.scenario]
+    cfg = _apply_overrides(module.Config(), args.set, args.steps, steps_field,
+                           need_trajectory=args.video is not None)
+    state0, step = module.make(cfg)
+    steps = getattr(cfg, steps_field)
+
+    prof = (profiling.trace(args.profile_dir) if args.profile_dir
+            else contextlib.nullcontext())
+    with prof:
+        if args.checked:
+            final, outs = checked_rollout(step, state0, steps)
+            start = 0
+        elif args.checkpoint_dir:
+            final, outs, start = rollout_chunked(
+                step, state0, steps, chunk=args.chunk,
+                checkpoint_dir=args.checkpoint_dir, resume=not args.no_resume)
+        else:
+            final, outs = rollout(step, state0, steps)
+            start = 0
+
+    record = {"scenario": args.scenario, "config": {
+        f.name: repr(getattr(cfg, f.name)) for f in dataclasses.fields(cfg)}}
+    if outs is not None:
+        record.update(summarize(outs))
+    if start:
+        record["resumed_from_step"] = start
+    if args.video and outs is not None:
+        record["video"] = renderer(outs, cfg, args.video)
+    print(json.dumps(record))
+    return 0
+
+
+def cmd_list(_args) -> int:
+    for name, (module, steps_field, _) in sorted(_scenarios().items()):
+        cfg = module.Config()
+        knobs = ", ".join(f"{f.name}={getattr(cfg, f.name)!r}"
+                          for f in dataclasses.fields(cfg)
+                          if f.name != "dtype")
+        print(f"{name}  ({steps_field} is the horizon)\n    {knobs}")
+    return 0
+
+
+def cmd_bench(_args) -> int:
+    # bench.py lives at the repo root (driver contract), not in the package
+    # — load it by path so the command works from any cwd.
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")
+    spec = importlib.util.spec_from_file_location("bench", path)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    bench.main()
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m cbf_tpu")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    runp = sub.add_parser("run", help="run a scenario")
+    runp.add_argument("scenario", choices=sorted(_scenarios()))
+    runp.add_argument("--steps", type=int, default=None,
+                      help="rollout horizon (maps to steps/iterations)")
+    runp.add_argument("--set", action="append", default=[],
+                      metavar="FIELD=VALUE", help="override any config field")
+    runp.add_argument("--video", default=None,
+                      help="write a replay video/gif here")
+    runp.add_argument("--checkpoint-dir", default=None)
+    runp.add_argument("--chunk", type=int, default=1000,
+                      help="steps per compiled chunk when checkpointing")
+    runp.add_argument("--no-resume", action="store_true")
+    runp.add_argument("--profile-dir", default=None,
+                      help="write a jax.profiler trace here")
+    runp.add_argument("--checked", action="store_true",
+                      help="run under checkify NaN/inf validation")
+    runp.set_defaults(fn=cmd_run)
+
+    sub.add_parser("list", help="list scenarios + config knobs") \
+        .set_defaults(fn=cmd_list)
+    sub.add_parser("bench", help="run the driver benchmark") \
+        .set_defaults(fn=cmd_bench)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

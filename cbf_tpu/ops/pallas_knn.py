@@ -1,0 +1,144 @@
+"""Pallas TPU kernel: fused pairwise-distance + k-NN danger gating.
+
+The swarm scenario's non-QP hot path (SURVEY.md §7 hard part #3) is the
+O(N^2) neighbor search. The jnp reference path
+(:mod:`cbf_tpu.rollout.gating`) materializes an (N, N, 2) difference tensor
+and an (N, N) distance matrix in HBM and then runs ``lax.top_k`` — a
+sort-based O(N log N)-per-row op. At N=4096 that is ~200 MB of HBM traffic
+per step for outputs of size N*k.
+
+This kernel fuses the whole query: each grid program holds one TILE-row
+block of agents, forms its (TILE, N) squared-distance slab entirely in VMEM
+(two VPU passes — no MXU: the gating threshold needs exact small distances,
+see ops.pairwise), and extracts the k nearest in-radius neighbors by k
+masked min-reductions (k is small and static — cheaper and
+deterministic vs. a full sort). HBM traffic drops to the (N, 2) positions in
+and (N, k) indices/distances out. The all-pairs nearest distance (the
+min-pairwise-distance safety metric) rides along for free as a second
+output, so the scenario step needs no separate N^2 pass.
+
+Numerical contract = :func:`cbf_tpu.rollout.gating.knn_gating` with
+``exclude_self_row=all`` (the swarm configuration): eligibility is
+``0 < d < radius``; ties broken by lowest index (lax.top_k breaks ties the
+same way on distinct keys; exact-tie order may differ — irrelevant to the
+QP, whose solution is row-order invariant).
+
+Capacity: one row-block's slab is TILE x N_pad f32 in VMEM, so N is
+bounded by ~8k at TILE=128 (≈4 MB/slab, ~3 slabs live). The public wrapper
+falls back to the jnp path beyond that (and on non-TPU backends runs in
+interpret mode only under tests).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable only where the TPU plugin exists; interpret mode
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+    _SMEM = pltpu.SMEM
+except Exception:  # pragma: no cover
+    _VMEM = None
+    _SMEM = None
+
+TILE = 128
+MAX_N_FUSED = 8192
+_FAR = 1.0e6          # padding coordinate: far but finite (inf-inf = nan)
+
+
+def _knn_kernel(r2_ref, xs_ref, ys_ref, idx_ref, dist_ref, nearest_ref, *,
+                k: int, n: int, n_pad: int):
+    i = pl.program_id(0)
+    radius2 = r2_ref[0]
+    xr = xs_ref[0, pl.ds(i * TILE, TILE)]                    # (TILE,)
+    yr = ys_ref[0, pl.ds(i * TILE, TILE)]
+
+    dx = xr[:, None] - xs_ref[0, :][None, :]                 # (TILE, n_pad)
+    dy = yr[:, None] - ys_ref[0, :][None, :]
+    d2 = dx * dx + dy * dy
+
+    col = lax.broadcasted_iota(jnp.int32, (TILE, n_pad), 1)
+    row = i * TILE + lax.broadcasted_iota(jnp.int32, (TILE, n_pad), 0)
+    is_self = col == row
+    in_range = col < n
+
+    # All-pairs nearest (self and padding excluded) — the safety metric.
+    d2_all = jnp.where(is_self | ~in_range, jnp.inf, d2)
+    nearest_ref[:, 0] = jnp.sqrt(jnp.min(d2_all, axis=1))
+
+    # Danger eligibility: 0 < d < radius (the reference's `distance > 0`
+    # self-exclusion — meet_at_center.py:132 — which also drops exact
+    # coincidences, matching gating.knn_gating).
+    key = jnp.where((d2 < radius2) & (d2 > 0.0) & in_range, d2, jnp.inf)
+
+    for t in range(k):                                       # static unroll
+        m = jnp.min(key, axis=1)                             # (TILE,)
+        hit = key == m[:, None]
+        idx = jnp.min(jnp.where(hit, col, n_pad), axis=1)    # first minimizer
+        idx_ref[:, t] = jnp.where(jnp.isfinite(m), idx, 0)
+        dist_ref[:, t] = jnp.sqrt(m)
+        key = jnp.where(col == idx[:, None], jnp.inf, key)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "interpret"))
+def knn_neighbors(x, radius, k: int, *, interpret: bool = False):
+    """Fused k-NN danger gating over (N, 2) positions.
+
+    Returns (idx (N, k) int32, dist (N, k) f32 — inf on empty slots,
+    nearest_all (N,) f32 — nearest-any distance per agent).
+    """
+    n = x.shape[0]
+    n_pad = max(TILE, -(-n // TILE) * TILE)
+    xp = jnp.full((1, n_pad), _FAR, jnp.float32)
+    yp = jnp.full((1, n_pad), 2.0 * _FAR, jnp.float32)
+    xp = xp.at[0, :n].set(x[:, 0].astype(jnp.float32))
+    yp = yp.at[0, :n].set(x[:, 1].astype(jnp.float32))
+
+    r2 = (jnp.asarray(radius, jnp.float32) ** 2).reshape(1)
+
+    kernel = functools.partial(_knn_kernel, k=k, n=n, n_pad=n_pad)
+    grid = (n_pad // TILE,)
+    vmem = {} if _VMEM is None else {"memory_space": _VMEM}
+    smem = {} if _SMEM is None else {"memory_space": _SMEM}
+    idx, dist, nearest = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((1,), lambda i: (0,), **smem),
+                  pl.BlockSpec((1, n_pad), lambda i: (0, 0), **vmem),
+                  pl.BlockSpec((1, n_pad), lambda i: (0, 0), **vmem)],
+        out_specs=[pl.BlockSpec((TILE, k), lambda i: (i, 0), **vmem),
+                   pl.BlockSpec((TILE, k), lambda i: (i, 0), **vmem),
+                   pl.BlockSpec((TILE, 1), lambda i: (i, 0), **vmem)],
+        out_shape=[jax.ShapeDtypeStruct((n_pad, k), jnp.int32),
+                   jax.ShapeDtypeStruct((n_pad, k), jnp.float32),
+                   jax.ShapeDtypeStruct((n_pad, 1), jnp.float32)],
+        interpret=interpret,
+    )(r2, xp, yp)
+    return idx[:n], dist[:n], nearest[:n, 0]
+
+
+def supported(n: int) -> bool:
+    """Whether the fused kernel path applies: TPU backend and the row slab
+    fits VMEM (see module docstring)."""
+    if n > MAX_N_FUSED:
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def knn_gating_pallas(states4, radius, k: int, *, interpret: bool = False):
+    """Drop-in for :func:`cbf_tpu.rollout.gating.knn_gating` (all-row
+    self-exclusion form) + the nearest-any metric.
+
+    Args: states4 (N, 4). Returns (obs (N, k, 4), mask (N, k),
+    nearest_all (N,)).
+    """
+    idx, dist, nearest = knn_neighbors(states4[:, :2], radius, k,
+                                       interpret=interpret)
+    mask = jnp.isfinite(dist)
+    obs = jnp.take(states4, idx, axis=0)
+    return obs, mask, nearest

@@ -1,0 +1,8 @@
+from cbf_tpu.render.video import (  # noqa: F401
+    Layer,
+    determine_marker_size,
+    replay,
+    render_cross_and_rescue,
+    render_meet_at_center,
+    render_swarm,
+)

@@ -1,0 +1,182 @@
+"""Host-side trajectory rendering: replay recorded rollouts to video.
+
+The reference renders *inside* the hot loop — a live Robotarium figure
+(meet_at_center.py:51 ``show_figure=True``) and a per-step
+``writer.grab_frame()`` into ``simulation.mp4`` (cross_and_rescue.py:96-98)
+— so wall-clock is dominated by matplotlib. Here rendering is fully
+decoupled (SURVEY.md §7 step 3): scenarios record position snapshots as scan
+outputs on-device, and this module replays the stacked arrays through
+matplotlib afterwards. The sim never touches a figure; a 10k-step rollout
+costs the same with or without video.
+
+Writer selection: FFMpegWriter when ffmpeg is on PATH (.mp4, like the
+reference artifact), else PillowWriter (.gif). ``replay`` is the generic
+engine; ``render_meet_at_center`` / ``render_cross_and_rescue`` /
+``render_swarm`` adapt each scenario's recorded ``StepOutputs.trajectory``
+pytree to it with reference-matching styling (obstacle ring red, free agents
+blue, goal gold — cross_and_rescue.py:63-65).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import shutil
+from typing import Sequence
+
+import numpy as np
+
+from cbf_tpu.sim.robotarium import ARENA
+
+
+@dataclasses.dataclass(frozen=True)
+class Layer:
+    """One scatter layer of the replay.
+
+    positions: (T, 2, K) array — K entities tracked over T frames, column
+    layout as everywhere in the sim layer. A (2, K) array is broadcast as
+    static (the goal marker, a fixed obstacle).
+    """
+    positions: np.ndarray
+    color: str = "C0"
+    radius: float = 0.04          # meters — converted via determine_marker_size
+    marker: str = "o"
+    label: str | None = None
+    trail: int = 0                # draw a fading trail of this many past frames
+
+    def at(self, t: int) -> np.ndarray:
+        p = np.asarray(self.positions)
+        return p if p.ndim == 2 else p[t]
+
+
+def determine_marker_size(ax, radius: float) -> float:
+    """Meters -> matplotlib scatter size (points^2) for the given axes.
+
+    Equivalent of rps ``determine_marker_size`` (consumed at
+    cross_and_rescue.py:62 [external — inferred from usage]): a marker whose
+    on-screen diameter spans ``2*radius`` meters of axes data space.
+    """
+    fig = ax.get_figure()
+    # Axes width in display points.
+    bbox = ax.get_window_extent().transformed(fig.dpi_scale_trans.inverted())
+    width_points = bbox.width * 72.0
+    x0, x1 = ax.get_xlim()
+    meters_per_point = (x1 - x0) / max(width_points, 1e-9)
+    diameter_points = 2.0 * radius / meters_per_point
+    return diameter_points ** 2
+
+
+def _make_writer(out_path: str, fps: int):
+    from matplotlib import animation
+
+    if out_path.endswith(".mp4"):
+        if shutil.which("ffmpeg") is None:
+            raise RuntimeError(
+                "ffmpeg not on PATH — pass a .gif path (PillowWriter) instead")
+        return animation.FFMpegWriter(fps=fps)
+    return animation.PillowWriter(fps=fps)
+
+
+def replay(layers: Sequence[Layer], out_path: str, *, fps: int = 30,
+           stride: int = 1, arena=ARENA, figsize=(6.4, 4.0), dpi: int = 80,
+           title: str | None = None) -> str:
+    """Render layered position trajectories to ``out_path`` (.mp4/.gif).
+
+    Args:
+      layers: scatter layers; the first dynamic layer defines T.
+      stride: render every ``stride``-th recorded frame (a 3000-step rollout
+        at stride=10 becomes a 300-frame video).
+    Returns out_path.
+    """
+    import matplotlib
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    T = max((np.asarray(l.positions).shape[0]
+             for l in layers if np.asarray(l.positions).ndim == 3), default=1)
+
+    fig, ax = plt.subplots(figsize=figsize, dpi=dpi)
+    x0, x1, y0, y1 = arena
+    ax.set_xlim(x0, x1)
+    ax.set_ylim(y0, y1)
+    ax.set_aspect("equal")
+    if title:
+        ax.set_title(title)
+
+    scatters, trails = [], []
+    for l in layers:
+        p = l.at(0)
+        s = ax.scatter(p[0], p[1], s=determine_marker_size(ax, l.radius),
+                       c=l.color, marker=l.marker, label=l.label, zorder=3)
+        scatters.append(s)
+        tr = None
+        if l.trail:
+            tr = ax.scatter([], [], s=determine_marker_size(ax, l.radius) / 6,
+                            c=l.color, alpha=0.25, zorder=2)
+        trails.append(tr)
+    if any(l.label for l in layers):
+        ax.legend(loc="upper right", fontsize=8)
+
+    writer = _make_writer(out_path, fps)
+    with writer.saving(fig, out_path, dpi):
+        for t in range(0, T, stride):
+            for l, s, tr in zip(layers, scatters, trails):
+                p = l.at(t)
+                s.set_offsets(p.T)
+                if tr is not None and t > 0:
+                    past = np.asarray(l.positions)[max(0, t - l.trail):t]
+                    tr.set_offsets(past.transpose(0, 2, 1).reshape(-1, 2))
+            writer.grab_frame()
+    plt.close(fig)
+    return out_path
+
+
+def render_meet_at_center(trajectory, out_path: str, *, n_obstacles: int = 5,
+                          stride: int = 5, **kw) -> str:
+    """Replay a meet_at_center rollout.
+
+    Args: trajectory — the scenario's recorded ``StepOutputs.trajectory``,
+    a (T, 2, N) position stack; first ``n_obstacles`` columns are the
+    cyclic-pursuit ring.
+    """
+    traj = np.asarray(trajectory)
+    return replay(
+        [
+            Layer(traj[:, :, :n_obstacles], color="tab:red", label="obstacles"),
+            Layer(traj[:, :, n_obstacles:], color="tab:blue", trail=30,
+                  label="agents"),
+        ],
+        out_path, stride=stride, title="meet_at_center", **kw)
+
+
+def render_cross_and_rescue(trajectory, out_path: str, *,
+                            goal=(1.5, 0.0), stride: int = 10, **kw) -> str:
+    """Replay a cross_and_rescue rollout.
+
+    Args: trajectory — the scenario's recorded trajectory pytree
+    ``(robot_xy (T, 2, nR), obs_xy (T, 2, nO))``. Styling follows the
+    reference artifact: ring obstacles red, static origin obstacle red, goal
+    gold (cross_and_rescue.py:63-65).
+    """
+    robots, obs = (np.asarray(a) for a in trajectory)
+    static = np.zeros((2, 1))
+    goal_col = np.asarray(goal, float).reshape(2, 1)
+    return replay(
+        [
+            Layer(obs, color="tab:red", radius=0.1, label="obstacles"),
+            Layer(static, color="tab:red", radius=0.1),
+            Layer(goal_col, color="gold", radius=0.06, marker="*",
+                  label="goal"),
+            Layer(robots, color="tab:blue", trail=60, label="robots"),
+        ],
+        out_path, stride=stride, title="cross_and_rescue", **kw)
+
+
+def render_swarm(trajectory, out_path: str, *, stride: int = 10, **kw) -> str:
+    """Replay a swarm rollout. trajectory: (T, N, 2) (the swarm scenario
+    records row-major positions)."""
+    traj = np.asarray(trajectory).transpose(0, 2, 1)        # -> (T, 2, N)
+    half = float(np.abs(traj).max()) * 1.05 + 1e-3
+    return replay(
+        [Layer(traj, color="tab:blue", radius=0.02)],
+        out_path, stride=stride, arena=(-half, half, -half, half),
+        title="swarm rendezvous", **kw)

@@ -21,6 +21,7 @@ from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -42,11 +43,64 @@ def rollout(step_fn: Callable, state0, steps: int, *, unroll: int = 1):
 
     Returns (final_state, StepOutputs stacked over time).
     """
+    return _rollout_from(step_fn, state0, jnp.zeros((), jnp.int32), steps,
+                         unroll=unroll)
+
+
+@functools.partial(jax.jit, static_argnames=("step_fn", "steps", "unroll"))
+def _rollout_from(step_fn: Callable, state, t0, steps: int, unroll: int = 1):
+    """One compiled chunk: ``steps`` iterations starting at global step t0.
+
+    t0 is a traced scalar so every full-size chunk reuses one executable
+    (only a trailing partial chunk compiles a second program).
+    """
     def body(state, t):
         state, out = step_fn(state, t)
         return state, out
 
-    return lax.scan(body, state0, jnp.arange(steps), unroll=unroll)
+    return lax.scan(body, state, t0 + jnp.arange(steps), unroll=unroll)
+
+
+def rollout_chunked(step_fn: Callable, state0, steps: int, *,
+                    chunk: int = 1000, checkpoint_dir: str | None = None,
+                    resume: bool = True, unroll: int = 1):
+    """Run a long rollout in ``chunk``-step compiled segments, checkpointing
+    the state pytree at every boundary (SURVEY.md §5 checkpoint/resume —
+    absent in the reference).
+
+    With ``checkpoint_dir`` set, the newest checkpoint there is restored
+    first (unless ``resume=False``) and execution continues from its step;
+    outputs are returned only for the steps executed *this* call (completed
+    chunks' outputs are not replayed).
+
+    Returns (final_state, StepOutputs stacked over executed steps,
+    start_step).
+    """
+    from cbf_tpu.utils import checkpoint as ckpt
+
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    state, start = state0, 0
+    if checkpoint_dir and resume and ckpt.latest_step(checkpoint_dir) is not None:
+        state, start = ckpt.restore(checkpoint_dir, state0)
+
+    parts = []
+    t0 = start
+    while t0 < steps:
+        n = min(chunk, steps - t0)
+        state, outs = _rollout_from(step_fn, state, jnp.asarray(t0), n,
+                                    unroll=unroll)
+        parts.append(jax.device_get(outs))
+        t0 += n
+        if checkpoint_dir:
+            ckpt.save(checkpoint_dir, t0, state)
+
+    if not parts:
+        return state, None, start
+    # np.concatenate: chunk outputs were pulled to host above — keep the
+    # stacked history there (a 10k-step trajectory need not fit HBM).
+    stacked = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *parts)
+    return state, stacked, start
 
 
 def min_pairwise_distance(positions):

@@ -31,7 +31,9 @@ import jax
 import jax.numpy as jnp
 
 from cbf_tpu.core.filter import CBFParams, safe_controls
+from cbf_tpu.ops import pallas_knn
 from cbf_tpu.ops.pairwise import pairwise_distances
+from cbf_tpu.ops.pallas_knn import knn_gating_pallas
 from cbf_tpu.rollout.engine import StepOutputs, rollout
 from cbf_tpu.rollout.gating import knn_gating
 
@@ -65,6 +67,10 @@ class Config:
     dyn_scale: float = 0.1
     seed: int = 0
     record_trajectory: bool = False
+    # Neighbor-search backend: "auto" picks the fused Pallas kernel on TPU
+    # when N fits its VMEM bound (ops.pallas_knn), else the jnp path;
+    # "pallas"/"jnp" force (pallas runs in interpret mode off-TPU — tests).
+    gating: str = "auto"
     dtype: type = jnp.float32
 
     @property
@@ -127,6 +133,14 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
     g = cfg.dyn_scale * jnp.array([[1, 0], [0, 1], [0, 0], [0, 0]], dt_)
     K = cfg.k_neighbors
 
+    if cfg.gating not in ("auto", "pallas", "jnp"):
+        raise ValueError(f"gating must be auto|pallas|jnp, got {cfg.gating!r}")
+    if cfg.gating == "auto":
+        use_pallas = pallas_knn.supported(cfg.n)
+    else:
+        use_pallas = cfg.gating == "pallas"
+    pallas_interpret = jax.default_backend() != "tpu"
+
     state0 = initial_state(cfg)
 
     def step(state: State, t):
@@ -142,13 +156,22 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
 
         states4 = jnp.concatenate([x, state.v], axis=1)        # (N, 4)
 
-        # One pairwise-distance computation feeds both the k-NN gating and
-        # the min-distance safety metric (MXU matmul form — see ops.pairwise).
-        dist = pairwise_distances(x)                           # (N, N)
-        obs_slab, mask = knn_gating(
-            states4, states4, cfg.safety_distance, K,
-            exclude_self_row=jnp.ones(x.shape[0], bool), dist=dist,
-        )
+        if use_pallas:
+            # Fused Pallas kernel: distances + k-NN + nearest-any metric in
+            # one VMEM-resident pass (ops.pallas_knn).
+            obs_slab, mask, nearest = knn_gating_pallas(
+                states4, cfg.safety_distance, K, interpret=pallas_interpret)
+            min_dist = jnp.min(nearest)
+        else:
+            # jnp path: one pairwise-distance computation feeds both the
+            # k-NN gating and the min-distance safety metric.
+            dist = pairwise_distances(x)                       # (N, N)
+            obs_slab, mask = knn_gating(
+                states4, states4, cfg.safety_distance, K,
+                exclude_self_row=jnp.ones(x.shape[0], bool), dist=dist,
+            )
+            off = dist + jnp.where(jnp.eye(x.shape[0], dtype=bool), jnp.inf, 0.0)
+            min_dist = jnp.min(off)
 
         u_safe, info = safe_controls(states4, obs_slab, mask, f, g, u0, cbf)
         engaged = jnp.any(mask, axis=1)
@@ -157,9 +180,8 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
         x_new = x + cfg.dt * u
         v_new = u
 
-        off = dist + jnp.where(jnp.eye(x.shape[0], dtype=bool), jnp.inf, 0.0)
         out = StepOutputs(
-            min_pairwise_distance=jnp.min(off),
+            min_pairwise_distance=min_dist,
             filter_active_count=jnp.sum(engaged),
             infeasible_count=jnp.sum(~info.feasible & engaged),
             max_relax_rounds=jnp.max(info.relax_rounds),

@@ -1,0 +1,65 @@
+"""Checkpoint/resume for long rollouts (SURVEY.md §5: absent in the
+reference — sim state lives only in process memory; here rollout state is a
+small pytree saved at scan-chunk boundaries).
+
+Orbax-backed: ``CheckpointManager`` handles atomic writes, a latest-step
+index, and retention, and scales unchanged to multi-host sharded state (each
+host writes its shards — the same API the TPU pod path uses). The rollout
+engine's :func:`cbf_tpu.rollout.engine.rollout_chunked` calls this between
+``lax.scan`` chunks, so a 10k-step run interrupted at step 7000 resumes from
+the last boundary instead of restarting.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _manager(directory: str, max_to_keep: int | None = 2):
+    import orbax.checkpoint as ocp
+
+    return ocp.CheckpointManager(
+        os.path.abspath(directory),
+        options=ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep, create=True, enable_async_checkpointing=False,
+        ),
+    )
+
+
+def save(directory: str, step: int, state: Any, *, max_to_keep: int | None = 2
+         ) -> None:
+    """Save a state pytree under ``directory`` keyed by ``step``."""
+    import orbax.checkpoint as ocp
+
+    with _manager(directory, max_to_keep) as mgr:
+        mgr.save(step, args=ocp.args.StandardSave(state))
+        mgr.wait_until_finished()
+
+
+def latest_step(directory: str) -> int | None:
+    """Newest checkpointed step in ``directory``, or None."""
+    if not os.path.isdir(directory):
+        return None
+    with _manager(directory) as mgr:
+        return mgr.latest_step()
+
+
+def restore(directory: str, like: Any, step: int | None = None):
+    """Restore the pytree saved at ``step`` (default: latest).
+
+    ``like`` is an example pytree (e.g. the initial state) fixing structure,
+    dtypes, and shardings of the restored leaves.
+    """
+    import orbax.checkpoint as ocp
+
+    with _manager(directory) as mgr:
+        if step is None:
+            step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {directory}")
+        abstract = jax.tree.map(np.asarray, like)
+        return mgr.restore(step, args=ocp.args.StandardRestore(abstract)), step

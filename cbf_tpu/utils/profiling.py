@@ -1,0 +1,81 @@
+"""Tracing/profiling hooks (SURVEY.md §5: absent in the reference — its only
+performance awareness is a step-duration comment at meet_at_center.py:53).
+
+Three levels:
+- :func:`trace` — a ``jax.profiler`` trace context writing TensorBoard-
+  loadable protos (XLA op timeline, HBM usage) for a code region.
+- :func:`annotate` — named sub-regions (QP solve, neighbor search,
+  integration) that show up as spans inside the device trace.
+- :func:`cost_analysis` / :func:`compile_stats` — static XLA cost model
+  (FLOPs, bytes accessed) and compile-cache counters for a jitted function,
+  usable in tests and benchmarks without running a profiler.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Callable
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Profile a region into ``log_dir`` (TensorBoard trace-viewer format)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named span context; nests inside :func:`trace` device timelines and
+    into jitted HLO op metadata (via ``jax.named_scope``)."""
+    return jax.named_scope(name)
+
+
+def cost_analysis(fn: Callable, *args, **kwargs) -> dict[str, Any]:
+    """XLA's static cost model for ``fn(*args)``: flops, bytes accessed.
+
+    Returns {} keys absent on backends without a cost model.
+    """
+    compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    costs = compiled.cost_analysis()
+    if isinstance(costs, list):            # older jax returns [dict]
+        costs = costs[0] if costs else {}
+    return dict(costs or {})
+
+
+def compile_stats() -> dict[str, int]:
+    """Process-wide jit cache counters (hits = executable reuse)."""
+    from jax._src import monitoring  # no public accessor for these counters
+
+    events = getattr(monitoring, "_counter_events", None)
+    out = {}
+    if isinstance(events, dict):
+        for k, v in events.items():
+            if "cache" in k or "compil" in k:
+                out[k] = v
+    return out
+
+
+class StepTimer:
+    """Wall-clock phase timer for host-side loops (chunk boundaries,
+    checkpoint writes) — complements the device trace."""
+
+    def __init__(self):
+        self.totals: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[name] = (self.totals.get(name, 0.0)
+                                 + time.perf_counter() - t0)
+
+    def summary(self) -> str:
+        return " ".join(f"{k}={v:.3f}s" for k, v in sorted(self.totals.items()))

@@ -1,0 +1,68 @@
+"""Checkpoint/resume: chunked rollouts restart from the last saved boundary
+and reproduce the uninterrupted run exactly (SURVEY.md §5 — the reference has
+no checkpointing; rollout state is a small pytree)."""
+
+import numpy as np
+import pytest
+
+from cbf_tpu.rollout.engine import rollout, rollout_chunked
+from cbf_tpu.scenarios import swarm
+from cbf_tpu.utils import checkpoint as ckpt
+
+
+@pytest.fixture(scope="module")
+def scenario():
+    cfg = swarm.Config(n=16, steps=12, k_neighbors=4)
+    state0, step = swarm.make(cfg)
+    return cfg, state0, step
+
+
+def test_chunked_matches_monolithic(scenario):
+    cfg, state0, step = scenario
+    ref_final, ref_outs = rollout(step, state0, cfg.steps)
+    final, outs, start = rollout_chunked(step, state0, cfg.steps, chunk=5)
+    assert start == 0
+    np.testing.assert_array_equal(np.asarray(final.x), np.asarray(ref_final.x))
+    np.testing.assert_array_equal(
+        np.asarray(outs.min_pairwise_distance),
+        np.asarray(ref_outs.min_pairwise_distance))
+
+
+def test_resume_from_interruption(scenario, tmp_path):
+    cfg, state0, step = scenario
+    d = str(tmp_path / "ckpt")
+
+    # "Crash" after 2 chunks (8 of 12 steps).
+    mid, _, _ = rollout_chunked(step, state0, 8, chunk=4, checkpoint_dir=d)
+    assert ckpt.latest_step(d) == 8
+
+    # Resume picks up at step 8 and finishes; final state matches a clean run.
+    final, outs, start = rollout_chunked(step, state0, cfg.steps, chunk=4,
+                                         checkpoint_dir=d)
+    assert start == 8
+    assert np.asarray(outs.min_pairwise_distance).shape[0] == 4  # only new steps
+
+    ref_final, _ = rollout(step, state0, cfg.steps)
+    np.testing.assert_allclose(np.asarray(final.x), np.asarray(ref_final.x),
+                               rtol=0, atol=0)
+
+    # Fully-complete directory: nothing to run, state restored as-is.
+    final2, outs2, start2 = rollout_chunked(step, state0, cfg.steps, chunk=4,
+                                            checkpoint_dir=d)
+    assert start2 == cfg.steps and outs2 is None
+    np.testing.assert_array_equal(np.asarray(final2.x), np.asarray(final.x))
+
+
+def test_resume_false_ignores_checkpoints(scenario, tmp_path):
+    cfg, state0, step = scenario
+    d = str(tmp_path / "ckpt")
+    rollout_chunked(step, state0, 8, chunk=4, checkpoint_dir=d)
+    _, outs, start = rollout_chunked(step, state0, cfg.steps, chunk=6,
+                                     checkpoint_dir=d, resume=False)
+    assert start == 0
+    assert np.asarray(outs.min_pairwise_distance).shape[0] == cfg.steps
+
+
+def test_restore_missing_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore(str(tmp_path / "empty"), {"a": np.zeros(2)})

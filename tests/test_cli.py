@@ -1,0 +1,51 @@
+"""CLI frontend (`python -m cbf_tpu`) — the config/flag system of
+SURVEY.md §5, exercised in-process."""
+
+import json
+
+import pytest
+
+from cbf_tpu.__main__ import main
+
+
+def test_list(capsys):
+    assert main(["list"]) == 0
+    out = capsys.readouterr().out
+    for name in ("meet_at_center", "cross_and_rescue", "swarm"):
+        assert name in out
+
+
+def test_run_with_overrides(capsys):
+    assert main(["run", "swarm", "--steps", "3",
+                 "--set", "n=9", "--set", "k_neighbors=4"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["steps"] == 3
+    assert rec["config"]["n"] == "9"
+    assert rec["min_pairwise_distance"] > 0
+
+
+def test_run_video_and_checkpoint(tmp_path, capsys):
+    out = str(tmp_path / "v.gif")
+    d = str(tmp_path / "ck")
+    assert main(["run", "meet_at_center", "--steps", "4", "--video", out,
+                 "--checkpoint-dir", d, "--chunk", "2"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["video"] == out
+    assert open(out, "rb").read()[:3] == b"GIF"
+
+    # Second invocation resumes from the completed checkpoint.
+    assert main(["run", "meet_at_center", "--steps", "4",
+                 "--checkpoint-dir", d, "--chunk", "2"]) == 0
+    rec2 = json.loads(capsys.readouterr().out)
+    assert rec2.get("resumed_from_step") == 4
+
+
+def test_run_checked(capsys):
+    assert main(["run", "swarm", "--steps", "2", "--set", "n=4",
+                 "--checked"]) == 0
+    assert json.loads(capsys.readouterr().out)["steps"] == 2
+
+
+def test_unknown_field_errors():
+    with pytest.raises(SystemExit):
+        main(["run", "swarm", "--set", "bogus=1"])

@@ -1,0 +1,53 @@
+"""Profiling hooks + checkify validation (SURVEY.md §5 tracing and sanitizer
+equivalents)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import checkify
+
+from cbf_tpu.scenarios import swarm
+from cbf_tpu.utils import profiling
+from cbf_tpu.utils.debug import checked_rollout, summarize
+
+
+def test_trace_writes_profile(tmp_path):
+    d = str(tmp_path / "prof")
+    with profiling.trace(d):
+        with profiling.annotate("matmul"):
+            jnp.ones((64, 64)).dot(jnp.ones((64, 64))).block_until_ready()
+    found = [f for _, _, fs in os.walk(d) for f in fs]
+    assert any(f.endswith((".pb", ".json.gz", ".xplane.pb")) for f in found)
+
+
+def test_cost_analysis_reports_flops():
+    costs = profiling.cost_analysis(
+        lambda a, b: a @ b, jnp.ones((32, 16)), jnp.ones((16, 8)))
+    # 2*M*N*K FLOPs for the matmul (backend cost models may fold constants,
+    # so just require presence and a sane magnitude).
+    assert costs.get("flops", 0) >= 32 * 16 * 8
+
+
+def test_checked_rollout_clean_and_dirty():
+    cfg = swarm.Config(n=9, steps=3, k_neighbors=4)
+    state0, step = swarm.make(cfg)
+    final, outs = checked_rollout(step, state0, cfg.steps)
+    s = summarize(outs)
+    assert s["steps"] == 3 and np.isfinite(s["min_pairwise_distance"])
+
+    # Inject a NaN through the initial state: checkify must locate it.
+    bad = state0._replace(x=state0.x.at[0, 0].set(jnp.nan))
+    with pytest.raises(checkify.JaxRuntimeError):
+        checked_rollout(step, bad, cfg.steps)
+
+
+def test_step_timer():
+    t = profiling.StepTimer()
+    with t.phase("a"):
+        pass
+    with t.phase("a"):
+        pass
+    assert "a=" in t.summary() and t.totals["a"] >= 0.0

@@ -1,0 +1,66 @@
+"""Render subsystem: headless replay of recorded trajectories (SURVEY.md §7
+step 3 — rendering decoupled from the sim; reference renders in-loop at
+cross_and_rescue.py:96-98)."""
+
+import numpy as np
+import pytest
+
+import matplotlib
+matplotlib.use("Agg")
+
+from cbf_tpu.render import Layer, determine_marker_size, replay
+from cbf_tpu.render import render_cross_and_rescue, render_meet_at_center, render_swarm
+
+
+def test_marker_size_scales_with_radius():
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots()
+    ax.set_xlim(-1.6, 1.6)
+    s1 = determine_marker_size(ax, 0.05)
+    s2 = determine_marker_size(ax, 0.10)
+    plt.close(fig)
+    assert s1 > 0
+    assert np.isclose(s2 / s1, 4.0)      # size is points^2 -> quadratic
+
+
+def test_replay_writes_gif(tmp_path):
+    T, N = 6, 4
+    traj = np.cumsum(np.full((T, 2, N), 0.01), axis=0)
+    out = str(tmp_path / "out.gif")
+    path = replay([Layer(traj, trail=3)], out, stride=2, fps=5)
+    assert path == out
+    data = open(out, "rb").read()
+    assert data[:6] in (b"GIF87a", b"GIF89a") and len(data) > 100
+
+
+def test_scenario_renderers_end_to_end(tmp_path):
+    from cbf_tpu.scenarios import cross_and_rescue, meet_at_center, swarm
+
+    cfg = meet_at_center.Config(iterations=4)
+    _, outs = meet_at_center.run(cfg)
+    p1 = render_meet_at_center(outs.trajectory, str(tmp_path / "m.gif"),
+                               stride=2)
+
+    cfg2 = cross_and_rescue.Config(iterations=4)
+    _, outs2 = cross_and_rescue.run(cfg2)
+    p2 = render_cross_and_rescue(outs2.trajectory, str(tmp_path / "c.gif"),
+                                 stride=2)
+
+    cfg3 = swarm.Config(n=9, steps=4, record_trajectory=True)
+    _, outs3 = swarm.run(cfg3)
+    p3 = render_swarm(outs3.trajectory, str(tmp_path / "s.gif"), stride=2)
+
+    for p in (p1, p2, p3):
+        assert open(p, "rb").read()[:3] == b"GIF"
+
+
+def test_mp4_requires_ffmpeg(tmp_path):
+    import shutil
+
+    traj = np.zeros((2, 2, 1))
+    if shutil.which("ffmpeg") is None:
+        with pytest.raises(RuntimeError, match="ffmpeg"):
+            replay([Layer(traj)], str(tmp_path / "x.mp4"))
+    else:  # pragma: no cover
+        replay([Layer(traj)], str(tmp_path / "x.mp4"))
