@@ -1,0 +1,127 @@
+"""ctypes bindings for the native host-side QP solver (native/qp2d.cpp).
+
+The reference's critical path runs on one native component: cvxopt's C
+interior-point QP (reference cbf.py:2,81). This package is the rebuild's
+counterpart: a C++ batched 2-D QP solver (same KKT-enumeration algorithm as
+the on-device :mod:`cbf_tpu.solvers.exact2d`, float64, host-only) used for
+
+- fast golden-trace generation at scales where the scipy-SLSQP oracle is
+  too slow (it solves one QP per Python call; the native batch does ~1e6/s),
+- three-way parity testing: JAX enumeration vs. SLSQP vs. this independent
+  C++ implementation.
+
+Built on demand with g++ (no pybind11 in this environment — plain C ABI via
+ctypes). All entry points degrade gracefully: :func:`available` is False
+when no compiler/toolchain exists, and callers fall back to the Python
+oracle.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC_DIR = os.path.join(_REPO, "native")
+_SO = os.path.join(_SRC_DIR, "build", "libqp2d.so")
+
+_lib_cache: ctypes.CDLL | None = None
+_build_err: str | None = None
+
+
+def _build() -> str | None:
+    src = os.path.join(_SRC_DIR, "qp2d.cpp")
+    if not os.path.exists(src):
+        return f"source missing: {src}"
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(src):
+        return None
+    try:
+        res = subprocess.run(["make", "-C", _SRC_DIR], capture_output=True,
+                             text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return f"build failed to run: {e}"
+    if res.returncode != 0:
+        return f"build failed:\n{res.stdout}\n{res.stderr}"
+    return None
+
+
+def _lib() -> ctypes.CDLL:
+    global _lib_cache, _build_err
+    if _lib_cache is not None:
+        return _lib_cache
+    if _build_err is not None:          # failed once — don't re-spawn make
+        raise RuntimeError(_build_err)
+    err = _build()
+    if err is not None:
+        _build_err = err
+        raise RuntimeError(err)
+    lib = ctypes.CDLL(_SO)
+    d = ctypes.POINTER(ctypes.c_double)
+    lib.qp2d_solve_batch.argtypes = [
+        d, d, d, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_double,
+        d, ctypes.POINTER(ctypes.c_ubyte), d, d,
+    ]
+    lib.qp2d_solve_batch.restype = None
+    _lib_cache = lib
+    return lib
+
+
+def available() -> bool:
+    """True when the native solver is built (or buildable) and loadable."""
+    try:
+        _lib()
+        return True
+    except (RuntimeError, OSError):
+        return False
+
+
+def solve_qp_2d_batch(A, b, relax_mask=None, *, max_relax: int = 64,
+                      tol: float = 1e-6):
+    """Native ``min ||x||^2 s.t. A x <= b`` over a batch.
+
+    Args: A (N, M, 2), b (N, M), relax_mask (N, M) or None — same contract
+    as :func:`cbf_tpu.solvers.exact2d.solve_qp_2d_batch`, including the
+    default feasibility tolerance (1e-6, the float64 ``_feas_tol`` there),
+    so feasibility flags and relax counts agree between the two.
+    Returns (x (N, 2), feasible (N,) bool, relax_rounds (N,), viol (N,)).
+    """
+    lib = _lib()
+    A = np.ascontiguousarray(A, np.float64)
+    b = np.ascontiguousarray(b, np.float64)
+    n, m = b.shape
+    if A.shape != (n, m, 2):
+        raise ValueError(f"A shape {A.shape} != {(n, m, 2)}")
+    if relax_mask is not None:
+        relax_mask = np.ascontiguousarray(relax_mask, np.float64)
+        if relax_mask.shape != (n, m):
+            raise ValueError(f"relax_mask shape {relax_mask.shape} != {(n, m)}")
+
+    x = np.empty((n, 2), np.float64)
+    feas = np.empty((n,), np.uint8)
+    rounds = np.empty((n,), np.float64)
+    viol = np.empty((n,), np.float64)
+
+    dp = ctypes.POINTER(ctypes.c_double)
+    lib.qp2d_solve_batch(
+        A.ctypes.data_as(dp), b.ctypes.data_as(dp),
+        relax_mask.ctypes.data_as(dp) if relax_mask is not None else None,
+        n, m, max_relax, tol,
+        x.ctypes.data_as(dp), feas.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        rounds.ctypes.data_as(dp), viol.ctypes.data_as(dp),
+    )
+    return x, feas.astype(bool), rounds, viol
+
+
+def qp_backend(A, b):
+    """Single-problem adapter matching the :class:`cbf_tpu.oracle.OracleCBF`
+    ``qp_backend`` signature: (A (M, 2), b (M,)) -> (x (2,), feasible).
+
+    Note: pass to OracleCBF to swap SLSQP for the native solver — the
+    oracle's own relax loop still drives retries (relaxation stays outside,
+    as with the default backend).
+    """
+    x, feas, _, _ = solve_qp_2d_batch(A[None], b[None])
+    return x[0], bool(feas[0])

@@ -11,3 +11,8 @@ from cbf_tpu.sim.certificates import (  # noqa: F401
     CertificateParams,
     si_barrier_certificate,
 )
+from cbf_tpu.sim.controllers import (  # noqa: F401
+    at_position,
+    si_position_controller,
+    unicycle_position_controller,
+)

@@ -1,0 +1,61 @@
+"""Position controllers — the ``rps.utilities.controllers`` surface.
+
+The reference imports this module wholesale (meet_at_center.py:16) but never
+calls it (SURVEY.md §2.6: "never used — no pose controllers in either
+scenario"); it is still part of the simulator API a user switching from the
+reference stack expects. Functional, batched forms of the two standard rps
+controllers [external — inferred from the rps API the reference installs]:
+
+- :func:`si_position_controller` — proportional single-integrator go-to-goal
+  with a velocity-magnitude cap.
+- :func:`unicycle_position_controller` — CLF-style unicycle go-to-goal:
+  drive speed by the projected distance, steer by the bearing error.
+
+Both map (state (·, N), goals (2, N)) -> commands (2, N) and are pure jnp —
+they compose with ``vmap``/``scan`` like every other control law here
+(cf. cbf_tpu.sim.graph consensus laws).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from cbf_tpu.utils.math import safe_norm
+
+
+def si_position_controller(x, goals, gain: float = 1.0,
+                           magnitude_limit: float = 0.15):
+    """Single-integrator P controller toward per-agent goals.
+
+    Args: x (2, N) positions; goals (2, N). Returns dxi (2, N), capped at
+    ``magnitude_limit`` per agent (preserving direction).
+    """
+    dxi = gain * (goals - x)
+    norms = safe_norm(dxi, axis=0)
+    scale = jnp.maximum(1.0, norms / magnitude_limit)
+    return dxi / scale[None, :]
+
+
+def unicycle_position_controller(poses, goals, linear_gain: float = 0.8,
+                                 angular_gain: float = 3.0):
+    """Unicycle go-to-goal: (3, N) poses, (2, N) goals -> (2, N) (v, omega).
+
+    v tracks the goal distance projected on the heading (reverses cleanly
+    when the goal is behind); omega steers down the wrapped bearing error.
+    """
+    dx = goals[0] - poses[0]
+    dy = goals[1] - poses[1]
+    theta = poses[2]
+    dist = safe_norm(jnp.stack([dx, dy]), axis=0)
+    bearing = jnp.arctan2(dy, dx)
+    err = jnp.arctan2(jnp.sin(bearing - theta), jnp.cos(bearing - theta))
+    v = linear_gain * dist * jnp.cos(err)
+    # At the goal the bearing (arctan2(0, 0)) is meaningless — command rest.
+    w = jnp.where(dist > 1e-6, angular_gain * err, 0.0)
+    return jnp.stack([v, w])
+
+
+def at_position(x, goals, position_error: float = 0.02):
+    """(N,) bool: which agents have reached their goals (rps
+    ``at_pose``/``at_position`` convergence check equivalent)."""
+    return safe_norm(goals - x, axis=0) < position_error

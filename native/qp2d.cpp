@@ -1,0 +1,139 @@
+// Native batched 2-D inequality-QP solver: the framework's host-side
+// counterpart of the reference's only native component (cvxopt's C
+// interior-point QP, reference cbf.py:2,81).
+//
+// Solves  min ||x||^2  s.t.  A x <= b  for a batch of problems with the
+// same KKT-enumeration algorithm as cbf_tpu/solvers/exact2d.py (origin +
+// single-row projections + pair intersections; dual-sign and primal
+// feasibility checks; +1 RHS relaxation of masked rows on infeasibility,
+// mirroring the reference's relax-retry policy at cbf.py:78-87) — but in
+// float64 on the host, for fast golden-trace generation and as an
+// independent implementation for parity tests.
+//
+// Rows whose squared norm is < 1e-12 are inactive padding (masked QP rows).
+//
+// Build: make (g++ -O2 -shared -fPIC). ABI: plain C, consumed via ctypes
+// (cbf_tpu/native).
+
+#include <cmath>
+#include <cstring>
+
+namespace {
+
+constexpr double kBig = 1e30;
+constexpr double kRowEps = 1e-12;
+constexpr double kDetEps = 1e-10;
+constexpr double kGramEps = 1e-20;
+
+struct Best {
+  double x0 = 0.0, x1 = 0.0;
+  double score = kBig;   // ||x||^2 among valid; viol among invalid
+  bool valid = false;
+  double viol = kBig;
+};
+
+// Max constraint violation of (x0, x1) over all rows.
+double violation(const double* A, const double* b, int m, double x0,
+                 double x1) {
+  double v = -kBig;
+  for (int i = 0; i < m; ++i) {
+    double r = A[2 * i] * x0 + A[2 * i + 1] * x1 - b[i];
+    if (r > v) v = r;
+  }
+  return v;
+}
+
+void consider(const double* A, const double* b, int m, double tol, double x0,
+              double x1, bool dual_ok, Best* best) {
+  double viol = violation(A, b, m, x0, x1);
+  if (dual_ok && viol <= tol) {
+    double n2 = x0 * x0 + x1 * x1;
+    if (!best->valid || n2 < best->score) {
+      best->valid = true;
+      best->score = n2;
+      best->x0 = x0;
+      best->x1 = x1;
+      best->viol = viol;
+    }
+  } else if (!best->valid && viol < best->viol) {
+    // No valid KKT point yet: track the least-violating candidate over ALL
+    // candidates (dual-infeasible included), matching the JAX
+    // enumeration's infeasible diagnostic (exact2d._project_batch_lanes).
+    best->x0 = x0;
+    best->x1 = x1;
+    best->viol = viol;
+  }
+}
+
+// One enumeration pass at a fixed relaxation. Returns whether a valid KKT
+// point was found; fills x/viol either way.
+bool enumerate_once(const double* A, const double* b, int m, double tol,
+                    double* x0, double* x1, double* viol) {
+  Best best;
+  consider(A, b, m, tol, 0.0, 0.0, true, &best);   // empty active set
+
+  for (int i = 0; i < m; ++i) {
+    double ax = A[2 * i], ay = A[2 * i + 1];
+    double n2 = ax * ax + ay * ay;
+    if (n2 < kRowEps) continue;
+    // Single active row i: x = a_i * b_i / |a_i|^2; lambda >= 0 iff b_i <= 0.
+    consider(A, b, m, tol, ax * b[i] / n2, ay * b[i] / n2, b[i] <= tol,
+             &best);
+    for (int j = i + 1; j < m; ++j) {
+      double bx = A[2 * j], by = A[2 * j + 1];
+      double m2 = bx * bx + by * by;
+      if (m2 < kRowEps) continue;
+      double det = ax * by - ay * bx;
+      if (std::fabs(det) <= kDetEps) continue;
+      double px = (by * b[i] - ay * b[j]) / det;
+      double py = (ax * b[j] - bx * b[i]) / det;
+      // Dual signs from the 2x2 Gram system.
+      double gij = ax * bx + ay * by;
+      double detG = n2 * m2 - gij * gij;
+      if (std::fabs(detG) <= kGramEps) continue;
+      double lam_i = (-b[i] * m2 + b[j] * gij) / detG;
+      double lam_j = (-b[j] * n2 + b[i] * gij) / detG;
+      consider(A, b, m, tol, px, py, lam_i >= -tol && lam_j >= -tol, &best);
+    }
+  }
+  *x0 = best.x0;
+  *x1 = best.x1;
+  *viol = best.viol;
+  return best.valid;
+}
+
+}  // namespace
+
+extern "C" {
+
+// A: n*m*2 row-major, b: n*m, relax: n*m (may be null = no relaxation).
+// Outputs: x n*2, feasible n (0/1), relax_rounds n, viol n.
+void qp2d_solve_batch(const double* A, const double* b, const double* relax,
+                      int n, int m, int max_relax, double tol, double* x,
+                      unsigned char* feasible, double* relax_rounds,
+                      double* viol) {
+  double* brow = new double[m];
+  for (int p = 0; p < n; ++p) {
+    const double* Ap = A + static_cast<long>(p) * m * 2;
+    const double* bp = b + static_cast<long>(p) * m;
+    const double* rp = relax ? relax + static_cast<long>(p) * m : nullptr;
+    double t = 0.0;
+    bool found = false;
+    double vx = 0.0, vy = 0.0, vv = kBig;
+    std::memcpy(brow, bp, sizeof(double) * m);
+    for (;;) {
+      found = enumerate_once(Ap, brow, m, tol, &vx, &vy, &vv);
+      if (found || !rp || t >= max_relax) break;
+      t += 1.0;
+      for (int i = 0; i < m; ++i) brow[i] = bp[i] + t * rp[i];
+    }
+    x[2 * p] = vx;
+    x[2 * p + 1] = vy;
+    feasible[p] = found ? 1 : 0;
+    relax_rounds[p] = t;
+    viol[p] = vv;
+  }
+  delete[] brow;
+}
+
+}  // extern "C"

@@ -1,0 +1,41 @@
+"""Position controllers (rps.utilities.controllers surface — imported by the
+reference at meet_at_center.py:16, provided for simulator-API completeness)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cbf_tpu.sim import (at_position, si_position_controller,
+                         unicycle_position_controller, unicycle_step)
+
+
+def test_si_controller_converges():
+    x = jnp.array([[1.0, -0.5], [0.5, 0.8]])
+    goals = jnp.zeros((2, 2))
+    for _ in range(500):
+        x = x + 0.033 * si_position_controller(x, goals)
+    assert bool(at_position(x, goals, 0.05).all())
+
+
+def test_si_controller_magnitude_cap():
+    x = jnp.array([[10.0], [0.0]])
+    dxi = si_position_controller(x, jnp.zeros((2, 1)), magnitude_limit=0.15)
+    np.testing.assert_allclose(float(jnp.linalg.norm(dxi)), 0.15, rtol=1e-5)
+
+
+def test_unicycle_controller_reaches_goal():
+    poses = jnp.array([[-1.0], [0.3], [2.5]])      # facing away-ish
+    goals = jnp.array([[0.8], [-0.4]])
+
+    def body(poses, _):
+        dxu = unicycle_position_controller(poses, goals)
+        return unicycle_step(poses, dxu), ()
+
+    poses, _ = jax.lax.scan(body, poses, None, length=1500)
+    assert bool(at_position(poses[:2], goals, 0.05).all())
+
+
+def test_unicycle_controller_zero_at_goal():
+    poses = jnp.array([[0.5], [0.5], [1.0]])
+    dxu = unicycle_position_controller(poses, poses[:2])
+    np.testing.assert_allclose(np.asarray(dxu), 0.0, atol=1e-6)

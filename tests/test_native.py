@@ -1,0 +1,88 @@
+"""Native C++ QP solver: three-way parity (C++ vs JAX enumeration vs SLSQP
+oracle) and batch throughput sanity. Skipped when no toolchain."""
+
+import numpy as np
+import pytest
+
+from cbf_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+def _random_problems(rng, n, m):
+    A = rng.normal(0, 1.0, (n, m, 2))
+    b = rng.normal(0.5, 1.0, (n, m))
+    # Zero out some rows as padding.
+    pad = rng.uniform(size=(n, m)) < 0.2
+    A[pad] = 0.0
+    b[pad] = 0.0
+    return A, b
+
+
+def test_parity_vs_jax_enumeration(rng):
+    import jax
+    from cbf_tpu.solvers.exact2d import solve_qp_2d_batch
+
+    with jax.enable_x64(True):
+        A, b = _random_problems(rng, 200, 10)
+        x_n, feas_n, rounds_n, _ = native.solve_qp_2d_batch(A, b)
+        x_j, info = solve_qp_2d_batch(A, b)
+        np.testing.assert_array_equal(feas_n, np.asarray(info.feasible))
+        ok = feas_n
+        np.testing.assert_allclose(x_n[ok], np.asarray(x_j)[ok], atol=1e-8)
+
+
+def test_parity_vs_slsqp_oracle(rng):
+    from cbf_tpu.oracle.reference_filter import solve_qp_slsqp
+
+    A, b = _random_problems(rng, 50, 6)
+    x_n, feas_n, _, _ = native.solve_qp_2d_batch(A, b)
+    for i in range(50):
+        x_s, feas_s = solve_qp_slsqp(A[i], b[i])
+        if feas_n[i] and feas_s:
+            np.testing.assert_allclose(x_n[i], x_s, atol=1e-5)
+
+
+def test_relaxation_policy(rng):
+    # x <= -1 and -x <= -1 is infeasible; one +1 round opens it up.
+    A = np.array([[[1.0, 0.0], [-1.0, 0.0]]])
+    b = np.array([[-1.0, -1.0]])
+    relax = np.ones((1, 2))
+    x, feas, rounds, viol = native.solve_qp_2d_batch(A, b, relax)
+    assert feas[0] and rounds[0] == 1.0
+    np.testing.assert_allclose(x[0], [0.0, 0.0], atol=1e-12)
+
+    # Without a relax mask it stays infeasible.
+    x2, feas2, _, _ = native.solve_qp_2d_batch(A, b)
+    assert not feas2[0]
+
+
+def test_oracle_backend_swap(rng):
+    """OracleCBF produces the same filtered control with the native backend
+    as with SLSQP — the reference-semantics path is backend-agnostic."""
+    from cbf_tpu.oracle.reference_filter import OracleCBF
+
+    f = 0.1 * np.zeros((4, 4))
+    g = 0.1 * np.array([[1.0, 0], [0, 1], [0, 0], [0, 0]])
+    o_slsqp = OracleCBF(15.0)
+    o_native = OracleCBF(15.0, qp_backend=native.qp_backend)
+    for _ in range(20):
+        rs = rng.normal(0, 0.3, 4)
+        obs = rng.normal(0, 0.3, (3, 4))
+        u0 = rng.normal(0, 0.2, 2)
+        u1 = o_slsqp.get_safe_control(rs, obs, f, g, u0)
+        u2 = o_native.get_safe_control(rs, obs, f, g, u0)
+        np.testing.assert_allclose(u1, u2, atol=1e-5)
+
+
+def test_batch_throughput(rng):
+    import time
+
+    A, b = _random_problems(rng, 20000, 16)
+    t0 = time.perf_counter()
+    x, feas, _, _ = native.solve_qp_2d_batch(A, b)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(x).all()
+    # Far looser than reality (~1e6/s) — just catches pathological builds.
+    assert 20000 / dt > 50000
