@@ -1,0 +1,354 @@
+"""Drop-in migration layer: the reference's object API over the JAX kernels.
+
+The reference stack exposes two object surfaces a migrating user has code
+against: the ``ControlBarrierFunction`` class (reference: cbf.py:5-92) and the
+rps Robotarium simulator API it installs (consumed surface catalogued in
+SURVEY.md §2.6 — ``Robotarium`` container, ``create_si_to_uni_mapping``,
+``create_single_integrator_barrier_certificate_with_boundary``, ``completeGL``,
+``topological_neighbors``, ``determine_marker_size``, position controllers).
+This module provides every one of those names with the reference's calling
+conventions, each delegating to the framework's batched JAX implementations:
+
+    from cbf_tpu.compat import (
+        ControlBarrierFunction, Robotarium, completeGL,
+        topological_neighbors, create_si_to_uni_mapping,
+        create_single_integrator_barrier_certificate_with_boundary,
+    )
+
+    c = ControlBarrierFunction(15)                 # cbf.py-style filter
+    r = Robotarium(number_of_robots=10, initial_conditions=ic)
+    x = r.get_poses(); r.set_velocities(ids, dxu); r.step()
+
+Numpy arrays in, numpy arrays out; every call crosses the host↔device
+boundary, so this layer is for migration and small-N interactive scripts.
+The TPU-fast path is the functional stack (``cbf_tpu.safe_controls`` +
+``cbf_tpu.rollout``), where agents batch under ``vmap`` and whole rollouts
+fuse under ``lax.scan``.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cbf_tpu.core.filter import CBFParams, safe_control
+from cbf_tpu.render.video import determine_marker_size as _marker_size_ax
+from cbf_tpu.sim.certificates import CertificateParams, si_barrier_certificate
+from cbf_tpu.sim.controllers import (
+    si_position_controller,
+    unicycle_position_controller,
+)
+from cbf_tpu.sim.graph import complete_gl
+from cbf_tpu.sim.robotarium import ARENA, SimParams, unicycle_step
+from cbf_tpu.sim.transformations import si_to_uni_dyn, uni_to_si_states
+
+# Module-level jit wrappers (shared compilation cache across instances and
+# factory calls; all tunables are dynamic leaves, so each compiles once per
+# shape). ``si_to_uni_dyn``'s angular clamp folded in here.
+_STEP = jax.jit(unicycle_step)
+_CERT = jax.jit(si_barrier_certificate)
+_SI_POS = jax.jit(si_position_controller)
+_UNI_POS = jax.jit(unicycle_position_controller)
+_UNI_TO_SI = jax.jit(uni_to_si_states)
+
+
+@jax.jit
+def _si_to_uni_clamped(dxi, poses, projection_distance, angular_velocity_limit):
+    dxu = si_to_uni_dyn(dxi, poses, projection_distance)
+    w = jnp.clip(dxu[1], -angular_velocity_limit, angular_velocity_limit)
+    return dxu.at[1].set(w)
+
+
+class ControlBarrierFunction:
+    """Reference-interface CBF filter (cbf.py:5-16) on the JAX kernel.
+
+    Constructor signature matches cbf.py:6-16: ``max_speed`` required (the
+    scenarios pass 15 — meet_at_center.py:25), ``dmin=0.2``, ``k=1``;
+    ``gamma = 0.5`` is hard-coded exactly as the reference hard-codes it
+    (cbf.py:16).
+    """
+
+    def __init__(self, max_speed, dmin=0.2, k=1.0):
+        self.max_speed = float(max_speed)
+        self.dmin = float(dmin)
+        self.k = float(k)
+        self.gamma = 0.5
+        self.last_info = None   # QPInfo diagnostics of the most recent call
+
+    def get_safe_control(self, robot_state, obs_states, f, g, u0):
+        """Filtered control for one agent (cbf.py:18-92 contract).
+
+        Args mirror the reference: ``robot_state`` (4,) = (x, y, vx, vy),
+        ``obs_states`` sequence of (4,) danger states, ``f`` (4, 4) /
+        ``g`` (4, 2) affine dynamics, ``u0`` (2,) nominal control. Returns a
+        numpy (2,) filtered control; infeasibility is handled by the bounded
+        +1-relaxation equivalent of cbf.py:78-87 (rounds surfaced in
+        ``self.last_info``).
+        """
+        robot_state = np.asarray(robot_state, np.float32).reshape(4)
+        obs = np.asarray(obs_states, np.float32).reshape(-1, 4)
+        u0 = np.asarray(u0, np.float32).reshape(2)
+        m = obs.shape[0]
+        # Pad the obstacle axis to a power-of-two bucket so repeated calls
+        # with drifting danger counts (meet_at_center.py:124-133) reuse a
+        # handful of compiled programs instead of one per m.
+        K = max(1, 1 << (m - 1).bit_length()) if m else 1
+        obs_pad = np.zeros((K, 4), np.float32)
+        obs_pad[:m] = obs
+        mask = np.zeros(K, bool)
+        mask[:m] = True
+        u, info = safe_control(
+            jnp.asarray(robot_state), jnp.asarray(obs_pad), jnp.asarray(mask),
+            jnp.asarray(f, jnp.float32), jnp.asarray(g, jnp.float32),
+            jnp.asarray(u0),
+            CBFParams(self.max_speed, self.dmin, self.k, self.gamma),
+        )
+        self.last_info = jax.tree.map(np.asarray, info)
+        return np.asarray(u)
+
+
+class Robotarium:
+    """Stateful rps-style sim container over the functional unicycle core.
+
+    Implements the exact surface the reference scripts drive
+    (meet_at_center.py:51,79,151,153,159; cross_and_rescue.py:59,63-65,96 —
+    SURVEY.md §2.6): ``get_poses`` → ``set_velocities`` → ``step`` with the
+    one-``get_poses``-per-step discipline the rps original enforces, actuator
+    saturation in wheel space, a 0.033 s tick, optional live matplotlib
+    rendering (``show_figure``) and wall-clock pacing (``sim_in_real_time``).
+    ``.figure`` / ``.axes`` are real matplotlib handles (created lazily when
+    headless) so scenario code that scatters custom markers on them
+    (cross_and_rescue.py:63-65) works unchanged.
+    """
+
+    def __init__(self, number_of_robots=-1, show_figure=False,
+                 sim_in_real_time=False, initial_conditions=None,
+                 sim_params: SimParams = SimParams()):
+        ic = np.asarray(initial_conditions if initial_conditions is not None
+                        else [], np.float32)
+        if ic.size:
+            poses = ic.reshape(3, -1).astype(np.float32)
+            if number_of_robots not in (-1, None) \
+                    and poses.shape[1] != number_of_robots:
+                raise ValueError(
+                    f"initial_conditions provide {poses.shape[1]} robots, "
+                    f"number_of_robots={number_of_robots}")
+        else:
+            if number_of_robots in (-1, None):
+                raise ValueError("need number_of_robots or initial_conditions")
+            poses = self._random_poses(number_of_robots)
+        self.number_of_robots = poses.shape[1]
+        self.params = sim_params
+        self.show_figure = bool(show_figure)
+        self.sim_in_real_time = bool(sim_in_real_time)
+
+        self._poses = poses
+        self._velocities = np.zeros((2, self.number_of_robots), np.float32)
+        self._poses_read = False
+
+        self._figure = None
+        self._axes = None
+        self._robot_markers = None
+        self._steps = 0
+        self._t_start = time.time()
+        self._last_step_wall = self._t_start
+        self._min_pairwise = math.inf
+        if self.show_figure:
+            self._init_figure()
+
+    # -- figure ------------------------------------------------------------
+    def _init_figure(self):
+        import matplotlib
+        if not self.show_figure:
+            matplotlib.use("Agg", force=False)
+        import matplotlib.pyplot as plt
+
+        self._figure, self._axes = plt.subplots(figsize=(6.4, 4.0))
+        xmin, xmax, ymin, ymax = ARENA
+        self._axes.set_xlim(xmin, xmax)
+        self._axes.set_ylim(ymin, ymax)
+        self._axes.set_aspect("equal")
+        s = determine_marker_size(self, 0.06)
+        self._robot_markers = self._axes.scatter(
+            self._poses[0], self._poses[1], s=s, marker="o", zorder=3)
+        if self.show_figure:
+            plt.ion()
+            plt.show(block=False)
+
+    @property
+    def figure(self):
+        if self._figure is None:
+            self._init_figure()
+        return self._figure
+
+    @property
+    def axes(self):
+        if self._axes is None:
+            self._init_figure()
+        return self._axes
+
+    # -- rps contract ------------------------------------------------------
+    def _random_poses(self, n):
+        rng = np.random.default_rng()
+        xmin, xmax, ymin, ymax = ARENA
+        return np.stack([
+            rng.uniform(xmin + 0.1, xmax - 0.1, n),
+            rng.uniform(ymin + 0.1, ymax - 0.1, n),
+            rng.uniform(-np.pi, np.pi, n),
+        ]).astype(np.float32)
+
+    def get_poses(self):
+        """3×N (x, y, θ) poses; exactly one call per step() (rps rule)."""
+        if self._poses_read:
+            raise RuntimeError(
+                "get_poses() already called this step; call step() first "
+                "(the rps Robotarium enforces the same discipline)")
+        self._poses_read = True
+        return self._poses.copy()
+
+    def set_velocities(self, ids, velocities):
+        """Stage 2×N unicycle commands (v, ω) (meet_at_center.py:151).
+
+        ``ids`` is accepted for signature parity; like the rps original in
+        the reference's usage, the full 2×N array addresses all robots.
+        """
+        del ids
+        v = np.asarray(velocities, np.float32)
+        if v.shape != (2, self.number_of_robots):
+            raise ValueError(
+                f"velocities must be (2, {self.number_of_robots}), "
+                f"got {v.shape}")
+        self._velocities = v.copy()  # callers may reuse/mutate their buffer
+
+    def step(self):
+        """Advance one dt tick: saturate, integrate, render, pace."""
+        if not self._poses_read:
+            raise RuntimeError(
+                "call get_poses() before step() (rps discipline)")
+        self._poses = np.asarray(
+            _STEP(jnp.asarray(self._poses), jnp.asarray(self._velocities),
+                  self.params),
+            np.float32)
+        self._steps += 1
+        self._poses_read = False
+
+        if self.number_of_robots > 1:
+            d = self._poses[:2, :, None] - self._poses[:2, None, :]
+            dist = np.sqrt((d ** 2).sum(0))
+            np.fill_diagonal(dist, np.inf)
+            self._min_pairwise = min(self._min_pairwise, float(dist.min()))
+
+        if self._robot_markers is not None:
+            self._robot_markers.set_offsets(self._poses[:2].T)
+            if self.show_figure:
+                self._figure.canvas.draw_idle()
+                self._figure.canvas.flush_events()
+
+        if self.sim_in_real_time:
+            now = time.time()
+            sleep = float(self.params.dt) - (now - self._last_step_wall)
+            if sleep > 0:
+                time.sleep(sleep)
+        self._last_step_wall = time.time()
+
+    def call_at_scripts_end(self):
+        """End-of-run diagnostics hook (meet_at_center.py:159)."""
+        wall = time.time() - self._t_start
+        md = self._min_pairwise if self._min_pairwise < math.inf else float("nan")
+        print(f"cbf_tpu.compat.Robotarium: {self._steps} steps "
+              f"({self._steps * float(self.params.dt):.1f} sim-s) in "
+              f"{wall:.1f} wall-s; {self.number_of_robots} robots; "
+              f"min inter-robot distance {md:.4f} m")
+
+
+# -- rps utility factories -------------------------------------------------
+
+def completeGL(n):
+    """Complete-graph Laplacian (rps name; meet_at_center.py:74)."""
+    return complete_gl(int(n))
+
+
+def topological_neighbors(L, agent):
+    """Neighbor index array of ``agent`` from Laplacian row nonzeros
+    (meet_at_center.py:88,101 semantics: any nonzero off-diagonal entry)."""
+    L = np.asarray(L)
+    row = L[int(agent)].copy()
+    row[int(agent)] = 0.0
+    return np.nonzero(row)[0]
+
+
+def create_si_to_uni_mapping(projection_distance=0.05,
+                             angular_velocity_limit=np.pi):
+    """(si_to_uni_dyn, uni_to_si_states) closure pair (meet_at_center.py:61).
+
+    Near-identity diffeomorphism through a point ``projection_distance``
+    ahead of the wheel axis, with an angular-rate clamp [external — inferred
+    from usage; SURVEY.md §2.6].
+    """
+    def _si_to_uni(dxi, poses):
+        return np.asarray(_si_to_uni_clamped(
+            jnp.asarray(dxi, jnp.float32), jnp.asarray(poses, jnp.float32),
+            float(projection_distance), float(angular_velocity_limit)))
+
+    def _uni_to_si(poses):
+        return np.asarray(_UNI_TO_SI(
+            jnp.asarray(poses, jnp.float32), float(projection_distance)))
+
+    return _si_to_uni, _uni_to_si
+
+
+def create_single_integrator_barrier_certificate_with_boundary(
+        barrier_gain=100.0, safety_radius=0.17, magnitude_limit=0.2):
+    """Joint all-agent min-deviation certificate QP factory
+    (created meet_at_center.py:58, applied cross_and_rescue.py:163).
+
+    Returns ``cert(dxi, x) -> dxi`` enforcing pairwise distance ≥
+    safety_radius plus arena-boundary rows, solved by the batched ADMM
+    backend inside one jitted XLA program (the rps original calls a host QP
+    solver per step).
+    """
+    params = CertificateParams(float(barrier_gain), float(safety_radius),
+                               float(magnitude_limit))
+
+    def cert(dxi, x):
+        return np.asarray(_CERT(jnp.asarray(dxi, jnp.float32),
+                                jnp.asarray(x, jnp.float32), params))
+
+    return cert
+
+
+def create_si_position_controller(velocity_magnitude_limit=0.15, gain=1.0):
+    """P go-to-goal factory (rps.utilities.controllers surface — imported by
+    the reference at meet_at_center.py:16, never called)."""
+    def controller(x, positions):
+        return np.asarray(_SI_POS(jnp.asarray(x, jnp.float32)[:2],
+                                  jnp.asarray(positions, jnp.float32)[:2],
+                                  float(gain),
+                                  float(velocity_magnitude_limit)))
+
+    return controller
+
+
+def create_clf_unicycle_position_controller(linear_velocity_gain=0.8,
+                                            angular_velocity_gain=3.0):
+    """CLF unicycle go-to-goal factory (rps controllers surface)."""
+    def controller(poses, positions):
+        return np.asarray(_UNI_POS(jnp.asarray(poses, jnp.float32),
+                                   jnp.asarray(positions, jnp.float32)[:2],
+                                   float(linear_velocity_gain),
+                                   float(angular_velocity_gain)))
+
+    return controller
+
+
+def determine_marker_size(robotarium_or_axes, marker_size_meters):
+    """Meters → matplotlib scatter points² (cross_and_rescue.py:62).
+
+    Accepts a :class:`Robotarium` (rps calling convention) or a bare axes.
+    """
+    ax = getattr(robotarium_or_axes, "axes", robotarium_or_axes)
+    return _marker_size_ax(ax, float(marker_size_meters))

@@ -1,0 +1,167 @@
+"""The migration layer (cbf_tpu.compat) honors the reference's object API.
+
+Checks the drop-in ``ControlBarrierFunction`` against the float64 oracle, the
+``Robotarium`` container's rps calling discipline, and the rps utility
+factories' semantics (SURVEY.md §2.6 consumed-surface table).
+"""
+
+import numpy as np
+import pytest
+
+from cbf_tpu import compat
+from cbf_tpu.oracle.reference_filter import OracleCBF
+
+# Scenario dynamics (reference: meet_at_center.py:26-27).
+FX = 0.1 * np.zeros((4, 4))
+GX = 0.1 * np.array([[1.0, 0], [0, 1.0], [0, 0], [0, 0]])
+
+
+def test_control_barrier_function_matches_oracle(rng):
+    """Drop-in class reproduces the reference filter across random cases."""
+    c = compat.ControlBarrierFunction(15)
+    oracle = OracleCBF(max_speed=15)
+    assert c.gamma == 0.5   # hard-coded like cbf.py:16
+    for _ in range(12):
+        m = int(rng.integers(1, 6))
+        robot = rng.uniform(-1, 1, 4)
+        obs = robot[None, :] + rng.uniform(-0.15, 0.15, (m, 4))
+        u0 = rng.uniform(-0.2, 0.2, 2)
+        u = c.get_safe_control(robot, list(obs), FX, GX, u0)
+        u_ref = oracle.get_safe_control(robot, obs, FX, GX, u0)
+        np.testing.assert_allclose(u, u_ref, atol=2e-4)
+        assert c.last_info is not None
+
+
+def test_control_barrier_function_accepts_column_vectors():
+    c = compat.ControlBarrierFunction(15)
+    u = c.get_safe_control(
+        np.array([[0.1], [0.1], [0.0], [0.0]]),
+        [np.array([[0.15], [0.1], [0.0], [0.0]])],
+        FX, GX, np.array([[0.1], [0.0]]))
+    assert u.shape == (2,)
+    assert np.all(np.isfinite(u))
+
+
+def test_robotarium_contract():
+    ic = np.array([[0.0, 0.5], [0.0, 0.0], [0.0, np.pi]])
+    r = compat.Robotarium(number_of_robots=2, initial_conditions=ic)
+    x = r.get_poses()
+    np.testing.assert_allclose(x, ic, atol=1e-6)
+    # rps discipline: one get_poses per step.
+    with pytest.raises(RuntimeError):
+        r.get_poses()
+    r.set_velocities(np.arange(2), np.array([[0.1, 0.1], [0.0, 0.0]]))
+    r.step()
+    x2 = r.get_poses()
+    # Robot 0 heads +x, robot 1 (theta=pi) heads -x.
+    assert x2[0, 0] > x[0, 0]
+    assert x2[0, 1] < x[0, 1]
+    r.step()
+    with pytest.raises(RuntimeError):  # step without get_poses
+        r.step()
+    r.call_at_scripts_end()
+
+
+def test_robotarium_rejects_bad_shapes():
+    with pytest.raises(ValueError):
+        compat.Robotarium()  # neither count nor initial conditions
+    r = compat.Robotarium(number_of_robots=3)
+    with pytest.raises(ValueError):
+        r.set_velocities(np.arange(3), np.zeros((2, 4)))
+
+
+def test_robotarium_axes_headless():
+    r = compat.Robotarium(number_of_robots=1,
+                          initial_conditions=np.zeros((3, 1)))
+    ax = r.axes          # lazily created, matplotlib Agg
+    assert r.figure is not None
+    s = compat.determine_marker_size(r, 0.05)
+    assert s > 0
+    # Also accepts a bare axes (framework convention).
+    assert compat.determine_marker_size(ax, 0.05) == s
+
+
+def test_graph_utilities():
+    L = compat.completeGL(4)
+    assert L.shape == (4, 4)
+    np.testing.assert_allclose(np.diag(L), 3.0)
+    nbrs = compat.topological_neighbors(L, 2)
+    np.testing.assert_array_equal(nbrs, [0, 1, 3])
+    ring = -np.eye(3)
+    ring[0, 1] = ring[1, 2] = ring[2, 0] = 1.0
+    np.testing.assert_array_equal(compat.topological_neighbors(ring, 0), [1])
+
+
+def test_si_uni_mapping_roundtrip():
+    si_to_uni, uni_to_si = compat.create_si_to_uni_mapping()
+    poses = np.array([[0.0], [0.0], [0.0]])
+    p = uni_to_si(poses)
+    np.testing.assert_allclose(p[:, 0], [0.05, 0.0], atol=1e-6)
+    dxu = si_to_uni(np.array([[0.1], [0.0]]), poses)
+    np.testing.assert_allclose(dxu[:, 0], [0.1, 0.0], atol=1e-6)
+    # Angular clamp engages for sideways commands near the limit.
+    dxu = si_to_uni(np.array([[0.0], [1.0]]), poses)
+    assert abs(dxu[1, 0]) <= np.pi + 1e-5
+
+
+def test_certificate_factory_far_apart_is_identity():
+    cert = compat.create_single_integrator_barrier_certificate_with_boundary(
+        safety_radius=0.12)
+    x = np.array([[-0.5, 0.5], [0.0, 0.0]])
+    dxi = np.array([[0.05, -0.05], [0.0, 0.0]])
+    out = cert(dxi, x)
+    np.testing.assert_allclose(out, dxi, atol=5e-3)
+
+
+def test_position_controller_factories():
+    si = compat.create_si_position_controller()
+    x = np.zeros((2, 3))
+    goals = np.array([[1.0, -1.0, 0.0], [0.0, 0.0, 0.0]])
+    dxi = si(x, goals)
+    assert dxi.shape == (2, 3)
+    assert dxi[0, 0] > 0 and dxi[0, 1] < 0
+    uni = compat.create_clf_unicycle_position_controller()
+    dxu = uni(np.zeros((3, 3)), goals)
+    assert dxu.shape == (2, 3)
+
+
+def test_reference_style_script_end_to_end():
+    """A meet_at_center-shaped loop written purely against compat names
+    (the migration smoke test: reference script structure, zero edits
+    beyond imports)."""
+    N = 4
+    theta0 = np.linspace(0, 2 * np.pi, N, endpoint=False)
+    ic = np.stack([0.6 * np.cos(theta0), 0.6 * np.sin(theta0),
+                   np.zeros(N)])
+    r = compat.Robotarium(number_of_robots=N, initial_conditions=ic)
+    c = compat.ControlBarrierFunction(15)
+    si_to_uni, uni_to_si = compat.create_si_to_uni_mapping()
+    cert = compat.create_single_integrator_barrier_certificate_with_boundary(
+        safety_radius=0.12)
+    L = compat.completeGL(N)
+
+    for _ in range(15):
+        x = r.get_poses()
+        x_si = uni_to_si(x)
+        dxi = np.zeros((2, N), np.float32)
+        for i in range(N):
+            for j in compat.topological_neighbors(L, i):
+                dxi[:, i] += x_si[:, j] - x_si[:, i]
+        dxi *= 0.05
+        states = np.concatenate([x_si, dxi]).T          # (N, 4) like :114
+        for i in range(N):
+            danger = [states[j] for j in range(N)
+                      if j != i
+                      and np.linalg.norm(states[j, :2] - states[i, :2]) < 0.2]
+            if danger:
+                dxi[:, i] = c.get_safe_control(states[i], danger, FX, GX,
+                                               dxi[:, i])
+        dxi = cert(dxi, x_si)
+        r.set_velocities(np.arange(N), si_to_uni(dxi, x))
+        r.step()
+    xf = r.get_poses()
+    assert np.all(np.isfinite(xf))
+    # Consensus contracts the circle.
+    assert np.linalg.norm(xf[:2], axis=0).mean() \
+        < np.linalg.norm(ic[:2], axis=0).mean()
+    r.call_at_scripts_end()
