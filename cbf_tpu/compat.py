@@ -20,10 +20,12 @@ conventions, each delegating to the framework's batched JAX implementations:
     x = r.get_poses(); r.set_velocities(ids, dxu); r.step()
 
 Numpy arrays in, numpy arrays out; every call crosses the host↔device
-boundary, so this layer is for migration and small-N interactive scripts.
-The TPU-fast path is the functional stack (``cbf_tpu.safe_controls`` +
-``cbf_tpu.rollout``), where agents batch under ``vmap`` and whole rollouts
-fuse under ``lax.scan``.
+boundary, so this layer is for migration and small-N interactive scripts —
+run it on host CPU (``jax.config.update("jax_platforms", "cpu")`` before
+first use; see examples/) where per-call dispatch is microseconds, not
+tunneled-accelerator round-trips. The TPU-fast path is the functional stack
+(``cbf_tpu.safe_controls`` + ``cbf_tpu.rollout``), where agents batch under
+``vmap`` and whole rollouts fuse under ``lax.scan``.
 """
 
 from __future__ import annotations
@@ -192,14 +194,27 @@ class Robotarium:
         return self._axes
 
     # -- rps contract ------------------------------------------------------
-    def _random_poses(self, n):
+    def _random_poses(self, n, min_spacing=0.2):
+        """Uniform poses with pairwise min-spacing rejection, so robots never
+        spawn already violating the certificate radius (matching the rps
+        generator's spaced initial conditions [external — inferred])."""
         rng = np.random.default_rng()
         xmin, xmax, ymin, ymax = ARENA
-        return np.stack([
-            rng.uniform(xmin + 0.1, xmax - 0.1, n),
-            rng.uniform(ymin + 0.1, ymax - 0.1, n),
-            rng.uniform(-np.pi, np.pi, n),
-        ]).astype(np.float32)
+        pts = np.empty((2, 0))
+        for _ in range(1000):
+            cand = np.stack([rng.uniform(xmin + 0.1, xmax - 0.1),
+                             rng.uniform(ymin + 0.1, ymax - 0.1)])[:, None]
+            if pts.shape[1] == 0 or \
+                    np.min(np.linalg.norm(pts - cand, axis=0)) >= min_spacing:
+                pts = np.concatenate([pts, cand], axis=1)
+                if pts.shape[1] == n:
+                    break
+        else:
+            raise RuntimeError(
+                f"could not place {n} robots {min_spacing} m apart in the "
+                "arena; pass initial_conditions")
+        return np.concatenate(
+            [pts, rng.uniform(-np.pi, np.pi, (1, n))]).astype(np.float32)
 
     def get_poses(self):
         """3×N (x, y, θ) poses; exactly one call per step() (rps rule)."""
@@ -321,14 +336,22 @@ def create_single_integrator_barrier_certificate_with_boundary(
     return cert
 
 
-def create_si_position_controller(velocity_magnitude_limit=0.15, gain=1.0):
+def create_si_position_controller(x_velocity_gain=1.0, y_velocity_gain=1.0,
+                                  velocity_magnitude_limit=0.15):
     """P go-to-goal factory (rps.utilities.controllers surface — imported by
-    the reference at meet_at_center.py:16, never called)."""
+    the reference at meet_at_center.py:16, never called). Signature follows
+    the rps original's per-axis gains [external — inferred; SURVEY.md §2.6].
+    """
+    gains = np.array([[float(x_velocity_gain)], [float(y_velocity_gain)]],
+                     np.float32)
+
     def controller(x, positions):
-        return np.asarray(_SI_POS(jnp.asarray(x, jnp.float32)[:2],
-                                  jnp.asarray(positions, jnp.float32)[:2],
-                                  float(gain),
-                                  float(velocity_magnitude_limit)))
+        x = jnp.asarray(x, jnp.float32)[:2]
+        goals = jnp.asarray(positions, jnp.float32)[:2]
+        # Per-axis gain == unit-gain controller on gain-scaled error.
+        dxi = _SI_POS(jnp.zeros_like(x), gains * (goals - x), 1.0,
+                      float(velocity_magnitude_limit))
+        return np.asarray(dxi)
 
     return controller
 

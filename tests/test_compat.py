@@ -120,9 +120,25 @@ def test_position_controller_factories():
     dxi = si(x, goals)
     assert dxi.shape == (2, 3)
     assert dxi[0, 0] > 0 and dxi[0, 1] < 0
+    # Per-axis gains (rps signature): under the cap, y gain doubles v_y.
+    si2 = compat.create_si_position_controller(1.0, 2.0,
+                                               velocity_magnitude_limit=10.0)
+    near = np.array([[0.0], [0.0]])
+    g = np.array([[0.03], [0.03]])
+    d = si2(near, g)
+    np.testing.assert_allclose(d[1, 0], 2.0 * d[0, 0], rtol=1e-5)
     uni = compat.create_clf_unicycle_position_controller()
     dxu = uni(np.zeros((3, 3)), goals)
     assert dxu.shape == (2, 3)
+
+
+def test_random_poses_are_spaced():
+    r = compat.Robotarium(number_of_robots=12)
+    x = r.get_poses()
+    d = x[:2, :, None] - x[:2, None, :]
+    dist = np.sqrt((d ** 2).sum(0))
+    np.fill_diagonal(dist, np.inf)
+    assert dist.min() >= 0.2
 
 
 def test_reference_style_script_end_to_end():

@@ -1,0 +1,32 @@
+"""The migration example scripts (examples/) run end-to-end."""
+
+import importlib.util
+import os
+
+import numpy as np
+
+_EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_EXAMPLES, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_meet_at_center_compat_runs():
+    mod = _load("meet_at_center_compat")
+    final = mod.main(steps=25)
+    assert final.shape == (3, 10)
+    assert np.all(np.isfinite(final))
+
+
+def test_cross_and_rescue_compat_runs(tmp_path):
+    mod = _load("cross_and_rescue_compat")
+    final = mod.main(steps=25, video=str(tmp_path / "v.gif"))
+    assert final.shape == (3, 4)
+    assert np.all(np.isfinite(final))
+    assert (tmp_path / "v.gif").exists()
