@@ -1,0 +1,101 @@
+"""Multi-host execution: the framework's NCCL/MPI-backend equivalent.
+
+The reference is single-process with no distributed story (SURVEY.md §2.7);
+the TPU-native counterpart of a NCCL/MPI communication backend is JAX's
+distributed runtime + XLA collectives: every process calls
+:func:`initialize`, after which ``jax.devices()`` spans all hosts and the
+exact same mesh/shard_map code from cbf_tpu.parallel runs unchanged —
+collectives ride ICI within a slice, DCN (or Gloo on CPU) across hosts.
+
+Typical pod usage (one process per host)::
+
+    from cbf_tpu.parallel import multihost
+    multihost.initialize()                  # env/TPU autodetection
+    mesh = multihost.global_mesh(n_sp=4)    # dp x sp over ALL hosts' chips
+    x0 = multihost.shard_host_ensembles(mesh, local_x0)   # per-host feed
+    (xf, vf), metrics = sharded_swarm_rollout(cfg, mesh, seeds, ...)
+
+Tested for real in tests/test_multihost.py: two OS processes, Gloo
+collectives over CPU devices, one global mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from cbf_tpu.parallel.mesh import make_mesh
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Join the global distributed runtime (idempotent).
+
+    With no arguments, JAX autodetects cluster shape from the environment
+    (TPU pod metadata, SLURM, or JAX_COORDINATOR_ADDRESS/NUM_PROCESSES/
+    PROCESS_ID vars). Explicit args cover bare-metal launches. Safe to call
+    when already initialized or single-process.
+    """
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None and is_init():
+        return
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except RuntimeError:
+        # "should only be called once" — a second init on an older JAX
+        # without is_initialized(); the runtime is already up.
+        if is_init is None or is_init():
+            return
+        raise
+    except ValueError:
+        # No cluster environment to autodetect and no explicit args: a
+        # plain single-process run — nothing to initialize.
+        if coordinator_address is None and num_processes is None:
+            return
+        raise
+
+
+def process_info() -> tuple[int, int]:
+    """(process_index, process_count) of this host."""
+    return jax.process_index(), jax.process_count()
+
+
+def is_primary() -> bool:
+    """True on exactly one process — gate logging/checkpoint writes with it."""
+    return jax.process_index() == 0
+
+
+def global_mesh(n_sp: int = 1, n_dp: int | None = None):
+    """(dp, sp) mesh over ALL processes' devices (call after initialize)."""
+    return make_mesh(n_dp=n_dp, n_sp=n_sp, devices=jax.devices())
+
+
+def shard_host_ensembles(mesh, local_data, spec: P | None = None):
+    """Assemble one global dp-sharded array from per-host ensemble blocks.
+
+    Each host passes its own ``(E_local, ...)`` block (e.g. its slice of
+    Monte-Carlo seeds' initial states); the result is the global
+    ``(E_local * process_count, ...)`` array sharded over ``dp`` with zero
+    cross-host data movement — the multi-host feed path for
+    sharded_swarm_rollout.
+    """
+    local_data = np.asarray(local_data)
+    if spec is None:
+        spec = P("dp", *([None] * (local_data.ndim - 1)))
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), local_data)
+
+
+def gather_metrics(tree):
+    """All-gather a metrics pytree to every host as numpy (host-level
+    all-reduce for logging; cheap — metrics are tiny). Sharded leaves come
+    back whole (tiled along their leading axis); host-local leaves come back
+    stacked across processes."""
+    from jax.experimental import multihost_utils
+
+    return jax.tree.map(
+        np.asarray, multihost_utils.process_allgather(tree, tiled=True))

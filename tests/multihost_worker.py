@@ -1,0 +1,73 @@
+"""Worker for tests/test_multihost.py — one OS process of a 2-process run.
+
+Usage: python multihost_worker.py <process_id> <port>
+Each process gets 4 virtual CPU devices (XLA_FLAGS set by the parent), joins
+the distributed runtime, builds one global (dp=4, sp=2) mesh spanning both
+processes, feeds its own ensemble block, and runs the sharded swarm rollout —
+the full multi-host path on Gloo CPU collectives.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main(process_id: int, port: int) -> None:
+    from cbf_tpu.parallel import multihost
+
+    multihost.initialize(coordinator_address=f"localhost:{port}",
+                         num_processes=2, process_id=process_id)
+    # Idempotent: a second call is a no-op, not a RuntimeError.
+    multihost.initialize(coordinator_address=f"localhost:{port}",
+                         num_processes=2, process_id=process_id)
+    pid, nproc = multihost.process_info()
+    assert (pid, nproc) == (process_id, 2)
+    assert len(jax.devices()) == 8, len(jax.devices())
+    assert multihost.is_primary() == (process_id == 0)
+
+    mesh = multihost.global_mesh(n_sp=2)                 # dp=4 x sp=2 global
+
+    from cbf_tpu.parallel.ensemble import (
+        ensemble_initial_states,
+        sharded_swarm_rollout,
+    )
+    from cbf_tpu.scenarios import swarm
+
+    cfg = swarm.Config(n=8, steps=40, k_neighbors=4)
+    seeds = list(range(8))                               # E=8 over dp=4
+    (xf, vf), metrics = sharded_swarm_rollout(cfg, mesh, seeds)
+
+    # Host-level metric gather: every process sees every ensemble's series.
+    nearest = multihost.gather_metrics(metrics.nearest_distance)
+    nearest = np.asarray(nearest).reshape(-1, cfg.steps)
+    assert nearest.shape[0] == 8
+    # inf = "no neighbor inside the gating radius yet" — legal early on.
+    # The enforced invariant is the reference's L1 barrier |dx|+|dy| >= dmin
+    # (cbf.py:38-59), whose Euclidean floor is dmin/sqrt(2) ~= 0.1414; at
+    # this density agents stay well above it.
+    assert np.all(nearest > 0.2 / np.sqrt(2) - 5e-3), nearest.min()
+    xf_all = multihost.gather_metrics(xf)
+    assert xf_all.shape == (8, 8, 2)
+    assert np.all(np.isfinite(xf_all))
+
+    # shard_host_ensembles: per-host blocks -> one global dp-sharded array.
+    cfg2 = swarm.Config(n=8)
+    local_seeds = [process_id * 2, process_id * 2 + 1]
+    x0_local, _ = ensemble_initial_states(cfg2, local_seeds)
+    x0_global = multihost.shard_host_ensembles(mesh, np.asarray(x0_local))
+    assert x0_global.shape == (4, 8, 2), x0_global.shape
+
+    print(f"MULTIHOST_OK process={pid}/{nproc} "
+          f"min_nearest={float(nearest.min()):.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]), int(sys.argv[2]))
