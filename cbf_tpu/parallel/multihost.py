@@ -45,10 +45,12 @@ def initialize(coordinator_address: str | None = None,
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)
-    except RuntimeError:
-        # "should only be called once" — a second init on an older JAX
-        # without is_initialized(); the runtime is already up.
-        if is_init is None or is_init():
+    except RuntimeError as e:
+        # "should only be called once" — a second init; the runtime is
+        # already up. Any other RuntimeError (unreachable coordinator,
+        # barrier timeout) must propagate, or hosts would silently run
+        # disconnected single-process jobs.
+        if (is_init is not None and is_init()) or "once" in str(e).lower():
             return
         raise
     except ValueError:
@@ -92,9 +94,10 @@ def shard_host_ensembles(mesh, local_data, spec: P | None = None):
 
 def gather_metrics(tree):
     """All-gather a metrics pytree to every host as numpy (host-level
-    all-reduce for logging; cheap — metrics are tiny). Sharded leaves come
-    back whole (tiled along their leading axis); host-local leaves come back
-    stacked across processes."""
+    all-reduce for logging; cheap — metrics are tiny). Every leaf comes back
+    *concatenated along its leading axis*: a globally-sharded (E, ...) array
+    comes back whole as (E, ...); a host-local (E_local, ...) block comes
+    back as (P * E_local, ...) in process order (no new process axis)."""
     from jax.experimental import multihost_utils
 
     return jax.tree.map(

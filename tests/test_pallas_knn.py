@@ -63,3 +63,32 @@ def test_swarm_scenario_pallas_path_matches_jnp():
         np.asarray(outs_p.min_pairwise_distance), rtol=1e-6, atol=1e-7)
     np.testing.assert_array_equal(np.asarray(outs_j.filter_active_count),
                                   np.asarray(outs_p.filter_active_count))
+
+
+@pytest.mark.parametrize("n,k,radius", [(100, 4, 0.5), (600, 8, 0.4),
+                                        (1025, 3, 0.3)])
+def test_blocked_matches_fused(rng, n, k, radius):
+    """Streaming (column-blocked) kernel == single-pass fused kernel.
+
+    n=600/1025 span multiple CTILE=512 column blocks, exercising the
+    running-top-k merge across grid steps."""
+    from cbf_tpu.ops.pallas_knn import knn_neighbors_blocked
+
+    x = jnp.asarray(rng.uniform(-2, 2, (n, 2)), jnp.float32)
+    idx_f, dist_f, near_f = knn_neighbors(x, radius, k, interpret=True)
+    idx_b, dist_b, near_b = knn_neighbors_blocked(x, radius, k,
+                                                  interpret=True)
+    np.testing.assert_array_equal(np.asarray(idx_f), np.asarray(idx_b))
+    np.testing.assert_allclose(np.asarray(dist_f), np.asarray(dist_b),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(near_f), np.asarray(near_b),
+                               rtol=1e-6)
+
+
+def test_blocked_empty_and_coincident():
+    from cbf_tpu.ops.pallas_knn import knn_neighbors_blocked
+
+    x = jnp.zeros((4, 2), jnp.float32).at[2:].set(50.0)
+    idx, dist, nearest = knn_neighbors_blocked(x, 1.0, 2, interpret=True)
+    assert not np.isfinite(np.asarray(dist[:2])).any()   # 0 < d excludes
+    np.testing.assert_allclose(np.asarray(nearest[:2]), 0.0)
