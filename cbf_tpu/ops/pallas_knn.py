@@ -50,6 +50,7 @@ try:  # pltpu is importable only where the TPU plugin exists; interpret mode
     _VMEM = pltpu.VMEM
     _SMEM = pltpu.SMEM
 except Exception:  # pragma: no cover
+    pltpu = None
     _VMEM = None
     _SMEM = None
 
@@ -152,6 +153,22 @@ def _knn_kernel_blocked(r2_ref, xr_ref, yr_ref, xc_ref, yc_ref,
     ``d2_ref``/``near_ref`` carry *squared* distances between grid steps;
     the last column step writes the sqrt.
     """
+    _stream_step(r2_ref, xr_ref, yr_ref, xc_ref, yc_ref,
+                 idx_ref, d2_ref, near_ref,
+                 col_base=pl.program_id(1) * CTILE, k=k, n=n,
+                 last_col_step=n_col_blocks - 1)
+
+
+def _stream_step(r2_ref, xr_ref, yr_ref, xc_ref, yc_ref,
+                 idx_ref, d2_ref, near_ref, *, col_base, k, n, last_col_step):
+    """One streaming-top-k grid step, shared by the blocked and banded
+    kernels (they differ only in where the column block's global ids start
+    — ``col_base`` — and which j is the final accumulation step).
+
+    Computes the (RTILE, CTILE) distance slab, folds the nearest-any
+    metric, and merges the block's in-radius candidates into the running
+    per-row top-k held in ``idx_ref``/``d2_ref`` (squared distances until
+    the final step's sqrt)."""
     i = pl.program_id(0)
     j = pl.program_id(1)
     radius2 = r2_ref[0]
@@ -170,7 +187,7 @@ def _knn_kernel_blocked(r2_ref, xr_ref, yr_ref, xc_ref, yc_ref,
     dy = yr[:, None] - yc[None, :]
     d2 = dx * dx + dy * dy
 
-    col_g = j * CTILE + lax.broadcasted_iota(jnp.int32, (RTILE, CTILE), 1)
+    col_g = col_base + lax.broadcasted_iota(jnp.int32, (RTILE, CTILE), 1)
     row_g = i * RTILE + lax.broadcasted_iota(jnp.int32, (RTILE, CTILE), 0)
     is_self = col_g == row_g
     in_range = col_g < n
@@ -219,7 +236,7 @@ def _knn_kernel_blocked(r2_ref, xr_ref, yr_ref, xc_ref, yc_ref,
         d2_ref[...] = jnp.stack(new_d, axis=1)
         idx_ref[...] = jnp.stack(new_i, axis=1)
 
-    @pl.when(j == n_col_blocks - 1)
+    @pl.when(j == last_col_step)
     def _finalize():
         d2_ref[...] = jnp.sqrt(d2_ref[...])
         near_ref[...] = jnp.sqrt(near_ref[...])
@@ -257,6 +274,102 @@ def knn_neighbors_blocked(x, radius, k: int, *, interpret: bool = False):
     return idx[:n], dist[:n], nearest[:n, 0]
 
 
+def _knn_kernel_banded(r2_ref, starts_ref, xr_ref, yr_ref, xc_ref, yc_ref,
+                       idx_ref, d2_ref, near_ref, *,
+                       k: int, n: int, w: int):
+    """Banded variant of :func:`_knn_kernel_blocked`: identical streaming
+    top-k, but the w column blocks are this row block's pre-gathered
+    y-window (XLA ``dynamic_slice`` outside the kernel — data-dependent
+    windows without scalar-prefetch index maps, which hang this TPU
+    stack's Mosaic pipeline). ``starts_ref`` carries the window's first
+    global sorted index, so column ids are ``starts[i] + j*CTILE + lane``."""
+    _stream_step(r2_ref, xr_ref, yr_ref, xc_ref, yc_ref,
+                 idx_ref, d2_ref, near_ref,
+                 col_base=starts_ref[0, 0] + pl.program_id(1) * CTILE,
+                 k=k, n=n, last_col_step=w - 1)
+
+@functools.partial(jax.jit, static_argnames=("k", "window_blocks", "interpret"))
+def knn_neighbors_banded(x, radius, k: int, *, window_blocks: int,
+                         interpret: bool = False):
+    """O(N·W) k-NN gating: y-sorted band decomposition.
+
+    Sorts agents by y (XLA sort, outside the kernel), so each RTILE row
+    block's in-radius candidates occupy a *contiguous* window of the sorted
+    order; ``searchsorted`` finds each block's window start and a
+    scalar-prefetch array steers the column BlockSpec through just
+    ``window_blocks`` CTILE blocks instead of all N/CTILE — the O(N²) slab
+    work drops to O(N·W). Results are scattered back to original agent
+    order, neighbor indices included.
+
+    Correctness contract: exact (same as :func:`knn_neighbors`, up to
+    exact-tie neighbor order) whenever each block's true band fits its
+    window; rows whose band overflows are reported in the returned
+    per-agent ``overflow`` flag — callers must surface it (the swarm
+    scenario counts it in StepOutputs). The nearest-any metric is exact
+    when ≤ radius; beyond radius it is a window-local (over-)estimate.
+
+    Returns (idx (N, k), dist (N, k), nearest (N,), overflow (N,) bool).
+    """
+    n = x.shape[0]
+    order = jnp.argsort(x[:, 1])
+    xs = x[order]
+    xp, yp, r2, n_pad = _pad_coords(xs, radius, max(RTILE, CTILE))
+    n_row_blocks = n_pad // RTILE
+    w = int(min(window_blocks, n_pad // CTILE))
+    wlen = w * CTILE
+
+    # Window start per row block: the first sorted index whose y could be
+    # within radius of the block (padding ys are 2*_FAR > any real y, so
+    # pure-padding blocks clamp to the tail — their outputs are sliced off).
+    ys = yp[0]
+    row0 = jnp.arange(n_row_blocks) * RTILE
+    lo = jnp.searchsorted(ys[:n], ys[row0] - radius)
+    starts = jnp.clip(lo.astype(jnp.int32), 0, n_pad - wlen)   # element units
+
+    # Overflow: the last needed index falls beyond the window.
+    row_end = jnp.minimum(row0 + RTILE, n) - 1
+    hi = jnp.searchsorted(ys[:n], ys[row_end] + radius, side="right")
+    block_overflow = hi.astype(jnp.int32) > starts + wlen      # (n_row_blocks,)
+
+    # Per-row-block column windows, gathered by XLA (O(N·W) data movement)
+    # so the kernel's BlockSpecs stay pure grid-id maps.
+    def win(arr):  # (n_pad,) -> (n_row_blocks, wlen)
+        return jax.vmap(lambda s: lax.dynamic_slice(arr, (s,), (wlen,)))(starts)
+
+    xw = win(xp[0])
+    yw = win(yp[0])
+
+    kernel = functools.partial(_knn_kernel_banded, k=k, n=n, w=w)
+    vmem = {} if _VMEM is None else {"memory_space": _VMEM}
+    smem = {} if _SMEM is None else {"memory_space": _SMEM}
+    idx_s, dist_s, near_s = pl.pallas_call(
+        kernel,
+        grid=(n_row_blocks, w),
+        in_specs=[pl.BlockSpec((1,), lambda i, j: (0,), **smem),
+                  pl.BlockSpec((1, 1), lambda i, j: (i, 0), **smem),
+                  pl.BlockSpec((1, RTILE), lambda i, j: (0, i), **vmem),
+                  pl.BlockSpec((1, RTILE), lambda i, j: (0, i), **vmem),
+                  pl.BlockSpec((1, CTILE), lambda i, j: (i, j), **vmem),
+                  pl.BlockSpec((1, CTILE), lambda i, j: (i, j), **vmem)],
+        out_specs=[pl.BlockSpec((RTILE, k), lambda i, j: (i, 0), **vmem),
+                   pl.BlockSpec((RTILE, k), lambda i, j: (i, 0), **vmem),
+                   pl.BlockSpec((RTILE, 1), lambda i, j: (i, 0), **vmem)],
+        out_shape=[jax.ShapeDtypeStruct((n_pad, k), jnp.int32),
+                   jax.ShapeDtypeStruct((n_pad, k), jnp.float32),
+                   jax.ShapeDtypeStruct((n_pad, 1), jnp.float32)],
+        interpret=interpret,
+    )(r2, starts[:, None], xp, yp, xw, yw)
+
+    # Back to original agent order: rows unsorted via the inverse
+    # permutation, neighbor ids mapped through the sort order.
+    inv = jnp.argsort(order)
+    idx = order[idx_s[:n]][inv]
+    dist = dist_s[:n][inv]
+    nearest = near_s[:n, 0][inv]
+    overflow = jnp.repeat(block_overflow, RTILE)[:n][inv]
+    return idx, dist, nearest, overflow
+
+
 def supported(n: int) -> bool:
     """Whether a Pallas kernel path applies: TPU backend and N within the
     streaming kernel's practical bound (the gating wrapper picks fused vs
@@ -279,3 +392,19 @@ def knn_gating_pallas(states4, radius, k: int, *, interpret: bool = False):
     mask = jnp.isfinite(dist)
     obs = jnp.take(states4, idx, axis=0)
     return obs, mask, nearest
+
+
+def knn_gating_banded(states4, radius, k: int, *, window_blocks: int,
+                      interpret: bool = False):
+    """Banded (O(N·W)) form of :func:`knn_gating_pallas`.
+
+    Returns (obs (N, k, 4), mask (N, k), nearest_all (N,),
+    overflow (N,) bool — rows whose y-band exceeded the window; see
+    :func:`knn_neighbors_banded`).
+    """
+    idx, dist, nearest, overflow = knn_neighbors_banded(
+        states4[:, :2], radius, k, window_blocks=window_blocks,
+        interpret=interpret)
+    mask = jnp.isfinite(dist)
+    obs = jnp.take(states4, idx, axis=0)
+    return obs, mask, nearest, overflow

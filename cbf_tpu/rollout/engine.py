@@ -35,6 +35,9 @@ class StepOutputs(NamedTuple):
     infeasible_count: Any         # scalar — agents whose QP hit the relax cap
     max_relax_rounds: Any         # scalar — worst relaxation this step
     trajectory: Any               # optional (.., N)-shaped position snapshot
+    # Agents whose banded-gating y-window overflowed (possible missed
+    # neighbors — see ops.pallas_knn.knn_neighbors_banded); () elsewhere.
+    gating_overflow_count: Any = ()
 
 
 @functools.partial(jax.jit, static_argnames=("step_fn", "steps", "unroll"))

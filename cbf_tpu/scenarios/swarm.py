@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from cbf_tpu.core.filter import CBFParams, safe_controls
 from cbf_tpu.ops import pallas_knn
 from cbf_tpu.ops.pairwise import pairwise_distances
-from cbf_tpu.ops.pallas_knn import knn_gating_pallas
+from cbf_tpu.ops.pallas_knn import knn_gating_banded, knn_gating_pallas
 from cbf_tpu.rollout.engine import StepOutputs, rollout
 from cbf_tpu.rollout.gating import knn_gating
 
@@ -67,10 +67,15 @@ class Config:
     dyn_scale: float = 0.1
     seed: int = 0
     record_trajectory: bool = False
-    # Neighbor-search backend: "auto" picks the fused Pallas kernel on TPU
-    # when N fits its VMEM bound (ops.pallas_knn), else the jnp path;
-    # "pallas"/"jnp" force (pallas runs in interpret mode off-TPU — tests).
+    # Neighbor-search backend: "auto" picks a Pallas kernel on TPU
+    # (fused <= 8192 agents, streaming beyond — ops.pallas_knn), else the
+    # jnp path; "pallas"/"jnp" force (pallas runs in interpret mode off-TPU
+    # — tests); "banded" opts into the O(N*W) y-sorted window kernel with
+    # overflow surfaced in StepOutputs.gating_overflow_count.
     gating: str = "auto"
+    # Banded window in CTILE-column blocks; None = density heuristic from
+    # the packed-state estimate (see make()).
+    gating_window_blocks: int | None = None
     dtype: type = jnp.float32
 
     @property
@@ -133,13 +138,26 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
     g = cfg.dyn_scale * jnp.array([[1, 0], [0, 1], [0, 0], [0, 0]], dt_)
     K = cfg.k_neighbors
 
-    if cfg.gating not in ("auto", "pallas", "jnp"):
-        raise ValueError(f"gating must be auto|pallas|jnp, got {cfg.gating!r}")
+    if cfg.gating not in ("auto", "pallas", "jnp", "banded"):
+        raise ValueError(
+            f"gating must be auto|pallas|jnp|banded, got {cfg.gating!r}")
+    use_banded = cfg.gating == "banded"
     if cfg.gating == "auto":
         use_pallas = pallas_knn.supported(cfg.n)
     else:
         use_pallas = cfg.gating == "pallas"
     pallas_interpret = jax.default_backend() != "tpu"
+    if use_banded:
+        if cfg.gating_window_blocks is not None:
+            window_blocks = cfg.gating_window_blocks
+        else:
+            # Density heuristic at the packed (densest) state: agents whose
+            # y lies within ±safety_distance of a 256-row band of the
+            # y-sorted order, assuming the packed disk's uniform density.
+            band = cfg.n * 2.0 * cfg.safety_distance / max(
+                2.0 * cfg.pack_radius, 1e-6)
+            window_blocks = int(np.ceil(
+                (band + 2 * pallas_knn.RTILE) / pallas_knn.CTILE)) + 1
 
     state0 = initial_state(cfg)
 
@@ -156,7 +174,16 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
 
         states4 = jnp.concatenate([x, state.v], axis=1)        # (N, 4)
 
-        if use_pallas:
+        overflow_count = ()
+        if use_banded:
+            # O(N*W) y-sorted banded kernel; window overflow (possible
+            # missed neighbors) is surfaced, never swallowed.
+            obs_slab, mask, nearest, overflow = knn_gating_banded(
+                states4, cfg.safety_distance, K,
+                window_blocks=window_blocks, interpret=pallas_interpret)
+            min_dist = jnp.min(nearest)
+            overflow_count = jnp.sum(overflow)
+        elif use_pallas:
             # Fused Pallas kernel: distances + k-NN + nearest-any metric in
             # one VMEM-resident pass (ops.pallas_knn).
             obs_slab, mask, nearest = knn_gating_pallas(
@@ -186,6 +213,7 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
             infeasible_count=jnp.sum(~info.feasible & engaged),
             max_relax_rounds=jnp.max(info.relax_rounds),
             trajectory=x if cfg.record_trajectory else (),
+            gating_overflow_count=overflow_count,
         )
         return State(x=x_new, v=v_new), out
 

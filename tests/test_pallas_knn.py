@@ -92,3 +92,61 @@ def test_blocked_empty_and_coincident():
     idx, dist, nearest = knn_neighbors_blocked(x, 1.0, 2, interpret=True)
     assert not np.isfinite(np.asarray(dist[:2])).any()   # 0 < d excludes
     np.testing.assert_allclose(np.asarray(nearest[:2]), 0.0)
+
+
+@pytest.mark.parametrize("n,k,radius,w", [(200, 4, 0.4, 1), (600, 8, 0.3, 2),
+                                          (1100, 4, 0.25, 2)])
+def test_banded_matches_fused_on_masked_slots(rng, n, k, radius, w):
+    """O(N·W) banded kernel == fused kernel wherever a neighbor exists.
+
+    Wide uniform clouds with ample windows: no overflow, identical neighbor
+    sets/distances (empty slots differ only in their unused idx filler)."""
+    from cbf_tpu.ops.pallas_knn import knn_neighbors_banded
+
+    x = jnp.asarray(rng.uniform(-3, 3, (n, 2)), jnp.float32)
+    idx_f, dist_f, near_f = knn_neighbors(x, radius, k, interpret=True)
+    idx_b, dist_b, near_b, ovf = knn_neighbors_banded(
+        x, radius, k, window_blocks=w, interpret=True)
+    assert not np.asarray(ovf).any()
+    mask = np.isfinite(np.asarray(dist_f))
+    np.testing.assert_array_equal(np.asarray(mask),
+                                  np.isfinite(np.asarray(dist_b)))
+    np.testing.assert_array_equal(np.where(mask, np.asarray(idx_f), 0),
+                                  np.where(mask, np.asarray(idx_b), 0))
+    np.testing.assert_allclose(np.where(mask, np.asarray(dist_f), 0),
+                               np.where(mask, np.asarray(dist_b), 0),
+                               rtol=1e-6)
+    # nearest-any: exact whenever within the gating radius.
+    nf, nb = np.asarray(near_f), np.asarray(near_b)
+    close = nf <= radius
+    np.testing.assert_allclose(nb[close], nf[close], rtol=1e-6)
+
+
+def test_banded_overflow_flagged(rng):
+    """A y-degenerate cloud (all agents in one thin band) with a too-small
+    window must raise the overflow flag rather than silently miss."""
+    from cbf_tpu.ops.pallas_knn import knn_neighbors_banded
+
+    n = 1200   # > 2 column blocks of candidates in one band
+    x = jnp.asarray(
+        np.stack([rng.uniform(-0.5, 0.5, n), rng.uniform(0, 1e-3, n)], 1),
+        jnp.float32)
+    _, _, _, ovf = knn_neighbors_banded(x, 0.4, 4, window_blocks=1,
+                                        interpret=True)
+    assert np.asarray(ovf).any()
+
+
+def test_swarm_banded_path_matches_pallas():
+    from cbf_tpu.scenarios import swarm
+
+    base = dict(n=640, steps=6, k_neighbors=4)
+    _, outs_p = swarm.run(swarm.Config(**base, gating="pallas"))
+    _, outs_b = swarm.run(swarm.Config(**base, gating="banded",
+                                       gating_window_blocks=2))
+    assert int(np.asarray(outs_b.gating_overflow_count).sum()) == 0
+    np.testing.assert_allclose(
+        np.asarray(outs_b.min_pairwise_distance),
+        np.asarray(outs_p.min_pairwise_distance), rtol=1e-5)
+    np.testing.assert_array_equal(
+        np.asarray(outs_b.filter_active_count),
+        np.asarray(outs_p.filter_active_count))
