@@ -295,11 +295,11 @@ def knn_neighbors_banded(x, radius, k: int, *, window_blocks: int,
 
     Sorts agents by y (XLA sort, outside the kernel), so each RTILE row
     block's in-radius candidates occupy a *contiguous* window of the sorted
-    order; ``searchsorted`` finds each block's window start and a
-    scalar-prefetch array steers the column BlockSpec through just
-    ``window_blocks`` CTILE blocks instead of all N/CTILE — the O(N²) slab
-    work drops to O(N·W). Results are scattered back to original agent
-    order, neighbor indices included.
+    order; ``searchsorted`` finds each block's window start, XLA
+    ``dynamic_slice`` pre-gathers just its ``window_blocks`` CTILE columns
+    (the kernel's BlockSpecs stay pure grid-id maps), and the kernel sweeps
+    only those — the O(N²) slab work drops to O(N·W). Results are scattered
+    back to original agent order, neighbor indices included.
 
     Correctness contract: exact (same as :func:`knn_neighbors`, up to
     exact-tie neighbor order) whenever each block's true band fits its
@@ -310,6 +310,8 @@ def knn_neighbors_banded(x, radius, k: int, *, window_blocks: int,
 
     Returns (idx (N, k), dist (N, k), nearest (N,), overflow (N,) bool).
     """
+    if window_blocks < 1:
+        raise ValueError(f"window_blocks must be >= 1, got {window_blocks}")
     n = x.shape[0]
     order = jnp.argsort(x[:, 1])
     xs = x[order]

@@ -150,3 +150,11 @@ def test_swarm_banded_path_matches_pallas():
     np.testing.assert_array_equal(
         np.asarray(outs_b.filter_active_count),
         np.asarray(outs_p.filter_active_count))
+
+
+def test_banded_rejects_nonpositive_window():
+    from cbf_tpu.ops.pallas_knn import knn_neighbors_banded
+
+    x = jnp.zeros((16, 2), jnp.float32)
+    with pytest.raises(ValueError):
+        knn_neighbors_banded(x, 0.4, 2, window_blocks=0, interpret=True)

@@ -1,0 +1,108 @@
+"""Property-based invariants (SURVEY.md §4): QP optimality certificates and
+discrete-time barrier invariance, over randomized problem families rather
+than fixed fixtures."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from cbf_tpu.core.filter import CBFParams, safe_control
+from cbf_tpu.solvers.exact2d import solve_qp_2d
+
+
+def _random_feasible_qp(rng, m):
+    """Random rows through a known interior point -> guaranteed feasible."""
+    A = rng.normal(0, 1, (m, 2))
+    interior = rng.normal(0, 0.5, 2)
+    slack = rng.uniform(0.05, 1.0, m)
+    b = A @ interior + slack
+    return A, b
+
+
+@pytest.mark.parametrize("m", [1, 3, 8, 16])
+def test_qp_solution_is_optimal_certificate(x64, rng, m):
+    """For 40 random feasible polyhedra: the exact2d solution is (a)
+    feasible and (b) no random feasible point beats its objective — an
+    optimality certificate independent of any second solver."""
+    for _ in range(40):
+        A, b = _random_feasible_qp(rng, m)
+        x, info = solve_qp_2d(jnp.asarray(A), jnp.asarray(b))
+        x = np.asarray(x)
+        assert bool(info.feasible)
+        assert np.max(A @ x - b) <= 1e-7
+        # Random feasible probes: rejection-sample points inside.
+        probes = rng.normal(0, 2.0, (500, 2))
+        ok = (probes @ A.T <= b[None, :] - 1e-9).all(axis=1)
+        if ok.any():
+            best = np.min(np.sum(probes[ok] ** 2, axis=1))
+            assert np.sum(x ** 2) <= best + 1e-6
+
+
+def test_qp_kkt_stationarity(x64, rng):
+    """Active-set stationarity: the solution is the projection of the
+    origin onto the active constraints — residual of the KKT system ~ 0."""
+    for _ in range(40):
+        A, b = _random_feasible_qp(rng, 5)
+        x, info = solve_qp_2d(jnp.asarray(A), jnp.asarray(b))
+        x = np.asarray(x)
+        act = np.where(np.abs(A @ x - b) <= 1e-7)[0]
+        if len(act) == 0:
+            np.testing.assert_allclose(x, 0.0, atol=1e-9)  # interior optimum
+        else:
+            # 2x = -A_act^T lam for some lam >= 0  (stationarity + dual feas)
+            Aact = A[act][:2]                     # at most 2 active in R^2
+            lam, *_ = np.linalg.lstsq(Aact.T, -2.0 * x, rcond=None)
+            np.testing.assert_allclose(Aact.T @ lam, -2.0 * x, atol=1e-6)
+            assert np.all(lam >= -1e-6)
+
+
+@pytest.mark.parametrize("gamma,k_vel", [(0.5, 0.0), (0.3, 0.0), (0.5, 1.0)])
+def test_discrete_barrier_invariance(x64, rng, gamma, k_vel):
+    """h(t+1) >= (1 - gamma*dt_eff) * h(t) in closed loop: an agent driven
+    straight at a static obstacle, filtered each step, never crosses the
+    L1 barrier h = |dx|+|dy|+k(..) - dmin below 0 (the reference's safety
+    contract, cbf.py:38-59), across random approach geometries."""
+    params = CBFParams(max_speed=15.0, dmin=0.2, k=k_vel, gamma=gamma)
+    fx = np.zeros((4, 4))
+    gx = np.array([[1.0, 0], [0, 1.0], [0, 0], [0, 0]])
+    for _ in range(10):
+        ang = rng.uniform(0, 2 * np.pi)
+        pos = 0.8 * np.array([np.cos(ang), np.sin(ang)])
+        obs = np.zeros(4)
+        dt = 0.05
+        h_min = np.inf
+        vel = np.zeros(2)
+        for _ in range(120):
+            u0 = -0.3 * pos / max(np.linalg.norm(pos), 1e-9)  # charge at it
+            state = np.concatenate([pos, vel])
+            u, info = safe_control(
+                jnp.asarray(state), jnp.asarray(obs[None, :]),
+                jnp.ones(1, bool), jnp.asarray(fx), jnp.asarray(gx),
+                jnp.asarray(u0), params)
+            u = np.asarray(u)
+            pos = pos + dt * u
+            vel = u
+            d = np.concatenate([pos, vel]) - obs
+            sx = -1.0 if d[0] < 0 else 1.0
+            sy = -1.0 if d[1] < 0 else 1.0
+            h = sx * d[0] + sy * d[1] + k_vel * (sx * d[2] + sy * d[3]) - 0.2
+            h_min = min(h_min, h)
+        assert h_min > -5e-3, f"barrier violated: h_min={h_min}"
+
+
+def test_swarm_safety_across_random_configs(x64, rng):
+    """Scenario-level property: across random swarm shapes/speeds the
+    minimum pairwise distance never crosses the L1 barrier's Euclidean
+    floor dmin/sqrt(2)."""
+    from cbf_tpu.scenarios import swarm
+
+    for seed in range(3):
+        n = int(rng.choice([24, 48, 96]))
+        cfg = swarm.Config(
+            n=n, steps=80, seed=seed,
+            k_neighbors=int(rng.choice([4, 8])),
+            speed_limit=float(rng.uniform(0.1, 0.3)),
+        )
+        _, outs = swarm.run(cfg)
+        md = float(np.asarray(outs.min_pairwise_distance).min())
+        assert md > 0.2 / np.sqrt(2) - 5e-3, (n, md)
