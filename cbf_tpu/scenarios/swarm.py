@@ -78,11 +78,18 @@ class Config:
     gating_window_blocks: int | None = None
     dtype: type = jnp.float32
 
+    # Override the spawn box half-width (None = density-safe default).
+    # Training configs set this low so the filter engages within short
+    # differentiable horizons (cf. examples/train_safety_params.py).
+    spawn_half_width_override: float | None = None
+
     @property
     def spawn_half_width(self) -> float:
         # Scale the spawn box with sqrt(N) to keep initial density safe
         # (grid spacing ~0.4 m > the 0.2 m danger radius), spawning outside
         # the packing radius so agents must migrate inward.
+        if self.spawn_half_width_override is not None:
+            return float(self.spawn_half_width_override)
         return max(1.5, 0.2 * float(np.sqrt(self.n)))
 
     @property

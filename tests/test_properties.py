@@ -20,10 +20,11 @@ def _random_feasible_qp(rng, m):
 
 
 @pytest.mark.parametrize("m", [1, 3, 8, 16])
-def test_qp_solution_is_optimal_certificate(x64, rng, m):
+def test_qp_solution_is_optimal_certificate(x64, m):
     """For 40 random feasible polyhedra: the exact2d solution is (a)
     feasible and (b) no random feasible point beats its objective — an
     optimality certificate independent of any second solver."""
+    rng = np.random.default_rng(100 + m)
     for _ in range(40):
         A, b = _random_feasible_qp(rng, m)
         x, info = solve_qp_2d(jnp.asarray(A), jnp.asarray(b))
@@ -38,9 +39,10 @@ def test_qp_solution_is_optimal_certificate(x64, rng, m):
             assert np.sum(x ** 2) <= best + 1e-6
 
 
-def test_qp_kkt_stationarity(x64, rng):
+def test_qp_kkt_stationarity(x64):
     """Active-set stationarity: the solution is the projection of the
     origin onto the active constraints — residual of the KKT system ~ 0."""
+    rng = np.random.default_rng(7)
     for _ in range(40):
         A, b = _random_feasible_qp(rng, 5)
         x, info = solve_qp_2d(jnp.asarray(A), jnp.asarray(b))
@@ -57,11 +59,12 @@ def test_qp_kkt_stationarity(x64, rng):
 
 
 @pytest.mark.parametrize("gamma,k_vel", [(0.5, 0.0), (0.3, 0.0), (0.5, 1.0)])
-def test_discrete_barrier_invariance(x64, rng, gamma, k_vel):
+def test_discrete_barrier_invariance(x64, gamma, k_vel):
     """h(t+1) >= (1 - gamma*dt_eff) * h(t) in closed loop: an agent driven
     straight at a static obstacle, filtered each step, never crosses the
     L1 barrier h = |dx|+|dy|+k(..) - dmin below 0 (the reference's safety
     contract, cbf.py:38-59), across random approach geometries."""
+    rng = np.random.default_rng(int(1000 * gamma) + int(k_vel))
     params = CBFParams(max_speed=15.0, dmin=0.2, k=k_vel, gamma=gamma)
     fx = np.zeros((4, 4))
     gx = np.array([[1.0, 0], [0, 1.0], [0, 0], [0, 0]])
@@ -90,10 +93,11 @@ def test_discrete_barrier_invariance(x64, rng, gamma, k_vel):
         assert h_min > -5e-3, f"barrier violated: h_min={h_min}"
 
 
-def test_swarm_safety_across_random_configs(x64, rng):
+def test_swarm_safety_across_random_configs(x64):
     """Scenario-level property: across random swarm shapes/speeds the
     minimum pairwise distance never crosses the L1 barrier's Euclidean
     floor dmin/sqrt(2)."""
+    rng = np.random.default_rng(42)
     from cbf_tpu.scenarios import swarm
 
     for seed in range(3):
