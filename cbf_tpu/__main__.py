@@ -28,16 +28,21 @@ def _scenarios():
                                 render_swarm)
     from cbf_tpu.scenarios import cross_and_rescue, meet_at_center, swarm
 
+    # Last field: the recorded trajectory layout — "dims_major" = (T, 2, N)
+    # columns-of-agents (the sim-layer convention), "agent_major" = (T, N, 2).
     return {
         "meet_at_center": (meet_at_center, "iterations",
                            lambda outs, cfg, path: render_meet_at_center(
                                outs.trajectory, path,
-                               n_obstacles=cfg.n_obstacles)),
+                               n_obstacles=cfg.n_obstacles),
+                           "dims_major"),
         "cross_and_rescue": (cross_and_rescue, "iterations",
                              lambda outs, cfg, path: render_cross_and_rescue(
-                                 outs.trajectory, path, goal=cfg.goal)),
+                                 outs.trajectory, path, goal=cfg.goal),
+                             "dims_major"),
         "swarm": (swarm, "steps",
-                  lambda outs, cfg, path: render_swarm(outs.trajectory, path)),
+                  lambda outs, cfg, path: render_swarm(outs.trajectory, path),
+                  "agent_major"),
     }
 
 
@@ -76,9 +81,10 @@ def cmd_run(args) -> int:
     from cbf_tpu.utils import profiling
     from cbf_tpu.utils.debug import checked_rollout, summarize
 
-    module, steps_field, renderer = _scenarios()[args.scenario]
+    module, steps_field, renderer, traj_layout = _scenarios()[args.scenario]
+    need_traj = args.video is not None or args.traj is not None
     cfg = _apply_overrides(module.Config(), args.set, args.steps, steps_field,
-                           need_trajectory=args.video is not None)
+                           need_trajectory=need_traj)
     state0, step = module.make(cfg)
     steps = getattr(cfg, steps_field)
 
@@ -104,12 +110,42 @@ def cmd_run(args) -> int:
         record["resumed_from_step"] = start
     if args.video and outs is not None:
         record["video"] = renderer(outs, cfg, args.video)
+    if args.traj and outs is not None:
+        record["traj"] = _write_traj(args.traj, outs, traj_layout)
     print(json.dumps(record))
     return 0
 
 
+def _write_traj(path: str, outs, layout: str) -> str:
+    """Stream recorded positions to disk via the native async sink
+    (cbf_tpu.native.trajsink), numpy fallback without a toolchain.
+
+    ``layout`` comes from the scenario table — each scenario declares its
+    own recording convention rather than the CLI guessing from shapes."""
+    import numpy as np
+
+    traj = outs.trajectory
+    if isinstance(traj, tuple):          # scenarios recording several layers
+        traj = traj[0]
+    traj = np.asarray(traj, np.float32)
+    if layout == "dims_major":           # (T, dims, N) -> (T, N, dims)
+        traj = traj.transpose(0, 2, 1)
+    from cbf_tpu.native import trajsink
+
+    if trajsink.available():
+        with trajsink.TrajectorySink(path, n_agents=traj.shape[1],
+                                     dims=traj.shape[2]) as sink:
+            # Bounded chunks: keep the sink's copy + queue memory flat and
+            # let disk writes overlap the remaining appends.
+            for t0 in range(0, traj.shape[0], 1024):
+                sink.append(traj[t0:t0 + 1024])
+        return path
+    np.save(path + ".npy", traj)         # graceful degradation
+    return path + ".npy"
+
+
 def cmd_list(_args) -> int:
-    for name, (module, steps_field, _) in sorted(_scenarios().items()):
+    for name, (module, steps_field, *_rest) in sorted(_scenarios().items()):
         cfg = module.Config()
         knobs = ", ".join(f"{f.name}={getattr(cfg, f.name)!r}"
                           for f in dataclasses.fields(cfg)
@@ -145,6 +181,10 @@ def main(argv=None) -> int:
                       metavar="FIELD=VALUE", help="override any config field")
     runp.add_argument("--video", default=None,
                       help="write a replay video/gif here")
+    runp.add_argument("--traj", default=None,
+                      help="stream recorded positions to this .cbt file "
+                           "(native async sink; read back with "
+                           "cbf_tpu.native.trajsink.read_trajectory)")
     runp.add_argument("--checkpoint-dir", default=None)
     runp.add_argument("--chunk", type=int, default=1000,
                       help="steps per compiled chunk when checkpointing")

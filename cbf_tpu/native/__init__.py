@@ -32,11 +32,16 @@ _lib_cache: ctypes.CDLL | None = None
 _build_err: str | None = None
 
 
-def _build() -> str | None:
-    src = os.path.join(_SRC_DIR, "qp2d.cpp")
+def _build(src_name: str = "qp2d.cpp", so_name: str = "libqp2d.so") -> str | None:
+    """Ensure ONE native library is built; per-target freshness so a
+    prebuilt .so keeps working on toolchain-less machines even when a
+    sibling target is missing (make builds everything, but is only invoked
+    when THIS consumer's library is stale)."""
+    src = os.path.join(_SRC_DIR, src_name)
+    so = os.path.join(_SRC_DIR, "build", so_name)
     if not os.path.exists(src):
         return f"source missing: {src}"
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(src):
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
         return None
     try:
         res = subprocess.run(["make", "-C", _SRC_DIR], capture_output=True,

@@ -27,6 +27,8 @@ import numpy as np  # noqa: E402
 
 
 def main(opt_steps: int = 40):
+    if opt_steps < 1:
+        raise SystemExit(f"--steps must be >= 1, got {opt_steps}")
     from cbf_tpu.learn import TrainConfig, init_params, make_train_step
     from cbf_tpu.learn.tuning import params_to_cbf
     from cbf_tpu.parallel import make_mesh

@@ -1,0 +1,135 @@
+// Asynchronous trajectory sink: a background-thread binary writer for
+// streaming rollout trajectories to disk without stalling the step loop.
+//
+// Runtime counterpart of the reference's only IO pipeline — the matplotlib
+// frame grab piped to an ffmpeg subprocess INSIDE the hot loop
+// (reference cross_and_rescue.py:96-98), which dominates its wall-clock.
+// Here the device loop hands off (frames, n_agents, dims) float32 chunks;
+// a worker thread owns the file. Plain C ABI for ctypes (no pybind11 in
+// this environment).
+//
+// File format "CBT1": magic[4] | int32 n_agents | int32 dims |
+//                     int64 frame_count (patched on close) | payload f32.
+//
+// Build: make -C native  (g++ -O2 -fPIC -shared -pthread)
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Sink {
+  FILE* f = nullptr;
+  int n_agents = 0;
+  int dims = 0;
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<std::vector<float>> queue;
+  bool stop = false;
+  bool write_error = false;
+  std::atomic<int64_t> frames_written{0};
+
+  void run() {
+    for (;;) {
+      std::vector<float> chunk;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv.wait(lk, [&] { return stop || !queue.empty(); });
+        if (queue.empty()) {
+          if (stop) return;
+          continue;
+        }
+        chunk = std::move(queue.front());
+        queue.pop_front();
+      }
+      size_t n = chunk.size();
+      if (fwrite(chunk.data(), sizeof(float), n, f) != n) {
+        std::lock_guard<std::mutex> lk(mu);
+        write_error = true;
+        return;
+      }
+      frames_written += static_cast<int64_t>(n) / (n_agents * dims);
+    }
+  }
+};
+
+constexpr char kMagic[4] = {'C', 'B', 'T', '1'};
+constexpr long kHeaderBytes = 4 + 4 + 4 + 8;
+
+}  // namespace
+
+extern "C" {
+
+void* trajsink_open(const char* path, int n_agents, int dims) {
+  if (n_agents <= 0 || dims <= 0) return nullptr;
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  int64_t zero = 0;
+  if (fwrite(kMagic, 1, 4, f) != 4 ||
+      fwrite(&n_agents, sizeof(int32_t), 1, f) != 1 ||
+      fwrite(&dims, sizeof(int32_t), 1, f) != 1 ||
+      fwrite(&zero, sizeof(int64_t), 1, f) != 1) {
+    fclose(f);
+    return nullptr;
+  }
+  Sink* s = new Sink;
+  s->f = f;
+  s->n_agents = n_agents;
+  s->dims = dims;
+  s->worker = std::thread([s] { s->run(); });
+  return s;
+}
+
+// Enqueue `frames` frames of (n_agents * dims) float32s. Returns 0 on
+// success, -1 on a prior write error (caller should close).
+int trajsink_append(void* h, const float* data, int64_t frames) {
+  Sink* s = static_cast<Sink*>(h);
+  if (!s || frames < 0) return -1;
+  if (frames == 0) return 0;
+  size_t n = static_cast<size_t>(frames) * s->n_agents * s->dims;
+  std::vector<float> chunk(data, data + n);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    if (s->write_error || s->stop) return -1;
+    s->queue.push_back(std::move(chunk));
+  }
+  s->cv.notify_one();
+  return 0;
+}
+
+int64_t trajsink_frames_written(void* h) {
+  Sink* s = static_cast<Sink*>(h);
+  return s ? s->frames_written.load() : -1;
+}
+
+// Drain, patch the header frame count, and free. Returns the total frame
+// count, or -1 on write error.
+int64_t trajsink_close(void* h) {
+  Sink* s = static_cast<Sink*>(h);
+  if (!s) return -1;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->stop = true;
+  }
+  s->cv.notify_one();
+  s->worker.join();
+  int64_t frames = s->frames_written.load();
+  bool err = s->write_error;
+  if (!err) {
+    err = fseek(s->f, 4 + 4 + 4, SEEK_SET) != 0 ||
+          fwrite(&frames, sizeof(int64_t), 1, s->f) != 1;
+  }
+  err = (fclose(s->f) != 0) || err;
+  delete s;
+  return err ? -1 : frames;
+}
+
+}  // extern "C"

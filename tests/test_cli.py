@@ -49,3 +49,43 @@ def test_run_checked(capsys):
 def test_unknown_field_errors():
     with pytest.raises(SystemExit):
         main(["run", "swarm", "--set", "bogus=1"])
+
+
+def test_run_writes_trajectory_file(tmp_path, capsys):
+    import numpy as np
+
+    from cbf_tpu.__main__ import main
+    from cbf_tpu.native import trajsink
+
+    path = str(tmp_path / "out.cbt")
+    rc = main(["run", "swarm", "--steps", "8", "--set", "n=12",
+               "--traj", path])
+    assert rc == 0
+    import json
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    written = rec["traj"]
+    if written.endswith(".npy"):          # no toolchain fallback
+        traj = np.load(written)
+    else:
+        traj = trajsink.read_trajectory(written)
+    assert traj.shape == (8, 12, 2)
+    assert np.isfinite(traj).all()
+
+
+def test_run_traj_dims_major_scenario(tmp_path, capsys):
+    """meet_at_center records (T, 2, N); the scenario-declared layout must
+    normalize it to (T, N, 2) in the sink file — including tiny N where
+    shape guessing would be ambiguous."""
+    import numpy as np
+
+    from cbf_tpu.__main__ import main
+    from cbf_tpu.native import trajsink
+
+    path = str(tmp_path / "mc.cbt")
+    rc = main(["run", "meet_at_center", "--steps", "5", "--traj", path])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    written = rec["traj"]
+    traj = (np.load(written) if written.endswith(".npy")
+            else trajsink.read_trajectory(written))
+    assert traj.shape == (5, 10, 2)       # N=10 agents, 2 dims

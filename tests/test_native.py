@@ -86,3 +86,67 @@ def test_batch_throughput(rng):
     assert np.isfinite(x).all()
     # Far looser than reality (~1e6/s) — just catches pathological builds.
     assert 20000 / dt > 50000
+
+
+# --- async trajectory sink (native/trajsink.cpp) -------------------------
+
+def test_trajsink_roundtrip(tmp_path):
+    from cbf_tpu.native import trajsink
+
+    if not trajsink.available():
+        pytest.skip("no native toolchain")
+    rng = np.random.default_rng(0)
+    path = str(tmp_path / "run.cbt")
+    chunks = [rng.normal(0, 1, (t, 6, 2)).astype(np.float32)
+              for t in (5, 1, 17)]
+    with trajsink.TrajectorySink(path, n_agents=6, dims=2) as sink:
+        for c in chunks:
+            sink.append(c)
+        sink.append(chunks[0][0])            # single-frame (N, D) form
+    traj = trajsink.read_trajectory(path)
+    expect = np.concatenate(chunks + [chunks[0][:1]], axis=0)
+    assert traj.shape == (24, 6, 2)
+    np.testing.assert_array_equal(traj, expect)
+
+
+def test_trajsink_many_chunks_from_rollout(tmp_path):
+    """Stream a real chunked rollout's recorded positions through the sink."""
+    from cbf_tpu.native import trajsink
+    from cbf_tpu.rollout.engine import rollout
+    from cbf_tpu.scenarios import swarm
+
+    if not trajsink.available():
+        pytest.skip("no native toolchain")
+    cfg = swarm.Config(n=16, steps=30, record_trajectory=True)
+    state0, step = swarm.make(cfg)
+    _, outs = rollout(step, state0, cfg.steps)
+    traj = np.asarray(outs.trajectory)                    # (T, N, 2)
+    path = str(tmp_path / "roll.cbt")
+    with trajsink.TrajectorySink(path, n_agents=cfg.n) as sink:
+        for t0 in range(0, cfg.steps, 7):                 # uneven chunks
+            sink.append(traj[t0:t0 + 7])
+    back = trajsink.read_trajectory(path)
+    np.testing.assert_allclose(back, traj, rtol=1e-6)
+
+
+def test_trajsink_rejects_bad_shapes_and_closed(tmp_path):
+    from cbf_tpu.native import trajsink
+
+    if not trajsink.available():
+        pytest.skip("no native toolchain")
+    path = str(tmp_path / "bad.cbt")
+    sink = trajsink.TrajectorySink(path, n_agents=4, dims=2)
+    with pytest.raises(ValueError):
+        sink.append(np.zeros((3, 5, 2), np.float32))     # wrong N
+    assert sink.close() == 0
+    with pytest.raises(ValueError):
+        sink.append(np.zeros((1, 4, 2), np.float32))     # after close
+
+
+def test_trajsink_read_rejects_garbage(tmp_path):
+    from cbf_tpu.native import trajsink
+
+    p = tmp_path / "junk.cbt"
+    p.write_bytes(b"NOPE" + b"\0" * 32)
+    with pytest.raises(ValueError):
+        trajsink.read_trajectory(str(p))
