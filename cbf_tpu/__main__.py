@@ -52,8 +52,6 @@ def _apply_overrides(cfg, pairs: list[str], steps: int | None,
     updates = {}
     if steps is not None:
         updates[steps_field] = steps
-    if need_trajectory:
-        updates["record_trajectory"] = True
     for pair in pairs:
         key, _, raw = pair.partition("=")
         if key not in fields:
@@ -71,6 +69,10 @@ def _apply_overrides(cfg, pairs: list[str], steps: int | None,
         else:
             val = raw
         updates[key] = val
+    # Applied last: --video/--traj need the trajectory regardless of any
+    # --set record_trajectory=false (the explicit output request wins).
+    if need_trajectory:
+        updates["record_trajectory"] = True
     return dataclasses.replace(cfg, **updates)
 
 

@@ -44,8 +44,11 @@ def _build(src_name: str = "qp2d.cpp", so_name: str = "libqp2d.so") -> str | Non
     if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
         return None
     try:
-        res = subprocess.run(["make", "-C", _SRC_DIR], capture_output=True,
-                             text=True, timeout=120)
+        # Per-target make keeps failure domains separate: a broken sibling
+        # source can't take down this consumer's library.
+        res = subprocess.run(
+            ["make", "-C", _SRC_DIR, os.path.join("build", so_name)],
+            capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired) as e:
         return f"build failed to run: {e}"
     if res.returncode != 0:

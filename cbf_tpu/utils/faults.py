@@ -1,0 +1,74 @@
+"""Fault injection: prove the failure-detection machinery actually fires.
+
+The reference has no failure detection at all (SURVEY.md §5) — its closest
+analogue is the QP relax-retry loop. This framework surfaces three failure
+signals (checkify NaN/inf location, per-agent QP infeasibility flags, banded
+gating overflow counts); this module injects the corresponding faults into
+an otherwise-healthy rollout so tests — and operators debugging a flaky
+model — can confirm each signal trips where expected, inside compiled code.
+
+All injectors are pure step-fn wrappers: they compose with ``rollout``,
+``checked_rollout``, ``rollout_chunked`` and ``scan`` like any step.
+
+    step = faults.nan_at_step(step, step_index=50)
+    checked_rollout(step, state0, 100)      # -> JaxRuntimeError at t=50
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def _maybe_corrupt(leaf, hit, value):
+    """Return ``leaf`` with one element set to ``value`` when ``hit``;
+    non-float leaves pass through untouched (single source of the dtype
+    filter — callers don't re-check)."""
+    if not hasattr(leaf, "dtype") or not jnp.issubdtype(leaf.dtype, jnp.floating):
+        return leaf
+    if leaf.ndim:
+        corrupted = leaf.at[(0,) * leaf.ndim].set(value)
+    else:
+        corrupted = jnp.asarray(value, leaf.dtype)
+    return jnp.where(hit, corrupted, leaf)
+
+
+def nan_at_step(step_fn: Callable, step_index: int) -> Callable:
+    """Corrupt one element of every float state leaf with NaN at ``t ==
+    step_index`` (branch-free — a ``where`` on the traced step counter, so
+    the wrapper is scan/jit-safe)."""
+    return _value_at_step(step_fn, step_index, jnp.nan)
+
+
+def inf_at_step(step_fn: Callable, step_index: int) -> Callable:
+    """Same as :func:`nan_at_step` with +inf (overflow-style faults)."""
+    return _value_at_step(step_fn, step_index, jnp.inf)
+
+
+def _value_at_step(step_fn: Callable, step_index: int, value) -> Callable:
+    def wrapped(state, t):
+        hit = t == step_index
+        corrupted = jax.tree.map(
+            lambda leaf: _maybe_corrupt(leaf, hit, value), state)
+        return step_fn(corrupted, t)
+
+    return wrapped
+
+
+def teleport_at_step(step_fn: Callable, step_index: int,
+                     agent: int = 0, offset=(0.0, 0.0)) -> Callable:
+    """Teleport one agent by ``offset`` at ``t == step_index`` — a finite
+    state corruption (sensor glitch / collision-course injection) for
+    exercising infeasibility flags and safety-margin monitors rather than
+    float checks."""
+    off = jnp.asarray(offset, jnp.float32)
+
+    def wrapped(state, t):
+        x = state.x
+        hit = (t == step_index)
+        x2 = x.at[agent].add(jnp.where(hit, off, jnp.zeros_like(off)))
+        return step_fn(state._replace(x=x2), t)
+
+    return wrapped

@@ -25,13 +25,19 @@
 
 namespace {
 
+// Backpressure bound: append() blocks once this many chunks are queued, so
+// a producer outrunning the disk holds at most kMaxQueuedChunks chunks in
+// RAM instead of the whole trajectory.
+constexpr size_t kMaxQueuedChunks = 64;
+
 struct Sink {
   FILE* f = nullptr;
   int n_agents = 0;
   int dims = 0;
   std::thread worker;
   std::mutex mu;
-  std::condition_variable cv;
+  std::condition_variable cv;        // worker wakeup: work or stop
+  std::condition_variable cv_space;  // producer wakeup: queue drained
   std::deque<std::vector<float>> queue;
   bool stop = false;
   bool write_error = false;
@@ -50,10 +56,12 @@ struct Sink {
         chunk = std::move(queue.front());
         queue.pop_front();
       }
+      cv_space.notify_all();
       size_t n = chunk.size();
       if (fwrite(chunk.data(), sizeof(float), n, f) != n) {
         std::lock_guard<std::mutex> lk(mu);
         write_error = true;
+        cv_space.notify_all();
         return;
       }
       frames_written += static_cast<int64_t>(n) / (n_agents * dims);
@@ -97,7 +105,10 @@ int trajsink_append(void* h, const float* data, int64_t frames) {
   size_t n = static_cast<size_t>(frames) * s->n_agents * s->dims;
   std::vector<float> chunk(data, data + n);
   {
-    std::lock_guard<std::mutex> lk(s->mu);
+    std::unique_lock<std::mutex> lk(s->mu);
+    s->cv_space.wait(lk, [&] {
+      return s->write_error || s->stop || s->queue.size() < kMaxQueuedChunks;
+    });
     if (s->write_error || s->stop) return -1;
     s->queue.push_back(std::move(chunk));
   }
@@ -120,6 +131,7 @@ int64_t trajsink_close(void* h) {
     s->stop = true;
   }
   s->cv.notify_one();
+  s->cv_space.notify_all();
   s->worker.join();
   int64_t frames = s->frames_written.load();
   bool err = s->write_error;

@@ -89,3 +89,20 @@ def test_run_traj_dims_major_scenario(tmp_path, capsys):
     traj = (np.load(written) if written.endswith(".npy")
             else trajsink.read_trajectory(written))
     assert traj.shape == (5, 10, 2)       # N=10 agents, 2 dims
+
+
+def test_traj_wins_over_record_trajectory_false(tmp_path, capsys):
+    """--traj forces trajectory recording even against an explicit --set."""
+    import numpy as np
+
+    from cbf_tpu.__main__ import main
+    from cbf_tpu.native import trajsink
+
+    path = str(tmp_path / "w.cbt")
+    rc = main(["run", "swarm", "--steps", "4", "--set", "n=8",
+               "--set", "record_trajectory=false", "--traj", path])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    traj = (np.load(rec["traj"]) if rec["traj"].endswith(".npy")
+            else trajsink.read_trajectory(rec["traj"]))
+    assert traj.shape == (4, 8, 2)

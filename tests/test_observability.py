@@ -51,3 +51,69 @@ def test_step_timer():
     with t.phase("a"):
         pass
     assert "a=" in t.summary() and t.totals["a"] >= 0.0
+
+
+# --- fault injection -> detection (utils/faults.py) ----------------------
+
+def test_injected_nan_is_detected_and_located():
+    import jax
+    import pytest
+
+    from cbf_tpu.scenarios import swarm
+    from cbf_tpu.utils import faults
+    from cbf_tpu.utils.debug import checked_rollout
+
+    cfg = swarm.Config(n=12, steps=20)
+    state0, step = swarm.make(cfg)
+    bad = faults.nan_at_step(step, step_index=7)
+    with pytest.raises(Exception) as ei:
+        checked_rollout(bad, state0, cfg.steps)
+    assert "nan" in str(ei.value).lower()
+    # The same faulty program runs silently WITHOUT the checker — that
+    # asymmetry is the point of having one.
+    from cbf_tpu.rollout.engine import rollout
+    final, _ = rollout(bad, state0, cfg.steps)
+    assert not np.isfinite(np.asarray(final.x)).all()
+
+
+def test_injected_inf_is_detected():
+    import pytest
+
+    from cbf_tpu.scenarios import swarm
+    from cbf_tpu.utils import faults
+    from cbf_tpu.utils.debug import checked_rollout
+
+    cfg = swarm.Config(n=12, steps=12)
+    state0, step = swarm.make(cfg)
+    with pytest.raises(Exception):
+        checked_rollout(faults.inf_at_step(step, 3), state0, cfg.steps)
+
+
+def test_clean_rollout_passes_checks():
+    from cbf_tpu.scenarios import swarm
+    from cbf_tpu.utils.debug import checked_rollout
+
+    cfg = swarm.Config(n=12, steps=12)
+    state0, step = swarm.make(cfg)
+    final, outs = checked_rollout(step, state0, cfg.steps)   # no raise
+    assert np.isfinite(np.asarray(final.x)).all()
+
+
+def test_teleport_fault_shows_in_safety_metrics():
+    """A finite corruption (agent teleported onto a neighbor) must show up
+    in the surfaced safety metrics: min distance collapses at that step
+    and the filter reacts — no silent swallow."""
+    from cbf_tpu.rollout.engine import rollout
+    from cbf_tpu.scenarios import swarm
+    from cbf_tpu.utils import faults
+
+    cfg = swarm.Config(n=12, steps=30)
+    state0, step = swarm.make(cfg)
+    # Teleport agent 0 onto agent 1's spawn position at t=10.
+    x0 = np.asarray(state0.x)
+    off = (x0[1] - x0[0]) + np.array([0.03, 0.0], np.float32)
+    bad = faults.teleport_at_step(step, 10, agent=0, offset=tuple(off))
+    _, outs = rollout(bad, state0, cfg.steps)
+    md = np.asarray(outs.min_pairwise_distance)
+    assert md[10] < 0.1                       # collapse visible at t=10
+    assert np.asarray(outs.filter_active_count)[10:].sum() > 0
