@@ -28,9 +28,53 @@ import numpy as np
 TARGET_RATE_PER_CHIP = 4096 * 10_000 / 60.0 / 4.0   # BASELINE.json ladder
 
 
+def _device_health_check(timeout_s: float) -> bool:
+    """Run a trivial op with a watchdog. The tunneled-TPU environment can
+    wedge (a killed client leaves the remote device stuck); without this a
+    wedged device hangs the whole bench instead of reporting."""
+    import threading
+
+    done = threading.Event()
+    failure: list[BaseException] = []
+
+    def probe():
+        try:
+            import jax.numpy as jnp
+
+            o = (jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum()
+            jax.block_until_ready(o)
+        except BaseException as e:  # init errors are fast — report, not hang
+            failure.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        return False, f"device unresponsive after {timeout_s:.0f}s (tunnel/device wedged)"
+    if failure:
+        return False, f"device init failed: {failure[0]!r}"
+    return True, ""
+
+
 def main():
     from cbf_tpu.rollout.engine import rollout
     from cbf_tpu.scenarios import swarm
+
+    health_timeout = float(os.environ.get("BENCH_HEALTH_TIMEOUT", "180"))
+    healthy, reason = _device_health_check(health_timeout)
+    if not healthy:
+        print(json.dumps({
+            "metric": "agent-QP-steps/sec/chip (swarm N=4096)",
+            "value": 0,
+            "unit": "agent_qp_steps_per_sec_per_chip",
+            "vs_baseline": 0,
+            "error": f"{reason} — no measurement possible; last good "
+                     "single-chip numbers are in README.md",
+        }))
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(2)   # the stuck runtime thread would block a clean exit
 
     n = int(os.environ.get("BENCH_N", "4096"))
     steps = int(os.environ.get("BENCH_STEPS", "500"))

@@ -33,10 +33,10 @@ _build_err: str | None = None
 
 
 def _build(src_name: str = "qp2d.cpp", so_name: str = "libqp2d.so") -> str | None:
-    """Ensure ONE native library is built; per-target freshness so a
-    prebuilt .so keeps working on toolchain-less machines even when a
-    sibling target is missing (make builds everything, but is only invoked
-    when THIS consumer's library is stale)."""
+    """Ensure ONE native library is built; per-target freshness AND a
+    per-target make invocation, so a prebuilt .so keeps working on
+    toolchain-less machines even when a sibling target is missing, and a
+    broken sibling source can't take this consumer's library down."""
     src = os.path.join(_SRC_DIR, src_name)
     so = os.path.join(_SRC_DIR, "build", so_name)
     if not os.path.exists(src):
