@@ -1,0 +1,85 @@
+"""All-gather pairwise exchange: the Ulysses-style alternative to the ring.
+
+Two canonical ways to parallelize long-axis pairwise interactions map onto
+swarms exactly as they do onto attention (SURVEY.md §2.7; the reference has
+neither — it is a serial loop):
+
+- **ring** (:mod:`cbf_tpu.parallel.ring`): candidate blocks rotate with
+  ``ppermute``; O(N/n_sp) memory per device, n_sp hops whose compute
+  overlaps ICI transfer. Right when N is large enough that one device
+  cannot hold all positions.
+- **all-gather** (this module): one ``lax.all_gather`` of the compact
+  (x, y, vx, vu) states, then each device runs the single-device gating on
+  its local rows against the full candidate set. One collective instead of
+  n_sp dependent hops — lower latency whenever the gathered array fits
+  comfortably in memory (it is 16 bytes/agent: at N=262144 a 4 MB gather).
+
+Both produce the single-device :func:`cbf_tpu.rollout.gating.knn_gating`
+contract; :func:`exchange_knn` picks between them by gathered size.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from cbf_tpu.parallel.ring import ring_knn
+from cbf_tpu.utils.math import safe_norm
+
+# Above this per-device DISTANCE-SLAB byte size — the (n_local, N) matrix
+# all_gather_knn materializes, which dwarfs the 16 B/agent gather itself —
+# prefer the ring (it streams candidates in O(n_local^2)-sized blocks);
+# below it, one all-gather beats n_sp dependent ppermute hops.
+ALL_GATHER_MAX_SLAB_BYTES = 32 * 1024 * 1024
+
+
+def all_gather_knn(states4_local, k: int, radius, axis_name: str,
+                   return_distances: bool = False):
+    """Top-k in-radius neighbors via one all-gather over ``axis_name``.
+
+    Args/returns match :func:`cbf_tpu.parallel.ring.ring_knn` exactly
+    (tested equal). Memory: every device materializes the full (N, 4)
+    candidate array and an (n_local, N) distance slab.
+    """
+    n_local = states4_local.shape[0]
+    # (n_sp, n_local, 4) -> (N, 4): every shard's agents, shard-major.
+    all_states = lax.all_gather(states4_local, axis_name).reshape(-1, 4)
+    n_total = all_states.shape[0]
+
+    diff = states4_local[:, None, :2] - all_states[None, :, :2]
+    dist = safe_norm(diff)                               # (n_local, N)
+    eligible = (dist < radius) & (dist > 0)
+    keyed = jnp.where(eligible, dist, jnp.inf)
+    k_eff = min(k, n_total)                              # top_k needs k <= N
+    neg_d, idx = lax.top_k(-keyed, k_eff)
+    best_d = -neg_d
+    obs = jnp.take(all_states, idx, axis=0)              # (n_local, k_eff, 4)
+    if k_eff < k:                                        # pad to the k slots
+        pad = k - k_eff
+        best_d = jnp.concatenate(
+            [best_d, jnp.full((n_local, pad), jnp.inf, best_d.dtype)], axis=1)
+        obs = jnp.concatenate(
+            [obs, jnp.zeros((n_local, pad, 4), obs.dtype)], axis=1)
+    mask = jnp.isfinite(best_d)
+    if return_distances:
+        return obs, mask, best_d
+    return obs, mask
+
+
+def exchange_knn(states4_local, k: int, radius, axis_name: str,
+                 return_distances: bool = False, *,
+                 n_total: int | None = None):
+    """Sharded k-NN gating, picking all-gather vs ring by gathered size.
+
+    ``n_total``: global agent count (n_local * n_sp). Must be static at
+    trace time; pass it from the scenario config — inside ``shard_map`` the
+    axis size is available but n_local * size is computed here when None.
+    """
+    if n_total is None:
+        n_total = states4_local.shape[0] * lax.axis_size(axis_name)
+    slab_bytes = (states4_local.shape[0] * n_total
+                  * states4_local.dtype.itemsize)
+    if slab_bytes <= ALL_GATHER_MAX_SLAB_BYTES:
+        return all_gather_knn(states4_local, k, radius, axis_name,
+                              return_distances)
+    return ring_knn(states4_local, k, radius, axis_name, return_distances)

@@ -4,9 +4,11 @@ The BASELINE.md ladder's distributed rungs: Monte-Carlo ensembles of
 independent swarms sharded over the ``dp`` mesh axis (the reference's
 "distributed execution" equivalent — SURVEY.md §2.7: swarm instances are
 embarrassingly parallel), and each swarm's agents optionally sharded over
-``sp`` with the ppermute ring of cbf_tpu.parallel.ring doing the pairwise
-neighbor search. The only cross-device traffic is the ring permute (ICI),
-the per-step psum for the global centroid, and pmin metric reductions.
+``sp`` with :func:`cbf_tpu.parallel.alltoall.exchange_knn` doing the
+pairwise neighbor search — one ``all_gather`` of the compact states at
+practical sizes, the ``ppermute`` ring beyond the slab-memory threshold.
+The only cross-device traffic is that exchange collective (ICI), the
+per-step psum for the global centroid, and pmin metric reductions.
 """
 
 from __future__ import annotations
@@ -32,7 +34,7 @@ except ImportError:  # pragma: no cover
                               out_specs=out_specs)
 
 from cbf_tpu.core.filter import CBFParams, safe_controls
-from cbf_tpu.parallel.ring import ring_knn
+from cbf_tpu.parallel.alltoall import exchange_knn
 from cbf_tpu.scenarios import swarm as swarm_scenario
 from cbf_tpu.utils.math import safe_norm
 
@@ -74,8 +76,10 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
     u0 = u0 * jnp.minimum(1.0, cfg.speed_limit / jnp.maximum(speed, 1e-9))
 
     states4 = jnp.concatenate([x, v], axis=1)
-    obs_slab, mask, nearest_d = ring_knn(
-        states4, K, cfg.safety_distance, axis_name, return_distances=True)
+    # exchange_knn picks all-gather vs ppermute-ring by gathered size
+    # (Ulysses-vs-ring duality — parallel.alltoall).
+    obs_slab, mask, nearest_d = exchange_knn(
+        states4, K, cfg.safety_distance, axis_name, True, n_total=cfg.n)
 
     u_safe, info = safe_controls(states4, obs_slab, mask, f, g, u0, cbf,
                                  unroll_relax=unroll_relax)

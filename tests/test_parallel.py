@@ -110,3 +110,110 @@ def test_train_step_runs_and_descends():
     assert np.isfinite(losses).all(), losses
     # Gradients are real: params moved.
     assert abs(float(params.gamma_raw) - float(init_params().gamma_raw)) > 0
+
+
+def test_all_gather_knn_matches_ring():
+    """Ulysses-style all-gather exchange == ring exchange == single-device
+    gating, on a real 4-way sp shard."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from cbf_tpu.parallel import make_mesh
+    from cbf_tpu.parallel.alltoall import all_gather_knn, exchange_knn
+    from cbf_tpu.parallel.ensemble import shard_map
+    from cbf_tpu.parallel.ring import ring_knn
+    from cbf_tpu.rollout.gating import knn_gating
+
+    rng = np.random.default_rng(5)
+    n, k, radius = 64, 6, 0.6
+    states = jnp.asarray(
+        np.concatenate([rng.uniform(-1.5, 1.5, (n, 2)),
+                        rng.normal(0, 0.1, (n, 2))], axis=1), jnp.float32)
+
+    mesh = make_mesh(n_dp=2, n_sp=4)
+
+    def run(fn):
+        f = shard_map(lambda s: fn(s, k, radius, "sp", True),
+                      mesh=mesh, in_specs=P("sp", None),
+                      out_specs=(P("sp", None, None), P("sp", None),
+                                 P("sp", None)))
+        return jax.jit(f)(states)
+
+    obs_r, mask_r, d_r = run(ring_knn)
+    obs_a, mask_a, d_a = run(all_gather_knn)
+    obs_x, mask_x, d_x = run(exchange_knn)
+
+    np.testing.assert_array_equal(np.asarray(mask_r), np.asarray(mask_a))
+    np.testing.assert_allclose(np.asarray(d_r), np.asarray(d_a), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.where(np.asarray(mask_r)[..., None], np.asarray(obs_r), 0),
+        np.where(np.asarray(mask_a)[..., None], np.asarray(obs_a), 0),
+        rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(mask_a), np.asarray(mask_x))
+
+    # And both equal the unsharded single-device gating.
+    obs_s, mask_s = knn_gating(states, states, radius, k,
+                               exclude_self_row=jnp.ones(n, bool))
+    np.testing.assert_array_equal(np.asarray(mask_s), np.asarray(mask_a))
+    np.testing.assert_allclose(
+        np.where(np.asarray(mask_s)[..., None], np.asarray(obs_s), 0),
+        np.where(np.asarray(mask_a)[..., None], np.asarray(obs_a), 0),
+        rtol=1e-6)
+
+
+def test_exchange_knn_ring_branch(monkeypatch):
+    """Force the threshold to 0 so exchange_knn takes the RING branch and
+    still matches all-gather (the auto-dispatch itself under test)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from cbf_tpu.parallel import alltoall, make_mesh
+    from cbf_tpu.parallel.ensemble import shard_map
+
+    monkeypatch.setattr(alltoall, "ALL_GATHER_MAX_SLAB_BYTES", 0)
+    rng = np.random.default_rng(9)
+    n, k, radius = 32, 4, 0.6
+    states = jnp.asarray(
+        np.concatenate([rng.uniform(-1, 1, (n, 2)),
+                        np.zeros((n, 2))], axis=1), jnp.float32)
+    mesh = make_mesh(n_dp=2, n_sp=4)
+
+    def run(fn):
+        f = shard_map(lambda s: fn(s, k, radius, "sp", True),
+                      mesh=mesh, in_specs=P("sp", None),
+                      out_specs=(P("sp", None, None), P("sp", None),
+                                 P("sp", None)))
+        return jax.jit(f)(states)
+
+    obs_x, mask_x, d_x = run(alltoall.exchange_knn)      # -> ring branch
+    obs_a, mask_a, d_a = run(alltoall.all_gather_knn)
+    np.testing.assert_array_equal(np.asarray(mask_x), np.asarray(mask_a))
+    np.testing.assert_allclose(np.asarray(d_x), np.asarray(d_a), rtol=1e-6)
+
+
+def test_all_gather_knn_k_exceeds_total():
+    """k > global agent count: clamps + pads instead of crashing (matches
+    ring_knn's tolerance)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from cbf_tpu.parallel import make_mesh
+    from cbf_tpu.parallel.alltoall import all_gather_knn
+    from cbf_tpu.parallel.ensemble import shard_map
+
+    states = jnp.asarray(
+        [[0.0, 0.0, 0, 0], [0.1, 0.0, 0, 0],
+         [0.0, 0.1, 0, 0], [5.0, 5.0, 0, 0]], jnp.float32)
+    mesh = make_mesh(n_dp=2, n_sp=4)
+    f = shard_map(lambda s: all_gather_knn(s, 8, 0.5, "sp", True),
+                  mesh=mesh, in_specs=P("sp", None),
+                  out_specs=(P("sp", None, None), P("sp", None),
+                             P("sp", None)))
+    obs, mask, d = jax.jit(f)(states)
+    assert obs.shape == (4, 8, 4) and mask.shape == (4, 8)
+    m = np.asarray(mask)
+    assert m[:3].sum(axis=1).tolist() == [2, 2, 2]   # 3-clique neighbors
+    assert m[3].sum() == 0                           # isolated agent
