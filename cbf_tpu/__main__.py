@@ -26,7 +26,8 @@ import sys
 def _scenarios():
     from cbf_tpu.render import (render_cross_and_rescue, render_meet_at_center,
                                 render_swarm)
-    from cbf_tpu.scenarios import cross_and_rescue, meet_at_center, swarm
+    from cbf_tpu.scenarios import (antipodal, cross_and_rescue,
+                                   meet_at_center, swarm)
 
     # Last field: the recorded trajectory layout — "dims_major" = (T, 2, N)
     # columns-of-agents (the sim-layer convention), "agent_major" = (T, N, 2).
@@ -43,6 +44,10 @@ def _scenarios():
         "swarm": (swarm, "steps",
                   lambda outs, cfg, path: render_swarm(outs.trajectory, path),
                   "agent_major"),
+        "antipodal": (antipodal, "steps",
+                      lambda outs, cfg, path: render_swarm(
+                          outs.trajectory, path),
+                      "agent_major"),
     }
 
 

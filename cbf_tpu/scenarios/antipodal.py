@@ -1,0 +1,161 @@
+"""Scenario 4: antipodal position swap — the classic CBF stress test.
+
+N agents start on a circle and must swap to their antipodal points, so
+every straight-line path crosses the center simultaneously: the densest
+possible filter engagement, the standard benchmark scene in the CBF
+literature for deadlock/liveness behavior. The reference has no such
+scenario (its two scenes engage the filter on a handful of agent-steps);
+this one exists to stress exactly what the reference's machinery is for —
+the same barrier math and relax policy (cbf.py:38-87 semantics), under
+maximal sustained load.
+
+Standard symmetric-deadlock mitigation: a counter-clockwise bias rotates
+the nominal go-to-goal command (constant ``swirl``), with an additional
+engagement-adaptive term (``swirl_engaged``, the right-hand-rule
+deconfliction: agents whose gating mask is live rotate harder around the
+blocker). The bias lives in the nominal controller only — the safety layer
+is untouched. Measured at N=32: without the adaptive term 4 agents end in
+a symmetric standoff; with it all 32 reach their antipodes exactly while
+the min pairwise distance stays pinned at the L1 barrier floor.
+
+Run headless: ``python -m cbf_tpu.scenarios.antipodal``; or
+``python -m cbf_tpu run antipodal --video swap.gif``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from cbf_tpu.core.filter import CBFParams, safe_controls
+from cbf_tpu.rollout.engine import StepOutputs, min_pairwise_distance, rollout
+from cbf_tpu.rollout.gating import knn_gating
+from cbf_tpu.sim.controllers import si_position_controller
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    n: int = 32
+    steps: int = 1500
+    k_neighbors: int = 8
+    safety_distance: float = 0.4
+    # Circle radius scales with N so the start ring itself is collision-free
+    # (arc spacing >= 0.3 m).
+    min_radius: float = 1.2
+    speed_limit: float = 0.15
+    goal_gain: float = 1.0
+    # Counter-clockwise nominal-command bias (radians) — symmetric-deadlock
+    # mitigation; 0 disables.
+    swirl: float = 0.35
+    # Extra swirl applied only to agents whose gating mask is live (the
+    # right-hand-rule deconfliction): blocked agents rotate harder around
+    # the blocker instead of pushing into the standoff. With 0 extra,
+    # symmetric 4-agent standoffs persist near the goals (28/32 arrivals);
+    # with 0.4, all 32 arrive exactly (measured, N=32).
+    swirl_engaged: float = 0.4
+    # Deterministic per-agent angular spawn jitter (fraction of the agent
+    # spacing) — an alternative symmetry breaker, off by default since the
+    # adaptive swirl resolves the standoffs on its own.
+    spawn_jitter: float = 0.0
+    seed: int = 0
+    max_speed: float = 15.0
+    dyn_scale: float = 0.1             # reference dynamics scale
+    dt: float = 0.033
+    record_trajectory: bool = False
+    dtype: type = jnp.float32
+
+    @property
+    def circle_radius(self) -> float:
+        return max(self.min_radius, 0.3 * self.n / (2 * np.pi))
+
+
+class State(NamedTuple):
+    x: jnp.ndarray     # (N, 2)
+    v: jnp.ndarray     # (N, 2) previous filtered velocities
+
+
+def initial_state(cfg: Config) -> State:
+    th = 2 * np.pi * np.arange(cfg.n) / cfg.n
+    spacing = 2 * np.pi / cfg.n
+    rng = np.random.default_rng(cfg.seed)
+    th = th + cfg.spawn_jitter * spacing * rng.uniform(-0.5, 0.5, cfg.n)
+    x0 = cfg.circle_radius * np.stack([np.cos(th), np.sin(th)], axis=1)
+    return State(x=jnp.asarray(x0, cfg.dtype),
+                 v=jnp.zeros((cfg.n, 2), cfg.dtype))
+
+
+def goals(cfg: Config) -> jnp.ndarray:
+    """(N, 2): each agent's antipodal point."""
+    x0 = np.asarray(initial_state(cfg).x)
+    return jnp.asarray(-x0, cfg.dtype)
+
+
+def make(cfg: Config = Config(), cbf: CBFParams | None = None):
+    if cbf is None:
+        cbf = CBFParams(max_speed=cfg.max_speed, k=0.0)
+    dt_ = cfg.dtype
+    f = cfg.dyn_scale * jnp.zeros((4, 4), dt_)
+    g = cfg.dyn_scale * jnp.array([[1, 0], [0, 1], [0, 0], [0, 0]], dt_)
+    K = min(cfg.k_neighbors, cfg.n - 1)
+    target = goals(cfg)
+
+    state0 = initial_state(cfg)
+
+    def step(state: State, t):
+        x = state.x
+        states4 = jnp.concatenate([x, state.v], axis=1)
+        obs_slab, mask = knn_gating(
+            states4, states4, cfg.safety_distance, K,
+            exclude_self_row=jnp.ones(cfg.n, bool))
+        engaged = jnp.any(mask, axis=1)
+
+        u0 = si_position_controller(x.T, target.T, cfg.goal_gain,
+                                    cfg.speed_limit).T       # (N, 2)
+        # Per-agent swirl: base bias plus the engagement-adaptive term.
+        ang = cfg.swirl + cfg.swirl_engaged * engaged.astype(dt_)
+        c, s = jnp.cos(ang), jnp.sin(ang)
+        u0 = jnp.stack([c * u0[:, 0] - s * u0[:, 1],
+                        s * u0[:, 0] + c * u0[:, 1]], axis=1)
+
+        u_safe, info = safe_controls(states4, obs_slab, mask, f, g, u0, cbf)
+        u = jnp.where(engaged[:, None], u_safe, u0)
+
+        x_new = x + cfg.dt * u
+        out = StepOutputs(
+            min_pairwise_distance=min_pairwise_distance(x.T),
+            filter_active_count=jnp.sum(engaged),
+            infeasible_count=jnp.sum(~info.feasible & engaged),
+            max_relax_rounds=jnp.max(info.relax_rounds),
+            trajectory=x if cfg.record_trajectory else (),
+        )
+        return State(x=x_new, v=u), out
+
+    return state0, step
+
+
+def run(cfg: Config = Config(), **kw):
+    state0, step = make(cfg, **kw)
+    return rollout(step, state0, cfg.steps)
+
+
+def main():
+    cfg = Config()
+    final, outs = run(cfg)
+    d_goal = np.linalg.norm(np.asarray(final.x) - np.asarray(goals(cfg)),
+                            axis=1)
+    md = float(np.asarray(outs.min_pairwise_distance).min())
+    print(f"antipodal swap: N={cfg.n}, {cfg.steps} steps")
+    print(f"  agents within 0.2 m of antipode: {(d_goal < 0.2).sum()}/{cfg.n}"
+          f" (mean residual {d_goal.mean():.3f} m)")
+    print(f"  min pairwise distance over run: {md:.4f} m "
+          f"(L1 barrier floor {0.2 / np.sqrt(2):.4f})")
+    print(f"  filter engaged {int(np.asarray(outs.filter_active_count).sum())}"
+          f" agent-steps; infeasible "
+          f"{int(np.asarray(outs.infeasible_count).sum())}")
+
+
+if __name__ == "__main__":
+    main()

@@ -120,3 +120,24 @@ def test_meet_at_center_trace_oracle_parity(x64):
         np.testing.assert_allclose(
             np.asarray(state.poses), poses, atol=5e-5,
             err_msg=f"trajectory diverged from oracle replay at step {t}")
+
+
+def test_antipodal_swap_completes_safely(x64):
+    """The CBF stress benchmark: all agents cross the center to their
+    antipodes under maximal filter engagement, with zero infeasibility and
+    the min pairwise distance pinned at (never below) the L1 barrier
+    floor."""
+    import numpy as np
+
+    from cbf_tpu.scenarios import antipodal
+
+    cfg = antipodal.Config(n=16, steps=1200)
+    final, outs = antipodal.run(cfg)
+    d = np.linalg.norm(np.asarray(final.x) - np.asarray(antipodal.goals(cfg)),
+                       axis=1)
+    assert (d < 0.2).sum() == cfg.n, d
+    md = float(np.asarray(outs.min_pairwise_distance).min())
+    assert md > 0.2 / np.sqrt(2) - 5e-3
+    assert int(np.asarray(outs.infeasible_count).sum()) == 0
+    # It IS a stress test: the filter must have engaged heavily.
+    assert int(np.asarray(outs.filter_active_count).sum()) > 100 * cfg.n
