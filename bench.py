@@ -165,11 +165,12 @@ def _child_single(n: int, steps: int) -> dict:
 
     min_dist = float(np.asarray(outs.min_pairwise_distance).min())
     infeasible = int(np.asarray(outs.infeasible_count).sum())
+    dropped = int(np.asarray(outs.gating_dropped_count).sum())
     rate = n * steps / wall
 
     print(f"bench: wall={wall:.3f}s (first run incl. compile "
           f"{compile_and_first:.1f}s), min_dist={min_dist:.4f}, "
-          f"infeasible={infeasible}", file=sys.stderr)
+          f"infeasible={infeasible}, knn_dropped={dropped}", file=sys.stderr)
 
     err = _check_safety(min_dist, infeasible)
     if err:
@@ -218,6 +219,7 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
     # distance — the same separation series the single-chip mode floors.
     min_dist = float(np.asarray(mets.nearest_distance).min())
     infeasible = int(np.asarray(mets.infeasible_count).sum())
+    dropped = int(np.asarray(mets.dropped_count).sum())
     rate_per_chip = E * n * steps / wall / chips
 
     # Gate on safety before spending two more rollouts on the efficiency
@@ -247,8 +249,8 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
 
     print(f"bench: wall={wall:.3f}s (first incl. compile "
           f"{compile_and_first:.1f}s), min_dist={min_dist:.4f}, "
-          f"infeasible={infeasible}, efficiency={efficiency:.3f}",
-          file=sys.stderr)
+          f"infeasible={infeasible}, knn_dropped={dropped}, "
+          f"efficiency={efficiency:.3f}", file=sys.stderr)
 
     return {
         "metric": "agent-QP-steps/sec/chip (ensemble E=%d x N=%d)" % (E, n),

@@ -77,8 +77,8 @@ def _pad_coords(x, radius, blk: int):
     return xp, yp, r2, n_pad
 
 
-def _knn_kernel(r2_ref, xs_ref, ys_ref, idx_ref, dist_ref, nearest_ref, *,
-                k: int, n: int, n_pad: int):
+def _knn_kernel(r2_ref, xs_ref, ys_ref, idx_ref, dist_ref, nearest_ref,
+                cnt_ref, *, k: int, n: int, n_pad: int):
     i = pl.program_id(0)
     radius2 = r2_ref[0]
     xr = xs_ref[0, pl.ds(i * TILE, TILE)]                    # (TILE,)
@@ -100,7 +100,11 @@ def _knn_kernel(r2_ref, xs_ref, ys_ref, idx_ref, dist_ref, nearest_ref, *,
     # Danger eligibility: 0 < d < radius (the reference's `distance > 0`
     # self-exclusion — meet_at_center.py:132 — which also drops exact
     # coincidences, matching gating.knn_gating).
-    key = jnp.where((d2 < radius2) & (d2 > 0.0) & in_range, d2, jnp.inf)
+    eligible = (d2 < radius2) & (d2 > 0.0) & in_range
+    key = jnp.where(eligible, d2, jnp.inf)
+    # Total in-radius candidates per row — callers turn this into the
+    # dropped-beyond-k truncation diagnostic (see knn_gating_pallas).
+    cnt_ref[:, 0] = jnp.sum(eligible.astype(jnp.int32), axis=1)
 
     for t in range(k):                                       # static unroll
         m = jnp.min(key, axis=1)                             # (TILE,)
@@ -116,7 +120,9 @@ def knn_neighbors(x, radius, k: int, *, interpret: bool = False):
     """Fused k-NN danger gating over (N, 2) positions.
 
     Returns (idx (N, k) int32, dist (N, k) f32 — inf on empty slots,
-    nearest_all (N,) f32 — nearest-any distance per agent).
+    nearest_all (N,) f32 — nearest-any distance per agent,
+    count (N,) int32 — total in-radius candidates per agent, including any
+    beyond the k slots).
     """
     n = x.shape[0]
     xp, yp, r2, n_pad = _pad_coords(x, radius, TILE)
@@ -125,7 +131,7 @@ def knn_neighbors(x, radius, k: int, *, interpret: bool = False):
     grid = (n_pad // TILE,)
     vmem = {} if _VMEM is None else {"memory_space": _VMEM}
     smem = {} if _SMEM is None else {"memory_space": _SMEM}
-    idx, dist, nearest = pl.pallas_call(
+    idx, dist, nearest, cnt = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[pl.BlockSpec((1,), lambda i: (0,), **smem),
@@ -133,17 +139,19 @@ def knn_neighbors(x, radius, k: int, *, interpret: bool = False):
                   pl.BlockSpec((1, n_pad), lambda i: (0, 0), **vmem)],
         out_specs=[pl.BlockSpec((TILE, k), lambda i: (i, 0), **vmem),
                    pl.BlockSpec((TILE, k), lambda i: (i, 0), **vmem),
+                   pl.BlockSpec((TILE, 1), lambda i: (i, 0), **vmem),
                    pl.BlockSpec((TILE, 1), lambda i: (i, 0), **vmem)],
         out_shape=[jax.ShapeDtypeStruct((n_pad, k), jnp.int32),
                    jax.ShapeDtypeStruct((n_pad, k), jnp.float32),
-                   jax.ShapeDtypeStruct((n_pad, 1), jnp.float32)],
+                   jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((n_pad, 1), jnp.int32)],
         interpret=interpret,
     )(r2, xp, yp)
-    return idx[:n], dist[:n], nearest[:n, 0]
+    return idx[:n], dist[:n], nearest[:n, 0], cnt[:n, 0]
 
 
 def _knn_kernel_blocked(r2_ref, xr_ref, yr_ref, xc_ref, yc_ref,
-                        idx_ref, d2_ref, near_ref, *,
+                        idx_ref, d2_ref, near_ref, cnt_ref, *,
                         k: int, n: int, n_col_blocks: int):
     """Streaming top-k: one RTILE row block accumulates its k nearest
     in-radius neighbors while CTILE column blocks stream past (grid dim 1,
@@ -154,13 +162,14 @@ def _knn_kernel_blocked(r2_ref, xr_ref, yr_ref, xc_ref, yc_ref,
     the last column step writes the sqrt.
     """
     _stream_step(r2_ref, xr_ref, yr_ref, xc_ref, yc_ref,
-                 idx_ref, d2_ref, near_ref,
+                 idx_ref, d2_ref, near_ref, cnt_ref,
                  col_base=pl.program_id(1) * CTILE, k=k, n=n,
                  last_col_step=n_col_blocks - 1)
 
 
 def _stream_step(r2_ref, xr_ref, yr_ref, xc_ref, yc_ref,
-                 idx_ref, d2_ref, near_ref, *, col_base, k, n, last_col_step):
+                 idx_ref, d2_ref, near_ref, cnt_ref, *,
+                 col_base, k, n, last_col_step):
     """One streaming-top-k grid step, shared by the blocked and banded
     kernels (they differ only in where the column block's global ids start
     — ``col_base`` — and which j is the final accumulation step).
@@ -178,6 +187,7 @@ def _stream_step(r2_ref, xr_ref, yr_ref, xc_ref, yc_ref,
         idx_ref[...] = jnp.zeros((RTILE, k), jnp.int32)
         d2_ref[...] = jnp.full((RTILE, k), jnp.inf, jnp.float32)
         near_ref[...] = jnp.full((RTILE, 1), jnp.inf, jnp.float32)
+        cnt_ref[...] = jnp.zeros((RTILE, 1), jnp.int32)
 
     xr = xr_ref[0, :]                                        # (RTILE,)
     yr = yr_ref[0, :]
@@ -195,7 +205,13 @@ def _stream_step(r2_ref, xr_ref, yr_ref, xc_ref, yc_ref,
     d2_all = jnp.where(is_self | ~in_range, jnp.inf, d2)
     near_ref[:, 0] = jnp.minimum(near_ref[:, 0], jnp.min(d2_all, axis=1))
 
-    key = jnp.where((d2 < radius2) & (d2 > 0.0) & in_range, d2, jnp.inf)
+    eligible = (d2 < radius2) & (d2 > 0.0) & in_range
+    key = jnp.where(eligible, d2, jnp.inf)
+    # Running in-radius candidate total (the truncation diagnostic) — must
+    # accumulate unconditionally: blocks skipped by the pl.when below have
+    # zero candidates and contribute zero anyway.
+    cnt_ref[:, 0] = cnt_ref[:, 0] + jnp.sum(eligible.astype(jnp.int32),
+                                            axis=1)
 
     # At sane densities the overwhelming majority of (row, column) block
     # pairs contain zero in-radius candidates — the distance slab and the
@@ -255,7 +271,7 @@ def knn_neighbors_blocked(x, radius, k: int, *, interpret: bool = False):
     grid = (n_pad // RTILE, n_col_blocks)
     vmem = {} if _VMEM is None else {"memory_space": _VMEM}
     smem = {} if _SMEM is None else {"memory_space": _SMEM}
-    idx, dist, nearest = pl.pallas_call(
+    idx, dist, nearest, cnt = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[pl.BlockSpec((1,), lambda i, j: (0,), **smem),
@@ -265,17 +281,19 @@ def knn_neighbors_blocked(x, radius, k: int, *, interpret: bool = False):
                   pl.BlockSpec((1, CTILE), lambda i, j: (0, j), **vmem)],
         out_specs=[pl.BlockSpec((RTILE, k), lambda i, j: (i, 0), **vmem),
                    pl.BlockSpec((RTILE, k), lambda i, j: (i, 0), **vmem),
+                   pl.BlockSpec((RTILE, 1), lambda i, j: (i, 0), **vmem),
                    pl.BlockSpec((RTILE, 1), lambda i, j: (i, 0), **vmem)],
         out_shape=[jax.ShapeDtypeStruct((n_pad, k), jnp.int32),
                    jax.ShapeDtypeStruct((n_pad, k), jnp.float32),
-                   jax.ShapeDtypeStruct((n_pad, 1), jnp.float32)],
+                   jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((n_pad, 1), jnp.int32)],
         interpret=interpret,
     )(r2, xp, yp, xp, yp)
-    return idx[:n], dist[:n], nearest[:n, 0]
+    return idx[:n], dist[:n], nearest[:n, 0], cnt[:n, 0]
 
 
 def _knn_kernel_banded(r2_ref, starts_ref, xr_ref, yr_ref, xc_ref, yc_ref,
-                       idx_ref, d2_ref, near_ref, *,
+                       idx_ref, d2_ref, near_ref, cnt_ref, *,
                        k: int, n: int, w: int):
     """Banded variant of :func:`_knn_kernel_blocked`: identical streaming
     top-k, but the w column blocks are this row block's pre-gathered
@@ -284,7 +302,7 @@ def _knn_kernel_banded(r2_ref, starts_ref, xr_ref, yr_ref, xc_ref, yc_ref,
     stack's Mosaic pipeline). ``starts_ref`` carries the window's first
     global sorted index, so column ids are ``starts[i] + j*CTILE + lane``."""
     _stream_step(r2_ref, xr_ref, yr_ref, xc_ref, yc_ref,
-                 idx_ref, d2_ref, near_ref,
+                 idx_ref, d2_ref, near_ref, cnt_ref,
                  col_base=starts_ref[0, 0] + pl.program_id(1) * CTILE,
                  k=k, n=n, last_col_step=w - 1)
 
@@ -308,7 +326,9 @@ def knn_neighbors_banded(x, radius, k: int, *, window_blocks: int,
     scenario counts it in StepOutputs). The nearest-any metric is exact
     when ≤ radius; beyond radius it is a window-local (over-)estimate.
 
-    Returns (idx (N, k), dist (N, k), nearest (N,), overflow (N,) bool).
+    Returns (idx (N, k), dist (N, k), nearest (N,), overflow (N,) bool,
+    count (N,) int32 — in-radius candidates seen within the window; add the
+    overflow flag for the rows where this undercounts).
     """
     if window_blocks < 1:
         raise ValueError(f"window_blocks must be >= 1, got {window_blocks}")
@@ -344,7 +364,7 @@ def knn_neighbors_banded(x, radius, k: int, *, window_blocks: int,
     kernel = functools.partial(_knn_kernel_banded, k=k, n=n, w=w)
     vmem = {} if _VMEM is None else {"memory_space": _VMEM}
     smem = {} if _SMEM is None else {"memory_space": _SMEM}
-    idx_s, dist_s, near_s = pl.pallas_call(
+    idx_s, dist_s, near_s, cnt_s = pl.pallas_call(
         kernel,
         grid=(n_row_blocks, w),
         in_specs=[pl.BlockSpec((1,), lambda i, j: (0,), **smem),
@@ -355,10 +375,12 @@ def knn_neighbors_banded(x, radius, k: int, *, window_blocks: int,
                   pl.BlockSpec((1, CTILE), lambda i, j: (i, j), **vmem)],
         out_specs=[pl.BlockSpec((RTILE, k), lambda i, j: (i, 0), **vmem),
                    pl.BlockSpec((RTILE, k), lambda i, j: (i, 0), **vmem),
+                   pl.BlockSpec((RTILE, 1), lambda i, j: (i, 0), **vmem),
                    pl.BlockSpec((RTILE, 1), lambda i, j: (i, 0), **vmem)],
         out_shape=[jax.ShapeDtypeStruct((n_pad, k), jnp.int32),
                    jax.ShapeDtypeStruct((n_pad, k), jnp.float32),
-                   jax.ShapeDtypeStruct((n_pad, 1), jnp.float32)],
+                   jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((n_pad, 1), jnp.int32)],
         interpret=interpret,
     )(r2, starts[:, None], xp, yp, xw, yw)
 
@@ -369,7 +391,8 @@ def knn_neighbors_banded(x, radius, k: int, *, window_blocks: int,
     dist = dist_s[:n][inv]
     nearest = near_s[:n, 0][inv]
     overflow = jnp.repeat(block_overflow, RTILE)[:n][inv]
-    return idx, dist, nearest, overflow
+    count = cnt_s[:n, 0][inv]
+    return idx, dist, nearest, overflow, count
 
 
 def supported(n: int) -> bool:
@@ -386,14 +409,18 @@ def knn_gating_pallas(states4, radius, k: int, *, interpret: bool = False):
     self-exclusion form) + the nearest-any metric.
 
     Args: states4 (N, 4). Returns (obs (N, k, 4), mask (N, k),
-    nearest_all (N,)).
+    nearest_all (N,), dropped (N,) int32 — in-radius candidates beyond the
+    k slots, i.e. the truncation vs. the reference's exact danger scan;
+    callers must surface it (StepOutputs.gating_dropped_count)).
     """
     n = states4.shape[0]
     fn = knn_neighbors if n <= MAX_N_FUSED else knn_neighbors_blocked
-    idx, dist, nearest = fn(states4[:, :2], radius, k, interpret=interpret)
+    idx, dist, nearest, count = fn(states4[:, :2], radius, k,
+                                   interpret=interpret)
     mask = jnp.isfinite(dist)
     obs = jnp.take(states4, idx, axis=0)
-    return obs, mask, nearest
+    dropped = jnp.maximum(count - k, 0)
+    return obs, mask, nearest, dropped
 
 
 def knn_gating_banded(states4, radius, k: int, *, window_blocks: int,
@@ -402,11 +429,13 @@ def knn_gating_banded(states4, radius, k: int, *, window_blocks: int,
 
     Returns (obs (N, k, 4), mask (N, k), nearest_all (N,),
     overflow (N,) bool — rows whose y-band exceeded the window; see
-    :func:`knn_neighbors_banded`).
+    :func:`knn_neighbors_banded` — and dropped (N,) int32, window-local
+    in-radius candidates beyond the k slots).
     """
-    idx, dist, nearest, overflow = knn_neighbors_banded(
+    idx, dist, nearest, overflow, count = knn_neighbors_banded(
         states4[:, :2], radius, k, window_blocks=window_blocks,
         interpret=interpret)
     mask = jnp.isfinite(dist)
     obs = jnp.take(states4, idx, axis=0)
-    return obs, mask, nearest, overflow
+    dropped = jnp.maximum(count - k, 0)
+    return obs, mask, nearest, overflow, dropped

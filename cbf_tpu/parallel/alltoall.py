@@ -34,7 +34,8 @@ ALL_GATHER_MAX_SLAB_BYTES = 32 * 1024 * 1024
 
 
 def all_gather_knn(states4_local, k: int, radius, axis_name: str,
-                   return_distances: bool = False):
+                   return_distances: bool = False,
+                   with_dropped: bool = False):
     """Top-k in-radius neighbors via one all-gather over ``axis_name``.
 
     Args/returns match :func:`cbf_tpu.parallel.ring.ring_knn` exactly
@@ -61,14 +62,19 @@ def all_gather_knn(states4_local, k: int, radius, axis_name: str,
         obs = jnp.concatenate(
             [obs, jnp.zeros((n_local, pad, 4), obs.dtype)], axis=1)
     mask = jnp.isfinite(best_d)
+    out = (obs, mask)
     if return_distances:
-        return obs, mask, best_d
-    return obs, mask
+        out = out + (best_d,)
+    if with_dropped:
+        dropped = jnp.maximum(
+            jnp.sum(eligible, axis=1, dtype=jnp.int32) - k, 0)
+        out = out + (dropped,)
+    return out
 
 
 def exchange_knn(states4_local, k: int, radius, axis_name: str,
                  return_distances: bool = False, *,
-                 n_total: int | None = None):
+                 with_dropped: bool = False, n_total: int | None = None):
     """Sharded k-NN gating, picking all-gather vs ring by gathered size.
 
     ``n_total``: global agent count (n_local * n_sp). Must be static at
@@ -81,5 +87,6 @@ def exchange_knn(states4_local, k: int, radius, axis_name: str,
                   * states4_local.dtype.itemsize)
     if slab_bytes <= ALL_GATHER_MAX_SLAB_BYTES:
         return all_gather_knn(states4_local, k, radius, axis_name,
-                              return_distances)
-    return ring_knn(states4_local, k, radius, axis_name, return_distances)
+                              return_distances, with_dropped)
+    return ring_knn(states4_local, k, radius, axis_name, return_distances,
+                    with_dropped)

@@ -43,6 +43,9 @@ class EnsembleMetrics(NamedTuple):
     nearest_distance: jax.Array    # (E, steps) min over agents of nearest-neighbor dist
     engaged_count: jax.Array       # (E, steps)
     infeasible_count: jax.Array    # (E, steps)
+    # (E, steps) in-radius neighbors dropped by k-NN truncation, summed over
+    # agents — the sharded twin of StepOutputs.gating_dropped_count.
+    dropped_count: jax.Array
 
 
 def ensemble_initial_states(cfg: swarm_scenario.Config, seeds):
@@ -78,8 +81,9 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
     states4 = jnp.concatenate([x, v], axis=1)
     # exchange_knn picks all-gather vs ppermute-ring by gathered size
     # (Ulysses-vs-ring duality — parallel.alltoall).
-    obs_slab, mask, nearest_d = exchange_knn(
-        states4, K, cfg.safety_distance, axis_name, True, n_total=cfg.n)
+    obs_slab, mask, nearest_d, dropped = exchange_knn(
+        states4, K, cfg.safety_distance, axis_name, True,
+        with_dropped=True, n_total=cfg.n)
 
     u_safe, info = safe_controls(states4, obs_slab, mask, f, g, u0, cbf,
                                  unroll_relax=unroll_relax)
@@ -93,6 +97,7 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
             lax.pmin(jnp.min(nearest_d[:, 0]), axis_name),
             lax.psum(jnp.sum(engaged), axis_name),
             lax.psum(jnp.sum(~info.feasible & engaged), axis_name),
+            lax.psum(jnp.sum(dropped), axis_name),
         )
     return x_new, u, metrics, nearest_d[:, 0]
 
@@ -133,7 +138,7 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
         local_rollout, mesh,
         in_specs=(spec_state, spec_state),
         out_specs=(spec_state, spec_state,
-                   (spec_metric, spec_metric, spec_metric)),
+                   (spec_metric, spec_metric, spec_metric, spec_metric)),
     )
     xf, vf, mets = jax.jit(fn)(x0, v0)
     return (xf, vf), EnsembleMetrics(*mets)

@@ -27,7 +27,7 @@ from cbf_tpu.utils.math import match_vma, safe_norm
 
 
 def ring_knn(states4_local, k: int, radius, axis_name: str,
-             return_distances: bool = False):
+             return_distances: bool = False, with_dropped: bool = False):
     """Top-k in-radius neighbors of each local agent over ALL shards.
 
     Args:
@@ -38,9 +38,12 @@ def ring_knn(states4_local, k: int, radius, axis_name: str,
       axis_name: the mesh axis to ring over.
       return_distances: also return the sorted (n_local, k) neighbor
         distances (inf where masked) for metric reuse.
+      with_dropped: also return the (n_local,) int32 count of in-radius
+        candidates beyond the k slots (truncation diagnostic — the same
+        contract as ``gating.knn_gating(with_dropped=True)``).
 
-    Returns (obs: (n_local, k, 4), mask: (n_local, k) bool)[, distances],
-    aligned with the single-device
+    Returns (obs: (n_local, k, 4), mask: (n_local, k) bool)[, distances]
+    [, dropped], aligned with the single-device
     :func:`cbf_tpu.rollout.gating.knn_gating` contract.
     """
     n_shards = lax.axis_size(axis_name)
@@ -50,10 +53,11 @@ def ring_knn(states4_local, k: int, radius, axis_name: str,
     perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
 
     def hop(_, carry):
-        best_d, best_s, block = carry
+        best_d, best_s, count, block = carry
         diff = states4_local[:, None, :2] - block[None, :, :2]
         dist = safe_norm(diff)                                 # (n_local, m)
         eligible = (dist < radius) & (dist > 0)
+        count = count + jnp.sum(eligible, axis=1, dtype=jnp.int32)
         keyed = jnp.where(eligible, dist, jnp.inf)
         cat_d = jnp.concatenate([best_d, keyed], axis=1)       # (n_local, k+m)
         cat_s = jnp.concatenate(
@@ -65,16 +69,20 @@ def ring_knn(states4_local, k: int, radius, axis_name: str,
         best_d = -neg_d
         best_s = jnp.take_along_axis(cat_s, idx[:, :, None], axis=1)
         block = lax.ppermute(block, axis_name, perm)
-        return best_d, best_s, block
+        return best_d, best_s, count, block
 
     # The loop carry must enter with the same device-varying type it leaves
     # with (JAX tracks manual-axes variance through shard_map loops).
     best_d0 = match_vma(jnp.full((n_local, k), jnp.inf, dtype), states4_local)
     best_s0 = match_vma(jnp.zeros((n_local, k, 4), dtype), states4_local)
-    best_d, best_s, _ = lax.fori_loop(
-        0, n_shards, hop, (best_d0, best_s0, states4_local)
+    count0 = match_vma(jnp.zeros((n_local,), jnp.int32), states4_local)
+    best_d, best_s, count, _ = lax.fori_loop(
+        0, n_shards, hop, (best_d0, best_s0, count0, states4_local)
     )
     mask = jnp.isfinite(best_d)
+    out = (best_s, mask)
     if return_distances:
-        return best_s, mask, best_d
-    return best_s, mask
+        out = out + (best_d,)
+    if with_dropped:
+        out = out + (jnp.maximum(count - k, 0),)
+    return out

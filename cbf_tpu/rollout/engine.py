@@ -38,6 +38,10 @@ class StepOutputs(NamedTuple):
     # Agents whose banded-gating y-window overflowed (possible missed
     # neighbors — see ops.pallas_knn.knn_neighbors_banded); () elsewhere.
     gating_overflow_count: Any = ()
+    # Total in-radius neighbors dropped by k-NN truncation this step (the
+    # deliberate deviation from the reference's exact danger scan,
+    # meet_at_center.py:124-133, made observable); () on exact-gating paths.
+    gating_dropped_count: Any = ()
 
 
 @functools.partial(jax.jit, static_argnames=("step_fn", "steps", "unroll"))

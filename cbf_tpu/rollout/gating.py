@@ -49,7 +49,7 @@ def danger_slab(agent_states, candidate_states, radius, exclude_self_row=None):
 
 
 def knn_gating(agent_states, candidate_states, radius, k: int,
-               exclude_self_row=None, dist=None):
+               exclude_self_row=None, dist=None, with_dropped: bool = False):
     """Top-k nearest in-radius gating for large swarms.
 
     Same contract as :func:`danger_slab` but returns a (N, k, 4) slab of the
@@ -57,6 +57,13 @@ def knn_gating(agent_states, candidate_states, radius, k: int,
     pushed to +inf distance before the top-k. ``k`` is clamped to the
     candidate count. ``dist`` may pass a precomputed (N, M) distance matrix
     (e.g. when the caller also derives metrics from it).
+
+    With ``with_dropped=True`` a third (N,) int32 output counts, per agent,
+    the in-radius candidates that did NOT fit in the k slots — the
+    truncation this path silently applies relative to the reference's exact
+    danger scan (meet_at_center.py:124-133). Callers on the scaling path
+    must surface it (StepOutputs.gating_dropped_count) so a too-small k is
+    an observable event, not a silent safety degradation.
     """
     if dist is None:
         diff = agent_states[:, None, :2] - candidate_states[None, :, :2]
@@ -69,4 +76,8 @@ def knn_gating(agent_states, candidate_states, radius, k: int,
     neg_d, idx = lax.top_k(-keyed, k)                          # (N, k)
     mask = jnp.isfinite(-neg_d)
     obs = jnp.take(candidate_states, idx, axis=0)              # (N, k, 4)
+    if with_dropped:
+        dropped = jnp.maximum(
+            jnp.sum(eligible, axis=1, dtype=jnp.int32) - k, 0)
+        return obs, mask, dropped
     return obs, mask

@@ -107,9 +107,9 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
     def step(state: State, t):
         x = state.x
         states4 = jnp.concatenate([x, state.v], axis=1)
-        obs_slab, mask = knn_gating(
+        obs_slab, mask, dropped = knn_gating(
             states4, states4, cfg.safety_distance, K,
-            exclude_self_row=jnp.ones(cfg.n, bool))
+            exclude_self_row=jnp.ones(cfg.n, bool), with_dropped=True)
         engaged = jnp.any(mask, axis=1)
 
         u0 = si_position_controller(x.T, target.T, cfg.goal_gain,
@@ -130,6 +130,7 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
             infeasible_count=jnp.sum(~info.feasible & engaged),
             max_relax_rounds=jnp.max(info.relax_rounds),
             trajectory=x if cfg.record_trajectory else (),
+            gating_dropped_count=jnp.sum(dropped),
         )
         return State(x=x_new, v=u), out
 

@@ -185,7 +185,7 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
         if use_banded:
             # O(N*W) y-sorted banded kernel; window overflow (possible
             # missed neighbors) is surfaced, never swallowed.
-            obs_slab, mask, nearest, overflow = knn_gating_banded(
+            obs_slab, mask, nearest, overflow, dropped = knn_gating_banded(
                 states4, cfg.safety_distance, K,
                 window_blocks=window_blocks, interpret=pallas_interpret)
             min_dist = jnp.min(nearest)
@@ -193,16 +193,17 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
         elif use_pallas:
             # Fused Pallas kernel: distances + k-NN + nearest-any metric in
             # one VMEM-resident pass (ops.pallas_knn).
-            obs_slab, mask, nearest = knn_gating_pallas(
+            obs_slab, mask, nearest, dropped = knn_gating_pallas(
                 states4, cfg.safety_distance, K, interpret=pallas_interpret)
             min_dist = jnp.min(nearest)
         else:
             # jnp path: one pairwise-distance computation feeds both the
             # k-NN gating and the min-distance safety metric.
             dist = pairwise_distances(x)                       # (N, N)
-            obs_slab, mask = knn_gating(
+            obs_slab, mask, dropped = knn_gating(
                 states4, states4, cfg.safety_distance, K,
                 exclude_self_row=jnp.ones(x.shape[0], bool), dist=dist,
+                with_dropped=True,
             )
             off = dist + jnp.where(jnp.eye(x.shape[0], dtype=bool), jnp.inf, 0.0)
             min_dist = jnp.min(off)
@@ -221,6 +222,7 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
             max_relax_rounds=jnp.max(info.relax_rounds),
             trajectory=x if cfg.record_trajectory else (),
             gating_overflow_count=overflow_count,
+            gating_dropped_count=jnp.sum(dropped),
         )
         return State(x=x_new, v=v_new), out
 

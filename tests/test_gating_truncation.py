@@ -1,0 +1,163 @@
+"""k-NN truncation surfacing: the deliberate deviation from the reference's
+exact danger scan (meet_at_center.py:124-133) must be observable and bounded.
+
+The scaling path keeps only the K nearest in-radius neighbors
+(rollout/gating.knn_gating, ops/pallas_knn). At packed densities an agent
+has more than K in-radius neighbors; these tests (a) assert the dropped
+count surfaces on every gating path, (b) measure the resulting control
+deviation vs. the exact all-candidate slab and pin it to a bound, and
+(c) prove exactness wherever nothing was dropped.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cbf_tpu.core.filter import CBFParams, safe_controls
+from cbf_tpu.rollout.gating import danger_slab, knn_gating
+
+
+RADIUS = 0.4
+K = 8
+
+
+def _packed_states(n: int, spacing: float, rng) -> np.ndarray:
+    """A jittered hex-ish grid at the swarm's packed spacing (~0.14-0.2 m
+    inside the 0.4 m radius — the density regime of the N=4096 bench)."""
+    side = int(np.ceil(np.sqrt(n)))
+    lin = np.arange(side) * spacing
+    gx, gy = np.meshgrid(lin, lin)
+    gx = gx + (np.arange(side)[:, None] % 2) * spacing / 2   # stagger rows
+    pos = np.stack([gx.ravel(), gy.ravel()], 1)[:n]
+    pos = pos + rng.uniform(-0.1 * spacing, 0.1 * spacing, (n, 2))
+    return np.concatenate([pos, np.zeros((n, 2))], 1).astype(np.float32)
+
+
+def _controls(states4, obs, mask, cbf):
+    f = 0.1 * jnp.zeros((4, 4), jnp.float32)
+    g = 0.1 * jnp.array([[1, 0], [0, 1], [0, 0], [0, 0]], jnp.float32)
+    x = states4[:, :2]
+    to_c = jnp.mean(x, axis=0)[None] - x
+    d = jnp.linalg.norm(to_c, axis=1, keepdims=True)
+    u0 = to_c / jnp.maximum(d, 1e-9) * jnp.minimum(d, 0.2)
+    u, info = safe_controls(states4, obs, mask, f, g, u0, cbf)
+    engaged = jnp.any(mask, axis=1)
+    return np.asarray(jnp.where(engaged[:, None], u, u0)), info
+
+
+def test_dropped_count_positive_at_packed_density(rng):
+    """At the bench's packed spacing an agent has >K in-radius neighbors:
+    the truncation must be reported, not silent."""
+    s = jnp.asarray(_packed_states(512, 0.15, rng))
+    _, mask, dropped = knn_gating(s, s, RADIUS, K,
+                                  exclude_self_row=jnp.ones(len(s), bool),
+                                  with_dropped=True)
+    dropped = np.asarray(dropped)
+    # Interior agents at 0.15 m spacing have ~20 in-radius neighbors.
+    assert dropped.max() > 0
+    assert (dropped > 0).sum() > 256          # most of the grid truncates
+    # Cross-check against the exact eligibility count.
+    _, mask_exact = danger_slab(s, s, RADIUS,
+                                exclude_self_row=jnp.ones(len(s), bool))
+    expect = np.maximum(np.asarray(mask_exact).sum(1) - K, 0)
+    np.testing.assert_array_equal(dropped, expect)
+
+
+def test_dropped_count_zero_when_sparse(rng):
+    s = jnp.asarray(np.concatenate(
+        [rng.uniform(-50, 50, (64, 2)), np.zeros((64, 2))], 1), np.float32)
+    _, _, dropped = knn_gating(s, s, RADIUS, K,
+                               exclude_self_row=jnp.ones(64, bool),
+                               with_dropped=True)
+    assert not np.asarray(dropped).any()
+
+
+def test_controls_exact_where_nothing_dropped(rng):
+    """Agents whose in-radius set fits the K slots see the *same* candidate
+    set as the exact scan — their filtered controls must match exactly
+    (the QP is row-order invariant)."""
+    s = jnp.asarray(_packed_states(256, 0.28, rng))   # moderate density
+    cbf = CBFParams(max_speed=15.0, k=0.0)
+    obs_k, mask_k, dropped = knn_gating(
+        s, s, RADIUS, K, exclude_self_row=jnp.ones(len(s), bool),
+        with_dropped=True)
+    obs_e, mask_e = danger_slab(s, s, RADIUS,
+                                exclude_self_row=jnp.ones(len(s), bool))
+    u_k, _ = _controls(s, obs_k, mask_k, cbf)
+    u_e, _ = _controls(s, obs_e, mask_e, cbf)
+    clean = np.asarray(dropped) == 0
+    assert clean.any()
+    np.testing.assert_allclose(u_k[clean], u_e[clean], atol=1e-6)
+
+
+def test_control_deviation_bounded_at_packed_density(rng):
+    """Where truncation DOES occur, measure the control deviation vs. the
+    exact slab and pin it: the K nearest in-radius rows dominate the QP, so
+    dropping the farther rows must not change the control materially.
+
+    This is the measured bound VERDICT r2 asked for under the headline
+    bench number (the 6M agent-steps/s path runs exactly this gating)."""
+    n = 512
+    s = jnp.asarray(_packed_states(n, 0.15, rng))
+    cbf = CBFParams(max_speed=15.0, k=0.0)
+
+    obs_k, mask_k, dropped = knn_gating(
+        s, s, RADIUS, K, exclude_self_row=jnp.ones(n, bool),
+        with_dropped=True)
+    obs_e, mask_e = danger_slab(s, s, RADIUS,
+                                exclude_self_row=jnp.ones(n, bool))
+    u_k, info_k = _controls(s, obs_k, mask_k, cbf)
+    u_e, info_e = _controls(s, obs_e, mask_e, cbf)
+
+    dev = np.linalg.norm(u_k - u_e, axis=1)
+    dropped = np.asarray(dropped)
+    assert dropped.max() >= 8                 # the stress regime is real
+
+    # Agents with no truncation: exact (sanity anchor for the bound below).
+    np.testing.assert_allclose(dev[dropped == 0], 0.0, atol=1e-6)
+
+    # Truncated agents: the binding constraint of each of the 4 direction
+    # classes (core.barrier dedup) is *usually* among the K nearest; when it
+    # is not, the deviation stays small because farther rows have larger h
+    # (slacker RHS). Pin both the typical and the worst case.
+    assert np.median(dev[dropped > 0]) < 5e-3, np.median(dev[dropped > 0])
+    assert dev.max() < 0.08, dev.max()        # < half the 0.2 speed limit
+
+    # And truncation must never manufacture infeasibility.
+    assert not np.asarray(
+        (~info_k.feasible) & jnp.any(mask_k, axis=1)).any()
+
+
+def test_swarm_scenario_surfaces_dropped_counts():
+    """The flagship scenario reports per-step dropped totals on both the
+    jnp and Pallas (interpret) paths, and they agree."""
+    from cbf_tpu.scenarios import swarm
+
+    # pack_spacing far below the danger radius => guaranteed truncation
+    # once the crowd packs.
+    base = dict(n=96, steps=40, k_neighbors=4, pack_spacing=0.1, seed=3)
+    _, outs_j = swarm.run(swarm.Config(**base, gating="jnp"))
+    _, outs_p = swarm.run(swarm.Config(**base, gating="pallas"))
+    dj = np.asarray(outs_j.gating_dropped_count)
+    dp = np.asarray(outs_p.gating_dropped_count)
+    assert dj.shape == (40,)
+    assert dj.sum() > 0, "packed swarm must truncate at K=4"
+    np.testing.assert_array_equal(dj, dp)
+
+
+def test_ensemble_metrics_surface_dropped_counts():
+    """The sharded path (exchange_knn inside shard_map) reports the same
+    truncation diagnostic through EnsembleMetrics."""
+    import jax
+    from cbf_tpu.parallel import make_mesh
+    from cbf_tpu.parallel.ensemble import sharded_swarm_rollout
+    from cbf_tpu.scenarios import swarm
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    mesh = make_mesh(n_dp=2, n_sp=2)
+    cfg = swarm.Config(n=32, steps=40, k_neighbors=2, pack_spacing=0.1)
+    _, mets = sharded_swarm_rollout(cfg, mesh, seeds=[0, 1])
+    d = np.asarray(mets.dropped_count)
+    assert d.shape == (2, 40)
+    assert d.sum() > 0, "packed swarm at K=2 must truncate"

@@ -17,12 +17,15 @@ def test_matches_jnp_gating(rng, n, k, radius):
     v = jnp.asarray(rng.normal(0, 0.1, (n, 2)), jnp.float32)
     states4 = jnp.concatenate([x, v], axis=1)
 
-    obs_p, mask_p, nearest = knn_gating_pallas(states4, radius, k,
-                                               interpret=True)
-    obs_j, mask_j = knn_gating(states4, states4, radius, k,
-                               exclude_self_row=jnp.ones(n, bool))
+    obs_p, mask_p, nearest, dropped_p = knn_gating_pallas(
+        states4, radius, k, interpret=True)
+    obs_j, mask_j, dropped_j = knn_gating(states4, states4, radius, k,
+                                          exclude_self_row=jnp.ones(n, bool),
+                                          with_dropped=True)
 
     np.testing.assert_array_equal(np.asarray(mask_p), np.asarray(mask_j))
+    np.testing.assert_array_equal(np.asarray(dropped_p),
+                                  np.asarray(dropped_j))
     # Random reals: distances are distinct, so the selected neighbor sets
     # (and their order, nearest-first) coincide exactly.
     np.testing.assert_allclose(
@@ -38,7 +41,8 @@ def test_matches_jnp_gating(rng, n, k, radius):
 
 def test_empty_neighborhoods(rng):
     x = jnp.asarray(rng.uniform(-100, 100, (32, 2)), jnp.float32)  # sparse
-    idx, dist, nearest = knn_neighbors(x, 0.01, 4, interpret=True)
+    idx, dist, nearest, count = knn_neighbors(x, 0.01, 4, interpret=True)
+    assert not np.asarray(count).any()
     assert not np.isfinite(np.asarray(dist)).any()
     assert np.isfinite(np.asarray(nearest)).all()
 
@@ -47,7 +51,7 @@ def test_coincident_points_excluded(rng):
     # Two agents at the same spot: `0 < d` drops the pair from gating but
     # the nearest-any metric must still report 0 (a collision!).
     x = jnp.zeros((4, 2), jnp.float32).at[2:].set(5.0)
-    idx, dist, nearest = knn_neighbors(x, 1.0, 2, interpret=True)
+    idx, dist, nearest, count = knn_neighbors(x, 1.0, 2, interpret=True)
     assert not np.isfinite(np.asarray(dist[:2])).any()
     np.testing.assert_allclose(np.asarray(nearest[:2]), 0.0)
 
@@ -75,9 +79,11 @@ def test_blocked_matches_fused(rng, n, k, radius):
     from cbf_tpu.ops.pallas_knn import knn_neighbors_blocked
 
     x = jnp.asarray(rng.uniform(-2, 2, (n, 2)), jnp.float32)
-    idx_f, dist_f, near_f = knn_neighbors(x, radius, k, interpret=True)
-    idx_b, dist_b, near_b = knn_neighbors_blocked(x, radius, k,
-                                                  interpret=True)
+    idx_f, dist_f, near_f, cnt_f = knn_neighbors(x, radius, k,
+                                                 interpret=True)
+    idx_b, dist_b, near_b, cnt_b = knn_neighbors_blocked(x, radius, k,
+                                                         interpret=True)
+    np.testing.assert_array_equal(np.asarray(cnt_f), np.asarray(cnt_b))
     np.testing.assert_array_equal(np.asarray(idx_f), np.asarray(idx_b))
     np.testing.assert_allclose(np.asarray(dist_f), np.asarray(dist_b),
                                rtol=1e-6)
@@ -89,7 +95,8 @@ def test_blocked_empty_and_coincident():
     from cbf_tpu.ops.pallas_knn import knn_neighbors_blocked
 
     x = jnp.zeros((4, 2), jnp.float32).at[2:].set(50.0)
-    idx, dist, nearest = knn_neighbors_blocked(x, 1.0, 2, interpret=True)
+    idx, dist, nearest, count = knn_neighbors_blocked(x, 1.0, 2,
+                                                      interpret=True)
     assert not np.isfinite(np.asarray(dist[:2])).any()   # 0 < d excludes
     np.testing.assert_allclose(np.asarray(nearest[:2]), 0.0)
 
@@ -104,9 +111,11 @@ def test_banded_matches_fused_on_masked_slots(rng, n, k, radius, w):
     from cbf_tpu.ops.pallas_knn import knn_neighbors_banded
 
     x = jnp.asarray(rng.uniform(-3, 3, (n, 2)), jnp.float32)
-    idx_f, dist_f, near_f = knn_neighbors(x, radius, k, interpret=True)
-    idx_b, dist_b, near_b, ovf = knn_neighbors_banded(
+    idx_f, dist_f, near_f, cnt_f = knn_neighbors(x, radius, k,
+                                                 interpret=True)
+    idx_b, dist_b, near_b, ovf, cnt_b = knn_neighbors_banded(
         x, radius, k, window_blocks=w, interpret=True)
+    np.testing.assert_array_equal(np.asarray(cnt_f), np.asarray(cnt_b))
     assert not np.asarray(ovf).any()
     mask = np.isfinite(np.asarray(dist_f))
     np.testing.assert_array_equal(np.asarray(mask),
@@ -131,8 +140,8 @@ def test_banded_overflow_flagged(rng):
     x = jnp.asarray(
         np.stack([rng.uniform(-0.5, 0.5, n), rng.uniform(0, 1e-3, n)], 1),
         jnp.float32)
-    _, _, _, ovf = knn_neighbors_banded(x, 0.4, 4, window_blocks=1,
-                                        interpret=True)
+    _, _, _, ovf, _ = knn_neighbors_banded(x, 0.4, 4, window_blocks=1,
+                                           interpret=True)
     assert np.asarray(ovf).any()
 
 
