@@ -23,7 +23,9 @@ number.
 Prints exactly ONE JSON line to stdout.
 
 Modes / env knobs:
-  BENCH_N (4096), BENCH_STEPS (500) — problem size.
+  BENCH_N (4096), BENCH_STEPS (10000) — problem size (defaults = the
+    BASELINE.md ladder rung as written). BENCH_CHUNK (1000) — compiled-chunk
+    length of the checkpointed single-swarm path.
   BENCH_ENSEMBLE=1 (or --ensemble) — dp-sharded ensemble of independent
     swarms over all available devices (the multi-chip measurement path for
     the v4-8 ladder rung); adds "chips" + "scaling_efficiency" fields.
@@ -141,34 +143,53 @@ def probe_device_subprocess(
 
 
 def _child_single(n: int, steps: int) -> dict:
+    """The ladder rung as written (BASELINE.md: "4096 agents x 10k steps
+    < 60 s"): the measured run goes through ``rollout_chunked`` with live
+    boundary checkpointing, so the number covers the production long-rollout
+    path (compiled chunk reuse + orbax saves), not a bare scan."""
+    import shutil
+    import tempfile
+
     import jax
     import numpy as np
 
-    from cbf_tpu.rollout.engine import rollout
+    from cbf_tpu.rollout.engine import rollout_chunked
     from cbf_tpu.scenarios import swarm
 
     cfg = swarm.Config(n=n, steps=steps, record_trajectory=False)
     state0, step = swarm.make(cfg)
+    chunk = min(_env_int("BENCH_CHUNK", 1000), steps)
 
-    print(f"bench: swarm N={n}, steps={steps}, devices={jax.devices()}",
-          file=sys.stderr)
+    print(f"bench: swarm N={n}, steps={steps} (chunk={chunk}, checkpointed), "
+          f"devices={jax.devices()}", file=sys.stderr)
 
+    # Warmup: compile every executable the measured run will use — the
+    # full-size chunk and, when steps % chunk != 0, the trailing partial
+    # chunk (a distinct static scan length that would otherwise compile
+    # inside the timed window).
     t0 = time.time()
-    final, outs = rollout(step, state0, steps)
-    jax.block_until_ready(final)
+    for w in dict.fromkeys((chunk, steps % chunk or chunk)):
+        final, _, _ = rollout_chunked(step, state0, w, chunk=w)
+        jax.block_until_ready(final.x)
     compile_and_first = time.time() - t0
 
-    t0 = time.time()
-    final, outs = rollout(step, state0, steps)
-    jax.block_until_ready(final)
-    wall = time.time() - t0
+    ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        t0 = time.time()
+        final, outs, _ = rollout_chunked(step, state0, steps, chunk=chunk,
+                                         checkpoint_dir=ckpt_dir,
+                                         resume=False)
+        jax.block_until_ready(final.x)
+        wall = time.time() - t0
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
 
     min_dist = float(np.asarray(outs.min_pairwise_distance).min())
     infeasible = int(np.asarray(outs.infeasible_count).sum())
     dropped = int(np.asarray(outs.gating_dropped_count).sum())
     rate = n * steps / wall
 
-    print(f"bench: wall={wall:.3f}s (first run incl. compile "
+    print(f"bench: wall={wall:.3f}s (warmup incl. compile "
           f"{compile_and_first:.1f}s), min_dist={min_dist:.4f}, "
           f"infeasible={infeasible}, knn_dropped={dropped}", file=sys.stderr)
 
@@ -181,6 +202,10 @@ def _child_single(n: int, steps: int) -> dict:
         "value": round(rate, 1),
         "unit": "agent_qp_steps_per_sec_per_chip",
         "vs_baseline": round(rate / TARGET_RATE_PER_CHIP, 3),
+        "steps": steps,
+        "chunk": chunk,
+        "wall_s": round(wall, 3),
+        "checkpointed": True,
     }
 
 
@@ -262,6 +287,16 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
     }
 
 
+def _is_permanent_error(e: BaseException) -> bool:
+    """Transient device/tunnel deaths raise (XlaRuntimeError: connection
+    reset / DEADLINE_EXCEEDED / UNAVAILABLE) rather than hang — those must
+    be retried, same as a wedge. Only clear Python-level code bugs are
+    permanent: retrying them wastes bounded time, while misclassifying a
+    transient as permanent zeroes the round."""
+    return isinstance(e, (ValueError, TypeError, ImportError,
+                          AttributeError, KeyError, AssertionError))
+
+
 def child_main(result_path: str, ensemble: bool) -> None:
     forced = os.environ.get("BENCH_FORCE_PLATFORM")
     if forced:
@@ -281,7 +316,9 @@ def child_main(result_path: str, ensemble: bool) -> None:
         os._exit(RC_RETRYABLE)   # stuck runtime thread blocks a clean exit
 
     n = _env_int("BENCH_N", 4096)
-    steps = _env_int("BENCH_STEPS", 500)
+    # Default = the BASELINE.md ladder rung as written: 10k steps (~7 s at
+    # the r02 rate; the 420 s attempt timeout has ample slack).
+    steps = _env_int("BENCH_STEPS", 10_000)
     try:
         if ensemble:
             result = _child_ensemble(n, steps,
@@ -289,15 +326,8 @@ def child_main(result_path: str, ensemble: bool) -> None:
         else:
             result = _child_single(n, steps)
     except Exception as e:
-        # Transient device/tunnel deaths raise (XlaRuntimeError: connection
-        # reset / DEADLINE_EXCEEDED / UNAVAILABLE) rather than hang — those
-        # must be retried, same as a wedge. Only clear Python-level code
-        # bugs are permanent: retrying them wastes bounded time, while
-        # misclassifying a transient as permanent zeroes the round.
-        permanent = isinstance(e, (ValueError, TypeError, ImportError,
-                                   AttributeError, KeyError, AssertionError))
         result = {"error": f"{type(e).__name__}: {e}",
-                  "retryable": not permanent}
+                  "retryable": not _is_permanent_error(e)}
 
     with open(result_path, "w") as fh:
         json.dump(result, fh)

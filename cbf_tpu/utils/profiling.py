@@ -48,17 +48,36 @@ def cost_analysis(fn: Callable, *args, **kwargs) -> dict[str, Any]:
     return dict(costs or {})
 
 
-def compile_stats() -> dict[str, int]:
-    """Process-wide jit cache counters (hits = executable reuse)."""
-    from jax._src import monitoring  # no public accessor for these counters
+# Compile/cache counters observed via the public jax.monitoring listener
+# API. Registration happens at module import, so counts cover every compile
+# after `cbf_tpu.utils.profiling` is first imported (there is no public
+# accessor for JAX's own process-lifetime counters).
+_event_counts: dict[str, int] = {}
+_listeners_registered = False
 
-    events = getattr(monitoring, "_counter_events", None)
-    out = {}
-    if isinstance(events, dict):
-        for k, v in events.items():
-            if "cache" in k or "compil" in k:
-                out[k] = v
-    return out
+
+def _count_event(name: str, *_args, **_kw) -> None:
+    if "cache" in name or "compil" in name:
+        _event_counts[name] = _event_counts.get(name, 0) + 1
+
+
+def _ensure_listeners() -> None:
+    global _listeners_registered
+    if _listeners_registered:
+        return
+    jax.monitoring.register_event_listener(_count_event)
+    jax.monitoring.register_event_duration_secs_listener(_count_event)
+    _listeners_registered = True
+
+
+_ensure_listeners()
+
+
+def compile_stats() -> dict[str, int]:
+    """Jit compile/cache event counters (e.g. backend_compile_duration
+    fires per fresh compile; absence of growth between two calls around a
+    jitted call means the executable was reused from cache)."""
+    return dict(_event_counts)
 
 
 class StepTimer:

@@ -1,6 +1,8 @@
 """Unit tests for the fixed-iteration ADMM box-QP solver."""
 
+import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from cbf_tpu.oracle.reference_filter import solve_qp_slsqp
 
@@ -47,6 +49,121 @@ def test_equality_like_tight_box(x64):
     np.testing.assert_allclose(np.asarray(x), [0.5, 0.5], atol=1e-5)
 
 
+# ------------------- joint certificate rigor (VERDICT r2 #6) -------------
+#
+# The certificate QP solved by the fixed-iteration ADMM, cross-checked
+# against an INDEPENDENT SLSQP solve built from the spec formula (module
+# docstring of cbf_tpu.sim.certificates), at the real cross_and_rescue
+# shape (N=4) and ladder sizes N=16/32; N=64 is covered residual-wise plus
+# the pruned==dense equivalence.
+
+def _cluster_states(n, rng):
+    """Positions in the arena with genuinely binding pairs: half the agents
+    clustered within ~2x the safety radius, half spread out."""
+    tight = rng.normal(0, 0.08, (2, n // 2))
+    loose = rng.uniform(-1.2, 1.2, (2, n - n // 2))
+    x = np.concatenate([tight, loose], axis=1)
+    dxi = rng.normal(0, 0.3, (2, n))
+    return x, dxi
+
+
+def _slsqp_certificate(dxi, x, params):
+    """Spec-formula reference solve (vectorized constraints, float64)."""
+    from scipy.optimize import minimize
+    from cbf_tpu.sim.robotarium import ARENA
+
+    N = x.shape[1]
+    gain, r = params.barrier_gain, params.safety_radius
+    # Magnitude pre-limit, per the spec.
+    norms = np.linalg.norm(dxi, axis=0)
+    u_nom = (dxi / np.maximum(1.0, norms / params.magnitude_limit)).T  # (N,2)
+
+    I, J = np.triu_indices(N, k=1)
+    err = (x[:, I] - x[:, J]).T                       # (P, 2)
+    h = np.sum(err * err, axis=1) - r**2
+    b_pair = gain * h**3
+    xmin, xmax, ymin, ymax = ARENA
+    r2, gb = r / 2.0, 0.4 * gain
+    b_bnd = np.stack([gb * (ymax - r2 - x[1]) ** 3,
+                      gb * (x[1] - ymin - r2) ** 3,
+                      gb * (xmax - r2 - x[0]) ** 3,
+                      gb * (x[0] - xmin - r2) ** 3], axis=1).ravel()
+
+    def cons(z):
+        u = z.reshape(N, 2)
+        du = u[I] - u[J]                              # (P, 2)
+        pair = b_pair + 2.0 * np.sum(err * du, axis=1)
+        bnd = b_bnd - np.stack([u[:, 1], -u[:, 1],
+                                u[:, 0], -u[:, 0]], axis=1).ravel()
+        return np.concatenate([pair, bnd])
+
+    res = minimize(lambda z: 0.5 * np.sum((z.reshape(N, 2) - u_nom) ** 2),
+                   u_nom.ravel(),
+                   jac=lambda z: z - u_nom.ravel(),
+                   constraints=[{"type": "ineq", "fun": cons}],
+                   method="SLSQP", tol=1e-12,
+                   options={"maxiter": 500})
+    assert res.success, res.message
+    return res.x.reshape(N, 2).T                      # (2, N)
+
+
+@pytest.mark.parametrize("n", [4, 16, 32])
+def test_certificate_matches_slsqp(x64, n):
+    from cbf_tpu.sim import CertificateParams, si_barrier_certificate
+    from cbf_tpu.solvers.admm import ADMMSettings
+
+    rng = np.random.default_rng(100 + n)
+    params = CertificateParams()
+    x, dxi = _cluster_states(n, rng)
+    u, info = si_barrier_certificate(
+        jnp.asarray(dxi), jnp.asarray(x), params,
+        ADMMSettings(iters=800), with_info=True)
+    u_ref = _slsqp_certificate(dxi, x, params)
+    assert float(info.primal_residual) < 1e-5
+    np.testing.assert_allclose(np.asarray(u), u_ref, atol=5e-4)
+
+
+def test_certificate_n64_residual_and_pruning(x64):
+    """N=64: residuals prove convergence at the largest advertised size, and
+    pruning to the 16N tightest pairs reproduces the dense solution (this
+    instance has 733 pairs inside the ~0.5 m bindable zone; 16N = 1024 kept
+    rows cover them, and the cubic-margin rows beyond never bind — the
+    documented basis for lifting the dense (N^2/2+4N)-row bound)."""
+    from cbf_tpu.sim import CertificateParams, si_barrier_certificate
+    from cbf_tpu.solvers.admm import ADMMSettings
+
+    n = 64
+    rng = np.random.default_rng(64)
+    params = CertificateParams()
+    x, dxi = _cluster_states(n, rng)
+    st = ADMMSettings(iters=800)
+    u_dense, info = si_barrier_certificate(
+        jnp.asarray(dxi), jnp.asarray(x), params, st, with_info=True)
+    assert float(info.primal_residual) < 1e-6
+    assert np.isfinite(float(info.dual_residual))
+
+    u_pruned, info_p = si_barrier_certificate(
+        jnp.asarray(dxi), jnp.asarray(x), params, st,
+        max_pairs=16 * n, with_info=True)
+    assert float(info_p.primal_residual) < 1e-6
+    np.testing.assert_allclose(np.asarray(u_pruned), np.asarray(u_dense),
+                               atol=1e-5)
+
+
+def test_cross_and_rescue_rollout_asserts_residuals():
+    """Scenario use now records the certificate residual every step — assert
+    the whole (short) rollout converged, per the round-2 requirement that
+    scenario use asserts returned residuals."""
+    from cbf_tpu.scenarios import cross_and_rescue as cr
+
+    cfg = cr.Config(iterations=40, record_trajectory=False)
+    _, outs = cr.run(cfg)
+    res = np.asarray(outs.certificate_residual)
+    assert res.shape == (40,)
+    assert np.isfinite(res).all()
+    assert res.max() < 1e-3, f"ADMM residual spiked: {res.max()}"
+
+
 def test_vmap_batch(x64, rng):
     import jax
     import jax.numpy as jnp
@@ -57,7 +174,7 @@ def test_vmap_batch(x64, rng):
     b = rng.normal(size=(B, m)) + 1.0
     q = rng.normal(size=(B, n))
     P = np.broadcast_to(np.eye(n), (B, n, n)).copy()
-    settings = ADMMSettings(iters=300)
+    settings = ADMMSettings(iters=800)
     xs, infos = jax.vmap(
         lambda Pb, qb, Ab, bb: solve_box_qp_admm(
             Pb, qb, Ab, jnp.full(m, -jnp.inf), bb, settings)

@@ -1,0 +1,192 @@
+"""The bench harness itself (bench.py): safety gate, error classification,
+child rc/result-file protocol, and a tiny end-to-end CPU run of both modes.
+
+These paths execute at most a handful of times per round, under the driver,
+where a bug is maximally expensive (VERDICT r2 weak #1) — so they get the
+same test discipline as the framework code they measure.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import bench  # noqa: E402
+
+
+# ------------------------------------------------------------ _check_safety
+
+def test_check_safety_passes_above_floor():
+    assert bench._check_safety(bench.SAFETY_FLOOR + 0.01, 0) is None
+
+
+@pytest.mark.parametrize("bad", [bench.SAFETY_FLOOR - 0.01, 0.0,
+                                 float("nan"), -1.0])
+def test_check_safety_rejects_low_or_nan_distance(bad):
+    err = bench._check_safety(bad, 0)
+    assert err is not None and "safety violation" in err
+
+
+def test_check_safety_rejects_infeasible():
+    err = bench._check_safety(0.2, 3)
+    assert err is not None and "infeasible" in err
+
+
+# ----------------------------------------------- error classification
+
+@pytest.mark.parametrize("e", [ValueError("x"), TypeError("x"),
+                               ImportError("x"), AttributeError("x"),
+                               KeyError("x"), AssertionError("x")])
+def test_code_bugs_are_permanent(e):
+    assert bench._is_permanent_error(e)
+
+
+@pytest.mark.parametrize("e", [RuntimeError("UNAVAILABLE: connection reset"),
+                               OSError("socket closed"),
+                               TimeoutError("deadline"),
+                               Exception("XlaRuntimeError: DEADLINE_EXCEEDED")])
+def test_device_deaths_are_retryable(e):
+    assert not bench._is_permanent_error(e)
+
+
+# --------------------------------------- _run_attempt child protocol
+
+def _stub_child(tmp_path, monkeypatch, body: str):
+    """Point _run_attempt's argv at a stub script instead of bench.py.
+
+    The stub receives the same argv contract the real child does:
+    ``<script> --child <result_path> [--ensemble]``.
+    """
+    stub = tmp_path / "stub_child.py"
+    stub.write_text("import json, os, sys\n"
+                    "result_path = sys.argv[2]\n" + body)
+    monkeypatch.setattr(bench, "__file__", str(stub))
+
+
+def test_run_attempt_success(tmp_path, monkeypatch):
+    _stub_child(tmp_path, monkeypatch, """
+json.dump({"metric": "m", "value": 1.5}, open(result_path, "w"))
+sys.exit(0)
+""")
+    result, retryable = bench._run_attempt(30.0, ensemble=False)
+    assert result == {"metric": "m", "value": 1.5}
+    assert retryable is False
+
+
+def test_run_attempt_permanent_failure(tmp_path, monkeypatch):
+    _stub_child(tmp_path, monkeypatch, """
+json.dump({"error": "safety violation: boom", "retryable": False},
+          open(result_path, "w"))
+sys.exit(3)
+""")
+    result, retryable = bench._run_attempt(30.0, ensemble=False)
+    assert result["error"].startswith("safety violation")
+    assert retryable is False
+
+
+def test_run_attempt_retryable_failure(tmp_path, monkeypatch):
+    _stub_child(tmp_path, monkeypatch, """
+json.dump({"error": "device wedged", "retryable": True},
+          open(result_path, "w"))
+sys.exit(2)
+""")
+    result, retryable = bench._run_attempt(30.0, ensemble=False)
+    assert result["error"] == "device wedged"
+    assert retryable is True
+
+
+def test_run_attempt_child_dies_without_result(tmp_path, monkeypatch):
+    _stub_child(tmp_path, monkeypatch, "sys.exit(1)\n")
+    result, retryable = bench._run_attempt(30.0, ensemble=False)
+    assert result is None
+    assert retryable is True       # no-result deaths are retried
+
+def test_run_attempt_child_garbage_result(tmp_path, monkeypatch):
+    _stub_child(tmp_path, monkeypatch, """
+open(result_path, "w").write("{not json")
+sys.exit(0)
+""")
+    result, retryable = bench._run_attempt(30.0, ensemble=False)
+    assert result is None
+    assert retryable is True
+
+
+def test_run_attempt_timeout_kills_child(tmp_path, monkeypatch):
+    _stub_child(tmp_path, monkeypatch, """
+import time
+time.sleep(60)
+""")
+    result, retryable = bench._run_attempt(2.0, ensemble=False)
+    assert result is None
+    assert retryable is True
+
+
+def test_run_attempt_rc0_with_error_result_not_success(tmp_path, monkeypatch):
+    """A child that exits 0 but reports an error must not count as a
+    measurement (guards the `rc == 0 and "error" not in result` conjunction)."""
+    _stub_child(tmp_path, monkeypatch, """
+json.dump({"error": "oops", "retryable": False}, open(result_path, "w"))
+sys.exit(0)
+""")
+    result, retryable = bench._run_attempt(30.0, ensemble=False)
+    assert "error" in result
+    assert retryable is False
+
+
+def test_run_attempt_passes_ensemble_flag(tmp_path, monkeypatch):
+    _stub_child(tmp_path, monkeypatch, """
+json.dump({"ensemble_flag": "--ensemble" in sys.argv[3:]},
+          open(result_path, "w"))
+sys.exit(0)
+""")
+    result, _ = bench._run_attempt(30.0, ensemble=True)
+    assert result["ensemble_flag"] is True
+
+
+# ------------------------------------------------- probe + end-to-end
+
+def test_probe_device_subprocess_cpu(monkeypatch):
+    monkeypatch.setenv("BENCH_FORCE_PLATFORM", "cpu")
+    ok, reason = bench.probe_device_subprocess(timeout_s=120.0)
+    assert ok, reason
+
+
+def _run_bench_e2e(extra_env):
+    env = dict(os.environ)
+    env.update({"BENCH_FORCE_PLATFORM": "cpu", "BENCH_N": "64",
+                "BENCH_STEPS": "30", "BENCH_ATTEMPTS": "1",
+                "BENCH_ATTEMPT_TIMEOUT": "240"})
+    env.update(extra_env)
+    proc = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
+                          capture_output=True, text=True, timeout=280,
+                          cwd=ROOT, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"bench must print exactly one line: {lines}"
+    out = json.loads(lines[0])
+    assert out["unit"] == "agent_qp_steps_per_sec_per_chip"
+    assert out["value"] > 0 and math.isfinite(out["value"])
+    assert "error" not in out
+    return out, proc.stderr
+
+
+def test_bench_end_to_end_single_mode_cpu():
+    out, stderr = _run_bench_e2e({})
+    assert "swarm N=64" in out["metric"]
+    assert "knn_dropped=" in stderr       # truncation diagnostic surfaced
+
+
+def test_bench_end_to_end_ensemble_mode_cpu():
+    # Under the suite's XLA_FLAGS the child sees 8 virtual CPU devices, so
+    # this exercises the real dp-sharded path incl. the efficiency baseline.
+    out, stderr = _run_bench_e2e({"BENCH_ENSEMBLE": "1"})
+    assert "ensemble" in out["metric"]
+    assert out["chips"] >= 1
+    assert 0 < out["scaling_efficiency"] <= 1.5
+    assert "knn_dropped=" in stderr

@@ -66,3 +66,82 @@ def test_resume_false_ignores_checkpoints(scenario, tmp_path):
 def test_restore_missing_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         ckpt.restore(str(tmp_path / "empty"), {"a": np.zeros(2)})
+
+
+# --------------------------- sharded checkpoint/resume (VERDICT r2 #4) ----
+
+def _dp_sp_mesh(n_dp, n_sp):
+    import jax
+    from cbf_tpu.parallel import make_mesh
+
+    if len(jax.devices()) < n_dp * n_sp:
+        pytest.skip(f"needs {n_dp * n_sp} devices")
+    return make_mesh(n_dp=n_dp, n_sp=n_sp)
+
+
+def test_sharded_state_roundtrips_with_shardings(tmp_path):
+    """A (dp, sp)-sharded ensemble state restores as jax.Arrays on the SAME
+    NamedSharding — not as host numpy (the round-2 regression: np.asarray in
+    the abstract tree dropped shardings on restore)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _dp_sp_mesh(4, 2)
+    sh = NamedSharding(mesh, P("dp", "sp", None))
+    x = jax.device_put(jnp.arange(4 * 16 * 2, dtype=jnp.float32)
+                       .reshape(4, 16, 2), sh)
+    state = {"x": x, "v": jnp.zeros_like(x), "step": np.int64(7)}
+
+    d = str(tmp_path / "sharded")
+    ckpt.save(d, 0, state)
+    restored, step = ckpt.restore(d, state)
+    assert step == 0
+
+    for key in ("x", "v"):
+        leaf = restored[key]
+        assert isinstance(leaf, jax.Array)
+        assert leaf.sharding == state[key].sharding, (
+            f"{key}: sharding dropped on restore: {leaf.sharding}")
+        np.testing.assert_array_equal(np.asarray(leaf),
+                                      np.asarray(state[key]))
+    assert int(restored["step"]) == 7
+
+
+def test_sharded_rollout_resume_equality(tmp_path):
+    """Checkpoint mid-run, restore, continue: bit-identical to the
+    uninterrupted sharded run (the ensemble twin of
+    test_resume_from_interruption)."""
+    import jax
+    from cbf_tpu.parallel.ensemble import sharded_swarm_rollout
+    from cbf_tpu.scenarios import swarm
+
+    mesh = _dp_sp_mesh(2, 4)
+    cfg = swarm.Config(n=16, steps=40)
+    seeds = [0, 1]
+
+    (x_ref, v_ref), _ = sharded_swarm_rollout(cfg, mesh, seeds, steps=40)
+
+    (x_mid, v_mid), _ = sharded_swarm_rollout(cfg, mesh, seeds, steps=20)
+    d = str(tmp_path / "ens")
+    ckpt.save(d, 20, {"x": x_mid, "v": v_mid})
+    restored, _ = ckpt.restore(d, {"x": x_mid, "v": v_mid})
+    assert restored["x"].sharding == x_mid.sharding
+
+    (x_res, v_res), _ = sharded_swarm_rollout(
+        cfg, mesh, seeds, steps=20,
+        initial_state=(restored["x"], restored["v"]))
+
+    np.testing.assert_array_equal(np.asarray(x_res), np.asarray(x_ref))
+    np.testing.assert_array_equal(np.asarray(v_res), np.asarray(v_ref))
+
+
+def test_sharded_rollout_rejects_bad_initial_state_shape():
+    from cbf_tpu.parallel.ensemble import sharded_swarm_rollout
+    from cbf_tpu.scenarios import swarm
+
+    mesh = _dp_sp_mesh(2, 2)
+    cfg = swarm.Config(n=16, steps=4)
+    bad = np.zeros((3, 16, 2), np.float32)
+    with pytest.raises(ValueError, match="initial_state"):
+        sharded_swarm_rollout(cfg, mesh, [0, 1], initial_state=(bad, bad))

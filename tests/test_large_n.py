@@ -1,0 +1,47 @@
+"""Ladder-size safety tests on the CPU backend (BASELINE.md rungs N=1024,
+N=4096) so the separation floor and zero-infeasibility invariants the TPU
+bench gates on (bench.py SAFETY_FLOOR) are also asserted in the test record,
+not only inside the bench child where the suite cannot see them.
+
+Floor: the swarm's k=0 barrier is L1 (h = |dx|+|dy| - 0.2), whose Euclidean
+floor is 0.2/sqrt(2) ~ 0.1414; 0.13 leaves the same discretization slack the
+bench uses.
+"""
+
+import numpy as np
+import pytest
+
+from cbf_tpu.scenarios import swarm
+
+SAFETY_FLOOR = 0.13
+
+
+def _run_and_check(cfg):
+    import jax
+
+    final, outs = swarm.run(cfg)
+    jax.block_until_ready(final)
+    md = float(np.asarray(outs.min_pairwise_distance).min())
+    assert md > SAFETY_FLOOR, f"separation floor violated: {md:.4f}"
+    assert int(np.asarray(outs.infeasible_count).sum()) == 0
+    # Non-vacuous: the filter must actually have engaged.
+    assert int(np.asarray(outs.filter_active_count).max()) > cfg.n // 2
+    return outs
+
+
+@pytest.mark.parametrize("n,steps", [(1024, 150), (4096, 60)])
+def test_ladder_rung_safety_floor(n, steps):
+    """Default spawn, rendezvous toward the packed disk: agents contact the
+    barrier within the horizon (verified: min distance reaches ~0.1414, the
+    exact L1 floor) with zero infeasible QPs."""
+    _run_and_check(swarm.Config(n=n, steps=steps, gating="jnp"))
+
+
+def test_ladder_compressed_start_truncation_regime():
+    """N=1024 from a compressed spawn commanding near-point rendezvous: the
+    densest regime the bench path sees — heavy k-NN truncation (dropped
+    counts must report it) while the floor and feasibility still hold."""
+    outs = _run_and_check(swarm.Config(
+        n=1024, steps=150, gating="jnp", pack_spacing=0.05,
+        spawn_half_width_override=4.0))
+    assert int(np.asarray(outs.gating_dropped_count).sum()) > 10_000
