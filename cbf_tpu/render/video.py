@@ -9,16 +9,19 @@ outputs on-device, and this module replays the stacked arrays through
 matplotlib afterwards. The sim never touches a figure; a 10k-step rollout
 costs the same with or without video.
 
-Writer selection: FFMpegWriter when ffmpeg is on PATH (.mp4, like the
-reference artifact), else PillowWriter (.gif). ``replay`` is the generic
-engine; ``render_meet_at_center`` / ``render_cross_and_rescue`` /
-``render_swarm`` adapt each scenario's recorded ``StepOutputs.trajectory``
+Writer selection for .mp4 (the reference artifact's format —
+cross_and_rescue.py:96-98): FFMpegWriter when ffmpeg is on PATH, else an
+OpenCV-backed writer (environments frequently ship cv2 but no ffmpeg
+binary), else a RuntimeError pointing at .gif (PillowWriter). ``replay`` is
+the generic engine; ``render_meet_at_center`` / ``render_cross_and_rescue``
+/ ``render_swarm`` adapt each scenario's recorded ``StepOutputs.trajectory``
 pytree to it with reference-matching styling (obstacle ring red, free agents
 blue, goal gold — cross_and_rescue.py:63-65).
 """
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import shutil
 from typing import Sequence
@@ -65,14 +68,56 @@ def determine_marker_size(ax, radius: float) -> float:
     return diameter_points ** 2
 
 
+class _Cv2Mp4Writer:
+    """Minimal FFMpegWriter-compatible mp4 writer over OpenCV — implements
+    exactly the ``saving(fig, path, dpi)`` / ``grab_frame()`` surface that
+    ``replay`` (and the reference's in-loop pattern, cross_and_rescue.py:96-98)
+    uses. The VideoWriter opens lazily on the first frame, when the figure's
+    pixel size is known."""
+
+    def __init__(self, fps: int):
+        self.fps = fps
+        self._fig = None
+        self._vw = None
+
+    @contextlib.contextmanager
+    def saving(self, fig, out_path: str, dpi=None):
+        self._fig, self._path = fig, out_path
+        try:
+            yield self
+        finally:
+            if self._vw is not None:
+                self._vw.release()
+            self._fig = self._vw = None
+
+    def grab_frame(self):
+        import cv2
+
+        self._fig.canvas.draw()
+        rgb = np.asarray(self._fig.canvas.buffer_rgba())[..., :3]
+        h, w = rgb.shape[:2]
+        if self._vw is None:
+            self._vw = cv2.VideoWriter(
+                self._path, cv2.VideoWriter_fourcc(*"mp4v"), self.fps, (w, h))
+            if not self._vw.isOpened():
+                raise RuntimeError(
+                    f"OpenCV VideoWriter failed to open {self._path}")
+        self._vw.write(rgb[..., ::-1].copy())      # RGB -> BGR
+
+
 def _make_writer(out_path: str, fps: int):
     from matplotlib import animation
 
     if out_path.endswith(".mp4"):
-        if shutil.which("ffmpeg") is None:
+        if shutil.which("ffmpeg") is not None:
+            return animation.FFMpegWriter(fps=fps)
+        try:
+            import cv2  # noqa: F401
+        except ImportError:
             raise RuntimeError(
-                "ffmpeg not on PATH — pass a .gif path (PillowWriter) instead")
-        return animation.FFMpegWriter(fps=fps)
+                "mp4 needs ffmpeg on PATH or OpenCV installed — pass a "
+                ".gif path (PillowWriter) instead")
+        return _Cv2Mp4Writer(fps=fps)
     return animation.PillowWriter(fps=fps)
 
 

@@ -33,10 +33,56 @@ def test_cross_and_rescue_compat_runs(tmp_path):
 
 
 def test_train_safety_params_example_moves_params():
-    """The differentiable-training demo gets real gradient signal (a flat
-    loss means the filter never engaged — regression for the dense-spawn
-    requirement)."""
+    """The differentiable-training demo gets real gradient signal through
+    the full 100-step remat horizon (a flat loss means the filter never
+    engaged — regression for the dense-spawn requirement)."""
     mod = _load("train_safety_params")
-    loss0, loss1 = mod.main(opt_steps=8)
+    loss0, loss1 = mod.main(opt_steps=5, horizon=100)
     assert np.isfinite(loss1)
     assert loss1 < loss0  # moved downhill, i.e. nonzero gradients
+    assert os.path.exists(os.path.join(_EXAMPLES, "media",
+                                       "training_loss.csv"))
+
+
+def test_post_training_safety_floor_holds():
+    """Parameters trained over the 100-step remat horizon still produce a
+    safe swarm: roll out a fresh scenario under the trained CBF and assert
+    the separation floor implied by the trained d_min, with zero infeasible
+    QPs (the post-training parity check of VERDICT r2 #7)."""
+    import jax
+    from cbf_tpu.learn import TrainConfig, init_params, make_train_step
+    from cbf_tpu.learn.tuning import params_to_cbf
+    from cbf_tpu.parallel import make_mesh
+    from cbf_tpu.parallel.ensemble import ensemble_initial_states
+    from cbf_tpu.scenarios import swarm
+
+    n_dev = len(jax.devices())
+    n_sp = 2 if n_dev % 2 == 0 else 1
+    mesh = make_mesh(n_dp=n_dev // n_sp, n_sp=n_sp)
+    n = 8 * n_sp
+    train_cfg = swarm.Config(n=n, steps=100, k_neighbors=4, pack_spacing=0.02,
+                             spawn_half_width_override=0.45)
+    tc = TrainConfig(steps=100, learning_rate=3e-2)
+    train_step, optimizer = make_train_step(train_cfg, mesh, tc)
+    x0, v0 = ensemble_initial_states(train_cfg, list(range(2 * (n_dev // n_sp))))
+    params = init_params()
+    opt_state = optimizer.init(params)
+    for _ in range(3):
+        params, opt_state, loss = train_step(params, opt_state, x0, v0)
+    assert np.isfinite(float(loss))
+
+    cbf = params_to_cbf(params, 15.0)
+    dmin = float(cbf.dmin)
+    assert 0.05 < dmin < 0.5        # trained into a sane range
+
+    # Fresh rollout (k may be > 0 now, so the commanded-velocity positive-
+    # feedback regime is avoided by the same actual-velocity convention the
+    # swarm always uses).
+    eval_cfg = swarm.Config(n=128, steps=200, seed=7, gating="jnp")
+    _, outs = swarm.run(eval_cfg, cbf=cbf)
+    md = float(np.asarray(outs.min_pairwise_distance).min())
+    # L1 barrier floor for the trained dmin, with the same discretization
+    # slack ratio the bench applies to the default (0.13/0.1414).
+    floor = 0.92 * dmin / np.sqrt(2)
+    assert md > floor, f"min {md:.4f} <= trained floor {floor:.4f}"
+    assert int(np.asarray(outs.infeasible_count).sum()) == 0

@@ -55,12 +55,23 @@ def test_scenario_renderers_end_to_end(tmp_path):
         assert open(p, "rb").read()[:3] == b"GIF"
 
 
-def test_mp4_requires_ffmpeg(tmp_path):
-    import shutil
+def test_mp4_renders_end_to_end(tmp_path):
+    """The reference artifact's format (simulation.mp4 —
+    cross_and_rescue.py:96-98) renders here too: FFMpegWriter when ffmpeg
+    exists, else the OpenCV writer. Asserts a valid ISO-BMFF container."""
+    traj = np.cumsum(np.full((6, 2, 3), 0.01), axis=0)
+    p = replay([Layer(traj, trail=2)], str(tmp_path / "x.mp4"), fps=5)
+    data = open(p, "rb").read()
+    assert data[4:8] == b"ftyp", data[:12]
+    assert len(data) > 500
 
-    traj = np.zeros((2, 2, 1))
-    if shutil.which("ffmpeg") is None:
-        with pytest.raises(RuntimeError, match="ffmpeg"):
-            replay([Layer(traj)], str(tmp_path / "x.mp4"))
-    else:  # pragma: no cover
-        replay([Layer(traj)], str(tmp_path / "x.mp4"))
+
+def test_mp4_raises_without_ffmpeg_and_cv2(tmp_path, monkeypatch):
+    import sys
+
+    from cbf_tpu.render import video as video_mod
+
+    monkeypatch.setattr(video_mod.shutil, "which", lambda _: None)
+    monkeypatch.setitem(sys.modules, "cv2", None)   # import cv2 -> ImportError
+    with pytest.raises(RuntimeError, match="ffmpeg"):
+        replay([Layer(np.zeros((2, 2, 1)))], str(tmp_path / "y.mp4"))
