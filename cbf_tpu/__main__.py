@@ -84,6 +84,11 @@ def _apply_overrides(cfg, pairs: list[str], steps: int | None,
 def cmd_run(args) -> int:
     import contextlib
 
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
     from cbf_tpu.rollout.engine import rollout, rollout_chunked
     from cbf_tpu.utils import profiling
     from cbf_tpu.utils.debug import checked_rollout, summarize
@@ -182,6 +187,10 @@ def main(argv=None) -> int:
 
     runp = sub.add_parser("run", help="run a scenario")
     runp.add_argument("scenario", choices=sorted(_scenarios()))
+    runp.add_argument("--platform", default=None, choices=("cpu", "tpu"),
+                      help="force a JAX backend before first use (the TPU "
+                           "plugin here ignores the JAX_PLATFORMS env var, "
+                           "so headless CPU runs need an in-process switch)")
     runp.add_argument("--steps", type=int, default=None,
                       help="rollout horizon (maps to steps/iterations)")
     runp.add_argument("--set", action="append", default=[],

@@ -148,6 +148,8 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
     else:
         x0, v0 = ensemble_initial_states(cfg, seeds)
 
+    E_local = E // n_dp
+
     def local_rollout(x0l, v0l):
         def one(x0i, v0i):
             def body(carry, t):
@@ -158,6 +160,13 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
             (xf, vf), mets = lax.scan(body, (x0i, v0i), jnp.arange(steps))
             return xf, vf, mets
 
+        if E_local == 1:
+            # One member per device: skip the vmap wrapper — identical math,
+            # but batched lowering of the Pallas neighbor kernel is not free
+            # on TPU, and this is the bench's chips==E configuration.
+            xf, vf, mets = one(x0l[0], v0l[0])
+            return (xf[None], vf[None],
+                    jax.tree.map(lambda m: m[None], mets))
         return jax.vmap(one)(x0l, v0l)
 
     spec_state = P("dp", "sp", None)

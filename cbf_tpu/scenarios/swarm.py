@@ -243,6 +243,8 @@ def main():
     print(f"  min pairwise distance over run: {md.min():.4f} m")
     print(f"  final max spread from centroid: {spread:.4f} m")
     print(f"  infeasible agent-steps: {int(np.asarray(outs.infeasible_count).sum())}")
+    print(f"  k-NN dropped neighbor-steps: "
+          f"{int(np.asarray(outs.gating_dropped_count).sum())}")
 
 
 if __name__ == "__main__":

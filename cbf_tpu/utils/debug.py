@@ -39,7 +39,7 @@ def checked_rollout(step_fn: Callable, state0, steps: int, *,
 def summarize(outs: StepOutputs) -> dict:
     """Host-side structured summary of a rollout's per-step metrics."""
     md = np.asarray(outs.min_pairwise_distance)
-    return {
+    out = {
         "steps": int(md.shape[0]),
         "min_pairwise_distance": float(md.min()),
         "final_pairwise_distance": float(md[-1]),
@@ -47,3 +47,14 @@ def summarize(outs: StepOutputs) -> dict:
         "infeasible_agent_steps": int(np.asarray(outs.infeasible_count).sum()),
         "max_relax_rounds": float(np.asarray(outs.max_relax_rounds).max()),
     }
+    # Optional diagnostics: () on scenarios that don't track them.
+    if not isinstance(outs.gating_dropped_count, tuple):
+        out["knn_dropped_neighbor_steps"] = int(
+            np.asarray(outs.gating_dropped_count).sum())
+    if not isinstance(outs.gating_overflow_count, tuple):
+        out["gating_overflow_agent_steps"] = int(
+            np.asarray(outs.gating_overflow_count).sum())
+    if not isinstance(outs.certificate_residual, tuple):
+        out["max_certificate_residual"] = float(
+            np.asarray(outs.certificate_residual).max())
+    return out

@@ -53,7 +53,7 @@ def _save_loss_curve(losses, path_base):
     plt.close(fig)
 
 
-def main(opt_steps: int = 40, horizon: int = 100):
+def main(opt_steps: int = 40, horizon: int = 100, media_dir: str = MEDIA):
     if opt_steps < 1:
         raise SystemExit(f"--steps must be >= 1, got {opt_steps}")
     from cbf_tpu.learn import TrainConfig, init_params, make_train_step
@@ -104,8 +104,9 @@ def main(opt_steps: int = 40, horizon: int = 100):
     print(f"loss {losses[0]:.5f} -> {losses[-1]:.5f}")
     if not np.isfinite(losses[-1]):
         raise SystemExit("non-finite loss")
-    os.makedirs(MEDIA, exist_ok=True)
-    _save_loss_curve(np.asarray(losses), os.path.join(MEDIA, "training_loss"))
+    os.makedirs(media_dir, exist_ok=True)
+    _save_loss_curve(np.asarray(losses),
+                     os.path.join(media_dir, "training_loss"))
     return losses[0], losses[-1]
 
 

@@ -106,3 +106,18 @@ def test_traj_wins_over_record_trajectory_false(tmp_path, capsys):
     traj = (np.load(rec["traj"]) if rec["traj"].endswith(".npy")
             else trajsink.read_trajectory(rec["traj"]))
     assert traj.shape == (4, 8, 2)
+
+
+def test_run_platform_flag_and_diagnostics(capsys):
+    """--platform cpu forces the backend in-process (the TPU plugin ignores
+    JAX_PLATFORMS), and the summary line carries the observability fields:
+    k-NN truncation for swarm, certificate residual for cross_and_rescue."""
+    assert main(["run", "swarm", "--platform", "cpu", "--steps", "3",
+                 "--set", "n=9", "--set", "k_neighbors=2"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert "knn_dropped_neighbor_steps" in rec
+
+    assert main(["run", "cross_and_rescue", "--platform", "cpu",
+                 "--steps", "4", "--set", "record_trajectory=false"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["max_certificate_residual"] < 1e-3
