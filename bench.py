@@ -159,9 +159,11 @@ def _child_single(n: int, steps: int) -> dict:
     cfg = swarm.Config(n=n, steps=steps, record_trajectory=False)
     state0, step = swarm.make(cfg)
     chunk = min(_env_int("BENCH_CHUNK", 1000), steps)
+    unroll = _env_int("BENCH_UNROLL", 1)
 
-    print(f"bench: swarm N={n}, steps={steps} (chunk={chunk}, checkpointed), "
-          f"devices={jax.devices()}", file=sys.stderr)
+    print(f"bench: swarm N={n}, steps={steps} (chunk={chunk}, "
+          f"unroll={unroll}, checkpointed), devices={jax.devices()}",
+          file=sys.stderr)
 
     # Warmup: compile every executable the measured run will use — the
     # full-size chunk and, when steps % chunk != 0, the trailing partial
@@ -169,7 +171,8 @@ def _child_single(n: int, steps: int) -> dict:
     # inside the timed window).
     t0 = time.time()
     for w in dict.fromkeys((chunk, steps % chunk or chunk)):
-        final, _, _ = rollout_chunked(step, state0, w, chunk=w)
+        final, _, _ = rollout_chunked(step, state0, w, chunk=w,
+                                      unroll=unroll)
         jax.block_until_ready(final.x)
     compile_and_first = time.time() - t0
 
@@ -178,7 +181,7 @@ def _child_single(n: int, steps: int) -> dict:
         t0 = time.time()
         final, outs, _ = rollout_chunked(step, state0, steps, chunk=chunk,
                                          checkpoint_dir=ckpt_dir,
-                                         resume=False)
+                                         resume=False, unroll=unroll)
         jax.block_until_ready(final.x)
         wall = time.time() - t0
     finally:

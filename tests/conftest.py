@@ -25,6 +25,18 @@ import jax  # noqa: E402
 # virtual devices from XLA_FLAGS above) as default.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: repeat suite runs skip recompiles (keyed by
+# HLO fingerprint, so code changes invalidate naturally). Measured ~2.3x on
+# a representative scenario compile. Per-user path: a world-shared fixed
+# /tmp dir would collide between users on a shared machine.
+import getpass  # noqa: E402
+import tempfile  # noqa: E402
+
+_cache_dir = os.path.join(tempfile.gettempdir(),
+                          f"cbf_tpu_jax_cache_{getpass.getuser()}")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 import pytest  # noqa: E402
 
 

@@ -1,10 +1,11 @@
 """Worker for tests/test_multihost.py — one OS process of a 2-process run.
 
-Usage: python multihost_worker.py <process_id> <port>
+Usage: python multihost_worker.py <process_id> <port> <checkpoint_dir>
 Each process gets 4 virtual CPU devices (XLA_FLAGS set by the parent), joins
 the distributed runtime, builds one global (dp=4, sp=2) mesh spanning both
-processes, feeds its own ensemble block, and runs the sharded swarm rollout —
-the full multi-host path on Gloo CPU collectives.
+processes, feeds its own ensemble block, runs the sharded swarm rollout —
+the full multi-host path on Gloo CPU collectives — and round-trips the
+sharded final state through a multi-process orbax checkpoint.
 """
 
 import os
@@ -20,7 +21,7 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 
 
-def main(process_id: int, port: int) -> None:
+def main(process_id: int, port: int, ckpt_dir: str) -> None:
     from cbf_tpu.parallel import multihost
 
     multihost.initialize(coordinator_address=f"localhost:{port}",
@@ -65,9 +66,25 @@ def main(process_id: int, port: int) -> None:
     x0_global = multihost.shard_host_ensembles(mesh, np.asarray(x0_local))
     assert x0_global.shape == (4, 8, 2), x0_global.shape
 
+    # Multi-process checkpoint: every process participates in the save
+    # (each host writes its shards — the orbax multi-host path the
+    # checkpoint module advertises), and restore places leaves back on the
+    # same global NamedSharding with the same values.
+    from cbf_tpu.utils import checkpoint as ckpt
+
+    state = {"x": xf, "v": vf}
+    ckpt.save(ckpt_dir, 40, state)
+    restored, step = ckpt.restore(ckpt_dir, state)
+    assert step == 40
+    assert restored["x"].sharding == xf.sharding, restored["x"].sharding
+    np.testing.assert_array_equal(
+        np.asarray(multihost.gather_metrics(restored["x"])),
+        np.asarray(xf_all))
+
     print(f"MULTIHOST_OK process={pid}/{nproc} "
-          f"min_nearest={float(nearest.min()):.4f}", flush=True)
+          f"min_nearest={float(nearest.min()):.4f} ckpt_step={step}",
+          flush=True)
 
 
 if __name__ == "__main__":
-    main(int(sys.argv[1]), int(sys.argv[2]))
+    main(int(sys.argv[1]), int(sys.argv[2]), sys.argv[3])

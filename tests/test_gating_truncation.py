@@ -161,3 +161,41 @@ def test_ensemble_metrics_surface_dropped_counts():
     d = np.asarray(mets.dropped_count)
     assert d.shape == (2, 40)
     assert d.sum() > 0, "packed swarm at K=2 must truncate"
+
+
+def test_sharded_dropped_counts_match_unsharded():
+    """The ring and all-gather exchanges count truncation identically to the
+    single-device gating, on a real 4-way sp shard."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from cbf_tpu.parallel import alltoall, make_mesh
+    from cbf_tpu.parallel.ensemble import shard_map
+    from cbf_tpu.parallel.ring import ring_knn
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    rng = np.random.default_rng(11)
+    n, k, radius = 64, 4, 0.6
+    states = jnp.asarray(np.concatenate(
+        [rng.uniform(-1.0, 1.0, (n, 2)), np.zeros((n, 2))], 1), np.float32)
+
+    _, _, dropped_ref = knn_gating(states, states, radius, k,
+                                   exclude_self_row=jnp.ones(n, bool),
+                                   with_dropped=True)
+    assert np.asarray(dropped_ref).sum() > 0   # non-vacuous at this density
+
+    mesh = make_mesh(n_dp=2, n_sp=4)
+
+    def run(fn):
+        f = shard_map(
+            lambda s: fn(s, k, radius, "sp", False, with_dropped=True),
+            mesh=mesh, in_specs=P("sp", None),
+            out_specs=(P("sp", None, None), P("sp", None), P("sp")))
+        return jax.jit(f)(states)
+
+    for fn in (ring_knn, alltoall.all_gather_knn):
+        _, _, dropped = run(fn)
+        np.testing.assert_array_equal(np.asarray(dropped),
+                                      np.asarray(dropped_ref))

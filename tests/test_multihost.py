@@ -47,13 +47,15 @@ def test_initialize_noop_without_cluster():
     assert "SINGLE_OK" in out.stdout
 
 
-def test_two_process_sharded_rollout():
+def test_two_process_sharded_rollout(tmp_path):
     port = _free_port()
     env = dict(os.environ)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     env["JAX_PLATFORMS"] = "cpu"
+    ckpt_dir = str(tmp_path / "mh_ckpt")
     procs = [
-        subprocess.Popen([sys.executable, _WORKER, str(i), str(port)],
+        subprocess.Popen([sys.executable, _WORKER, str(i), str(port),
+                          ckpt_dir],
                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                          text=True, env=env)
         for i in range(2)
