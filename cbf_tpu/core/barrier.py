@@ -140,7 +140,8 @@ def box_rows(robot_state, u0, max_speed, *, reference_layout: bool = True):
 
 
 def assemble_qp_dedup(robot_states, obs_states, obs_mask, f, g, u0, *, dmin,
-                      k, gamma, max_speed, reference_layout=True):
+                      k, gamma, max_speed, reference_layout=True,
+                      priority_mask=None, priority_relax_weight=0.01):
     """Batched QP assembly with direction deduplication: K+8 rows -> 8.
 
     Key structural fact: every CBF row is ``A_i = -(sx*u + sy*w)`` with
@@ -154,9 +155,21 @@ def assemble_qp_dedup(robot_states, obs_states, obs_mask, f, g, u0, *, dmin,
     class shift together) — identical, while shrinking the enumeration
     solver's work ~7x.
 
+    ``priority_mask`` (N, K) bool marks candidates whose rows relax at
+    ``priority_relax_weight`` per round instead of +1 — tiered relaxation:
+    when a packed agent's QP goes infeasible (neighbors pin u = 0 while a
+    moving obstacle closes), the uniform reference policy (cbf.py:85-87)
+    neuters ALL rows and the agent is run over; with tiering the
+    inter-agent rows yield first and the obstacle row stays (nearly)
+    intact. Rows in a class no longer shift together under relaxation, so
+    priority rows get their OWN 4 dedup classes (8 -> 12 total rows);
+    exactness is preserved because min-b-per-(class, tier) still spans the
+    same feasible region at every relax round.
+
     Args: robot_states (N, 4), obs_states (N, K, 4), obs_mask (N, K),
     f (4,4), g (4,2), u0 (N, 2).
-    Returns (A (N, 8, 2), b (N, 8), relax_mask (N, 8)).
+    Returns (A (N, R, 2), b (N, R), relax_mask (N, R)) with R = 8, or 12
+    when ``priority_mask`` is given.
     """
     N = robot_states.shape[0]
     dtype = jnp.result_type(robot_states, obs_states, u0)
@@ -174,15 +187,24 @@ def assemble_qp_dedup(robot_states, obs_states, obs_mask, f, g, u0, *, dmin,
 
     signs = jnp.array(
         [[1.0, 1.0], [1.0, -1.0], [-1.0, 1.0], [-1.0, -1.0]], dtype)
-    A_cbf = -(signs[:, 0:1] * u_vec[None] + signs[:, 1:2] * w_vec[None])
-    A_cbf = jnp.broadcast_to(A_cbf[None], (N, 4, 2))          # (N, 4, 2)
+    A_dir = -(signs[:, 0:1] * u_vec[None] + signs[:, 1:2] * w_vec[None])
+    A_cbf = jnp.broadcast_to(A_dir[None], (N, 4, 2))          # (N, 4, 2)
 
-    b_cbf = []
-    for s1, s2 in ((1.0, 1.0), (1.0, -1.0), (-1.0, 1.0), (-1.0, -1.0)):
-        member = obs_mask & (sx == s1) & (sy == s2)
-        b_cbf.append(jnp.min(
-            jnp.where(member, b_all, MASKED_ROW_RHS), axis=1))
-    b_cbf = jnp.stack(b_cbf, axis=1)                          # (N, 4)
+    def class_min(member_mask):
+        cols = []
+        for s1, s2 in ((1.0, 1.0), (1.0, -1.0), (-1.0, 1.0), (-1.0, -1.0)):
+            member = member_mask & (sx == s1) & (sy == s2)
+            cols.append(jnp.min(
+                jnp.where(member, b_all, MASKED_ROW_RHS), axis=1))
+        return jnp.stack(cols, axis=1)                        # (N, 4)
+
+    if priority_mask is None:
+        b_cbf = class_min(obs_mask)
+    else:
+        b_cbf = class_min(obs_mask & ~priority_mask)
+        b_pri = class_min(obs_mask & priority_mask)
+        A_cbf = jnp.concatenate([A_cbf, A_cbf], axis=1)       # (N, 8, 2)
+        b_cbf = jnp.concatenate([b_cbf, b_pri], axis=1)       # (N, 8)
 
     # Box rows deduped by direction (min of the two RHS per direction, in
     # the reference's exact pairing — see box_rows).
@@ -207,10 +229,16 @@ def assemble_qp_dedup(robot_states, obs_states, obs_mask, f, g, u0, *, dmin,
              jnp.minimum(ms + u0y, ms + vy + u0y)],
             axis=1)
 
-    A = jnp.concatenate([A_cbf, A_box], axis=1)               # (N, 8, 2)
-    b = jnp.concatenate([b_cbf, b_box], axis=1)               # (N, 8)
-    relax_mask = jnp.concatenate(
-        [jnp.ones((N, 4), dtype), jnp.zeros((N, 4), dtype)], axis=1)
+    A = jnp.concatenate([A_cbf, A_box], axis=1)               # (N, R, 2)
+    b = jnp.concatenate([b_cbf, b_box], axis=1)               # (N, R)
+    if priority_mask is None:
+        relax_mask = jnp.concatenate(
+            [jnp.ones((N, 4), dtype), jnp.zeros((N, 4), dtype)], axis=1)
+    else:
+        relax_mask = jnp.concatenate(
+            [jnp.ones((N, 4), dtype),
+             jnp.full((N, 4), priority_relax_weight, dtype),
+             jnp.zeros((N, 4), dtype)], axis=1)
     return A, b, relax_mask
 
 

@@ -62,11 +62,13 @@ def safe_control(robot_state, obs_states, obs_mask, f, g, u0,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("max_relax", "unroll_relax", "reference_layout"),
+    static_argnames=("max_relax", "unroll_relax", "reference_layout",
+                     "priority_relax_weight"),
 )
 def safe_controls(robot_states, obs_states, obs_mask, f, g, u0,
                   params: CBFParams = CBFParams(), *, max_relax: int = 64,
-                  unroll_relax: int = 0, reference_layout: bool = True):
+                  unroll_relax: int = 0, reference_layout: bool = True,
+                  priority_mask=None, priority_relax_weight: float = 0.01):
     """All-agent batched filter.
 
     Default path (``unroll_relax=0``): direction-deduped batched assembly
@@ -82,6 +84,11 @@ def safe_controls(robot_states, obs_states, obs_mask, f, g, u0,
     Returns:
       (u: (N, 2), QPInfo with (N,) leaves).
 
+    ``priority_mask`` (N, K) marks candidates (e.g. uncontrolled moving
+    obstacles) whose CBF rows relax ``priority_relax_weight`` per round
+    instead of +1 under infeasibility — inter-agent spacing yields before
+    obstacle clearance does (tiered relaxation; see assemble_qp_dedup).
+
     Agents whose mask is all-False still run the QP against the box rows
     alone, which yields u == u0 whenever |u0| <= max_speed (always true in
     the shipped scenarios). The reference instead skips the QP entirely for
@@ -90,6 +97,11 @@ def safe_controls(robot_states, obs_states, obs_mask, f, g, u0,
     ``where(mask.any(-1), u_filtered, u0)``; the rollout engine does.
     """
     if unroll_relax > 0:
+        if priority_mask is not None:
+            raise ValueError(
+                "priority_mask (tiered relaxation) is not implemented on "
+                "the unroll_relax differentiable path — dropping it "
+                "silently would void the obstacle-clearance guarantee")
         # Differentiable path (unrolled relax rounds) — plain vmap.
         fn = functools.partial(
             safe_control, max_relax=max_relax, unroll_relax=unroll_relax,
@@ -106,6 +118,8 @@ def safe_controls(robot_states, obs_states, obs_mask, f, g, u0,
         robot_states, obs_states, obs_mask, f, g, u0,
         dmin=params.dmin, k=params.k, gamma=params.gamma,
         max_speed=params.max_speed, reference_layout=reference_layout,
+        priority_mask=priority_mask,
+        priority_relax_weight=priority_relax_weight,
     )
     du, info = solve_qp_2d_batch(A, b, relax_mask, max_relax=max_relax)
     u = jnp.clip(du + u0, -params.max_speed, params.max_speed)

@@ -96,7 +96,7 @@ def make_loss_fn(cfg: swarm_scenario.Config, mesh, tc: TrainConfig = TrainConfig
                 x, v = carry
                 x2, v2, _, nearest = _local_swarm_step(
                     x, v, cfg, cbf, "sp", unroll_relax=tc.unroll_relax,
-                    compute_metrics=False)
+                    compute_metrics=False, t=t)
                 # Hinge on separation: per-agent nearest-neighbor distance
                 # below the target (clipped to the gating radius when no
                 # neighbor is in range), psum-averaged across shards.

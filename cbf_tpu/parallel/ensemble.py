@@ -51,35 +51,45 @@ class EnsembleMetrics(NamedTuple):
 
 def ensemble_initial_states(cfg: swarm_scenario.Config, seeds):
     """(E, N, 2) positions + (E, N, 2) zero velocities, one jittered grid
-    per seed (vmap of the scenario's canonical spawn)."""
+    per seed (vmap of the scenario's canonical spawn, incl. the
+    obstacle-disk clearing push when cfg.n_obstacles > 0)."""
     keys = jnp.stack([jax.random.PRNGKey(int(s)) for s in seeds])
-    x0 = jax.vmap(lambda k: swarm_scenario.spawn_positions(cfg, k))(keys)
+    x0 = jax.vmap(lambda k: swarm_scenario.clear_obstacle_spawn(
+        cfg, swarm_scenario.spawn_positions(cfg, k)))(keys)
     return x0, jnp.zeros_like(x0)
 
 
 def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
                       axis_name: str, unroll_relax: int = 0,
-                      compute_metrics: bool = True):
+                      compute_metrics: bool = True, t=0):
     """One agent-sharded swarm step. x, v: (n_local, 2). Differentiable when
     ``unroll_relax > 0`` (see solvers.exact2d) and ``compute_metrics=False``
     (the metric reductions use pmin, which has no differentiation rule).
+    ``t`` is the global step index — the moving-obstacle ring is closed-form
+    in t (and global: the same ring on every member and shard).
 
     Returns (x_new, u, metrics_or_None, nearest_d_local).
     """
     dt_ = x.dtype
-    f = cfg.dyn_scale * jnp.zeros((4, 4), dt_)
-    g = cfg.dyn_scale * jnp.array([[1, 0], [0, 1], [0, 0], [0, 0]], dt_)
+    f, g, discrete = swarm_scenario.barrier_dynamics(cfg, dt_)
     K = min(cfg.k_neighbors, cfg.n - 1)
+    M = cfg.n_obstacles
 
     mean = lax.psum(jnp.sum(x, axis=0), axis_name) / cfg.n
     to_c = mean[None] - x
     d_c = safe_norm(to_c, keepdims=True)
     pull = jnp.maximum(d_c - cfg.pack_radius, 0.0)
     u0 = cfg.consensus_gain * pull * to_c / jnp.maximum(d_c, 1e-9)
+    if M:
+        obstacles4 = swarm_scenario.obstacle_states_at(cfg, t, dt_)
+        dodge, d_o = swarm_scenario.lane_dodge(x, obstacles4,
+                                               cfg.safety_distance)
+        u0 = u0 + 2.0 * dodge
     speed = safe_norm(u0, keepdims=True)
     u0 = u0 * jnp.minimum(1.0, cfg.speed_limit / jnp.maximum(speed, 1e-9))
 
-    states4 = jnp.concatenate([x, v], axis=1)
+    vslots = jnp.zeros_like(v) if discrete else v
+    states4 = jnp.concatenate([x, vslots], axis=1)
     if (lax.axis_size(axis_name) == 1 and unroll_relax == 0
             and pallas_knn.supported(cfg.n)):
         # dp-only sharding: each swarm is whole on its device, so the
@@ -102,8 +112,22 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
             with_dropped=True, n_total=cfg.n)
         nearest1 = nearest_d[:, 0]
 
+    priority = None
+    if M:
+        # Same contract as the single-device scenario: exact obstacle slab
+        # (never k-NN truncated), priority rows under tiered relaxation.
+        ob_mask = d_o < cfg.safety_distance
+        ob_slab = jnp.broadcast_to(obstacles4[None],
+                                   (x.shape[0],) + obstacles4.shape)
+        priority = jnp.concatenate(
+            [jnp.zeros_like(mask), jnp.ones_like(ob_mask)], axis=1)
+        obs_slab = jnp.concatenate([obs_slab, ob_slab], axis=1)
+        mask = jnp.concatenate([mask, ob_mask], axis=1)
+        nearest1 = jnp.minimum(nearest1, jnp.min(d_o, axis=1))
+
     u_safe, info = safe_controls(states4, obs_slab, mask, f, g, u0, cbf,
-                                 unroll_relax=unroll_relax)
+                                 unroll_relax=unroll_relax,
+                                 priority_mask=priority)
     engaged = jnp.any(mask, axis=1)
     u = jnp.where(engaged[:, None], u_safe, u0)
 
@@ -122,12 +146,14 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
 def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
                           steps: int | None = None,
                           cbf: CBFParams | None = None,
-                          initial_state=None):
+                          initial_state=None, t0: int = 0):
     """Run len(seeds) independent swarms over the (dp, sp) mesh.
 
     ``initial_state``: optional (x0, v0) pair of (E, N, 2) arrays to start
     from (e.g. a restored checkpoint) instead of the seeds' spawn grids —
-    the resume path of a chunked/checkpointed ensemble run.
+    the resume path of a chunked/checkpointed ensemble run. Pass the
+    matching ``t0`` (global step of the restored state) so the
+    closed-form moving-obstacle ring resumes in phase.
 
     Returns ((x_final, v_final) with (E, N, 2) global shape, EnsembleMetrics).
     """
@@ -154,10 +180,12 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
         def one(x0i, v0i):
             def body(carry, t):
                 x, v = carry
-                x2, v2, met, _ = _local_swarm_step(x, v, cfg, cbf, "sp")
+                x2, v2, met, _ = _local_swarm_step(x, v, cfg, cbf, "sp",
+                                                   t=t)
                 return (x2, v2), met
 
-            (xf, vf), mets = lax.scan(body, (x0i, v0i), jnp.arange(steps))
+            (xf, vf), mets = lax.scan(body, (x0i, v0i),
+                                      t0 + jnp.arange(steps))
             return xf, vf, mets
 
         if E_local == 1:

@@ -9,6 +9,12 @@ scenarios (same barrier math, same relax policy), gated on its k nearest
 in-radius neighbors (fixed-K sparsification of the O(N^2) danger scan —
 SURVEY.md §7 hard part #3), with the whole T-step rollout one ``lax.scan``.
 
+With ``n_obstacles > 0`` a ring of virtual obstacles (the reference
+scenarios' obstacle pattern — meet_at_center.py:65-96,
+cross_and_rescue.py:107-118 — generalized to swarm scale) orbits through
+the packing disk; obstacle rows join the k-NN candidate pool so agents
+yield around them through the same CBF filter.
+
 Dynamics use the reference's affine form f = 0.1*0, g = 0.1*[[I],[0]]
 (meet_at_center.py:26-27) with one deliberate deviation: the velocity slots
 of the 4-D states carry the *actual* (previous filtered) velocities, not the
@@ -67,6 +73,28 @@ class Config:
     dyn_scale: float = 0.1
     seed: int = 0
     record_trajectory: bool = False
+    # Moving obstacles: the reference scenarios' obstacle rings
+    # (meet_at_center.py:65-96, cross_and_rescue.py:107-118) generalized to
+    # swarm scale. M virtual obstacles orbit the origin on a circle of
+    # radius obstacle_orbit_frac * pack_radius at obstacle_omega rad/s —
+    # positions are closed-form in t, so they carry no state through the
+    # scan. They join the k-NN candidate pool (agents must yield around
+    # them); they are not themselves controlled.
+    n_obstacles: int = 0
+    obstacle_orbit_frac: float = 0.6
+    obstacle_omega: float = 0.5
+    # Barrier discretization. "continuous": the reference's rows as-is
+    # (f = 0, g = 0.1*I — meet_at_center.py:26-27), which models a *static*
+    # world between steps: a minimum-norm QP then ignores approaching
+    # obstacles until h ~ 0 and the floor erodes with obstacle speed
+    # (measured). "discrete": f = dt*(pos<-vel coupling), g = dt*I and
+    # zeroed agent velocity slots, making the row algebraically the exact
+    # discrete-time CBF condition h_{k+1} >= (1-gamma)*h_k — the L1 floor
+    # holds against obstacles up to 10x agent speed (probed to 2 m/s), and
+    # pairwise (both agents moving) h_{k+1} >= (1-2*gamma)*h_k, so
+    # gamma <= 0.5 keeps the floor. "auto" = discrete when obstacles are
+    # present, else continuous (the bench-measured configuration).
+    barrier: str = "auto"
     # Neighbor-search backend: "auto" picks a Pallas kernel on TPU
     # (fused <= 8192 agents, streaming beyond — ops.pallas_knn), else the
     # jnp path; "pallas"/"jnp" force (pallas runs in interpret mode off-TPU
@@ -126,8 +154,99 @@ def spawn_positions(cfg: Config, seed) -> jnp.ndarray:
     return jnp.asarray(grid, cfg.dtype) + jitter.astype(cfg.dtype)
 
 
+def obstacle_states_at(cfg: Config, t, dtype) -> jnp.ndarray:
+    """(M, 4) obstacle rows at traced step t — closed-form orbit (positions
+    carry no state through the scan; cf. the reference's Euler-stepped
+    ring, cross_and_rescue.py:173). Shared by the single-device scenario
+    and the sharded ensemble path (obstacles are global: the same ring for
+    every member and shard)."""
+    M = cfg.n_obstacles
+    phases = jnp.arange(M, dtype=dtype) * (2 * np.pi / M)
+    orbit_r = jnp.asarray(cfg.obstacle_orbit_frac * cfg.pack_radius, dtype)
+    ang = phases + cfg.obstacle_omega * cfg.dt * jnp.asarray(t).astype(dtype)
+    pos = orbit_r * jnp.stack([jnp.cos(ang), jnp.sin(ang)], axis=1)
+    vel = (cfg.obstacle_omega * orbit_r
+           * jnp.stack([-jnp.sin(ang), jnp.cos(ang)], axis=1))
+    return jnp.concatenate([pos, vel], axis=1)
+
+
+def lane_dodge(x, obstacles4, safety_distance):
+    """Sideways-out-of-the-lane nominal bias and the (N, M) agent-obstacle
+    distances it is derived from (reused by callers for gating/metrics).
+
+    A minimum-norm filter dodges *radially*, so an agent directly in a fast
+    obstacle's path brakes into the agent behind it and the pair gets
+    squeezed (measured); biasing the NOMINAL control toward whichever side
+    of the obstacle's travel lane the agent already is empties the lane
+    while the filter keeps the guarantees.
+    """
+    rel = x[:, None, :] - obstacles4[None, :, :2]          # (N, M, 2)
+    d_o = jnp.linalg.norm(rel, axis=-1)                    # (N, M)
+    ov = obstacles4[:, 2:]
+    lane = ov / jnp.maximum(
+        jnp.linalg.norm(ov, axis=1, keepdims=True), 1e-9)
+    perp = jnp.stack([-lane[:, 1], lane[:, 0]], axis=1)    # (M, 2)
+    side = jnp.sign(jnp.sum(rel * perp[None], axis=-1) + 1e-9)
+    w = jnp.maximum(safety_distance - d_o, 0.0)            # (N, M)
+    dodge = jnp.sum((w * side)[..., None] * perp[None], axis=1)
+    return dodge, d_o
+
+
+def barrier_dynamics(cfg: Config, dtype):
+    """(f, g, discrete) for the configured barrier discretization (see
+    Config.barrier)."""
+    if cfg.barrier not in ("auto", "continuous", "discrete"):
+        raise ValueError(
+            f"barrier must be auto|continuous|discrete, got {cfg.barrier!r}")
+    discrete = (cfg.n_obstacles > 0 if cfg.barrier == "auto"
+                else cfg.barrier == "discrete")
+    if discrete:
+        # Exact discrete-time CBF rows (see Config.barrier): the drift term
+        # carries dt * (relative velocity) and the control term dt * u, so
+        # the constraint IS h_{k+1} >= (1-gamma) h_k for the integration
+        # x_{k+1} = x_k + dt*u.
+        f = cfg.dt * jnp.array([[0, 0, 1, 0], [0, 0, 0, 1],
+                                [0, 0, 0, 0], [0, 0, 0, 0]], dtype)
+        g = cfg.dt * jnp.array([[1, 0], [0, 1], [0, 0], [0, 0]], dtype)
+    else:
+        f = cfg.dyn_scale * jnp.zeros((4, 4), dtype)
+        g = cfg.dyn_scale * jnp.array([[1, 0], [0, 1], [0, 0], [0, 0]],
+                                      dtype)
+    return f, g, discrete
+
+
+def obstacle_positions_at(cfg: Config, t: float) -> np.ndarray:
+    """Closed-form (M, 2) obstacle ring positions at step t (host-side
+    mirror of the device computation in make())."""
+    phases = np.arange(cfg.n_obstacles) * (2 * np.pi / cfg.n_obstacles)
+    ang = phases + cfg.obstacle_omega * cfg.dt * t
+    r = cfg.obstacle_orbit_frac * cfg.pack_radius
+    return r * np.stack([np.cos(ang), np.sin(ang)], axis=1)
+
+
+def clear_obstacle_spawn(cfg: Config, x0):
+    """Push spawned agents radially off their nearest obstacle to a 0.25 m
+    stand-off. The jittered grid knows nothing about the obstacle ring: an
+    agent can spawn inside an obstacle's barrier disk, which would show up
+    as a t=0 "violation" no filter can prevent (ring spacing at the
+    defaults is >0.5 m, so one pass w.r.t. the nearest obstacle clears
+    all of them). No-op when ``cfg.n_obstacles == 0``."""
+    if not cfg.n_obstacles:
+        return x0
+    opos = jnp.asarray(obstacle_positions_at(cfg, 0.0), x0.dtype)
+    diff = x0[:, None, :] - opos[None, :, :]                   # (N, M, 2)
+    d = jnp.linalg.norm(diff, axis=-1)
+    j = jnp.argmin(d, axis=1)
+    dn = jnp.take_along_axis(d, j[:, None], axis=1)[:, 0]
+    dirn = jnp.take_along_axis(
+        diff, j[:, None, None], axis=1)[:, 0] / jnp.maximum(
+        dn, 1e-6)[:, None]
+    push = jnp.maximum(0.25 - dn, 0.0)
+    return x0 + push[:, None] * dirn
+
+
 def initial_state(cfg: Config) -> State:
-    x0 = spawn_positions(cfg, cfg.seed)
+    x0 = clear_obstacle_spawn(cfg, spawn_positions(cfg, cfg.seed))
     return State(x=x0, v=jnp.zeros_like(x0))
 
 
@@ -141,13 +260,13 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
         # to 0 and never crosses it: no infeasibility, hard separation.
         cbf = CBFParams(max_speed=cfg.max_speed, k=0.0)
     dt_ = cfg.dtype
-    f = cfg.dyn_scale * jnp.zeros((4, 4), dt_)
-    g = cfg.dyn_scale * jnp.array([[1, 0], [0, 1], [0, 0], [0, 0]], dt_)
+    f, g, discrete = barrier_dynamics(cfg, dt_)
     K = cfg.k_neighbors
 
     if cfg.gating not in ("auto", "pallas", "jnp", "banded"):
         raise ValueError(
             f"gating must be auto|pallas|jnp|banded, got {cfg.gating!r}")
+    M = cfg.n_obstacles
     use_banded = cfg.gating == "banded"
     if cfg.gating == "auto":
         use_pallas = pallas_knn.supported(cfg.n)
@@ -168,6 +287,9 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
 
     state0 = initial_state(cfg)
 
+    def obstacle_states(t):
+        return obstacle_states_at(cfg, t, dt_)
+
     def step(state: State, t):
         x = state.x                                            # (N, 2)
         to_c = jnp.mean(x, axis=0)[None] - x                   # (N, 2)
@@ -175,11 +297,20 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
         # Pull toward the centroid only while outside the packing disk.
         pull = jnp.maximum(d_c - cfg.pack_radius, 0.0)
         u0 = cfg.consensus_gain * pull * to_c / jnp.maximum(d_c, 1e-9)
+        if M:
+            obstacles4 = obstacle_states(t)
+            dodge, d_o = lane_dodge(x, obstacles4, cfg.safety_distance)
+            u0 = u0 + 2.0 * dodge
         # Pre-filter actuator saturation (see Config.speed_limit).
         speed = jnp.linalg.norm(u0, axis=1, keepdims=True)
         u0 = u0 * jnp.minimum(1.0, cfg.speed_limit / jnp.maximum(speed, 1e-9))
 
-        states4 = jnp.concatenate([x, state.v], axis=1)        # (N, 4)
+        # Discrete barrier: agent velocity slots are zero by construction
+        # (u is the unknown the row solves for; a fellow agent's motion is
+        # covered by the pairwise (1-2*gamma) bound) — only obstacle rows
+        # carry real velocities into the drift term.
+        vslots = jnp.zeros_like(state.v) if discrete else state.v
+        states4 = jnp.concatenate([x, vslots], axis=1)         # (N, 4)
 
         overflow_count = ()
         if use_banded:
@@ -208,7 +339,31 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
             off = dist + jnp.where(jnp.eye(x.shape[0], dtype=bool), jnp.inf, 0.0)
             min_dist = jnp.min(off)
 
-        u_safe, info = safe_controls(states4, obs_slab, mask, f, g, u0, cbf)
+        priority = None
+        if M:
+            # Obstacles NEVER go through k-NN truncation: a closing obstacle
+            # beyond the K nearest agents would silently lose its constraint
+            # exactly when the crowd is packed (measured: the floor erodes).
+            # M is small and static, so an exact (N, M) slab rides alongside
+            # the truncated agent slab at negligible cost. Obstacle rows are
+            # also PRIORITY rows: if the QP goes infeasible (a boxed-in
+            # agent in the packed core), inter-agent spacing yields before
+            # obstacle clearance (tiered relaxation — core.filter).
+            # d_o is the dodge block's (N, M) distances, reused (the slab
+            # below is danger_slab's logic inlined on it).
+            ob_mask = d_o < cfg.safety_distance
+            ob_slab = jnp.broadcast_to(obstacles4[None],
+                                       (cfg.n,) + obstacles4.shape)
+            # priority width follows the gated mask (knn_gating clamps its
+            # slab to the candidate count when n <= k_neighbors).
+            priority = jnp.concatenate(
+                [jnp.zeros_like(mask), jnp.ones_like(ob_mask)], axis=1)
+            obs_slab = jnp.concatenate([obs_slab, ob_slab], axis=1)
+            mask = jnp.concatenate([mask, ob_mask], axis=1)
+            min_dist = jnp.minimum(min_dist, jnp.min(d_o))
+
+        u_safe, info = safe_controls(states4, obs_slab, mask, f, g, u0, cbf,
+                                     priority_mask=priority)
         engaged = jnp.any(mask, axis=1)
         u = jnp.where(engaged[:, None], u_safe, u0)
 

@@ -29,11 +29,13 @@ jax.config.update("jax_platforms", "cpu")
 # HLO fingerprint, so code changes invalidate naturally). Measured ~2.3x on
 # a representative scenario compile. Per-user path: a world-shared fixed
 # /tmp dir would collide between users on a shared machine.
-import getpass  # noqa: E402
 import tempfile  # noqa: E402
 
+# getuid over getpass.getuser(): the latter raises KeyError under uids
+# with no passwd entry (arbitrary-uid containers).
+_uid = os.getuid() if hasattr(os, "getuid") else "na"
 _cache_dir = os.path.join(tempfile.gettempdir(),
-                          f"cbf_tpu_jax_cache_{getpass.getuser()}")
+                          f"cbf_tpu_jax_cache_{_uid}")
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
