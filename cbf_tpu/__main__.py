@@ -29,23 +29,35 @@ def _scenarios():
     from cbf_tpu.scenarios import (antipodal, cross_and_rescue,
                                    meet_at_center, swarm)
 
+    def _render_swarm(outs, cfg, path, start=0):
+        import numpy as np
+
+        obstacles = None
+        if getattr(cfg, "n_obstacles", 0):
+            # Offset by the resume start step: a checkpoint-resumed rollout
+            # records only steps start..T, and the closed-form ring must be
+            # reconstructed in phase with them.
+            T = np.asarray(outs.trajectory).shape[0]
+            obstacles = np.stack(
+                [swarm.obstacle_positions_at(cfg, start + t)
+                 for t in range(T)])
+        return render_swarm(outs.trajectory, path, obstacles=obstacles)
+
     # Last field: the recorded trajectory layout — "dims_major" = (T, 2, N)
     # columns-of-agents (the sim-layer convention), "agent_major" = (T, N, 2).
     return {
         "meet_at_center": (meet_at_center, "iterations",
-                           lambda outs, cfg, path: render_meet_at_center(
+                           lambda outs, cfg, path, start=0: render_meet_at_center(
                                outs.trajectory, path,
                                n_obstacles=cfg.n_obstacles),
                            "dims_major"),
         "cross_and_rescue": (cross_and_rescue, "iterations",
-                             lambda outs, cfg, path: render_cross_and_rescue(
+                             lambda outs, cfg, path, start=0: render_cross_and_rescue(
                                  outs.trajectory, path, goal=cfg.goal),
                              "dims_major"),
-        "swarm": (swarm, "steps",
-                  lambda outs, cfg, path: render_swarm(outs.trajectory, path),
-                  "agent_major"),
+        "swarm": (swarm, "steps", _render_swarm, "agent_major"),
         "antipodal": (antipodal, "steps",
-                      lambda outs, cfg, path: render_swarm(
+                      lambda outs, cfg, path, start=0: render_swarm(
                           outs.trajectory, path),
                       "agent_major"),
     }
@@ -121,7 +133,7 @@ def cmd_run(args) -> int:
     if start:
         record["resumed_from_step"] = start
     if args.video and outs is not None:
-        record["video"] = renderer(outs, cfg, args.video)
+        record["video"] = renderer(outs, cfg, args.video, start)
     if args.traj and outs is not None:
         record["traj"] = _write_traj(args.traj, outs, traj_layout)
     print(json.dumps(record))

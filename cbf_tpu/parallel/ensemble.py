@@ -114,15 +114,8 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
 
     priority = None
     if M:
-        # Same contract as the single-device scenario: exact obstacle slab
-        # (never k-NN truncated), priority rows under tiered relaxation.
-        ob_mask = d_o < cfg.safety_distance
-        ob_slab = jnp.broadcast_to(obstacles4[None],
-                                   (x.shape[0],) + obstacles4.shape)
-        priority = jnp.concatenate(
-            [jnp.zeros_like(mask), jnp.ones_like(ob_mask)], axis=1)
-        obs_slab = jnp.concatenate([obs_slab, ob_slab], axis=1)
-        mask = jnp.concatenate([mask, ob_mask], axis=1)
+        obs_slab, mask, priority = swarm_scenario.attach_obstacle_rows(
+            obs_slab, mask, obstacles4, d_o, cfg.safety_distance)
         nearest1 = jnp.minimum(nearest1, jnp.min(d_o, axis=1))
 
     u_safe, info = safe_controls(states4, obs_slab, mask, f, g, u0, cbf,

@@ -216,12 +216,19 @@ def render_cross_and_rescue(trajectory, out_path: str, *,
         out_path, stride=stride, title="cross_and_rescue", **kw)
 
 
-def render_swarm(trajectory, out_path: str, *, stride: int = 10, **kw) -> str:
+def render_swarm(trajectory, out_path: str, *, stride: int = 10,
+                 obstacles=None, **kw) -> str:
     """Replay a swarm rollout. trajectory: (T, N, 2) (the swarm scenario
-    records row-major positions)."""
+    records row-major positions). ``obstacles``: optional (T, M, 2)
+    obstacle positions (reconstruct closed-form via
+    ``scenarios.swarm.obstacle_positions_at`` — they carry no state)."""
     traj = np.asarray(trajectory).transpose(0, 2, 1)        # -> (T, 2, N)
     half = float(np.abs(traj).max()) * 1.05 + 1e-3
+    layers = [Layer(traj, color="tab:blue", radius=0.02)]
+    if obstacles is not None:
+        obs = np.asarray(obstacles).transpose(0, 2, 1)      # -> (T, 2, M)
+        layers.append(Layer(obs, color="tab:red", radius=0.1,
+                            label="obstacles"))
     return replay(
-        [Layer(traj, color="tab:blue", radius=0.02)],
-        out_path, stride=stride, arena=(-half, half, -half, half),
+        layers, out_path, stride=stride, arena=(-half, half, -half, half),
         title="swarm rendezvous", **kw)

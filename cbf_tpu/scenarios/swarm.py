@@ -192,6 +192,31 @@ def lane_dodge(x, obstacles4, safety_distance):
     return dodge, d_o
 
 
+def attach_obstacle_rows(obs_slab, mask, obstacles4, d_o, safety_distance):
+    """Append the exact obstacle slab to a k-NN agent slab.
+
+    Obstacles never go through k-NN truncation: a closing obstacle beyond
+    the K nearest agents would silently lose its constraint exactly when
+    the crowd is packed (measured floor erosion). They are also PRIORITY
+    rows under tiered relaxation (core.filter): a boxed-in agent yields
+    inter-agent spacing before obstacle clearance. Shared by the
+    single-device scenario and the sharded ensemble path so the two
+    contracts cannot drift.
+
+    Args: obs_slab (N, K, 4), mask (N, K), obstacles4 (M, 4), d_o (N, M)
+    agent-obstacle distances (from :func:`lane_dodge`).
+    Returns (obs_slab (N, K+M, 4), mask (N, K+M), priority (N, K+M)).
+    """
+    n = obs_slab.shape[0]
+    ob_mask = d_o < safety_distance
+    ob_slab = jnp.broadcast_to(obstacles4[None], (n,) + obstacles4.shape)
+    priority = jnp.concatenate(
+        [jnp.zeros_like(mask), jnp.ones_like(ob_mask)], axis=1)
+    obs_slab = jnp.concatenate([obs_slab, ob_slab], axis=1)
+    mask = jnp.concatenate([mask, ob_mask], axis=1)
+    return obs_slab, mask, priority
+
+
 def barrier_dynamics(cfg: Config, dtype):
     """(f, g, discrete) for the configured barrier discretization (see
     Config.barrier)."""
@@ -225,12 +250,17 @@ def obstacle_positions_at(cfg: Config, t: float) -> np.ndarray:
 
 
 def clear_obstacle_spawn(cfg: Config, x0):
-    """Push spawned agents radially off their nearest obstacle to a 0.25 m
-    stand-off. The jittered grid knows nothing about the obstacle ring: an
-    agent can spawn inside an obstacle's barrier disk, which would show up
-    as a t=0 "violation" no filter can prevent (ring spacing at the
-    defaults is >0.5 m, so one pass w.r.t. the nearest obstacle clears
-    all of them). No-op when ``cfg.n_obstacles == 0``."""
+    """Push spawned agents radially off their nearest obstacle to at least
+    a 0.25 m stand-off. The jittered grid knows nothing about the obstacle
+    ring: an agent can spawn inside an obstacle's barrier disk, which would
+    show up as a t=0 "violation" no filter can prevent (ring spacing at the
+    defaults is >0.5 m, so one pass w.r.t. the nearest obstacle clears all
+    of them). The radius map is MONOTONE (r -> 0.25 + 0.6*r), not a
+    projection onto the 0.25 circle: projecting collapses same-disk agents
+    at different depths onto one circle and they land nearly coincident
+    (measured sub-dmin t=0 pairs on ~1 in 6 seeds); injectivity in r keeps
+    radial order and strictly grows transverse gaps. No-op when
+    ``cfg.n_obstacles == 0``."""
     if not cfg.n_obstacles:
         return x0
     opos = jnp.asarray(obstacle_positions_at(cfg, 0.0), x0.dtype)
@@ -241,8 +271,33 @@ def clear_obstacle_spawn(cfg: Config, x0):
     dirn = jnp.take_along_axis(
         diff, j[:, None, None], axis=1)[:, 0] / jnp.maximum(
         dn, 1e-6)[:, None]
-    push = jnp.maximum(0.25 - dn, 0.0)
-    return x0 + push[:, None] * dirn
+    r_new = 0.25 + 0.6 * dn
+    push = jnp.where(dn < 0.25, r_new - dn, 0.0)
+    x0 = x0 + push[:, None] * dirn
+
+    # The push can land cleared agents near neighbors that were already
+    # outside the disk; a few rounds of symmetric pairwise separation
+    # repair (each too-close pair moves apart by half its deficit) settle
+    # everyone above the floor, re-applying the obstacle stand-off each
+    # round so the repair cannot push anyone back into a disk. One-time
+    # spawn cost, not in the scan.
+    for _ in range(12):
+        diff_aa = x0[:, None, :] - x0[None, :, :]              # (N, N, 2)
+        d_aa = jnp.linalg.norm(diff_aa, axis=-1)
+        d_aa = d_aa + jnp.eye(x0.shape[0], dtype=x0.dtype) * 1e9
+        deficit = jnp.maximum(0.25 - d_aa, 0.0) / 2.0
+        x0 = x0 + jnp.sum(
+            deficit[..., None] * diff_aa / jnp.maximum(d_aa, 1e-6)[..., None],
+            axis=1)
+        diff = x0[:, None, :] - opos[None, :, :]
+        d = jnp.linalg.norm(diff, axis=-1)
+        j = jnp.argmin(d, axis=1)
+        dn = jnp.take_along_axis(d, j[:, None], axis=1)[:, 0]
+        dirn = jnp.take_along_axis(
+            diff, j[:, None, None], axis=1)[:, 0] / jnp.maximum(
+            dn, 1e-6)[:, None]
+        x0 = x0 + jnp.where(dn < 0.25, 0.25 - dn, 0.0)[:, None] * dirn
+    return x0
 
 
 def initial_state(cfg: Config) -> State:
@@ -341,25 +396,8 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
 
         priority = None
         if M:
-            # Obstacles NEVER go through k-NN truncation: a closing obstacle
-            # beyond the K nearest agents would silently lose its constraint
-            # exactly when the crowd is packed (measured: the floor erodes).
-            # M is small and static, so an exact (N, M) slab rides alongside
-            # the truncated agent slab at negligible cost. Obstacle rows are
-            # also PRIORITY rows: if the QP goes infeasible (a boxed-in
-            # agent in the packed core), inter-agent spacing yields before
-            # obstacle clearance (tiered relaxation — core.filter).
-            # d_o is the dodge block's (N, M) distances, reused (the slab
-            # below is danger_slab's logic inlined on it).
-            ob_mask = d_o < cfg.safety_distance
-            ob_slab = jnp.broadcast_to(obstacles4[None],
-                                       (cfg.n,) + obstacles4.shape)
-            # priority width follows the gated mask (knn_gating clamps its
-            # slab to the candidate count when n <= k_neighbors).
-            priority = jnp.concatenate(
-                [jnp.zeros_like(mask), jnp.ones_like(ob_mask)], axis=1)
-            obs_slab = jnp.concatenate([obs_slab, ob_slab], axis=1)
-            mask = jnp.concatenate([mask, ob_mask], axis=1)
+            obs_slab, mask, priority = attach_obstacle_rows(
+                obs_slab, mask, obstacles4, d_o, cfg.safety_distance)
             min_dist = jnp.minimum(min_dist, jnp.min(d_o))
 
         u_safe, info = safe_controls(states4, obs_slab, mask, f, g, u0, cbf,

@@ -187,3 +187,18 @@ def test_unroll_path_rejects_priority_mask():
     with pytest.raises(ValueError, match="priority_mask"):
         safe_controls(s, obs, mask, f, g, jnp.zeros((2, 2)), CBFParams(),
                       unroll_relax=2, priority_mask=jnp.ones((2, 3), bool))
+
+
+def test_spawn_clearing_never_stacks_agents():
+    """Seed sweep for the spawn-clearing repair (review regression: the
+    radial projection collapsed same-disk agents to sub-dmin pairs on ~1
+    in 6 seeds; the monotone map + pairwise repair must clear every seed)."""
+    for seed in range(12):
+        cfg = swarm.Config(n=256, steps=1, n_obstacles=12, seed=seed)
+        x0 = np.asarray(swarm.initial_state(cfg).x)
+        d = np.linalg.norm(x0[:, None] - x0[None], axis=-1)
+        np.fill_diagonal(d, np.inf)
+        opos = swarm.obstacle_positions_at(cfg, 0.0)
+        do = np.linalg.norm(x0[:, None] - opos[None], axis=-1)
+        assert d.min() > 0.24, (seed, d.min())
+        assert do.min() > 0.24, (seed, do.min())
