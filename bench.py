@@ -156,14 +156,16 @@ def _child_single(n: int, steps: int) -> dict:
     from cbf_tpu.rollout.engine import rollout_chunked
     from cbf_tpu.scenarios import swarm
 
-    cfg = swarm.Config(n=n, steps=steps, record_trajectory=False)
+    gating = os.environ.get("BENCH_GATING", "auto")
+    cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
+                       gating=gating)
     state0, step = swarm.make(cfg)
     chunk = min(_env_int("BENCH_CHUNK", 1000), steps)
     unroll = _env_int("BENCH_UNROLL", 1)
 
     print(f"bench: swarm N={n}, steps={steps} (chunk={chunk}, "
-          f"unroll={unroll}, checkpointed), devices={jax.devices()}",
-          file=sys.stderr)
+          f"unroll={unroll}, gating={gating}, checkpointed), "
+          f"devices={jax.devices()}", file=sys.stderr)
 
     # Warmup: compile every executable the measured run will use — the
     # full-size chunk and, when steps % chunk != 0, the trailing partial

@@ -243,12 +243,15 @@ def assemble_qp_dedup(robot_states, obs_states, obs_mask, f, g, u0, *, dmin,
 
 
 def assemble_qp(robot_state, obs_states, obs_mask, f, g, u0, *, dmin, k, gamma,
-                max_speed, reference_layout=True):
+                max_speed, reference_layout=True,
+                priority_mask=None, priority_relax_weight=0.01):
     """Full (K+8)-row QP data for one agent.
 
     Returns (A, b, relax_mask): ``min ||du||^2 s.t. A du <= b``; ``relax_mask``
     is 1.0 on real CBF rows — the rows the infeasibility-relaxation adds +1 to
-    (cbf.py:85-87) — and 0.0 on masked and box rows.
+    (cbf.py:85-87) — and 0.0 on masked and box rows. With ``priority_mask``
+    (K,) bool, marked candidates' rows carry ``priority_relax_weight``
+    instead of 1.0 (tiered relaxation; exact per row here — no dedup).
     """
     A_cbf, b_cbf = barrier_rows(
         robot_state, obs_states, obs_mask, f, g, u0, dmin=dmin, k=k, gamma=gamma
@@ -256,7 +259,9 @@ def assemble_qp(robot_state, obs_states, obs_mask, f, g, u0, *, dmin, k, gamma,
     G, S = box_rows(robot_state, u0, max_speed, reference_layout=reference_layout)
     A = jnp.concatenate([A_cbf, G], axis=0)
     b = jnp.concatenate([b_cbf, S], axis=0)
-    relax_mask = jnp.concatenate(
-        [obs_mask.astype(b.dtype), jnp.zeros((8,), dtype=b.dtype)]
-    )
+    weights = obs_mask.astype(b.dtype)
+    if priority_mask is not None:
+        weights = weights * jnp.where(priority_mask, priority_relax_weight,
+                                      1.0).astype(b.dtype)
+    relax_mask = jnp.concatenate([weights, jnp.zeros((8,), dtype=b.dtype)])
     return A, b, relax_mask

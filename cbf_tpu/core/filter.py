@@ -35,7 +35,8 @@ class CBFParams(NamedTuple):
 )
 def safe_control(robot_state, obs_states, obs_mask, f, g, u0,
                  params: CBFParams = CBFParams(), *, max_relax: int = 64,
-                 unroll_relax: int = 0, reference_layout: bool = True):
+                 unroll_relax: int = 0, reference_layout: bool = True,
+                 priority_mask=None, priority_relax_weight: float = 0.01):
     """Filter one agent's nominal control. Returns (u, QPInfo).
 
     Args:
@@ -51,6 +52,8 @@ def safe_control(robot_state, obs_states, obs_mask, f, g, u0,
         robot_state, obs_states, obs_mask, f, g, u0,
         dmin=params.dmin, k=params.k, gamma=params.gamma,
         max_speed=params.max_speed, reference_layout=reference_layout,
+        priority_mask=priority_mask,
+        priority_relax_weight=priority_relax_weight,
     )
     du, info = solve_qp_2d(
         A, b, relax_mask, max_relax=max_relax, unroll_relax=unroll_relax
@@ -97,19 +100,21 @@ def safe_controls(robot_states, obs_states, obs_mask, f, g, u0,
     ``where(mask.any(-1), u_filtered, u0)``; the rollout engine does.
     """
     if unroll_relax > 0:
-        if priority_mask is not None:
-            raise ValueError(
-                "priority_mask (tiered relaxation) is not implemented on "
-                "the unroll_relax differentiable path — dropping it "
-                "silently would void the obstacle-clearance guarantee")
-        # Differentiable path (unrolled relax rounds) — plain vmap.
+        # Differentiable path (unrolled relax rounds) — plain vmap; tiered
+        # relaxation is exact per row here (no dedup classes needed).
         fn = functools.partial(
             safe_control, max_relax=max_relax, unroll_relax=unroll_relax,
             reference_layout=reference_layout,
+            priority_relax_weight=priority_relax_weight,
         )
-        return jax.vmap(fn, in_axes=(0, 0, 0, None, None, 0, None))(
-            robot_states, obs_states, obs_mask, f, g, u0, params
-        )
+        if priority_mask is None:
+            return jax.vmap(fn, in_axes=(0, 0, 0, None, None, 0, None))(
+                robot_states, obs_states, obs_mask, f, g, u0, params
+            )
+        return jax.vmap(
+            lambda s, o, m, u, pri: fn(s, o, m, f, g, u, params,
+                                       priority_mask=pri)
+        )(robot_states, obs_states, obs_mask, u0, priority_mask)
 
     # Fast path: direction-deduped batched assembly (K+8 rows -> 8, exactly
     # equivalent — see assemble_qp_dedup) + the lane-major batch solver.

@@ -227,6 +227,9 @@ def render_swarm(trajectory, out_path: str, *, stride: int = 10,
     layers = [Layer(traj, color="tab:blue", radius=0.02)]
     if obstacles is not None:
         obs = np.asarray(obstacles).transpose(0, 2, 1)      # -> (T, 2, M)
+        # The arena must cover the obstacle orbit too, or a ring wider
+        # than the agent cloud draws entirely off-frame.
+        half = max(half, float(np.abs(obs).max()) * 1.05 + 1e-3)
         layers.append(Layer(obs, color="tab:red", radius=0.1,
                             label="obstacles"))
     return replay(

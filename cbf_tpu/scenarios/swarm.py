@@ -264,40 +264,47 @@ def clear_obstacle_spawn(cfg: Config, x0):
     if not cfg.n_obstacles:
         return x0
     opos = jnp.asarray(obstacle_positions_at(cfg, 0.0), x0.dtype)
-    diff = x0[:, None, :] - opos[None, :, :]                   # (N, M, 2)
-    d = jnp.linalg.norm(diff, axis=-1)
-    j = jnp.argmin(d, axis=1)
-    dn = jnp.take_along_axis(d, j[:, None], axis=1)[:, 0]
-    dirn = jnp.take_along_axis(
-        diff, j[:, None, None], axis=1)[:, 0] / jnp.maximum(
-        dn, 1e-6)[:, None]
-    r_new = 0.25 + 0.6 * dn
-    push = jnp.where(dn < 0.25, r_new - dn, 0.0)
-    x0 = x0 + push[:, None] * dirn
 
-    # The push can land cleared agents near neighbors that were already
-    # outside the disk; a few rounds of symmetric pairwise separation
-    # repair (each too-close pair moves apart by half its deficit) settle
-    # everyone above the floor, re-applying the obstacle stand-off each
-    # round so the repair cannot push anyone back into a disk. One-time
-    # spawn cost, not in the scan.
-    for _ in range(12):
-        diff_aa = x0[:, None, :] - x0[None, :, :]              # (N, N, 2)
-        d_aa = jnp.linalg.norm(diff_aa, axis=-1)
-        d_aa = d_aa + jnp.eye(x0.shape[0], dtype=x0.dtype) * 1e9
-        deficit = jnp.maximum(0.25 - d_aa, 0.0) / 2.0
-        x0 = x0 + jnp.sum(
-            deficit[..., None] * diff_aa / jnp.maximum(d_aa, 1e-6)[..., None],
-            axis=1)
-        diff = x0[:, None, :] - opos[None, :, :]
+    def nearest_obstacle(x):
+        """(dn, dirn): distance to and unit direction from each agent's
+        nearest obstacle."""
+        diff = x[:, None, :] - opos[None, :, :]                # (N, M, 2)
         d = jnp.linalg.norm(diff, axis=-1)
         j = jnp.argmin(d, axis=1)
         dn = jnp.take_along_axis(d, j[:, None], axis=1)[:, 0]
         dirn = jnp.take_along_axis(
             diff, j[:, None, None], axis=1)[:, 0] / jnp.maximum(
             dn, 1e-6)[:, None]
-        x0 = x0 + jnp.where(dn < 0.25, 0.25 - dn, 0.0)[:, None] * dirn
-    return x0
+        return dn, dirn
+
+    def obstacle_push(x):
+        dn, dirn = nearest_obstacle(x)
+        r_new = 0.25 + 0.6 * dn
+        return x + jnp.where(dn < 0.25, r_new - dn, 0.0)[:, None] * dirn
+
+    def pairwise_repair(x):
+        diff_aa = x[:, None, :] - x[None, :, :]                # (N, N, 2)
+        d_aa = jnp.linalg.norm(diff_aa, axis=-1)
+        d_aa = d_aa + jnp.eye(x.shape[0], dtype=x.dtype) * 1e9
+        deficit = jnp.maximum(0.25 - d_aa, 0.0) / 2.0
+        return x + jnp.sum(
+            deficit[..., None] * diff_aa / jnp.maximum(d_aa, 1e-6)[..., None],
+            axis=1)
+
+    # Interleave: the push can land cleared agents near neighbors that were
+    # already outside the disk; symmetric pairwise repair (each too-close
+    # pair moves apart by half its deficit) settles everyone above the
+    # floor, and the monotone push re-applies the obstacle stand-off
+    # without collapsing same-disk pairs. Both residuals contract toward 0
+    # across rounds, so ending on the repair leaves at most dust-sized
+    # obstacle deficit (measured < 1e-4 over wide seed sweeps); there is
+    # deliberately no data-dependent early exit (this runs under jit/vmap
+    # for ensemble spawns). One-time spawn cost, not in the scan.
+    x0 = obstacle_push(x0)
+    for _ in range(20):
+        x0 = pairwise_repair(x0)
+        x0 = obstacle_push(x0)
+    return pairwise_repair(x0)
 
 
 def initial_state(cfg: Config) -> State:

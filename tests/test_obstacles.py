@@ -177,28 +177,77 @@ def test_small_n_priority_width(rng):
     assert np.isfinite(np.asarray(outs.min_pairwise_distance)).all()
 
 
-def test_unroll_path_rejects_priority_mask():
+def test_unroll_path_matches_batch_path_with_priority():
+    """Tiered relaxation on the differentiable (unrolled) path equals the
+    dedup batch path — on the pinned-agent scenario where tiering is the
+    difference between dodging and being run over."""
     from cbf_tpu.core.filter import CBFParams, safe_controls
 
-    s = jnp.zeros((2, 4), jnp.float32)
-    obs = jnp.zeros((2, 3, 4), jnp.float32)
-    mask = jnp.zeros((2, 3), bool)
-    f = jnp.zeros((4, 4)); g = jnp.zeros((4, 2))
-    with pytest.raises(ValueError, match="priority_mask"):
-        safe_controls(s, obs, mask, f, g, jnp.zeros((2, 2)), CBFParams(),
-                      unroll_relax=2, priority_mask=jnp.ones((2, 3), bool))
+    dt = 0.033
+    f = dt * jnp.array([[0, 0, 1, 0], [0, 0, 0, 1],
+                        [0, 0, 0, 0], [0, 0, 0, 0]], jnp.float32)
+    g = dt * jnp.array([[1, 0], [0, 1], [0, 0], [0, 0]], jnp.float32)
+    cbf = CBFParams(max_speed=15.0, k=0.0)
+    agent = jnp.zeros((1, 4), jnp.float32)
+    neigh = np.array([[0.1, 0.1], [0.1, -0.1],
+                      [-0.1, 0.1], [-0.1, -0.1]], np.float32)
+    obstacle = np.array([[-0.3, 0.0, 2.0, 0.0]], np.float32)
+    cand = jnp.asarray(np.concatenate(
+        [np.concatenate([neigh, np.zeros((4, 2), np.float32)], 1),
+         obstacle]))[None]
+    mask = jnp.ones((1, 5), bool)
+    u0 = jnp.zeros((1, 2), jnp.float32)
+    priority = jnp.asarray([[False] * 4 + [True]])
+
+    u_batch, _ = safe_controls(agent, cand, mask, f, g, u0, cbf,
+                               priority_mask=priority)
+    u_unroll, _ = safe_controls(agent, cand, mask, f, g, u0, cbf,
+                                unroll_relax=2, priority_mask=priority)
+    np.testing.assert_allclose(np.asarray(u_unroll), np.asarray(u_batch),
+                               atol=1e-5)
+    assert float(jnp.linalg.norm(u_unroll[0])) > 0.05   # the dodge happened
 
 
 def test_spawn_clearing_never_stacks_agents():
-    """Seed sweep for the spawn-clearing repair (review regression: the
-    radial projection collapsed same-disk agents to sub-dmin pairs on ~1
-    in 6 seeds; the monotone map + pairwise repair must clear every seed)."""
-    for seed in range(12):
-        cfg = swarm.Config(n=256, steps=1, n_obstacles=12, seed=seed)
-        x0 = np.asarray(swarm.initial_state(cfg).x)
-        d = np.linalg.norm(x0[:, None] - x0[None], axis=-1)
-        np.fill_diagonal(d, np.inf)
-        opos = swarm.obstacle_positions_at(cfg, 0.0)
-        do = np.linalg.norm(x0[:, None] - opos[None], axis=-1)
-        assert d.min() > 0.24, (seed, d.min())
-        assert do.min() > 0.24, (seed, do.min())
+    """Seed/config sweep for the spawn-clearing repair (review regression:
+    the radial projection collapsed same-disk agents to sub-dmin pairs on
+    ~1 in 6 seeds; the interleaved monotone-push + pairwise-repair rounds
+    must clear every seed — measured exact 0.25 over 60 seeds x 3
+    configs)."""
+    for n, m, seeds in ((256, 12, range(12)), (96, 8, range(12, 20))):
+        for seed in seeds:
+            cfg = swarm.Config(n=n, steps=1, n_obstacles=m, seed=seed)
+            x0 = np.asarray(swarm.initial_state(cfg).x)
+            d = np.linalg.norm(x0[:, None] - x0[None], axis=-1)
+            np.fill_diagonal(d, np.inf)
+            opos = swarm.obstacle_positions_at(cfg, 0.0)
+            do = np.linalg.norm(x0[:, None] - opos[None], axis=-1)
+            assert d.min() > 0.249, (n, m, seed, d.min())
+            assert do.min() > 0.249, (n, m, seed, do.min())
+
+
+def test_training_under_obstacle_pressure():
+    """The differentiable path accepts obstacle configs end-to-end: tiered
+    priority rows flow through the unrolled relax loop inside the sharded
+    loss, gradients stay finite, and the loss descends."""
+    import jax
+    from cbf_tpu.learn import TrainConfig, init_params, make_train_step
+    from cbf_tpu.parallel import make_mesh
+    from cbf_tpu.parallel.ensemble import ensemble_initial_states
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    mesh = make_mesh(n_dp=4, n_sp=1)
+    cfg = swarm.Config(n=16, steps=40, k_neighbors=4, pack_spacing=0.02,
+                       spawn_half_width_override=0.6, n_obstacles=3)
+    tc = TrainConfig(steps=40, learning_rate=3e-2)
+    train_step, opt = make_train_step(cfg, mesh, tc)
+    x0, v0 = ensemble_initial_states(cfg, list(range(4)))
+    params = init_params()
+    st = opt.init(params)
+    losses = []
+    for _ in range(3):
+        params, st, loss = train_step(params, st, x0, v0)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
