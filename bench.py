@@ -157,15 +157,16 @@ def _child_single(n: int, steps: int) -> dict:
     from cbf_tpu.scenarios import swarm
 
     gating = os.environ.get("BENCH_GATING", "auto")
+    n_obstacles = _env_int("BENCH_N_OBSTACLES", 0)
     cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
-                       gating=gating)
+                       gating=gating, n_obstacles=n_obstacles)
     state0, step = swarm.make(cfg)
     chunk = min(_env_int("BENCH_CHUNK", 1000), steps)
     unroll = _env_int("BENCH_UNROLL", 1)
 
     print(f"bench: swarm N={n}, steps={steps} (chunk={chunk}, "
-          f"unroll={unroll}, gating={gating}, checkpointed), "
-          f"devices={jax.devices()}", file=sys.stderr)
+          f"unroll={unroll}, gating={gating}, obstacles={n_obstacles}, "
+          f"checkpointed), devices={jax.devices()}", file=sys.stderr)
 
     # Warmup: compile every executable the measured run will use — the
     # full-size chunk and, when steps % chunk != 0, the trailing partial
@@ -202,7 +203,7 @@ def _child_single(n: int, steps: int) -> dict:
     if err:
         return {"error": err, "retryable": False}
 
-    return {
+    result = {
         "metric": "agent-QP-steps/sec/chip (swarm N=%d)" % n,
         "value": round(rate, 1),
         "unit": "agent_qp_steps_per_sec_per_chip",
@@ -212,6 +213,14 @@ def _child_single(n: int, steps: int) -> dict:
         "wall_s": round(wall, 3),
         "checkpointed": True,
     }
+    if n_obstacles:
+        # Mark obstacle workloads in the metric AND the record: their
+        # vs_baseline is against the obstacle-free target rate and must
+        # not be read as a like-for-like regression.
+        result["metric"] = ("agent-QP-steps/sec/chip (swarm N=%d, M=%d "
+                            "obstacles)" % (n, n_obstacles))
+        result["n_obstacles"] = n_obstacles
+    return result
 
 
 def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
@@ -229,7 +238,8 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
     chips = len(devices)
     E = chips * per_device
     mesh = make_mesh(n_dp=chips, n_sp=1, devices=devices)
-    cfg = swarm.Config(n=n, steps=steps, record_trajectory=False)
+    cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
+                       n_obstacles=_env_int("BENCH_N_OBSTACLES", 0))
     seeds = list(range(E))
 
     print(f"bench: ensemble E={E} x swarm N={n}, steps={steps}, "
