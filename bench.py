@@ -25,7 +25,11 @@ Prints exactly ONE JSON line to stdout.
 Modes / env knobs:
   BENCH_N (4096), BENCH_STEPS (10000) — problem size (defaults = the
     BASELINE.md ladder rung as written). BENCH_CHUNK (1000) — compiled-chunk
-    length of the checkpointed single-swarm path.
+    length of the checkpointed single-swarm path. BENCH_UNROLL (1) — scan
+    unrolling. BENCH_GATING (auto) — neighbor-search backend.
+  BENCH_N_OBSTACLES (0) — orbit that many moving obstacles through the
+    swarm (workload is labeled in the metric + record; its vs_baseline is
+    still against the obstacle-free target rate).
   BENCH_ENSEMBLE=1 (or --ensemble) — dp-sharded ensemble of independent
     swarms over all available devices (the multi-chip measurement path for
     the v4-8 ladder rung); adds "chips" + "scaling_efficiency" fields.
@@ -238,8 +242,9 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
     chips = len(devices)
     E = chips * per_device
     mesh = make_mesh(n_dp=chips, n_sp=1, devices=devices)
+    n_obstacles = _env_int("BENCH_N_OBSTACLES", 0)
     cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
-                       n_obstacles=_env_int("BENCH_N_OBSTACLES", 0))
+                       n_obstacles=n_obstacles)
     seeds = list(range(E))
 
     print(f"bench: ensemble E={E} x swarm N={n}, steps={steps}, "
@@ -292,7 +297,7 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
           f"infeasible={infeasible}, knn_dropped={dropped}, "
           f"efficiency={efficiency:.3f}", file=sys.stderr)
 
-    return {
+    result = {
         "metric": "agent-QP-steps/sec/chip (ensemble E=%d x N=%d)" % (E, n),
         "value": round(rate_per_chip, 1),
         "unit": "agent_qp_steps_per_sec_per_chip",
@@ -300,6 +305,13 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
         "chips": chips,
         "scaling_efficiency": round(efficiency, 3),
     }
+    if n_obstacles:
+        # Same labeling contract as _child_single: obstacle workloads must
+        # be distinguishable in the metric AND the record.
+        result["metric"] = ("agent-QP-steps/sec/chip (ensemble E=%d x N=%d,"
+                            " M=%d obstacles)" % (E, n, n_obstacles))
+        result["n_obstacles"] = n_obstacles
+    return result
 
 
 def _is_permanent_error(e: BaseException) -> bool:

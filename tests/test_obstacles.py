@@ -251,3 +251,22 @@ def test_training_under_obstacle_pressure():
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_checkpoint_resume_in_phase_with_obstacles(tmp_path):
+    """Chunked resume under a moving-obstacle config: the closed-form ring
+    is a function of the global step (the scan consumes t0 + arange), so a
+    resumed run must reproduce the uninterrupted rollout exactly."""
+    from cbf_tpu.rollout.engine import rollout, rollout_chunked
+
+    cfg = swarm.Config(n=32, steps=30, n_obstacles=4, seed=2)
+    state0, step = swarm.make(cfg)
+    ref_final, _ = rollout(step, state0, 30)
+
+    d = str(tmp_path / "obs_ckpt")
+    rollout_chunked(step, state0, 16, chunk=8, checkpoint_dir=d)
+    final, outs, start = rollout_chunked(step, state0, 30, chunk=8,
+                                         checkpoint_dir=d)
+    assert start == 16
+    np.testing.assert_array_equal(np.asarray(final.x),
+                                  np.asarray(ref_final.x))
