@@ -36,7 +36,8 @@ class CBFParams(NamedTuple):
 def safe_control(robot_state, obs_states, obs_mask, f, g, u0,
                  params: CBFParams = CBFParams(), *, max_relax: int = 64,
                  unroll_relax: int = 0, reference_layout: bool = True,
-                 priority_mask=None, priority_relax_weight: float = 0.01):
+                 priority_mask=None, priority_relax_weight: float = 0.01,
+                 relax_cap=None):
     """Filter one agent's nominal control. Returns (u, QPInfo).
 
     Args:
@@ -55,8 +56,24 @@ def safe_control(robot_state, obs_states, obs_mask, f, g, u0,
         priority_mask=priority_mask,
         priority_relax_weight=priority_relax_weight,
     )
+    cap_arr = None
+    if relax_cap is not None:
+        if priority_mask is None:
+            raise ValueError(
+                "relax_cap requires priority_mask: capping every relaxable "
+                "row leaves no mechanism to restore feasibility (the relax "
+                "loop would spin to max_relax and return a least-violating "
+                "control)")
+        K = obs_states.shape[0]
+        inf = jnp.asarray(jnp.inf, b.dtype)
+        # Priority rows stay uncapped: their eps-per-round growth is what
+        # eventually restores feasibility.
+        cbf_caps = jnp.where(priority_mask, inf,
+                             jnp.full((K,), relax_cap, b.dtype))
+        cap_arr = jnp.concatenate([cbf_caps, jnp.full((8,), jnp.inf, b.dtype)])
     du, info = solve_qp_2d(
-        A, b, relax_mask, max_relax=max_relax, unroll_relax=unroll_relax
+        A, b, relax_mask, max_relax=max_relax, unroll_relax=unroll_relax,
+        relax_cap=cap_arr,
     )
     u = du + u0
     u = jnp.clip(u, -params.max_speed, params.max_speed)
@@ -71,7 +88,8 @@ def safe_control(robot_state, obs_states, obs_mask, f, g, u0,
 def safe_controls(robot_states, obs_states, obs_mask, f, g, u0,
                   params: CBFParams = CBFParams(), *, max_relax: int = 64,
                   unroll_relax: int = 0, reference_layout: bool = True,
-                  priority_mask=None, priority_relax_weight: float = 0.01):
+                  priority_mask=None, priority_relax_weight: float = 0.01,
+                  relax_cap=None):
     """All-agent batched filter.
 
     Default path (``unroll_relax=0``): direction-deduped batched assembly
@@ -106,6 +124,7 @@ def safe_controls(robot_states, obs_states, obs_mask, f, g, u0,
             safe_control, max_relax=max_relax, unroll_relax=unroll_relax,
             reference_layout=reference_layout,
             priority_relax_weight=priority_relax_weight,
+            relax_cap=relax_cap,
         )
         if priority_mask is None:
             return jax.vmap(fn, in_axes=(0, 0, 0, None, None, 0, None))(
@@ -126,6 +145,21 @@ def safe_controls(robot_states, obs_states, obs_mask, f, g, u0,
         priority_mask=priority_mask,
         priority_relax_weight=priority_relax_weight,
     )
-    du, info = solve_qp_2d_batch(A, b, relax_mask, max_relax=max_relax)
+    cap_arr = None
+    if relax_cap is not None:
+        if priority_mask is None:
+            raise ValueError(
+                "relax_cap requires priority_mask: capping every relaxable "
+                "row leaves no mechanism to restore feasibility (the relax "
+                "loop would spin to max_relax and return a least-violating "
+                "control)")
+        # Dedup layout: 4 normal-CBF rows + 4 priority rows + 4 box rows.
+        # Only the normal-CBF rows are capped; priority rows' eps growth is
+        # what eventually restores feasibility, and box rows never relax.
+        R = b.shape[1]
+        row_caps = jnp.full((R,), jnp.inf, b.dtype).at[:4].set(relax_cap)
+        cap_arr = jnp.broadcast_to(row_caps[None], b.shape)
+    du, info = solve_qp_2d_batch(A, b, relax_mask, max_relax=max_relax,
+                                 relax_cap=cap_arr)
     u = jnp.clip(du + u0, -params.max_speed, params.max_speed)
     return u, info

@@ -120,7 +120,8 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
 
     u_safe, info = safe_controls(states4, obs_slab, mask, f, g, u0, cbf,
                                  unroll_relax=unroll_relax,
-                                 priority_mask=priority)
+                                 priority_mask=priority,
+                                 relax_cap=cfg.relax_cap if M else None)
     engaged = jnp.any(mask, axis=1)
     u = jnp.where(engaged[:, None], u_safe, u0)
 

@@ -80,7 +80,7 @@ def project_polyhedron_2d(A, b, feas_tol=None):
 
 @functools.partial(jax.jit, static_argnames=("max_relax", "unroll_relax", "feas_tol"))
 def solve_qp_2d(A, b, relax_mask=None, *, max_relax: int = 64,
-                unroll_relax: int = 0, feas_tol=None):
+                unroll_relax: int = 0, feas_tol=None, relax_cap=None):
     """``min ||x||^2 s.t. A x <= b`` with reference-equivalent relaxation.
 
     Args:
@@ -93,6 +93,11 @@ def solve_qp_2d(A, b, relax_mask=None, *, max_relax: int = 64,
       unroll_relax: if > 0, use a fixed unrolled number of relax rounds with
         ``where``-selects instead of ``lax.while_loop`` — fully reverse-mode
         differentiable (for learned-parameter pipelines).
+      relax_cap: optional (M,) per-row ceiling on the TOTAL slack a row can
+        ever receive (inf = unbounded, the reference policy). A capped row
+        stops yielding at its ceiling while uncapped rows keep relaxing —
+        the provable-degradation half of tiered relaxation: a safety row
+        capped at c guarantees its constraint never loosens beyond c.
 
     Returns (x, QPInfo).
     """
@@ -102,7 +107,10 @@ def solve_qp_2d(A, b, relax_mask=None, *, max_relax: int = 64,
     relax_mask = relax_mask.astype(dtype)
 
     def attempt(t):
-        return project_polyhedron_2d(A, b + t * relax_mask, feas_tol=feas_tol)
+        slack = t * relax_mask
+        if relax_cap is not None:
+            slack = jnp.minimum(slack, relax_cap)
+        return project_polyhedron_2d(A, b + slack, feas_tol=feas_tol)
 
     if unroll_relax > 0:
         x, found, viol = attempt(jnp.asarray(0.0, dtype))
@@ -203,14 +211,21 @@ def _project_batch_lanes(A, b, tol, I, J):
 
 @functools.partial(jax.jit, static_argnames=("max_relax", "feas_tol"))
 def solve_qp_2d_batch(A, b, relax_mask=None, *, max_relax: int = 64,
-                      feas_tol=None):
+                      feas_tol=None, relax_cap=None):
     """Batched ``min ||x||^2 s.t. A x <= b`` over N agents, lane-major.
 
-    Args: A (N, M, 2), b (N, M), relax_mask (N, M). Returns
+    Args: A (N, M, 2), b (N, M), relax_mask (N, M), relax_cap optional
+    (N, M) per-row TOTAL-slack ceilings (see :func:`solve_qp_2d`). Returns
     (x (N, 2), QPInfo with (N,) leaves). Same semantics as vmapping
     :func:`solve_qp_2d` (including the +1 relax policy), but laid out for
     TPU lanes and with the relax loop guarded by a *scalar* condition so
     the all-feasible common case costs one enumeration pass.
+
+    Caller contract for caps: leave at least one relaxable row per agent
+    uncapped (inf) — if every relaxable row saturates while infeasible,
+    the loop runs to max_relax recomputing identical projections before
+    returning the least-violating control (the filter layer rejects that
+    configuration up front).
     """
     dtype = jnp.result_type(A, b)
     tol = _feas_tol(dtype) if feas_tol is None else feas_tol
@@ -220,6 +235,7 @@ def solve_qp_2d_batch(A, b, relax_mask=None, *, max_relax: int = 64,
     At = jnp.transpose(A, (1, 2, 0))                      # (M, 2, N)
     bt = b.T                                              # (M, N)
     rt = relax_mask.T.astype(dtype)                       # (M, N)
+    ct = None if relax_cap is None else relax_cap.T.astype(dtype)
     I, J = np.triu_indices(M, k=1)
 
     x0, found0, viol0 = _project_batch_lanes(At, bt, tol, I, J)
@@ -232,7 +248,10 @@ def solve_qp_2d_batch(A, b, relax_mask=None, *, max_relax: int = 64,
     def body(c):
         t, x, found, viol = c
         t_next = jnp.max(t) + 1.0
-        x2, f2, v2 = _project_batch_lanes(At, bt + t_next * rt, tol, I, J)
+        slack = t_next * rt
+        if ct is not None:
+            slack = jnp.minimum(slack, ct)
+        x2, f2, v2 = _project_batch_lanes(At, bt + slack, tol, I, J)
         upd = ~found
         x = jnp.where(upd[None], x2, x)
         viol = jnp.where(upd, v2, viol)
