@@ -170,20 +170,31 @@ def spawn_positions(cfg: Config, seed) -> jnp.ndarray:
     return jnp.asarray(grid, cfg.dtype) + jitter.astype(cfg.dtype)
 
 
+def _orbit_ring(cfg: Config, t, xp):
+    """The closed-form obstacle orbit law, single-sourced over an array
+    namespace: ``xp = jax.numpy`` on device (traced t inside the scan) or
+    ``xp = numpy`` on host (render/spawn/test paths must work without a
+    live JAX backend — e.g. when the TPU tunnel is wedged).
+
+    Returns (pos (M, 2), vel (M, 2))."""
+    M = cfg.n_obstacles
+    phases = xp.arange(M) * (2 * np.pi / M)
+    r = cfg.obstacle_orbit_frac * cfg.pack_radius
+    ang = phases + cfg.obstacle_omega * cfg.dt * t
+    pos = r * xp.stack([xp.cos(ang), xp.sin(ang)], axis=1)
+    vel = (cfg.obstacle_omega * r
+           * xp.stack([-xp.sin(ang), xp.cos(ang)], axis=1))
+    return pos, vel
+
+
 def obstacle_states_at(cfg: Config, t, dtype) -> jnp.ndarray:
     """(M, 4) obstacle rows at traced step t — closed-form orbit (positions
     carry no state through the scan; cf. the reference's Euler-stepped
     ring, cross_and_rescue.py:173). Shared by the single-device scenario
     and the sharded ensemble path (obstacles are global: the same ring for
     every member and shard)."""
-    M = cfg.n_obstacles
-    phases = jnp.arange(M, dtype=dtype) * (2 * np.pi / M)
-    orbit_r = jnp.asarray(cfg.obstacle_orbit_frac * cfg.pack_radius, dtype)
-    ang = phases + cfg.obstacle_omega * cfg.dt * jnp.asarray(t).astype(dtype)
-    pos = orbit_r * jnp.stack([jnp.cos(ang), jnp.sin(ang)], axis=1)
-    vel = (cfg.obstacle_omega * orbit_r
-           * jnp.stack([-jnp.sin(ang), jnp.cos(ang)], axis=1))
-    return jnp.concatenate([pos, vel], axis=1)
+    pos, vel = _orbit_ring(cfg, jnp.asarray(t).astype(dtype), jnp)
+    return jnp.concatenate([pos, vel], axis=1).astype(dtype)
 
 
 def lane_dodge(x, obstacles4, safety_distance):
@@ -241,28 +252,25 @@ def barrier_dynamics(cfg: Config, dtype):
             f"barrier must be auto|continuous|discrete, got {cfg.barrier!r}")
     discrete = (cfg.n_obstacles > 0 if cfg.barrier == "auto"
                 else cfg.barrier == "discrete")
-    if discrete:
-        # Exact discrete-time CBF rows (see Config.barrier): the drift term
-        # carries dt * (relative velocity) and the control term dt * u, so
-        # the constraint IS h_{k+1} >= (1-gamma) h_k for the integration
-        # x_{k+1} = x_k + dt*u.
-        f = cfg.dt * jnp.array([[0, 0, 1, 0], [0, 0, 0, 1],
-                                [0, 0, 0, 0], [0, 0, 0, 0]], dtype)
-        g = cfg.dt * jnp.array([[1, 0], [0, 1], [0, 0], [0, 0]], dtype)
-    else:
-        f = cfg.dyn_scale * jnp.zeros((4, 4), dtype)
-        g = cfg.dyn_scale * jnp.array([[1, 0], [0, 1], [0, 0], [0, 0]],
-                                      dtype)
+    # Discrete rows are exact discrete-time CBF conditions (see
+    # Config.barrier): the drift term carries dt * (relative velocity) and
+    # the control term dt * u, so the row IS h_{k+1} >= (1-gamma) h_k for
+    # the integration x_{k+1} = x_k + dt*u.
+    scale = cfg.dt if discrete else cfg.dyn_scale
+    g = scale * jnp.array([[1, 0], [0, 1], [0, 0], [0, 0]], dtype)
+    f = (cfg.dt * jnp.array([[0, 0, 1, 0], [0, 0, 0, 1],
+                             [0, 0, 0, 0], [0, 0, 0, 0]], dtype)
+         if discrete else cfg.dyn_scale * jnp.zeros((4, 4), dtype))
     return f, g, discrete
 
 
 def obstacle_positions_at(cfg: Config, t: float) -> np.ndarray:
-    """Closed-form (M, 2) obstacle ring positions at step t (host-side
-    mirror of the device computation in make())."""
-    phases = np.arange(cfg.n_obstacles) * (2 * np.pi / cfg.n_obstacles)
-    ang = phases + cfg.obstacle_omega * cfg.dt * t
-    r = cfg.obstacle_orbit_frac * cfg.pack_radius
-    return r * np.stack([np.cos(ang), np.sin(ang)], axis=1)
+    """Host-side (M, 2) obstacle ring positions at step t: pure numpy (no
+    JAX backend touched — render/test paths stay usable on a machine whose
+    accelerator is wedged), same law as :func:`obstacle_states_at` via
+    :func:`_orbit_ring`."""
+    pos, _ = _orbit_ring(cfg, float(t), np)
+    return pos
 
 
 def clear_obstacle_spawn(cfg: Config, x0):
@@ -365,9 +373,6 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
 
     state0 = initial_state(cfg)
 
-    def obstacle_states(t):
-        return obstacle_states_at(cfg, t, dt_)
-
     def step(state: State, t):
         x = state.x                                            # (N, 2)
         to_c = jnp.mean(x, axis=0)[None] - x                   # (N, 2)
@@ -376,7 +381,7 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
         pull = jnp.maximum(d_c - cfg.pack_radius, 0.0)
         u0 = cfg.consensus_gain * pull * to_c / jnp.maximum(d_c, 1e-9)
         if M:
-            obstacles4 = obstacle_states(t)
+            obstacles4 = obstacle_states_at(cfg, t, dt_)
             dodge, d_o = lane_dodge(x, obstacles4, cfg.safety_distance)
             u0 = u0 + 2.0 * dodge
         # Pre-filter actuator saturation (see Config.speed_limit).
