@@ -35,7 +35,9 @@ Modes / env knobs:
     the v4-8 ladder rung); adds "chips" + "scaling_efficiency" fields.
   BENCH_ENSEMBLE_E — ensembles per device (default 1).
   BENCH_ATTEMPTS (3), BENCH_ATTEMPT_TIMEOUT (420 s), BENCH_BACKOFF (20 s,
-    doubling), BENCH_TOTAL_TIMEOUT (1500 s), BENCH_HEALTH_TIMEOUT (120 s).
+    doubling), BENCH_TOTAL_TIMEOUT (1500 s), BENCH_HEALTH_TIMEOUT (120 s),
+    BENCH_TEARDOWN_TIMEOUT (20 s — bound on the child's clean backend
+    release before exit).
   BENCH_FORCE_PLATFORM=cpu — force a backend in the child (the JAX_PLATFORMS
     env var is not honored in this environment; the child applies
     jax.config.update instead). For testing the bench off-TPU.
@@ -70,35 +72,45 @@ def _env_int(name: str, default: int) -> int:
 
 # ----------------------------------------------------------------- child --
 
-def _device_health_check(timeout_s: float) -> tuple[bool, str]:
-    """Run a trivial op under a watchdog thread. The tunneled-TPU environment
-    can wedge (a killed client leaves the remote device stuck); letting the
-    plugin initialize then blocks *indefinitely* — so the probe runs in a
-    daemon thread and the child reports (and is killed by the parent) instead
-    of hanging."""
+def _run_with_watchdog(fn, timeout_s: float) -> tuple[bool, BaseException | None]:
+    """Run ``fn()`` in a daemon thread with a bounded wait. The tunneled-TPU
+    runtime can block *indefinitely* when wedged, so anything that touches
+    the backend runs under this: the caller learns (completed, exception)
+    and a stuck runtime can only cost ``timeout_s``, never a hang (the
+    abandoned daemon thread dies with the process)."""
     import threading
 
     done = threading.Event()
     failure: list[BaseException] = []
 
-    def probe():
+    def run():
         try:
-            import jax
-            import jax.numpy as jnp
-
-            o = (jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum()
-            jax.block_until_ready(o)
-        except BaseException as e:  # init errors are fast — report, not hang
+            fn()
+        except BaseException as e:
             failure.append(e)
         finally:
             done.set()
 
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
+    threading.Thread(target=run, daemon=True).start()
     if not done.wait(timeout_s):
+        return False, None
+    return True, failure[0] if failure else None
+
+
+def _device_health_check(timeout_s: float) -> tuple[bool, str]:
+    """Run a trivial op under a watchdog thread (a wedged tunnel would
+    otherwise hang the child at plugin init; the parent kills it instead)."""
+    def probe():
+        import jax
+        import jax.numpy as jnp
+
+        jax.block_until_ready((jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum())
+
+    completed, exc = _run_with_watchdog(probe, timeout_s)
+    if not completed:
         return False, f"device unresponsive after {timeout_s:.0f}s (tunnel/device wedged)"
-    if failure:
-        return False, f"device init failed: {failure[0]!r}"
+    if exc is not None:
+        return False, f"device init failed: {exc!r}"
     return True, ""
 
 
@@ -112,7 +124,36 @@ def _check_safety(min_dist: float, infeasible: int) -> str | None:
     return None
 
 
-HEALTH_TIMEOUT_DEFAULT = 120.0   # one default for every probe path
+HEALTH_TIMEOUT_DEFAULT = 120.0     # one default for every probe path
+TEARDOWN_TIMEOUT_DEFAULT = 20.0    # bound on the clean backend release
+
+
+def _graceful_backend_teardown(
+        timeout_s: float = TEARDOWN_TIMEOUT_DEFAULT) -> str | None:
+    """Best-effort clean PJRT client shutdown before ``os._exit``.
+
+    The tunneled-TPU backend wedges when a client dies abruptly with the
+    device attached (observed twice: a killed probe process, and a bench
+    child exiting via bare ``os._exit`` right after a successful run — the
+    next probe then times out for the rest of the session). Dropping the
+    backend clients via the public ``jax.extend.backend.clear_backends``
+    lets the channel close cleanly. Runs under the watchdog so a wedged
+    runtime can only cost ``timeout_s``, never a hang — the child still
+    exits via ``os._exit`` either way. Returns None on a clean release,
+    else a message distinguishing a stuck runtime from a raise."""
+    def teardown():
+        import jax
+        from jax.extend.backend import clear_backends
+
+        jax.clear_caches()
+        clear_backends()
+
+    completed, exc = _run_with_watchdog(teardown, timeout_s)
+    if not completed:
+        return f"timed out after {timeout_s:.0f}s (runtime stuck)"
+    if exc is not None:
+        return f"raised {exc!r}"
+    return None
 
 
 def probe_device_subprocess(
@@ -128,22 +169,47 @@ def probe_device_subprocess(
     """
     # Honor JAX_PLATFORMS/BENCH_FORCE_PLATFORM via config.update — the env
     # var alone is not honored in this environment (see child_main).
-    code = ("import os, jax, jax.numpy as jnp\n"
+    # Health is judged by the PROBE_OK sentinel, printed right after the
+    # matmul: the clean-release tail is best-effort, and a raise (old JAX
+    # without jax.extend.backend.clear_backends, a client whose close
+    # errors) or even a hang there must not flip a healthy verdict. The
+    # tail runs under its own in-child watchdog + os._exit so a hung
+    # release costs seconds, not the full probe timeout — if the release
+    # hangs the tunnel is already sick and there is nothing to preserve.
+    # One knob (BENCH_TEARDOWN_TIMEOUT) bounds the release here and in the
+    # bench child alike.
+    release_s = _env_float("BENCH_TEARDOWN_TIMEOUT", TEARDOWN_TIMEOUT_DEFAULT)
+    code = ("import os, threading, jax, jax.numpy as jnp\n"
             "p = os.environ.get('BENCH_FORCE_PLATFORM') "
             "or os.environ.get('JAX_PLATFORMS')\n"
             "if p and p != 'axon':\n"
             "    jax.config.update('jax_platforms', p)\n"
-            "jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))")
+            "jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))\n"
+            "print('PROBE_OK', flush=True)\n"
+            "def _release():\n"
+            "    try:\n"
+            "        from jax.extend.backend import clear_backends\n"
+            "        jax.clear_caches(); clear_backends()\n"
+            "    except BaseException:\n"
+            "        pass\n"
+            "t = threading.Thread(target=_release, daemon=True)\n"
+            f"t.start(); t.join({release_s})\n"
+            "os._exit(0)")
     try:
         proc = subprocess.run([sys.executable, "-c", code],
                               timeout=timeout_s, capture_output=True,
                               text=True)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout or ""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        if "PROBE_OK" in out:
+            return True, ""   # device healthy; only the release tail hung
         return False, (f"device unresponsive after {timeout_s:.0f}s "
                        "(tunnel/device wedged)")
-    if proc.returncode != 0:
-        return False, f"device init failed: {proc.stderr.strip()[-400:]}"
-    return True, ""
+    if "PROBE_OK" in proc.stdout:
+        return True, ""
+    return False, f"device init failed: {proc.stderr.strip()[-400:]}"
 
 
 def _child_single(n: int, steps: int) -> dict:
@@ -358,6 +424,15 @@ def child_main(result_path: str, ensemble: bool) -> None:
 
     with open(result_path, "w") as fh:
         json.dump(result, fh)
+    # The run is complete and the result durably written — now disconnect
+    # the device cleanly so this exit can't wedge the tunnel for the next
+    # attempt/mode (see _graceful_backend_teardown).
+    fail = _graceful_backend_teardown(_env_float("BENCH_TEARDOWN_TIMEOUT",
+                                                 TEARDOWN_TIMEOUT_DEFAULT))
+    if fail is None:
+        print("bench: backend released cleanly", file=sys.stderr)
+    else:
+        print(f"bench: backend teardown {fail}", file=sys.stderr)
     sys.stderr.flush()
     if "error" in result:
         os._exit(RC_PERMANENT if not result.get("retryable") else RC_RETRYABLE)
@@ -377,9 +452,9 @@ def _run_attempt(timeout_s: float, ensemble: bool) -> tuple[dict | None, bool]:
         proc = subprocess.run(argv, timeout=timeout_s)
         rc = proc.returncode
     except subprocess.TimeoutExpired:
+        rc = None
         print(f"bench: attempt timed out after {timeout_s:.0f}s, child killed",
               file=sys.stderr)
-        return None, True
     finally:
         result = None
         try:
@@ -393,11 +468,21 @@ def _run_attempt(timeout_s: float, ensemble: bool) -> tuple[dict | None, bool]:
             os.unlink(result_path)
         except OSError:
             pass
-    if rc == 0 and result and "error" not in result:
+    # The written verdict wins over how the child died: rc None (killed at
+    # the deadline) or nonzero rc with an error-free result both mean the
+    # measured run completed and the child only came apart in the post-
+    # result backend-release tail — a durably written verdict (success OR
+    # safety failure) beats throwing away a full multi-minute run.
+    if result and "error" not in result:
+        if rc != 0:
+            how = "deadline kill" if rc is None else f"rc={rc}"
+            print(f"bench: salvaged completed result written before child "
+                  f"death ({how})", file=sys.stderr)
         return result, False
-    if result and "error" in result:
+    if result:
         print(f"bench: attempt failed: {result['error']}", file=sys.stderr)
-        return result, bool(result.get("retryable", rc == RC_RETRYABLE))
+        return result, bool(result.get("retryable",
+                                       rc is None or rc == RC_RETRYABLE))
     print(f"bench: child died rc={rc} with no result — treating as retryable",
           file=sys.stderr)
     return None, True
