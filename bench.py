@@ -30,6 +30,9 @@ Modes / env knobs:
   BENCH_N_OBSTACLES (0) — orbit that many moving obstacles through the
     swarm (workload is labeled in the metric + record; its vs_baseline is
     still against the obstacle-free target rate).
+  BENCH_DYNAMICS (single) — dynamics family; "double" benches the
+    acceleration-controlled model (labeled in metric + record, gated at
+    its own documented floor).
   BENCH_ENSEMBLE=1 (or --ensemble) — dp-sharded ensemble of independent
     swarms over all available devices (the multi-chip measurement path for
     the v4-8 ladder rung); adds "chips" + "scaling_efficiency" fields.
@@ -57,6 +60,12 @@ TARGET_RATE_PER_CHIP = 4096 * 10_000 / 60.0 / 4.0   # BASELINE.json ladder
 # separation floor is 0.2/sqrt(2) ~ 0.1414; 0.13 leaves discretization slack
 # (same floor tests/test_scenarios.py asserts).
 SAFETY_FLOOR = 0.13
+# dynamics="double" (BENCH_DYNAMICS, opt-in): bounded-accel compression
+# squeezes erode the packed equilibrium below the ideal floor (documented:
+# ~0.104 at N=256, ~0.086 at N=1024 — tests/test_double_integrator.py);
+# the interpenetration failure mode sits at ~0.0003, so 0.05 separates a
+# healthy eroded equilibrium from a collapse unambiguously.
+SAFETY_FLOOR_DOUBLE = 0.05
 
 RC_RETRYABLE = 2      # wedge/timeout/init failure — try again
 RC_PERMANENT = 3      # safety violation or real error — don't retry
@@ -114,11 +123,12 @@ def _device_health_check(timeout_s: float) -> tuple[bool, str]:
     return True, ""
 
 
-def _check_safety(min_dist: float, infeasible: int) -> str | None:
+def _check_safety(min_dist: float, infeasible: int,
+                  floor: float = SAFETY_FLOOR) -> str | None:
     # `not (>)` rather than `<=`: NaN (numerically collapsed run) must fail.
-    if not (min_dist > SAFETY_FLOOR):
+    if not (min_dist > floor):
         return (f"safety violation: min pairwise distance {min_dist:.4f} not "
-                f"above floor {SAFETY_FLOOR} — rate not reportable")
+                f"above floor {floor} — rate not reportable")
     if infeasible != 0:
         return f"safety violation: {infeasible} infeasible agent-steps"
     return None
@@ -228,8 +238,10 @@ def _child_single(n: int, steps: int) -> dict:
 
     gating = os.environ.get("BENCH_GATING", "auto")
     n_obstacles = _env_int("BENCH_N_OBSTACLES", 0)
+    dynamics = os.environ.get("BENCH_DYNAMICS", "single")
     cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
-                       gating=gating, n_obstacles=n_obstacles)
+                       gating=gating, n_obstacles=n_obstacles,
+                       dynamics=dynamics)
     state0, step = swarm.make(cfg)
     chunk = min(_env_int("BENCH_CHUNK", 1000), steps)
     unroll = _env_int("BENCH_UNROLL", 1)
@@ -269,7 +281,9 @@ def _child_single(n: int, steps: int) -> dict:
           f"{compile_and_first:.1f}s), min_dist={min_dist:.4f}, "
           f"infeasible={infeasible}, knn_dropped={dropped}", file=sys.stderr)
 
-    err = _check_safety(min_dist, infeasible)
+    err = _check_safety(min_dist, infeasible,
+                        floor=(SAFETY_FLOOR_DOUBLE if dynamics == "double"
+                               else SAFETY_FLOOR))
     if err:
         return {"error": err, "retryable": False}
 
@@ -290,6 +304,10 @@ def _child_single(n: int, steps: int) -> dict:
         result["metric"] = ("agent-QP-steps/sec/chip (swarm N=%d, M=%d "
                             "obstacles)" % (n, n_obstacles))
         result["n_obstacles"] = n_obstacles
+    if dynamics != "single":
+        # Same labeling contract for the dynamics family.
+        result["metric"] += " [dynamics=%s]" % dynamics
+        result["dynamics"] = dynamics
     return result
 
 
@@ -309,8 +327,9 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
     E = chips * per_device
     mesh = make_mesh(n_dp=chips, n_sp=1, devices=devices)
     n_obstacles = _env_int("BENCH_N_OBSTACLES", 0)
+    dynamics = os.environ.get("BENCH_DYNAMICS", "single")
     cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
-                       n_obstacles=n_obstacles)
+                       n_obstacles=n_obstacles, dynamics=dynamics)
     seeds = list(range(E))
 
     print(f"bench: ensemble E={E} x swarm N={n}, steps={steps}, "
@@ -335,7 +354,9 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
 
     # Gate on safety before spending two more rollouts on the efficiency
     # baseline — a violating run is a permanent failure either way.
-    err = _check_safety(min_dist, infeasible)
+    err = _check_safety(min_dist, infeasible,
+                        floor=(SAFETY_FLOOR_DOUBLE if dynamics == "double"
+                               else SAFETY_FLOOR))
     if err:
         print(f"bench: wall={wall:.3f}s, min_dist={min_dist:.4f}, "
               f"infeasible={infeasible}", file=sys.stderr)
@@ -377,6 +398,9 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
         result["metric"] = ("agent-QP-steps/sec/chip (ensemble E=%d x N=%d,"
                             " M=%d obstacles)" % (E, n, n_obstacles))
         result["n_obstacles"] = n_obstacles
+    if dynamics != "single":
+        result["metric"] += " [dynamics=%s]" % dynamics
+        result["dynamics"] = dynamics
     return result
 
 

@@ -84,7 +84,8 @@ def barrier_rows(robot_state, obs_states, obs_mask, f, g, u0, *, dmin, k, gamma)
     return A, b
 
 
-def box_rows(robot_state, u0, max_speed, *, reference_layout: bool = True):
+def box_rows(robot_state, u0, max_speed, *, reference_layout: bool = True,
+             vel_box_rows: bool = True):
     """The 8 box rows G du <= S.
 
     ``reference_layout=True`` reproduces the reference's exact (quirky)
@@ -93,9 +94,21 @@ def box_rows(robot_state, u0, max_speed, *, reference_layout: bool = True):
     (|du + u0| <= ms componentwise; |du + u0 + v| <= ms componentwise) for
     users who want the intended constraint. Scenarios default to the
     reference layout for parity (it never binds at max_speed=15 anyway).
+
+    ``vel_box_rows=False`` drops the velocity coupling from rows 5-8 (they
+    become duplicates of rows 1-4, keeping the fixed shape), leaving the
+    pure actuator box |du + u0| <= ms. The reference's rows 5-8 fold the
+    state's velocity slots into the bound (cbf.py:67-70) — an artifact of
+    its commanded-velocity convention that is wrong for dynamics where the
+    velocity slots carry real state and the control is an acceleration
+    (scenarios.swarm dynamics="double": the box must bound |a|, not
+    |a + v|).
     """
     ms = max_speed
-    vx, vy = robot_state[2], robot_state[3]
+    if vel_box_rows:
+        vx, vy = robot_state[2], robot_state[3]
+    else:
+        vx = vy = jnp.zeros((), jnp.result_type(robot_state, u0))
     u0x, u0y = u0[0], u0[1]
     G = jnp.array(
         [
@@ -141,6 +154,7 @@ def box_rows(robot_state, u0, max_speed, *, reference_layout: bool = True):
 
 def assemble_qp_dedup(robot_states, obs_states, obs_mask, f, g, u0, *, dmin,
                       k, gamma, max_speed, reference_layout=True,
+                      vel_box_rows=True,
                       priority_mask=None, priority_relax_weight=0.01):
     """Batched QP assembly with direction deduplication: K+8 rows -> 8.
 
@@ -207,9 +221,13 @@ def assemble_qp_dedup(robot_states, obs_states, obs_mask, f, g, u0, *, dmin,
         b_cbf = jnp.concatenate([b_cbf, b_pri], axis=1)       # (N, 8)
 
     # Box rows deduped by direction (min of the two RHS per direction, in
-    # the reference's exact pairing — see box_rows).
+    # the reference's exact pairing — see box_rows). vel_box_rows=False
+    # zeroes the velocity coupling (pure actuator box — see box_rows).
     ms = max_speed
-    vx, vy = robot_states[:, 2], robot_states[:, 3]
+    if vel_box_rows:
+        vx, vy = robot_states[:, 2], robot_states[:, 3]
+    else:
+        vx = vy = jnp.zeros((N,), dtype)
     u0x, u0y = u0[:, 0], u0[:, 1]
     A_box = jnp.broadcast_to(
         jnp.array([[1, 0], [0, 1], [-1, 0], [0, -1]], dtype)[None],
@@ -243,7 +261,7 @@ def assemble_qp_dedup(robot_states, obs_states, obs_mask, f, g, u0, *, dmin,
 
 
 def assemble_qp(robot_state, obs_states, obs_mask, f, g, u0, *, dmin, k, gamma,
-                max_speed, reference_layout=True,
+                max_speed, reference_layout=True, vel_box_rows=True,
                 priority_mask=None, priority_relax_weight=0.01):
     """Full (K+8)-row QP data for one agent.
 
@@ -256,7 +274,9 @@ def assemble_qp(robot_state, obs_states, obs_mask, f, g, u0, *, dmin, k, gamma,
     A_cbf, b_cbf = barrier_rows(
         robot_state, obs_states, obs_mask, f, g, u0, dmin=dmin, k=k, gamma=gamma
     )
-    G, S = box_rows(robot_state, u0, max_speed, reference_layout=reference_layout)
+    G, S = box_rows(robot_state, u0, max_speed,
+                    reference_layout=reference_layout,
+                    vel_box_rows=vel_box_rows)
     A = jnp.concatenate([A_cbf, G], axis=0)
     b = jnp.concatenate([b_cbf, S], axis=0)
     weights = obs_mask.astype(b.dtype)

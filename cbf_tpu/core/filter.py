@@ -31,11 +31,14 @@ class CBFParams(NamedTuple):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("max_relax", "unroll_relax", "reference_layout")
+    jax.jit,
+    static_argnames=("max_relax", "unroll_relax", "reference_layout",
+                     "vel_box_rows")
 )
 def safe_control(robot_state, obs_states, obs_mask, f, g, u0,
                  params: CBFParams = CBFParams(), *, max_relax: int = 64,
                  unroll_relax: int = 0, reference_layout: bool = True,
+                 vel_box_rows: bool = True,
                  priority_mask=None, priority_relax_weight: float = 0.01,
                  relax_cap=None):
     """Filter one agent's nominal control. Returns (u, QPInfo).
@@ -53,6 +56,7 @@ def safe_control(robot_state, obs_states, obs_mask, f, g, u0,
         robot_state, obs_states, obs_mask, f, g, u0,
         dmin=params.dmin, k=params.k, gamma=params.gamma,
         max_speed=params.max_speed, reference_layout=reference_layout,
+        vel_box_rows=vel_box_rows,
         priority_mask=priority_mask,
         priority_relax_weight=priority_relax_weight,
     )
@@ -83,11 +87,12 @@ def safe_control(robot_state, obs_states, obs_mask, f, g, u0,
 @functools.partial(
     jax.jit,
     static_argnames=("max_relax", "unroll_relax", "reference_layout",
-                     "priority_relax_weight"),
+                     "vel_box_rows", "priority_relax_weight"),
 )
 def safe_controls(robot_states, obs_states, obs_mask, f, g, u0,
                   params: CBFParams = CBFParams(), *, max_relax: int = 64,
                   unroll_relax: int = 0, reference_layout: bool = True,
+                  vel_box_rows: bool = True,
                   priority_mask=None, priority_relax_weight: float = 0.01,
                   relax_cap=None):
     """All-agent batched filter.
@@ -122,7 +127,7 @@ def safe_controls(robot_states, obs_states, obs_mask, f, g, u0,
         # relaxation is exact per row here (no dedup classes needed).
         fn = functools.partial(
             safe_control, max_relax=max_relax, unroll_relax=unroll_relax,
-            reference_layout=reference_layout,
+            reference_layout=reference_layout, vel_box_rows=vel_box_rows,
             priority_relax_weight=priority_relax_weight,
             relax_cap=relax_cap,
         )
@@ -142,6 +147,7 @@ def safe_controls(robot_states, obs_states, obs_mask, f, g, u0,
         robot_states, obs_states, obs_mask, f, g, u0,
         dmin=params.dmin, k=params.k, gamma=params.gamma,
         max_speed=params.max_speed, reference_layout=reference_layout,
+        vel_box_rows=vel_box_rows,
         priority_mask=priority_mask,
         priority_relax_weight=priority_relax_weight,
     )

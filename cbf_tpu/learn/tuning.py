@@ -89,7 +89,12 @@ def make_loss_fn(cfg: swarm_scenario.Config, mesh, tc: TrainConfig = TrainConfig
     """
 
     def local_loss(params: TunableParams, x0l, v0l):
-        cbf = params_to_cbf(params, cfg.max_speed)
+        # Mode-aware actuator box: in double mode max_speed is the QP's
+        # bound on |a| (vel_box_rows=False) and must be the physical
+        # accel_limit — training against the 15.0 velocity bound would fit
+        # gamma/dmin/k to authority the deployed filter never has.
+        cbf = params_to_cbf(
+            params, swarm_scenario.default_cbf(cfg).max_speed)
 
         def one(x0i, v0i):
             def body(carry, t):

@@ -37,7 +37,7 @@ from cbf_tpu.core.filter import CBFParams, safe_controls
 from cbf_tpu.ops import pallas_knn
 from cbf_tpu.parallel.alltoall import exchange_knn
 from cbf_tpu.scenarios import swarm as swarm_scenario
-from cbf_tpu.utils.math import safe_norm
+from cbf_tpu.utils.math import l2_cap, safe_norm
 
 
 class EnsembleMetrics(NamedTuple):
@@ -68,7 +68,9 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
     ``t`` is the global step index — the moving-obstacle ring is closed-form
     in t (and global: the same ring on every member and shard).
 
-    Returns (x_new, u, metrics_or_None, nearest_d_local).
+    Returns (x_new, v_new, metrics_or_None, nearest_d_local)
+    — v_new is the applied velocity (== the filtered control u in
+    single mode; the integrated velocity state in double mode).
     """
     dt_ = x.dtype
     f, g, discrete = swarm_scenario.barrier_dynamics(cfg, dt_)
@@ -85,10 +87,13 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
         dodge, d_o = swarm_scenario.lane_dodge(x, obstacles4,
                                                cfg.safety_distance)
         u0 = u0 + 2.0 * dodge
-    speed = safe_norm(u0, keepdims=True)
-    u0 = u0 * jnp.minimum(1.0, cfg.speed_limit / jnp.maximum(speed, 1e-9))
+    u0 = l2_cap(u0, cfg.speed_limit)
 
-    vslots = jnp.zeros_like(v) if discrete else v
+    double = cfg.dynamics == "double"
+    if double:
+        u0 = swarm_scenario.nominal_accel(cfg, u0, v)
+
+    vslots = v if (double or not discrete) else jnp.zeros_like(v)
     states4 = jnp.concatenate([x, vslots], axis=1)
     if (lax.axis_size(axis_name) == 1 and unroll_relax == 0
             and pallas_knn.supported(cfg.n)):
@@ -118,14 +123,16 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
             obs_slab, mask, obstacles4, d_o, cfg.safety_distance)
         nearest1 = jnp.minimum(nearest1, jnp.min(d_o, axis=1))
 
-    u_safe, info = safe_controls(states4, obs_slab, mask, f, g, u0, cbf,
-                                 unroll_relax=unroll_relax,
-                                 priority_mask=priority,
-                                 relax_cap=cfg.relax_cap if M else None)
+    priority, cap = swarm_scenario.relax_tiers(cfg, mask, priority)
+    u_safe, info = safe_controls(
+        states4, obs_slab, mask, f, g, u0, cbf,
+        unroll_relax=unroll_relax,
+        priority_mask=priority, relax_cap=cap,
+        reference_layout=not double, vel_box_rows=not double)
     engaged = jnp.any(mask, axis=1)
     u = jnp.where(engaged[:, None], u_safe, u0)
 
-    x_new = x + cfg.dt * u
+    x_new, v_new = swarm_scenario.integrate(cfg, x, v, u)
     metrics = None
     if compute_metrics:
         metrics = (
@@ -134,7 +141,7 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
             lax.psum(jnp.sum(~info.feasible & engaged), axis_name),
             lax.psum(jnp.sum(dropped), axis_name),
         )
-    return x_new, u, metrics, nearest1
+    return x_new, v_new, metrics, nearest1
 
 
 def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
@@ -153,7 +160,7 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
     """
     steps = cfg.steps if steps is None else steps
     if cbf is None:
-        cbf = CBFParams(max_speed=cfg.max_speed, k=0.0)
+        cbf = swarm_scenario.default_cbf(cfg)
     E = len(seeds)
     n_dp, n_sp = mesh.shape["dp"], mesh.shape["sp"]
     if E % n_dp or cfg.n % n_sp:

@@ -42,6 +42,7 @@ from cbf_tpu.ops.pairwise import pairwise_distances
 from cbf_tpu.ops.pallas_knn import knn_gating_banded, knn_gating_pallas
 from cbf_tpu.rollout.engine import StepOutputs, rollout
 from cbf_tpu.rollout.gating import knn_gating
+from cbf_tpu.utils.math import l2_cap, safe_norm
 
 
 @dataclasses.dataclass(frozen=True)
@@ -111,6 +112,32 @@ class Config:
     # with no uncapped relaxable rows — feasibility could never be
     # restored).
     relax_cap: float | None = 0.05
+    # Dynamics family. "single": the reference's model — the filtered
+    # velocity IS the applied velocity (g routes control into the position
+    # rows, meet_at_center.py:26-27; SURVEY.md §2.4 — the reference brands
+    # itself "double integrator" but integrates first-order). "double": an
+    # honest second-order model this framework adds: control is an
+    # acceleration, velocity is carried state (semi-implicit Euler
+    # v' = v + dt*a, x' = x + dt*v'), and the CBF rows are the exact
+    # discrete-time condition for that update — h' - h = dt*s.dv
+    # + (dt^2 + k*dt)*s.a, i.e. f = dt*(pos<-vel), g = [[dt^2 I], [dt I]].
+    # The k*|dv| velocity term in the reference's own barrier
+    # (cbf.py:47-53) is what makes this work unchanged: it gives the row
+    # relative-degree-1 authority (k*dt per unit accel) over a
+    # relative-degree-2 output — the barrier is a discrete HOCBF as-is.
+    # The velocity slots carry ACTUAL velocities (known state in a
+    # second-order model; contrast the single/discrete case where u is the
+    # unknown), and the box rows drop the reference's velocity coupling
+    # (core.barrier vel_box_rows=False) so the QP box bounds |a| by
+    # accel_limit — the physical actuator limit.
+    dynamics: str = "single"
+    # Double mode only: actuator bound on acceleration (componentwise via
+    # the QP box + L2 via the nominal cap), and the time constant of the
+    # velocity-tracking PD that turns the nominal velocity field into a
+    # nominal acceleration: a0 = (u_cmd - v) / tau (tau >= dt; the cap
+    # makes small tau bang-bang rather than stiff).
+    accel_limit: float = 1.0
+    vel_tracking_tau: float = 0.2
     # Neighbor-search backend: "auto" picks a Pallas kernel on TPU
     # (fused <= 8192 agents, streaming beyond — ops.pallas_knn), else the
     # jnp path; "pallas"/"jnp" force (pallas runs in interpret mode off-TPU
@@ -246,10 +273,35 @@ def attach_obstacle_rows(obs_slab, mask, obstacles4, d_o, safety_distance):
 
 def barrier_dynamics(cfg: Config, dtype):
     """(f, g, discrete) for the configured barrier discretization (see
-    Config.barrier)."""
+    Config.barrier). Validates Config.dynamics — every execution path
+    (scenario step, sharded ensemble, trainer) comes through here, so a
+    typo'd mode raises instead of silently running single-integrator
+    physics."""
+    if cfg.dynamics not in ("single", "double"):
+        raise ValueError(
+            f"dynamics must be single|double, got {cfg.dynamics!r}")
     if cfg.barrier not in ("auto", "continuous", "discrete"):
         raise ValueError(
             f"barrier must be auto|continuous|discrete, got {cfg.barrier!r}")
+    if cfg.dynamics == "double":
+        # Exact discrete rows for the semi-implicit double integrator (see
+        # Config.dynamics). "continuous" has no meaning here — the rows ARE
+        # the discretized update.
+        if cfg.barrier == "continuous":
+            raise ValueError(
+                'dynamics="double" uses exact discrete-time rows; '
+                'barrier="continuous" is not meaningful for it')
+        if not (cfg.accel_limit > 0 and cfg.vel_tracking_tau > 0):
+            raise ValueError(
+                "double dynamics needs accel_limit > 0 and "
+                f"vel_tracking_tau > 0, got {cfg.accel_limit}, "
+                f"{cfg.vel_tracking_tau}")
+        dt = cfg.dt
+        f = dt * jnp.array([[0, 0, 1, 0], [0, 0, 0, 1],
+                            [0, 0, 0, 0], [0, 0, 0, 0]], dtype)
+        g = jnp.array([[dt * dt, 0], [0, dt * dt],
+                       [dt, 0], [0, dt]], dtype)
+        return f, g, True
     discrete = (cfg.n_obstacles > 0 if cfg.barrier == "auto"
                 else cfg.barrier == "discrete")
     # Discrete rows are exact discrete-time CBF conditions (see
@@ -336,17 +388,78 @@ def initial_state(cfg: Config) -> State:
     return State(x=x0, v=jnp.zeros_like(x0))
 
 
+def nominal_accel(cfg: Config, u_cmd, v):
+    """Double mode: velocity-tracking PD turns the nominal velocity field
+    into a nominal acceleration, L2-capped at the actuator limit. Shared by
+    the scenario step and the sharded ensemble path (like default_cbf — the
+    physics must not drift between them)."""
+    return l2_cap((u_cmd - v) / cfg.vel_tracking_tau, cfg.accel_limit)
+
+
+def relax_tiers(cfg: Config, mask, priority):
+    """(priority_mask, relax_cap) for the configured dynamics.
+
+    Double mode: eps-tiered relaxation for EVERY row. Acceleration control
+    has tiny per-step barrier authority ((k*dt + dt^2) per unit accel vs
+    dt*max_speed for velocity control), so compression-wave squeezes —
+    opposing front/back row demands on one agent — are genuinely
+    infeasible physics. The reference's uniform +1 relax (cbf.py:85-87)
+    then neuters 0.2-scale rows in one round and the crowd interpenetrates
+    (measured at N=256). Eps tiers instead make the squeezed agent brake
+    maximally and split a small violation across rows; h erodes slowly and
+    recovers when the wave passes. All rows share one eps tier (relax_cap's
+    agent-vs-obstacle tiering needs an uncapped tier to stay feasible, so
+    it is a single-mode refinement — not applied here).
+
+    Single mode: obstacle rows (when present) are the priority tier and
+    agent rows carry the per-row relax cap.
+    """
+    if cfg.dynamics == "double":
+        priority = (jnp.ones_like(mask) if priority is None
+                    else jnp.ones_like(priority))
+        return priority, None
+    return priority, (cfg.relax_cap if cfg.n_obstacles else None)
+
+
+def integrate(cfg: Config, x, v, u):
+    """(x_new, v_new) for the configured dynamics: semi-implicit Euler in
+    double mode (the update the barrier rows discretize exactly), the
+    reference's first-order update in single mode."""
+    if cfg.dynamics == "double":
+        v_new = v + cfg.dt * u
+        return x + cfg.dt * v_new, v_new
+    return x + cfg.dt * u, u
+
+
+def default_cbf(cfg: Config) -> CBFParams:
+    """The scenario's default filter parameters, shared with the sharded
+    ensemble path (parallel.ensemble) so the two cannot drift.
+
+    Single mode — k=0: position-only barrier h = |dx|+|dy| - dmin. At crowd
+    scale the reference's k=1 approach-velocity term is a positive feedback
+    loop — evasive outputs enter the next step's h, demanding ever-larger
+    evasion until QPs go infeasible. With k=0 the discrete-time closing
+    rate is bounded by gamma*h per step, so h contracts geometrically to 0
+    and never crosses it: no infeasibility, hard separation.
+
+    Double mode — k=1 (the reference's value): the velocity term is what
+    gives an acceleration control authority over the barrier (see
+    Config.dynamics) — k=0 would leave only the dt^2 position coupling.
+    The single-mode positive-feedback pathology does not apply: velocities
+    here are real damped state, not re-commanded outputs. max_speed doubles
+    as the QP's actuator box on |a| (vel_box_rows=False).
+    """
+    if cfg.dynamics == "double":
+        return CBFParams(max_speed=cfg.accel_limit, k=1.0)
+    return CBFParams(max_speed=cfg.max_speed, k=0.0)
+
+
 def make(cfg: Config = Config(), cbf: CBFParams | None = None):
-    if cbf is None:
-        # k=0: position-only barrier h = |dx|+|dy| - dmin. At crowd scale the
-        # reference's k=1 approach-velocity term is a positive feedback loop —
-        # evasive outputs enter the next step's h, demanding ever-larger
-        # evasion until QPs go infeasible. With k=0 the discrete-time closing
-        # rate is bounded by gamma*h per step, so h contracts geometrically
-        # to 0 and never crosses it: no infeasibility, hard separation.
-        cbf = CBFParams(max_speed=cfg.max_speed, k=0.0)
     dt_ = cfg.dtype
-    f, g, discrete = barrier_dynamics(cfg, dt_)
+    f, g, discrete = barrier_dynamics(cfg, dt_)   # validates cfg.dynamics
+    double = cfg.dynamics == "double"
+    if cbf is None:
+        cbf = default_cbf(cfg)
     K = cfg.k_neighbors
 
     if cfg.gating not in ("auto", "pallas", "jnp", "banded"):
@@ -385,14 +498,19 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
             dodge, d_o = lane_dodge(x, obstacles4, cfg.safety_distance)
             u0 = u0 + 2.0 * dodge
         # Pre-filter actuator saturation (see Config.speed_limit).
-        speed = jnp.linalg.norm(u0, axis=1, keepdims=True)
-        u0 = u0 * jnp.minimum(1.0, cfg.speed_limit / jnp.maximum(speed, 1e-9))
+        u0 = l2_cap(u0, cfg.speed_limit)
 
-        # Discrete barrier: agent velocity slots are zero by construction
-        # (u is the unknown the row solves for; a fellow agent's motion is
-        # covered by the pairwise (1-2*gamma) bound) — only obstacle rows
-        # carry real velocities into the drift term.
-        vslots = jnp.zeros_like(state.v) if discrete else state.v
+        if double:
+            u0 = nominal_accel(cfg, u0, state.v)
+
+        # Discrete barrier (single mode): agent velocity slots are zero by
+        # construction (u is the unknown the row solves for; a fellow
+        # agent's motion is covered by the pairwise (1-2*gamma) bound) —
+        # only obstacle rows carry real velocities into the drift term.
+        # Double mode: velocities are real carried state, known at step
+        # start — the drift term dt*s.dv needs them.
+        vslots = (state.v if (double or not discrete)
+                  else jnp.zeros_like(state.v))
         states4 = jnp.concatenate([x, vslots], axis=1)         # (N, 4)
 
         overflow_count = ()
@@ -428,14 +546,16 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
                 obs_slab, mask, obstacles4, d_o, cfg.safety_distance)
             min_dist = jnp.minimum(min_dist, jnp.min(d_o))
 
-        u_safe, info = safe_controls(states4, obs_slab, mask, f, g, u0, cbf,
-                                     priority_mask=priority,
-                                     relax_cap=cfg.relax_cap if M else None)
+        priority, cap = relax_tiers(cfg, mask, priority)
+        u_safe, info = safe_controls(
+            states4, obs_slab, mask, f, g, u0, cbf,
+            priority_mask=priority, relax_cap=cap,
+            reference_layout=not double,
+            vel_box_rows=not double)
         engaged = jnp.any(mask, axis=1)
         u = jnp.where(engaged[:, None], u_safe, u0)
 
-        x_new = x + cfg.dt * u
-        v_new = u
+        x_new, v_new = integrate(cfg, x, state.v, u)
 
         out = StepOutputs(
             min_pairwise_distance=min_dist,

@@ -22,6 +22,14 @@ def safe_norm(x, axis=-1, keepdims=False):
     return safe_sqrt(jnp.sum(x * x, axis=axis, keepdims=keepdims))
 
 
+def l2_cap(x, limit, axis=-1):
+    """Rescale ``x`` so its L2 norm along ``axis`` is at most ``limit``
+    (identity below the limit). The epsilon guard keeps the zero vector a
+    fixed point instead of 0/0."""
+    mag = safe_norm(x, axis=axis, keepdims=True)
+    return x * jnp.minimum(1.0, limit / jnp.maximum(mag, 1e-9))
+
+
 def match_vma(x, ref):
     """Give ``x`` the same varying-manual-axes type as ``ref``.
 

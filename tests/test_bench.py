@@ -221,6 +221,24 @@ def test_bench_end_to_end_single_mode_cpu():
     assert "knn_dropped=" in stderr       # truncation diagnostic surfaced
 
 
+def test_bench_end_to_end_double_dynamics_cpu():
+    out, stderr = _run_bench_e2e({"BENCH_DYNAMICS": "double",
+                                  "BENCH_STEPS": "60"})
+    assert "[dynamics=double]" in out["metric"]
+    assert out["dynamics"] == "double"
+
+
+def test_bench_end_to_end_ensemble_double_dynamics_cpu():
+    """BENCH_DYNAMICS must reach the ensemble child too — an unlabeled
+    single-dynamics number must never masquerade as a double-mode one."""
+    out, stderr = _run_bench_e2e({"BENCH_ENSEMBLE": "1",
+                                  "BENCH_DYNAMICS": "double",
+                                  "BENCH_STEPS": "30"})
+    assert "ensemble" in out["metric"]
+    assert "[dynamics=double]" in out["metric"]
+    assert out["dynamics"] == "double"
+
+
 def test_bench_end_to_end_ensemble_mode_cpu():
     # Under the suite's XLA_FLAGS the child sees 8 virtual CPU devices, so
     # this exercises the real dp-sharded path incl. the efficiency baseline.

@@ -1,0 +1,180 @@
+"""The double-integrator dynamics family (scenarios.swarm dynamics="double").
+
+The reference brands itself "double integrator" but routes control into the
+position rows (g = 0.1*[[I],[0]] — /root/reference/meet_at_center.py:26-27;
+SURVEY.md §2.4): first-order dynamics in a 4-D coat. This mode is the honest
+second-order model the framework adds: acceleration control, carried
+velocity state, exact discrete-time CBF rows for the semi-implicit update,
+and eps-tiered relaxation (the +1 policy neuters rows under bounded-accel
+compression squeezes — measured collapse at N=256 without it).
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from cbf_tpu.core.filter import CBFParams, safe_control, safe_controls
+from cbf_tpu.oracle import OracleCBF
+from cbf_tpu.scenarios import swarm
+
+
+def _double_fg(dt, dtype=jnp.float32):
+    f = dt * jnp.array([[0, 0, 1, 0], [0, 0, 0, 1],
+                        [0, 0, 0, 0], [0, 0, 0, 0]], dtype)
+    g = jnp.array([[dt * dt, 0], [0, dt * dt], [dt, 0], [0, dt]], dtype)
+    return f, g
+
+
+# --------------------------------------------------- row-level correctness
+
+def test_double_rows_match_oracle():
+    """The double-integrator (f, g) goes through the same assembly as any
+    affine dynamics — cross-check one agent against the float64 SLSQP
+    oracle (independent algorithm) with non-binding boxes on both sides."""
+    f, g = _double_fg(0.033)
+    state = jnp.array([0.0, 0.0, 0.15, -0.05])
+    obs = jnp.array([[0.25, 0.1, -0.1, 0.0], [-0.2, 0.15, 0.05, -0.1]])
+    mask = jnp.ones(2, bool)
+    u0 = jnp.array([0.8, -0.3])
+    params = CBFParams(max_speed=15.0, k=1.0)
+    u, info = safe_control(state, obs, mask, f, g, u0, params,
+                           reference_layout=False, vel_box_rows=False)
+    assert bool(info.feasible)
+    uo = OracleCBF(15.0).get_safe_control(
+        np.asarray(state, np.float64),
+        [np.asarray(o, np.float64) for o in obs],
+        np.asarray(f, np.float64), np.asarray(g, np.float64),
+        np.asarray(u0, np.float64))
+    np.testing.assert_allclose(np.asarray(u), uo, atol=5e-5)
+
+
+def test_exact_discrete_row_is_the_update():
+    """The row RHS algebra IS the semi-implicit update: for any accel a
+    satisfying the row with equality, stepping the pair forward gives
+    exactly h' = (1-gamma)*h (signs held)."""
+    dt, k, gamma, dmin = 0.033, 1.0, 0.5, 0.2
+    f, g = _double_fg(dt)
+    rng = np.random.default_rng(3)
+    for _ in range(20):
+        d = rng.uniform(-0.5, 0.5, 4)  # relative state, signs generic
+        s = np.sign(d[:2] + 1e-12)
+        hs = np.array([s[0], s[1], k * s[0], k * s[1]])
+        h = hs[:2] @ d[:2] + hs[2:] @ d[2:] - dmin
+        # row: hs.(f d) + hs.(g a) >= -gamma*h  — pick a on the boundary
+        # along the row normal.
+        row = np.asarray(hs @ np.asarray(g))
+        drift = float(hs @ (np.asarray(f) @ d))
+        a = row * (-gamma * h - drift) / (row @ row)
+        dv_new = d[2:] + dt * a
+        d_new = np.concatenate([d[:2] + dt * dv_new, dv_new])
+        h_new = hs[:2] @ d_new[:2] + hs[2:] @ d_new[2:] - dmin
+        np.testing.assert_allclose(h_new, (1 - gamma) * h, atol=1e-12)
+
+
+def test_vel_box_rows_off_gives_pure_actuator_box():
+    """With vel_box_rows=False the QP box bounds |a| by max_speed alone —
+    large velocities in the state slots must not tighten it."""
+    f, g = _double_fg(0.033)
+    state = jnp.array([0.0, 0.0, 5.0, -5.0])     # huge velocity slots
+    obs = jnp.zeros((1, 4))
+    mask = jnp.zeros(1, bool)                     # no CBF rows
+    u0 = jnp.array([0.9, -0.9])
+    params = CBFParams(max_speed=1.0, k=1.0)
+    u, info = safe_control(state, obs, mask, f, g, u0, params,
+                           reference_layout=False, vel_box_rows=False)
+    # Pure box |u| <= 1 admits u0 unchanged; the reference's velocity-
+    # coupled rows 5-8 would have forced |u + v| <= 1 instead.
+    np.testing.assert_allclose(np.asarray(u), np.asarray(u0), atol=1e-5)
+    assert bool(info.feasible)
+
+
+# --------------------------------------------------- scenario-level floors
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="dynamics"):
+        swarm.make(swarm.Config(n=8, dynamics="triple"))
+    with pytest.raises(ValueError, match="continuous"):
+        swarm.make(swarm.Config(n=8, dynamics="double", barrier="continuous"))
+
+
+def test_double_n64_holds_exact_floor():
+    """N=64: rendezvous to the packed disk with the full single-mode
+    separation floor (0.2/sqrt(2) Euclid), zero unresolved infeasibility,
+    and velocities damped at equilibrium."""
+    cfg = swarm.Config(n=64, steps=600, dynamics="double")
+    final, outs = swarm.run(cfg)
+    md = np.asarray(outs.min_pairwise_distance)
+    assert md.min() > 0.138
+    assert int(np.asarray(outs.infeasible_count).sum()) == 0
+    v = np.asarray(final.v)
+    assert np.linalg.norm(v, axis=1).max() < 0.02      # settled
+    x = np.asarray(final.x)
+    conv = np.linalg.norm(x - x.mean(0), axis=1).mean()
+    assert conv < cfg.pack_radius                       # packed, not stuck
+
+
+def test_double_n256_no_collapse():
+    """N=256: compression waves squeeze interior agents (bounded accel
+    cannot satisfy opposing front/back rows); eps-tiered relaxation keeps
+    the erosion bounded — without it the crowd interpenetrates to ~0.0003
+    (measured). Floor asserted well above the collapse mode and below the
+    ideal 0.1414 (documented equilibrium ~0.104-0.113)."""
+    cfg = swarm.Config(n=256, steps=500, dynamics="double")
+    final, outs = swarm.run(cfg)
+    md = np.asarray(outs.min_pairwise_distance)
+    assert md.min() > 0.095
+    assert int(np.asarray(outs.infeasible_count).sum()) == 0
+
+
+def test_double_accel_is_actuator_bounded():
+    """Applied accelerations respect the componentwise actuator box over
+    the whole rollout (incl. the compression phase where the filter is
+    most active), reconstructed from successive velocity states."""
+    cfg = swarm.Config(n=64, steps=150, dynamics="double")
+    state0, step = swarm.make(cfg)
+    state, worst = state0, 0.0
+    for t in range(cfg.steps):
+        nxt, _ = step(state, t)
+        a = (np.asarray(nxt.v) - np.asarray(state.v)) / cfg.dt
+        worst = max(worst, float(np.abs(a).max()))
+        state = nxt
+    assert worst <= cfg.accel_limit + 1e-4
+
+
+def test_double_rejects_nonpositive_tau_and_limit():
+    """tau <= 0 would NaN every position on step 1 (inf * capped-to-0);
+    validated centrally in barrier_dynamics like the mode strings."""
+    with pytest.raises(ValueError, match="vel_tracking_tau"):
+        swarm.make(swarm.Config(n=8, dynamics="double", vel_tracking_tau=0.0))
+    with pytest.raises(ValueError, match="accel_limit"):
+        swarm.make(swarm.Config(n=8, dynamics="double", accel_limit=-1.0))
+
+
+def test_double_sharded_matches_single_device():
+    """dp x sp sharded double-mode ensemble == the dp=1 x sp=1 run, and the
+    floor holds on the virtual 8-device mesh."""
+    from cbf_tpu.parallel import make_mesh
+    from cbf_tpu.parallel.ensemble import sharded_swarm_rollout
+
+    cfg = swarm.Config(n=64, steps=200, dynamics="double")
+    mesh = make_mesh(n_dp=4, n_sp=2)
+    (xf, vf), mets = sharded_swarm_rollout(cfg, mesh, seeds=[0, 1, 2, 3])
+    assert xf.shape == (4, 64, 2)
+    nd = np.asarray(mets.nearest_distance)
+    assert nd.min() > 0.138
+    assert int(np.asarray(mets.infeasible_count).sum()) == 0
+
+    mesh1 = make_mesh(n_dp=1, n_sp=1)
+    (x1, v1), _ = sharded_swarm_rollout(cfg, mesh1, seeds=[0])
+    np.testing.assert_allclose(np.asarray(xf)[0], np.asarray(x1)[0],
+                               atol=2e-5)
+
+
+def test_single_mode_unchanged_by_double_plumbing():
+    """Regression guard: the default single-mode scenario still reaches the
+    exact floor with the plumbing (vel_box_rows, eps tiers) present."""
+    cfg = swarm.Config(n=64, steps=400)
+    final, outs = swarm.run(cfg)
+    md = np.asarray(outs.min_pairwise_distance)
+    assert md.min() > 0.138
+    assert int(np.asarray(outs.infeasible_count).sum()) == 0
