@@ -62,7 +62,7 @@ TARGET_RATE_PER_CHIP = 4096 * 10_000 / 60.0 / 4.0   # BASELINE.json ladder
 SAFETY_FLOOR = 0.13
 # dynamics="double" (BENCH_DYNAMICS, opt-in): bounded-accel compression
 # squeezes erode the packed equilibrium below the ideal floor (documented:
-# ~0.104 at N=256, ~0.086 at N=1024 — tests/test_double_integrator.py);
+# ~0.104 at N=256, ~0.074 at N=1024 — tests/test_double_integrator.py);
 # the interpenetration failure mode sits at ~0.0003, so 0.05 separates a
 # healthy eroded equilibrium from a collapse unambiguously.
 SAFETY_FLOOR_DOUBLE = 0.05

@@ -248,6 +248,7 @@ def test_bench_end_to_end_ensemble_mode_cpu():
     # Virtual CPU "devices" share the host's one core pool: the 8-device run
     # saturates it while the 1-device baseline can't, so per-chip efficiency
     # can legitimately exceed 1 here (observed 1.7 at N=64/steps=30). The
-    # bound only rejects zero/NaN/garbage, not superlinearity.
-    assert 0 < out["scaling_efficiency"] <= 8.0
+    # bound tolerates that superlinearity but still catches accounting bugs
+    # (e.g. a wrong chip-count divisor inflating efficiency ~4x).
+    assert 0 < out["scaling_efficiency"] <= 3.0
     assert "knn_dropped=" in stderr
