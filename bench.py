@@ -60,12 +60,14 @@ TARGET_RATE_PER_CHIP = 4096 * 10_000 / 60.0 / 4.0   # BASELINE.json ladder
 # separation floor is 0.2/sqrt(2) ~ 0.1414; 0.13 leaves discretization slack
 # (same floor tests/test_scenarios.py asserts).
 SAFETY_FLOOR = 0.13
-# dynamics="double" (BENCH_DYNAMICS, opt-in): bounded-accel compression
-# squeezes erode the packed equilibrium below the ideal floor (documented:
-# ~0.104 at N=256, ~0.074 at N=1024 — tests/test_double_integrator.py);
-# the interpenetration failure mode sits at ~0.0003, so 0.05 separates a
-# healthy eroded equilibrium from a collapse unambiguously.
-SAFETY_FLOOR_DOUBLE = 0.05
+# dynamics="double" (BENCH_DYNAMICS, opt-in): with the separation nominal
+# the crowd rests ABOVE the ideal floor, but the convergence transient
+# still dips with scale (measured mins: 0.158 at N=64, 0.141 at N=256,
+# 0.114 at N=1024 — tests/test_double_integrator.py; 0.099 at N=4096 x
+# 1000 CPU steps — docs/BENCH_LOG.md); the interpenetration mode sits at
+# ~0.0003, so 0.08 passes every measured transient with margin while
+# rejecting any collapse unambiguously.
+SAFETY_FLOOR_DOUBLE = 0.08
 
 RC_RETRYABLE = 2      # wedge/timeout/init failure — try again
 RC_PERMANENT = 3      # safety violation or real error — don't retry

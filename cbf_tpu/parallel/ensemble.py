@@ -87,12 +87,7 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
         dodge, d_o = swarm_scenario.lane_dodge(x, obstacles4,
                                                cfg.safety_distance)
         u0 = u0 + 2.0 * dodge
-    u0 = l2_cap(u0, cfg.speed_limit)
-
     double = cfg.dynamics == "double"
-    if double:
-        u0 = swarm_scenario.nominal_accel(cfg, u0, v)
-
     vslots = v if (double or not discrete) else jnp.zeros_like(v)
     states4 = jnp.concatenate([x, vslots], axis=1)
     if (lax.axis_size(axis_name) == 1 and unroll_relax == 0
@@ -116,6 +111,8 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
             states4, K, cfg.safety_distance, axis_name, True,
             with_dropped=True, n_total=cfg.n)
         nearest1 = nearest_d[:, 0]
+
+    u0 = swarm_scenario.complete_nominal(cfg, u0, x, v, obs_slab, mask)
 
     priority = None
     if M:

@@ -138,6 +138,13 @@ class Config:
     # makes small tau bang-bang rather than stiff).
     accel_limit: float = 1.0
     vel_tracking_tau: float = 0.2
+    # Double mode only: short-range separation term in the nominal (see
+    # separation_bias). sep_target is the spacing below which pairs repel —
+    # default = the packed-disk design spacing (pack density 1/(pi r^2)
+    # gives mean spacing ~0.25 at pack_spacing 0.14); sep_gain = 0
+    # disables.
+    sep_gain: float = 1.0
+    sep_target: float = 0.25
     # Neighbor-search backend: "auto" picks a Pallas kernel on TPU
     # (fused <= 8192 agents, streaming beyond — ops.pallas_knn), else the
     # jnp path; "pallas"/"jnp" force (pallas runs in interpret mode off-TPU
@@ -388,6 +395,45 @@ def initial_state(cfg: Config) -> State:
     return State(x=x0, v=jnp.zeros_like(x0))
 
 
+def separation_bias(cfg: Config, x, obs_slab, mask):
+    """Double mode: short-range separation term in the nominal velocity
+    field, from the already-computed k-NN slab (agents only — obstacle
+    avoidance has its own lane-dodge bias and priority rows).
+
+    Without it the crowd freezes below the barrier floor: convergence
+    momentum over-compresses the core, every interior agent's opposing
+    rows go infeasible and eps-relax to a standstill, and no outward force
+    exists to decompress (the centroid pull is zero inside the packing
+    disk; boundary creep is damped by the velocity-tracking PD). Measured
+    fixed point 0.113 at N=256 over 8k steps. A nominal that pushes
+    below-target-spacing pairs apart releases the frozen pressure through
+    the QP (which still enforces every row) instead of against it.
+
+    Returns an (N, 2) velocity-field bias (capped later with the rest of
+    the nominal).
+    """
+    rel = x[:, None, :] - obs_slab[..., :2]               # (N, K, 2)
+    d = safe_norm(rel)                                    # (N, K)
+    w = jnp.where(mask, jnp.maximum(cfg.sep_target - d, 0.0), 0.0)
+    return cfg.sep_gain * jnp.sum(
+        (w / jnp.maximum(d, 1e-9))[..., None] * rel, axis=1)
+
+
+def complete_nominal(cfg: Config, u0, x, v, obs_slab, mask):
+    """Finish the nominal after gating: double-mode separation term (needs
+    the agent slab, before obstacle rows are attached), the speed cap, and
+    the double-mode accel conversion. One helper for the scenario step and
+    the sharded ensemble path — the ordering constraint must not be
+    mirrored by hand (cf. default_cbf / attach_obstacle_rows)."""
+    double = cfg.dynamics == "double"
+    if double and cfg.sep_gain:
+        u0 = u0 + separation_bias(cfg, x, obs_slab, mask)
+    u0 = l2_cap(u0, cfg.speed_limit)
+    if double:
+        u0 = nominal_accel(cfg, u0, v)
+    return u0
+
+
 def nominal_accel(cfg: Config, u_cmd, v):
     """Double mode: velocity-tracking PD turns the nominal velocity field
     into a nominal acceleration, L2-capped at the actuator limit. Shared by
@@ -497,12 +543,6 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
             obstacles4 = obstacle_states_at(cfg, t, dt_)
             dodge, d_o = lane_dodge(x, obstacles4, cfg.safety_distance)
             u0 = u0 + 2.0 * dodge
-        # Pre-filter actuator saturation (see Config.speed_limit).
-        u0 = l2_cap(u0, cfg.speed_limit)
-
-        if double:
-            u0 = nominal_accel(cfg, u0, state.v)
-
         # Discrete barrier (single mode): agent velocity slots are zero by
         # construction (u is the unknown the row solves for; a fellow
         # agent's motion is covered by the pairwise (1-2*gamma) bound) —
@@ -539,6 +579,8 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
             )
             off = dist + jnp.where(jnp.eye(x.shape[0], dtype=bool), jnp.inf, 0.0)
             min_dist = jnp.min(off)
+
+        u0 = complete_nominal(cfg, u0, x, state.v, obs_slab, mask)
 
         priority = None
         if M:
