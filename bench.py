@@ -33,6 +33,10 @@ Modes / env knobs:
   BENCH_DYNAMICS (single) — dynamics family; "double" benches the
     acceleration-controlled model (labeled in metric + record, gated at
     its own documented floor).
+  BENCH_PROFILE=<dir> — capture a jax.profiler device trace of the
+    measured window (TensorBoard trace-viewer format) into <dir>; the
+    wall number still excludes warmup but includes tracing overhead, so
+    profile runs are for tuning, not records.
   BENCH_ENSEMBLE=1 (or --ensemble) — dp-sharded ensemble of independent
     swarms over all available devices (the multi-chip measurement path for
     the v4-8 ladder rung); adds "chips" + "scaling_efficiency" fields.
@@ -224,6 +228,23 @@ def probe_device_subprocess(
     return False, f"device init failed: {proc.stderr.strip()[-400:]}"
 
 
+def _profile_ctx():
+    """(context manager, bool) for the BENCH_PROFILE knob: a jax.profiler
+    trace of the measured window, or a null context. Shared by both bench
+    modes; profiled results are marked in the record (tracing overhead
+    inflates wall time — tuning data, not a comparable measurement)."""
+    import contextlib
+
+    profile_dir = os.environ.get("BENCH_PROFILE")
+    if not profile_dir:
+        return contextlib.nullcontext(), False
+    from cbf_tpu.utils.profiling import trace
+
+    print(f"bench: profiling measured window into {profile_dir}",
+          file=sys.stderr)
+    return trace(profile_dir), True
+
+
 def _child_single(n: int, steps: int) -> dict:
     """The ladder rung as written (BASELINE.md: "4096 agents x 10k steps
     < 60 s"): the measured run goes through ``rollout_chunked`` with live
@@ -263,14 +284,17 @@ def _child_single(n: int, steps: int) -> dict:
         jax.block_until_ready(final.x)
     compile_and_first = time.time() - t0
 
+    prof, profiled = _profile_ctx()
+
     ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
     try:
-        t0 = time.time()
-        final, outs, _ = rollout_chunked(step, state0, steps, chunk=chunk,
-                                         checkpoint_dir=ckpt_dir,
-                                         resume=False, unroll=unroll)
-        jax.block_until_ready(final.x)
-        wall = time.time() - t0
+        with prof:
+            t0 = time.time()
+            final, outs, _ = rollout_chunked(step, state0, steps, chunk=chunk,
+                                             checkpoint_dir=ckpt_dir,
+                                             resume=False, unroll=unroll)
+            jax.block_until_ready(final.x)
+            wall = time.time() - t0
     finally:
         shutil.rmtree(ckpt_dir, ignore_errors=True)
 
@@ -299,6 +323,8 @@ def _child_single(n: int, steps: int) -> dict:
         "wall_s": round(wall, 3),
         "checkpointed": True,
     }
+    if profiled:
+        result["profiled"] = True
     if n_obstacles:
         # Mark obstacle workloads in the metric AND the record: their
         # vs_baseline is against the obstacle-free target rate and must
@@ -342,10 +368,12 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
     jax.block_until_ready(xf)
     compile_and_first = time.time() - t0
 
-    t0 = time.time()
-    (xf, vf), mets = sharded_swarm_rollout(cfg, mesh, seeds, steps=steps)
-    jax.block_until_ready(xf)
-    wall = time.time() - t0
+    prof, profiled = _profile_ctx()
+    with prof:
+        t0 = time.time()
+        (xf, vf), mets = sharded_swarm_rollout(cfg, mesh, seeds, steps=steps)
+        jax.block_until_ready(xf)
+        wall = time.time() - t0
 
     # nearest_distance is each swarm's per-step min nearest-neighbor
     # distance — the same separation series the single-chip mode floors.
@@ -394,6 +422,8 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
         "chips": chips,
         "scaling_efficiency": round(efficiency, 3),
     }
+    if profiled:
+        result["profiled"] = True
     if n_obstacles:
         # Same labeling contract as _child_single: obstacle workloads must
         # be distinguishable in the metric AND the record.

@@ -221,6 +221,16 @@ def test_bench_end_to_end_single_mode_cpu():
     assert "knn_dropped=" in stderr       # truncation diagnostic surfaced
 
 
+def test_bench_end_to_end_profile_capture_cpu(tmp_path):
+    """BENCH_PROFILE must produce a trace directory without disturbing the
+    one-JSON-line output contract."""
+    prof = str(tmp_path / "trace")
+    out, stderr = _run_bench_e2e({"BENCH_PROFILE": prof})
+    assert "profiling measured window" in stderr
+    assert os.path.isdir(prof) and os.listdir(prof)
+    assert out["profiled"] is True     # tuning runs are marked in-record
+
+
 def test_bench_end_to_end_double_dynamics_cpu():
     out, stderr = _run_bench_e2e({"BENCH_DYNAMICS": "double",
                                   "BENCH_STEPS": "60"})

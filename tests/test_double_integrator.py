@@ -262,6 +262,46 @@ def test_double_resume_equality(tmp_path):
                                   np.asarray(ref_final.v))
 
 
+def test_double_with_obstacles_sharded_matches_single_device():
+    """The untested triple point: double dynamics x moving obstacles x the
+    dp x sp sharded path. The global closed-form obstacle ring plus the
+    shared step helpers must make the sharded run equal the single-device
+    one, with the floor held and fast-obstacle infeasibility surfacing
+    consistently."""
+    from cbf_tpu.parallel import make_mesh
+    from cbf_tpu.parallel.ensemble import sharded_swarm_rollout
+
+    cfg = swarm.Config(n=64, steps=150, dynamics="double",
+                       n_obstacles=4, obstacle_omega=0.5)
+    mesh = make_mesh(n_dp=4, n_sp=2)
+    (xf, vf), mets = sharded_swarm_rollout(cfg, mesh, seeds=[0, 1, 2, 3])
+    nd = np.asarray(mets.nearest_distance)
+    assert nd.min() > 0.1
+    mesh1 = make_mesh(n_dp=1, n_sp=1)
+    (x1, v1), m1 = sharded_swarm_rollout(cfg, mesh1, seeds=[0])
+    np.testing.assert_allclose(np.asarray(xf)[0], np.asarray(x1)[0],
+                               atol=2e-5)
+    assert (int(np.asarray(mets.infeasible_count)[0].sum())
+            == int(np.asarray(m1.infeasible_count).sum()))
+
+
+def test_monte_carlo_ladder_shape():
+    """The BASELINE.md v4-32 rung shape scaled down: many more ensemble
+    members than devices (E=32 seeds x N=16 over dp=8), one sharded
+    program, every member safe."""
+    from cbf_tpu.parallel import make_mesh
+    from cbf_tpu.parallel.ensemble import sharded_swarm_rollout
+
+    cfg = swarm.Config(n=16, steps=60, k_neighbors=4)
+    mesh = make_mesh(n_dp=8, n_sp=1)
+    (xf, vf), mets = sharded_swarm_rollout(cfg, mesh, seeds=list(range(32)))
+    assert xf.shape == (32, 16, 2)
+    nd = np.asarray(mets.nearest_distance)
+    assert nd.shape == (32, 60)
+    assert nd.min() > 0.13
+    assert int(np.asarray(mets.infeasible_count).sum()) == 0
+
+
 def test_single_mode_unchanged_by_double_plumbing():
     """Regression guard: the default single-mode scenario still reaches the
     exact floor with the plumbing (vel_box_rows, eps tiers) present."""
