@@ -364,15 +364,15 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
           f"chips={chips}", file=sys.stderr)
 
     t0 = time.time()
-    (xf, vf), mets = sharded_swarm_rollout(cfg, mesh, seeds, steps=steps)
-    jax.block_until_ready(xf)
+    final, mets = sharded_swarm_rollout(cfg, mesh, seeds, steps=steps)
+    jax.block_until_ready(final[0])
     compile_and_first = time.time() - t0
 
     prof, profiled = _profile_ctx()
     with prof:
         t0 = time.time()
-        (xf, vf), mets = sharded_swarm_rollout(cfg, mesh, seeds, steps=steps)
-        jax.block_until_ready(xf)
+        final, mets = sharded_swarm_rollout(cfg, mesh, seeds, steps=steps)
+        jax.block_until_ready(final[0])
         wall = time.time() - t0
 
     # nearest_distance is each swarm's per-step min nearest-neighbor
@@ -398,13 +398,13 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
         # Scaling efficiency vs a single-device run of the same per-device
         # work (per_device ensembles on device 0).
         mesh1 = make_mesh(n_dp=1, n_sp=1, devices=devices[:1])
-        (x1, _), _ = sharded_swarm_rollout(cfg, mesh1, seeds[:per_device],
-                                           steps=steps)
-        jax.block_until_ready(x1)
+        f1, _ = sharded_swarm_rollout(cfg, mesh1, seeds[:per_device],
+                                      steps=steps)
+        jax.block_until_ready(f1[0])
         t0 = time.time()
-        (x1, _), _ = sharded_swarm_rollout(cfg, mesh1, seeds[:per_device],
-                                           steps=steps)
-        jax.block_until_ready(x1)
+        f1, _ = sharded_swarm_rollout(cfg, mesh1, seeds[:per_device],
+                                      steps=steps)
+        jax.block_until_ready(f1[0])
         wall1 = time.time() - t0
         rate1 = per_device * n * steps / wall1
         efficiency = rate_per_chip / rate1 if rate1 > 0 else 0.0

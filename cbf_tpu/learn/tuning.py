@@ -87,6 +87,11 @@ def make_loss_fn(cfg: swarm_scenario.Config, mesh, tc: TrainConfig = TrainConfig
 
     x0, v0: (E, N, 2) ensemble states (shard: dp x sp).
     """
+    if cfg.dynamics == "unicycle":
+        raise NotImplementedError(
+            "the trainer's loss plumbing carries (x, v) pair states; "
+            "unicycle (pose-state) training is not wired — train in "
+            "single/double mode (the filter parameters are shared)")
 
     def local_loss(params: TunableParams, x0l, v0l):
         # Mode-aware actuator box: in double mode max_speed is the QP's
@@ -99,7 +104,7 @@ def make_loss_fn(cfg: swarm_scenario.Config, mesh, tc: TrainConfig = TrainConfig
         def one(x0i, v0i):
             def body(carry, t):
                 x, v = carry
-                x2, v2, _, nearest = _local_swarm_step(
+                x2, v2, _, _, nearest = _local_swarm_step(
                     x, v, cfg, cbf, "sp", unroll_relax=tc.unroll_relax,
                     compute_metrics=False, t=t)
                 # Hinge on separation: per-agent nearest-neighbor distance

@@ -52,30 +52,44 @@ class EnsembleMetrics(NamedTuple):
 def ensemble_initial_states(cfg: swarm_scenario.Config, seeds):
     """(E, N, 2) positions + (E, N, 2) zero velocities, one jittered grid
     per seed (vmap of the scenario's canonical spawn, incl. the
-    obstacle-disk clearing push when cfg.n_obstacles > 0)."""
+    obstacle-disk clearing push when cfg.n_obstacles > 0). Unicycle mode
+    returns a third (E, N) array of seeded headings (the scenario's
+    heading_spawn law — shared so a sharded member starts exactly where
+    the scenario would)."""
     keys = jnp.stack([jax.random.PRNGKey(int(s)) for s in seeds])
     x0 = jax.vmap(lambda k: swarm_scenario.clear_obstacle_spawn(
         cfg, swarm_scenario.spawn_positions(cfg, k)))(keys)
+    if cfg.dynamics == "unicycle":
+        theta0 = jnp.stack(
+            [swarm_scenario.heading_spawn(cfg, s) for s in seeds])
+        return x0, jnp.zeros_like(x0), theta0
     return x0, jnp.zeros_like(x0)
 
 
 def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
                       axis_name: str, unroll_relax: int = 0,
-                      compute_metrics: bool = True, t=0):
+                      compute_metrics: bool = True, t=0, theta=None):
     """One agent-sharded swarm step. x, v: (n_local, 2). Differentiable when
     ``unroll_relax > 0`` (see solvers.exact2d) and ``compute_metrics=False``
     (the metric reductions use pmin, which has no differentiation rule).
     ``t`` is the global step index — the moving-obstacle ring is closed-form
-    in t (and global: the same ring on every member and shard).
+    in t (and global: the same ring on every member and shard). ``theta``
+    (n_local,) is required in unicycle mode — ``x`` is then the body
+    center and the filter works on the projection points, mirroring the
+    scenario step.
 
-    Returns (x_new, v_new, metrics_or_None, nearest_d_local)
-    — v_new is the applied velocity (== the filtered control u in
-    single mode; the integrated velocity state in double mode).
+    Returns (x_new, v_new, theta_new_or_None, metrics_or_None,
+    nearest_d_local) — v_new is the applied (si) velocity.
     """
     dt_ = x.dtype
     f, g, discrete = swarm_scenario.barrier_dynamics(cfg, dt_)
     K = min(cfg.k_neighbors, cfg.n - 1)
     M = cfg.n_obstacles
+
+    unicycle = cfg.dynamics == "unicycle"
+    body = x
+    if unicycle:
+        x = swarm_scenario.projection_points(cfg, body, theta)
 
     mean = lax.psum(jnp.sum(x, axis=0), axis_name) / cfg.n
     to_c = mean[None] - x
@@ -121,15 +135,22 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
         nearest1 = jnp.minimum(nearest1, jnp.min(d_o, axis=1))
 
     priority, cap = swarm_scenario.relax_tiers(cfg, mask, priority)
+    plain_box = double or unicycle
     u_safe, info = safe_controls(
         states4, obs_slab, mask, f, g, u0, cbf,
         unroll_relax=unroll_relax,
         priority_mask=priority, relax_cap=cap,
-        reference_layout=not double, vel_box_rows=not double)
+        reference_layout=not plain_box, vel_box_rows=not plain_box)
     engaged = jnp.any(mask, axis=1)
     u = jnp.where(engaged[:, None], u_safe, u0)
 
-    x_new, v_new = swarm_scenario.integrate(cfg, x, v, u)
+    theta_new = None
+    if unicycle:
+        x_new, theta_new, p_new = swarm_scenario.unicycle_apply(
+            cfg, body, theta, u)
+        v_new = (p_new - x) / cfg.dt
+    else:
+        x_new, v_new = swarm_scenario.integrate(cfg, x, v, u)
     metrics = None
     if compute_metrics:
         metrics = (
@@ -138,7 +159,7 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
             lax.psum(jnp.sum(~info.feasible & engaged), axis_name),
             lax.psum(jnp.sum(dropped), axis_name),
         )
-    return x_new, v_new, metrics, nearest1
+    return x_new, v_new, theta_new, metrics, nearest1
 
 
 def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
@@ -147,17 +168,21 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
                           initial_state=None, t0: int = 0):
     """Run len(seeds) independent swarms over the (dp, sp) mesh.
 
-    ``initial_state``: optional (x0, v0) pair of (E, N, 2) arrays to start
-    from (e.g. a restored checkpoint) instead of the seeds' spawn grids —
-    the resume path of a chunked/checkpointed ensemble run. Pass the
-    matching ``t0`` (global step of the restored state) so the
-    closed-form moving-obstacle ring resumes in phase.
+    ``initial_state``: optional (x0, v0) pair — (x0, v0, theta0) in
+    unicycle mode — of (E, N, 2) / (E, N) arrays to start from (e.g. a
+    restored checkpoint) instead of the seeds' spawn grids — the resume
+    path of a chunked/checkpointed ensemble run. Pass the matching ``t0``
+    (global step of the restored state) so the closed-form moving-obstacle
+    ring resumes in phase.
 
-    Returns ((x_final, v_final) with (E, N, 2) global shape, EnsembleMetrics).
+    Returns ((x_final, v_final) — plus theta_final in unicycle mode — with
+    (E, N, 2) / (E, N) global shapes, EnsembleMetrics).
     """
     steps = cfg.steps if steps is None else steps
     if cbf is None:
         cbf = swarm_scenario.default_cbf(cfg)
+    unicycle = cfg.dynamics == "unicycle"
+    parts = 3 if unicycle else 2
     E = len(seeds)
     n_dp, n_sp = mesh.shape["dp"], mesh.shape["sp"]
     if E % n_dp or cfg.n % n_sp:
@@ -165,43 +190,54 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
             f"E={E} must divide by dp={n_dp} and N={cfg.n} by sp={n_sp}")
 
     if initial_state is not None:
-        x0, v0 = initial_state
-        if x0.shape != (E, cfg.n, 2):
+        if len(initial_state) != parts:
             raise ValueError(
-                f"initial_state x0 shape {x0.shape} != {(E, cfg.n, 2)}")
+                f"initial_state needs {parts} arrays for "
+                f"dynamics={cfg.dynamics!r}, got {len(initial_state)}")
+        if initial_state[0].shape != (E, cfg.n, 2):
+            raise ValueError(
+                f"initial_state x0 shape {initial_state[0].shape} != "
+                f"{(E, cfg.n, 2)}")
+        if unicycle and initial_state[2].shape != (E, cfg.n):
+            raise ValueError(
+                f"initial_state theta0 shape {initial_state[2].shape} != "
+                f"{(E, cfg.n)}")
+        state0 = tuple(initial_state)
     else:
-        x0, v0 = ensemble_initial_states(cfg, seeds)
+        state0 = ensemble_initial_states(cfg, seeds)
 
     E_local = E // n_dp
 
-    def local_rollout(x0l, v0l):
-        def one(x0i, v0i):
+    def local_rollout(*state0l):
+        def one(*state0i):
             def body(carry, t):
-                x, v = carry
-                x2, v2, met, _ = _local_swarm_step(x, v, cfg, cbf, "sp",
-                                                   t=t)
-                return (x2, v2), met
+                th = carry[2] if unicycle else None
+                x2, v2, th2, met, _ = _local_swarm_step(
+                    carry[0], carry[1], cfg, cbf, "sp", t=t, theta=th)
+                new = (x2, v2, th2) if unicycle else (x2, v2)
+                return new, met
 
-            (xf, vf), mets = lax.scan(body, (x0i, v0i),
-                                      t0 + jnp.arange(steps))
-            return xf, vf, mets
+            final, mets = lax.scan(body, state0i, t0 + jnp.arange(steps))
+            return final + (mets,)
 
         if E_local == 1:
             # One member per device: skip the vmap wrapper — identical math,
             # but batched lowering of the Pallas neighbor kernel is not free
             # on TPU, and this is the bench's chips==E configuration.
-            xf, vf, mets = one(x0l[0], v0l[0])
-            return (xf[None], vf[None],
-                    jax.tree.map(lambda m: m[None], mets))
-        return jax.vmap(one)(x0l, v0l)
+            out = one(*(p[0] for p in state0l))
+            return tuple(jax.tree.map(lambda m: m[None], o) for o in out)
+        return jax.vmap(one)(*state0l)
 
     spec_state = P("dp", "sp", None)
+    spec_theta = P("dp", "sp")
     spec_metric = P("dp", None)
+    in_specs = ((spec_state, spec_state, spec_theta) if unicycle
+                else (spec_state, spec_state))
     fn = shard_map(
         local_rollout, mesh,
-        in_specs=(spec_state, spec_state),
-        out_specs=(spec_state, spec_state,
-                   (spec_metric, spec_metric, spec_metric, spec_metric)),
+        in_specs=in_specs,
+        out_specs=in_specs + (
+            (spec_metric, spec_metric, spec_metric, spec_metric),),
     )
-    xf, vf, mets = jax.jit(fn)(x0, v0)
-    return (xf, vf), EnsembleMetrics(*mets)
+    out = jax.jit(fn)(*state0)
+    return tuple(out[:parts]), EnsembleMetrics(*out[parts])

@@ -46,6 +46,12 @@ class StepOutputs(NamedTuple):
     # convergence is asserted from this, never assumed); () where no
     # certificate runs.
     certificate_residual: Any = ()
+    # Unicycle mode: worst per-agent |commanded - realized| si speed this
+    # step — wheel saturation truncating a commanded evasion is an
+    # actuation deficit the filter cannot see, so it must be observable
+    # (the silent-erosion failure mode is a saturated robot vs a fast
+    # obstacle); () elsewhere.
+    saturation_deficit: Any = ()
 
 
 @functools.partial(jax.jit, static_argnames=("step_fn", "steps", "unroll"))

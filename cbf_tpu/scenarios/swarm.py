@@ -130,6 +130,17 @@ class Config:
     # unknown), and the box rows drop the reference's velocity coupling
     # (core.barrier vel_box_rows=False) so the QP box bounds |a| by
     # accel_limit — the physical actuator limit.
+    # "unicycle": the reference's actual robot model at swarm scale — the
+    # Robotarium pipeline (meet_at_center.py:61,79-80,148-153) rebuilt
+    # batched: the CBF filter runs in single-integrator space on the
+    # projection points l ahead of the wheel axis (sim.transformations),
+    # the filtered si velocity maps to (v, omega) via si_to_uni_dyn, and
+    # sim.robotarium.unicycle_step integrates with wheel saturation.
+    # Saturation is proportional in (v, omega) — curvature-preserving, the
+    # same arc traversed slower — which for the k=0 barrier only shrinks
+    # each step's h-decrease, so it is safety-conservative (floors
+    # measured; the projection point is what the filter guarantees — body
+    # centers sit within projection_distance of it).
     dynamics: str = "single"
     # Double mode only: actuator bound on acceleration (componentwise via
     # the QP box + L2 via the nominal cap), and the time constant of the
@@ -138,6 +149,9 @@ class Config:
     # makes small tau bang-bang rather than stiff).
     accel_limit: float = 1.0
     vel_tracking_tau: float = 0.2
+    # Unicycle mode only: distance of the si projection point ahead of the
+    # wheel axis (the reference's create_si_to_uni_mapping default).
+    projection_distance: float = 0.05
     # Double mode only: short-range separation term in the nominal (see
     # separation_bias). sep_target is the spacing below which pairs repel —
     # default = the packed-disk design spacing (pack density 1/(pi r^2)
@@ -176,8 +190,11 @@ class Config:
 
 
 class State(NamedTuple):
-    x: jnp.ndarray   # (N, 2) positions
-    v: jnp.ndarray   # (N, 2) last applied velocities
+    x: jnp.ndarray   # (N, 2) positions (body centers in unicycle mode)
+    v: jnp.ndarray   # (N, 2) last applied (si) velocities
+    # (N,) headings — unicycle mode only; () otherwise (an empty pytree
+    # node: scan/checkpoint/render paths are unaffected).
+    theta: jnp.ndarray | tuple = ()
 
 
 def spawn_positions(cfg: Config, seed) -> jnp.ndarray:
@@ -284,9 +301,27 @@ def barrier_dynamics(cfg: Config, dtype):
     (scenario step, sharded ensemble, trainer) comes through here, so a
     typo'd mode raises instead of silently running single-integrator
     physics."""
-    if cfg.dynamics not in ("single", "double"):
+    if cfg.dynamics not in ("single", "double", "unicycle"):
         raise ValueError(
-            f"dynamics must be single|double, got {cfg.dynamics!r}")
+            f"dynamics must be single|double|unicycle, got {cfg.dynamics!r}")
+    if cfg.dynamics == "unicycle":
+        if not cfg.projection_distance > 0:
+            raise ValueError(
+                f"unicycle dynamics needs projection_distance > 0, got "
+                f"{cfg.projection_distance}")
+        # The safety contract boxes QP commands at the wheel-realizable
+        # speed (default_cbf); if speed_limit exceeded what the wheels can
+        # do, commands would again be silently truncated by physics — the
+        # measured near-contact erosion this mode is built to prevent.
+        from cbf_tpu.sim.robotarium import SimParams
+        p = SimParams(dt=cfg.dt)
+        vmax = p.wheel_radius * p.max_wheel_speed
+        if cfg.speed_limit > vmax + 1e-9:
+            raise ValueError(
+                f"unicycle speed_limit {cfg.speed_limit} exceeds the "
+                f"wheel-realizable max {vmax:.3f} (wheel_radius * "
+                "max_wheel_speed) — commands beyond it are physically "
+                "truncated with no infeasibility signal")
     if cfg.barrier not in ("auto", "continuous", "discrete"):
         raise ValueError(
             f"barrier must be auto|continuous|discrete, got {cfg.barrier!r}")
@@ -390,9 +425,30 @@ def clear_obstacle_spawn(cfg: Config, x0):
     return pairwise_repair(x0)
 
 
+def heading_spawn(cfg: Config, seed) -> jnp.ndarray:
+    """(N,) seeded initial headings — the single source for the scenario
+    and the ensemble. The key is fold_in(spawn_key, 1), NOT PRNGKey(seed+1):
+    the latter would alias member i's headings with member i+1's spawn
+    jitter in consecutive-seed Monte-Carlo ensembles."""
+    key = jax.random.fold_in(jax.random.PRNGKey(int(seed)), 1)
+    return jax.random.uniform(key, (cfg.n,), minval=-np.pi,
+                              maxval=np.pi).astype(cfg.dtype)
+
+
+def projection_points(cfg: Config, body_xy, theta):
+    """(N, 2) si projection points l ahead of the wheel axis — the row-major
+    twin of sim.transformations.uni_to_si_states, single-sourced for the
+    scenario step and the sharded ensemble step."""
+    return body_xy + cfg.projection_distance * jnp.stack(
+        [jnp.cos(theta), jnp.sin(theta)], axis=1)
+
+
 def initial_state(cfg: Config) -> State:
     x0 = clear_obstacle_spawn(cfg, spawn_positions(cfg, cfg.seed))
-    return State(x=x0, v=jnp.zeros_like(x0))
+    theta0 = ()
+    if cfg.dynamics == "unicycle":
+        theta0 = heading_spawn(cfg, cfg.seed)
+    return State(x=x0, v=jnp.zeros_like(x0), theta=theta0)
 
 
 def separation_bias(cfg: Config, x, obs_slab, mask):
@@ -460,11 +516,28 @@ def relax_tiers(cfg: Config, mask, priority):
     Single mode: obstacle rows (when present) are the priority tier and
     agent rows carry the per-row relax cap.
     """
-    if cfg.dynamics == "double":
+    if cfg.dynamics in ("double", "unicycle"):
         priority = (jnp.ones_like(mask) if priority is None
                     else jnp.ones_like(priority))
         return priority, None
     return priority, (cfg.relax_cap if cfg.n_obstacles else None)
+
+
+def unicycle_apply(cfg: Config, body_xy, theta, u_si):
+    """Apply a filtered si velocity to the unicycle fleet: map to
+    (v, omega) through the projection point (sim.transformations), one
+    saturated unicycle Euler step (sim.robotarium), and report the new
+    projection points. Returns (body_xy' (N, 2), theta' (N,),
+    p' (N, 2))."""
+    from cbf_tpu.sim.robotarium import SimParams, unicycle_step
+    from cbf_tpu.sim.transformations import si_to_uni_dyn, uni_to_si_states
+
+    poses = jnp.stack([body_xy[:, 0], body_xy[:, 1], theta])      # (3, N)
+    dxu = si_to_uni_dyn(u_si.T, poses, cfg.projection_distance)
+    new_poses = unicycle_step(poses, dxu, SimParams(dt=cfg.dt))
+    p_new = uni_to_si_states(new_poses, cfg.projection_distance).T
+    return (jnp.stack([new_poses[0], new_poses[1]], axis=1),
+            new_poses[2], p_new)
 
 
 def integrate(cfg: Config, x, v, u):
@@ -497,6 +570,14 @@ def default_cbf(cfg: Config) -> CBFParams:
     """
     if cfg.dynamics == "double":
         return CBFParams(max_speed=cfg.accel_limit, k=1.0)
+    if cfg.dynamics == "unicycle":
+        # The QP box bounds the COMMAND at the wheel-realizable speed:
+        # with the reference's 15.0 box a fast obstacle elicits evasion
+        # commands physics then truncates — h erodes with no infeasibility
+        # signal (measured near-contact 0.0057 at 13x obstacle speed).
+        # Boxed at speed_limit, impossible demands surface as relax rounds
+        # and the realizable command is what the integrator applies.
+        return CBFParams(max_speed=cfg.speed_limit, k=0.0)
     return CBFParams(max_speed=cfg.max_speed, k=0.0)
 
 
@@ -504,6 +585,7 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
     dt_ = cfg.dtype
     f, g, discrete = barrier_dynamics(cfg, dt_)   # validates cfg.dynamics
     double = cfg.dynamics == "double"
+    unicycle = cfg.dynamics == "unicycle"
     if cbf is None:
         cbf = default_cbf(cfg)
     K = cfg.k_neighbors
@@ -533,7 +615,13 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
     state0 = initial_state(cfg)
 
     def step(state: State, t):
-        x = state.x                                            # (N, 2)
+        if unicycle:
+            # Work in si space: the projection point l ahead of the wheel
+            # axis is what the filter sees and guarantees (the reference
+            # pipeline — uni_to_si_states at meet_at_center.py:80).
+            x = projection_points(cfg, state.x, state.theta)
+        else:
+            x = state.x                                        # (N, 2)
         to_c = jnp.mean(x, axis=0)[None] - x                   # (N, 2)
         d_c = jnp.linalg.norm(to_c, axis=1, keepdims=True)
         # Pull toward the centroid only while outside the packing disk.
@@ -589,15 +677,29 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
             min_dist = jnp.minimum(min_dist, jnp.min(d_o))
 
         priority, cap = relax_tiers(cfg, mask, priority)
+        # Actuation-bounded modes get the corrected pure actuator box (the
+        # reference's quirky velocity-coupled rows are a parity artifact).
+        plain_box = double or unicycle
         u_safe, info = safe_controls(
             states4, obs_slab, mask, f, g, u0, cbf,
             priority_mask=priority, relax_cap=cap,
-            reference_layout=not double,
-            vel_box_rows=not double)
+            reference_layout=not plain_box,
+            vel_box_rows=not plain_box)
         engaged = jnp.any(mask, axis=1)
         u = jnp.where(engaged[:, None], u_safe, u0)
 
-        x_new, v_new = integrate(cfg, x, state.v, u)
+        deficit = ()
+        if unicycle:
+            body_new, theta_new, p_new = unicycle_apply(
+                cfg, state.x, state.theta, u)
+            realized = (p_new - x) / cfg.dt
+            # Applied si velocity at the projection point — the actual
+            # velocity the continuous barrier's vslots carry next step.
+            new_state = State(x=body_new, v=realized, theta=theta_new)
+            deficit = jnp.max(safe_norm(u - realized))
+        else:
+            x_new, v_new = integrate(cfg, x, state.v, u)
+            new_state = State(x=x_new, v=v_new)
 
         out = StepOutputs(
             min_pairwise_distance=min_dist,
@@ -607,8 +709,9 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
             trajectory=x if cfg.record_trajectory else (),
             gating_overflow_count=overflow_count,
             gating_dropped_count=jnp.sum(dropped),
+            saturation_deficit=deficit,
         )
-        return State(x=x_new, v=v_new), out
+        return new_state, out
 
     return state0, step
 

@@ -51,6 +51,9 @@ def summarize(outs: StepOutputs) -> dict:
     if not isinstance(outs.gating_dropped_count, tuple):
         out["knn_dropped_neighbor_steps"] = int(
             np.asarray(outs.gating_dropped_count).sum())
+    if not isinstance(outs.saturation_deficit, tuple):
+        out["max_saturation_deficit"] = float(
+            np.asarray(outs.saturation_deficit).max())
     if not isinstance(outs.gating_overflow_count, tuple):
         out["gating_overflow_agent_steps"] = int(
             np.asarray(outs.gating_overflow_count).sum())
