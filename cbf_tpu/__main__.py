@@ -83,6 +83,25 @@ def _apply_overrides(cfg, pairs: list[str], steps: int | None,
             val = float(raw)
         elif isinstance(current, tuple):
             val = tuple(float(x) for x in raw.split(","))
+        elif current is None:
+            # Optional fields (certificate_pairs, relax_cap=None configs,
+            # spawn_half_width_override, ...) carry no type to infer from —
+            # parse literals so a numeric override doesn't arrive as a
+            # string and explode deep inside jit ("'<' not supported
+            # between 'str' and 'int'").
+            low = raw.lower()
+            if low in ("none", "null"):
+                val = None
+            elif low in ("true", "false"):
+                val = low == "true"
+            else:
+                try:
+                    val = int(raw)
+                except ValueError:
+                    try:
+                        val = float(raw)
+                    except ValueError:
+                        val = raw
         else:
             val = raw
         updates[key] = val

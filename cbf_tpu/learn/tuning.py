@@ -92,6 +92,12 @@ def make_loss_fn(cfg: swarm_scenario.Config, mesh, tc: TrainConfig = TrainConfig
             "the trainer's loss plumbing carries (x, v) pair states; "
             "unicycle (pose-state) training is not wired — train in "
             "single/double mode (the filter parameters are shared)")
+    if cfg.certificate:
+        raise NotImplementedError(
+            "the trainer rolls out through _local_swarm_step, which does "
+            "not apply the joint-certificate second layer — training a "
+            "certificate=True config would silently score uncertified "
+            "rollouts; train with certificate=False")
 
     def local_loss(params: TunableParams, x0l, v0l):
         # Mode-aware actuator box: in double mode max_speed is the QP's

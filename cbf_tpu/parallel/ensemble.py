@@ -179,6 +179,13 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
     (E, N, 2) / (E, N) global shapes, EnsembleMetrics).
     """
     steps = cfg.steps if steps is None else steps
+    if cfg.certificate:
+        raise NotImplementedError(
+            "the joint-certificate second layer is scenario-level (its 2N-"
+            "variable QP couples all agents and is not sp-shardable as "
+            "built) — run certificate configs via scenarios.swarm / "
+            "rollout_chunked; the sharded ensemble would otherwise return "
+            "uncertified trajectories under a certificate=True config")
     if cbf is None:
         cbf = swarm_scenario.default_cbf(cfg)
     unicycle = cfg.dynamics == "unicycle"

@@ -152,6 +152,17 @@ class Config:
     # Unicycle mode only: distance of the si projection point ahead of the
     # wheel axis (the reference's create_si_to_uni_mapping default).
     projection_distance: float = 0.05
+    # Two-layer safety stack at swarm scale: apply the reference's JOINT
+    # barrier certificate (cross_and_rescue.py:162-163 — the second QP of
+    # its stack) after the per-agent filter. The joint QP has 2N variables;
+    # certificate_pairs prunes to that many tightest pairwise rows (exact
+    # while it covers the sub-half-meter pairs — sim.certificates), and the
+    # boundary rows use the swarm's own spawn box, not the 3.2 m x 2 m
+    # Robotarium arena the crowd outgrows. Velocity-space: valid for
+    # single/unicycle commands, rejected for double (accelerations).
+    # Practical to mid N (the dense joint QP is quadratic in N).
+    certificate: bool = False
+    certificate_pairs: int | None = None   # None = 8*n heuristic
     # Double mode only: short-range separation term in the nominal (see
     # separation_bias). sep_target is the spacing below which pairs repel —
     # default = the packed-disk design spacing (pack density 1/(pi r^2)
@@ -304,6 +315,18 @@ def barrier_dynamics(cfg: Config, dtype):
     if cfg.dynamics not in ("single", "double", "unicycle"):
         raise ValueError(
             f"dynamics must be single|double|unicycle, got {cfg.dynamics!r}")
+    if cfg.certificate and cfg.dynamics == "double":
+        raise ValueError(
+            "certificate=True filters VELOCITY commands (the reference's "
+            "joint certificate, cross_and_rescue.py:162-163); double mode "
+            "outputs accelerations — the combination is not meaningful")
+    if cfg.certificate and cfg.n_obstacles:
+        raise ValueError(
+            "certificate=True with moving obstacles is rejected: the joint "
+            "certificate is obstacle-blind and its magnitude pre-limit "
+            "rescales the first layer's evasive commands (the post-filter-"
+            "saturation pathology Config.speed_limit documents) — the "
+            "obstacle barrier would erode with no signal")
     if cfg.dynamics == "unicycle":
         if not cfg.projection_distance > 0:
             raise ValueError(
@@ -688,6 +711,23 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
         engaged = jnp.any(mask, axis=1)
         u = jnp.where(engaged[:, None], u_safe, u0)
 
+        cert_residual = ()
+        if cfg.certificate:
+            # Second layer of the reference's stack: the joint certificate
+            # over the already-filtered si velocities (see Config).
+            from cbf_tpu.sim.certificates import (CertificateParams,
+                                                  si_barrier_certificate)
+            half = cfg.spawn_half_width * 1.5
+            pairs = (cfg.certificate_pairs if cfg.certificate_pairs
+                     is not None else 8 * cfg.n)
+            u_cert, cinfo = si_barrier_certificate(
+                u.T, x.T, CertificateParams(
+                    magnitude_limit=cfg.speed_limit),
+                max_pairs=pairs, with_info=True,
+                arena=(-half, half, -half, half))
+            u = u_cert.T
+            cert_residual = cinfo.primal_residual
+
         deficit = ()
         if unicycle:
             body_new, theta_new, p_new = unicycle_apply(
@@ -709,6 +749,7 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
             trajectory=x if cfg.record_trajectory else (),
             gating_overflow_count=overflow_count,
             gating_dropped_count=jnp.sum(dropped),
+            certificate_residual=cert_residual,
             saturation_deficit=deficit,
         )
         return new_state, out

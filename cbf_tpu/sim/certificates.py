@@ -37,8 +37,13 @@ class CertificateParams(NamedTuple):
 def si_barrier_certificate(dxi, x, params: CertificateParams = CertificateParams(),
                            settings: ADMMSettings = ADMMSettings(iters=250),
                            max_pairs: int | None = None,
-                           with_info: bool = False):
+                           with_info: bool = False,
+                           arena: tuple | None = ARENA):
     """Filter joint single-integrator velocities. Args: dxi (2, N), x (2, N).
+
+    ``arena``: (xmin, xmax, ymin, ymax) for the boundary rows — defaults to
+    the Robotarium testbed extent; pass a wider box for swarm-scale use, or
+    None to drop the boundary rows entirely (pairwise-only certificate).
 
     Size: the dense QP has 2N variables and N(N-1)/2 + 4N rows — quadratic
     in N, fine at the scenario scale (N <= a few dozen; the reference applies
@@ -85,24 +90,26 @@ def si_barrier_certificate(dxi, x, params: CertificateParams = CertificateParams
     A_pair = A_pair.at[rows, 2 * J + 1].set(2.0 * err[1])
     b_pair = params.barrier_gain * h**3
 
-    # Boundary rows: keep each agent r/2 inside the arena walls.
-    xmin, xmax, ymin, ymax = ARENA
-    r2 = params.safety_radius / 2.0
-    k = jnp.arange(N)
-    A_bnd = jnp.zeros((4 * N, 2 * N), dtype)
-    A_bnd = A_bnd.at[4 * k + 0, 2 * k + 1].set(1.0)    #  u_y <= ...
-    A_bnd = A_bnd.at[4 * k + 1, 2 * k + 1].set(-1.0)   # -u_y <= ...
-    A_bnd = A_bnd.at[4 * k + 2, 2 * k + 0].set(1.0)    #  u_x <= ...
-    A_bnd = A_bnd.at[4 * k + 3, 2 * k + 0].set(-1.0)   # -u_x <= ...
-    gb = 0.4 * params.barrier_gain
-    b_bnd = jnp.zeros((4 * N,), dtype)
-    b_bnd = b_bnd.at[4 * k + 0].set(gb * (ymax - r2 - x[1]) ** 3)
-    b_bnd = b_bnd.at[4 * k + 1].set(gb * (x[1] - ymin - r2) ** 3)
-    b_bnd = b_bnd.at[4 * k + 2].set(gb * (xmax - r2 - x[0]) ** 3)
-    b_bnd = b_bnd.at[4 * k + 3].set(gb * (x[0] - xmin - r2) ** 3)
-
-    A = jnp.concatenate([A_pair, A_bnd], axis=0)
-    b = jnp.concatenate([b_pair, b_bnd])
+    if arena is not None:
+        # Boundary rows: keep each agent r/2 inside the arena walls.
+        xmin, xmax, ymin, ymax = arena
+        r2 = params.safety_radius / 2.0
+        k = jnp.arange(N)
+        A_bnd = jnp.zeros((4 * N, 2 * N), dtype)
+        A_bnd = A_bnd.at[4 * k + 0, 2 * k + 1].set(1.0)    #  u_y <= ...
+        A_bnd = A_bnd.at[4 * k + 1, 2 * k + 1].set(-1.0)   # -u_y <= ...
+        A_bnd = A_bnd.at[4 * k + 2, 2 * k + 0].set(1.0)    #  u_x <= ...
+        A_bnd = A_bnd.at[4 * k + 3, 2 * k + 0].set(-1.0)   # -u_x <= ...
+        gb = 0.4 * params.barrier_gain
+        b_bnd = jnp.zeros((4 * N,), dtype)
+        b_bnd = b_bnd.at[4 * k + 0].set(gb * (ymax - r2 - x[1]) ** 3)
+        b_bnd = b_bnd.at[4 * k + 1].set(gb * (x[1] - ymin - r2) ** 3)
+        b_bnd = b_bnd.at[4 * k + 2].set(gb * (xmax - r2 - x[0]) ** 3)
+        b_bnd = b_bnd.at[4 * k + 3].set(gb * (x[0] - xmin - r2) ** 3)
+        A = jnp.concatenate([A_pair, A_bnd], axis=0)
+        b = jnp.concatenate([b_pair, b_bnd])
+    else:
+        A, b = A_pair, b_pair
 
     u_nom = dxi.T.reshape(-1)                                # [ux0, uy0, ux1, ...]
     Pmat = jnp.eye(2 * N, dtype=dtype)

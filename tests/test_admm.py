@@ -7,10 +7,15 @@ import pytest
 from cbf_tpu.oracle.reference_filter import solve_qp_slsqp
 
 
-def test_projection_qp_matches_slsqp(x64, rng):
+def test_projection_qp_matches_slsqp(x64):
     import jax.numpy as jnp
     from cbf_tpu.solvers.admm import ADMMSettings, solve_box_qp_admm
 
+    # Locally seeded: the session rng's stream depends on which tests ran
+    # before this one, and a shifted stream can draw a near-infeasible
+    # random QP where 400 ADMM iterations legitimately don't reach 1e-4
+    # (order-dependent flake, observed under partial-suite selections).
+    rng = np.random.default_rng(0)
     n, m = 4, 10
     for trial in range(10):
         A = rng.normal(size=(m, n))
@@ -164,11 +169,12 @@ def test_cross_and_rescue_rollout_asserts_residuals():
     assert res.max() < 1e-3, f"ADMM residual spiked: {res.max()}"
 
 
-def test_vmap_batch(x64, rng):
+def test_vmap_batch(x64):
     import jax
     import jax.numpy as jnp
     from cbf_tpu.solvers.admm import ADMMSettings, solve_box_qp_admm
 
+    rng = np.random.default_rng(7)   # local seed — see the SLSQP test above
     B, n, m = 16, 3, 6
     A = rng.normal(size=(B, m, n))
     b = rng.normal(size=(B, m)) + 1.0

@@ -121,3 +121,19 @@ def test_run_platform_flag_and_diagnostics(capsys):
                  "--steps", "4", "--set", "record_trajectory=false"]) == 0
     rec = json.loads(capsys.readouterr().out)
     assert rec["max_certificate_residual"] < 1e-3
+
+
+def test_set_types_none_default_fields(capsys):
+    """Optional (None-default) config fields parse --set literals instead
+    of smuggling strings into jit (certificate_pairs=64 used to arrive as
+    "64" and raise TypeError deep inside the joint QP)."""
+    assert main(["run", "swarm", "--steps", "3", "--set", "n=9",
+                 "--set", "certificate=true",
+                 "--set", "certificate_pairs=16"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["config"]["certificate_pairs"] == "16"     # int, repr'd
+    assert "max_certificate_residual" in rec
+    # "none" resets an optional field; numeric strings stay strings only
+    # when they are not numeric.
+    assert main(["run", "swarm", "--steps", "2", "--set", "n=9",
+                 "--set", "gating_window_blocks=none"]) == 0

@@ -285,6 +285,14 @@ def test_double_with_obstacles_sharded_matches_single_device():
             == int(np.asarray(m1.infeasible_count).sum()))
 
 
+def test_certificate_rejected_for_double():
+    """The joint certificate filters velocity commands; double mode
+    outputs accelerations — the combination must refuse, not silently
+    mis-filter."""
+    with pytest.raises(ValueError, match="certificate"):
+        swarm.make(swarm.Config(n=8, dynamics="double", certificate=True))
+
+
 def test_monte_carlo_ladder_shape():
     """The BASELINE.md v4-32 rung shape scaled down: many more ensemble
     members than devices (E=32 seeds x N=16 over dp=8), one sharded
