@@ -327,6 +327,21 @@ def barrier_dynamics(cfg: Config, dtype):
             "rescales the first layer's evasive commands (the post-filter-"
             "saturation pathology Config.speed_limit documents) — the "
             "obstacle barrier would erode with no signal")
+    if cfg.certificate:
+        # The certificate's boundary box (1.5x the spawn half-width, see
+        # make()) must be able to CONTAIN n agents at the certified
+        # spacing, or the joint QP is structurally infeasible every step
+        # and only the post-hoc residual would reveal it. 0.12 is the
+        # CertificateParams safety_radius the step uses; 2x is packing
+        # slack.
+        side = 2 * 1.5 * cfg.spawn_half_width
+        if side * side < 2.0 * cfg.n * 0.12 * 0.12:
+            raise ValueError(
+                f"certificate boundary box ({side:.2f} m square, from "
+                "spawn_half_width) cannot contain "
+                f"n={cfg.n} agents at the certified 0.12 m spacing — the "
+                "joint QP would be structurally infeasible; widen "
+                "spawn_half_width_override or disable the certificate")
     if cfg.dynamics == "unicycle":
         if not cfg.projection_distance > 0:
             raise ValueError(

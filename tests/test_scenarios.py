@@ -197,3 +197,8 @@ def test_swarm_certificate_guards():
     with pytest.raises(NotImplementedError, match="certificate"):
         tuning.make_loss_fn(swarm.Config(n=8, certificate=True),
                             make_mesh(n_dp=1, n_sp=1))
+    # A boundary box too small for n agents at the certified spacing would
+    # make the joint QP structurally infeasible every step.
+    with pytest.raises(ValueError, match="boundary box"):
+        swarm.make(swarm.Config(n=256, certificate=True,
+                                spawn_half_width_override=0.5))
