@@ -88,3 +88,14 @@ def test_post_training_safety_floor_holds():
     floor = 0.92 * dmin / np.sqrt(2)
     assert md > floor, f"min {md:.4f} <= trained floor {floor:.4f}"
     assert int(np.asarray(outs.infeasible_count).sum()) == 0
+
+
+def test_dynamics_families_example(tmp_path):
+    """The three-family comparison demo runs end-to-end and writes its
+    artifacts; every family's floor holds in the short demo horizon."""
+    mod = _load("dynamics_families")
+    summary = mod.main(n=32, steps=80, media_dir=str(tmp_path))
+    assert set(summary) == {"single", "unicycle", "double"}
+    for dyn, row in summary.items():
+        assert row["floor"] > 0.12, dyn
+    assert (tmp_path / "dynamics_families.csv").exists()
