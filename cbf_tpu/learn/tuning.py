@@ -83,23 +83,26 @@ def params_to_cbf(p: TunableParams, max_speed: float) -> CBFParams:
 
 
 def make_loss_fn(cfg: swarm_scenario.Config, mesh, tc: TrainConfig = TrainConfig()):
-    """Build loss(params, x0, v0) -> scalar over the (dp, sp) mesh.
+    """Build loss(params, *state0) -> scalar over the (dp, sp) mesh.
 
-    x0, v0: (E, N, 2) ensemble states (shard: dp x sp).
+    ``state0`` is (x0, v0) of (E, N, 2) arrays — plus an (E, N) theta0 in
+    unicycle mode (shard: dp x sp; matches
+    :func:`cbf_tpu.parallel.ensemble.ensemble_initial_states`). The
+    rollout differentiates through every family's physics — for unicycle
+    that includes the si<->uni trig maps and the wheel-saturation scaling
+    (piecewise-smooth; subgradients at the saturation knee).
     """
-    if cfg.dynamics == "unicycle":
-        raise NotImplementedError(
-            "the trainer's loss plumbing carries (x, v) pair states; "
-            "unicycle (pose-state) training is not wired — train in "
-            "single/double mode (the filter parameters are shared)")
     if cfg.certificate:
         raise NotImplementedError(
-            "the trainer rolls out through _local_swarm_step, which does "
-            "not apply the joint-certificate second layer — training a "
-            "certificate=True config would silently score uncertified "
-            "rollouts; train with certificate=False")
+            "certificate=True training is not supported: differentiating "
+            "the joint ADMM's fixed 250-iteration inner loop through the "
+            "rollout is unvalidated and memory-heavy — train with "
+            "certificate=False (filter parameters transfer; the second "
+            "layer is parameter-free)")
 
-    def local_loss(params: TunableParams, x0l, v0l):
+    unicycle = cfg.dynamics == "unicycle"
+
+    def local_loss(params: TunableParams, *state0l):
         # Mode-aware actuator box: in double mode max_speed is the QP's
         # bound on |a| (vel_box_rows=False) and must be the physical
         # accel_limit — training against the 15.0 velocity bound would fit
@@ -107,12 +110,13 @@ def make_loss_fn(cfg: swarm_scenario.Config, mesh, tc: TrainConfig = TrainConfig
         cbf = params_to_cbf(
             params, swarm_scenario.default_cbf(cfg).max_speed)
 
-        def one(x0i, v0i):
+        def one(*state0i):
             def body(carry, t):
-                x, v = carry
-                x2, v2, _, _, nearest = _local_swarm_step(
+                x, v = carry[0], carry[1]
+                th = carry[2] if unicycle else None
+                x2, v2, th2, _, nearest = _local_swarm_step(
                     x, v, cfg, cbf, "sp", unroll_relax=tc.unroll_relax,
-                    compute_metrics=False, t=t)
+                    compute_metrics=False, t=t, theta=th)
                 # Hinge on separation: per-agent nearest-neighbor distance
                 # below the target (clipped to the gating radius when no
                 # neighbor is in range), psum-averaged across shards.
@@ -125,22 +129,25 @@ def make_loss_fn(cfg: swarm_scenario.Config, mesh, tc: TrainConfig = TrainConfig
                 track = lax.psum(
                     jnp.sum(jnp.maximum(d_c - cfg.pack_radius, 0.0) ** 2),
                     "sp") / cfg.n
-                return (x2, v2), track + tc.safety_weight * sep
+                new = (x2, v2, th2) if unicycle else (x2, v2)
+                return new, track + tc.safety_weight * sep
 
             step_body = jax.checkpoint(body) if tc.remat else body
-            _, losses = lax.scan(step_body, (x0i, v0i),
+            _, losses = lax.scan(step_body, state0i,
                                  jnp.arange(tc.steps))
             return jnp.mean(losses)
 
-        per_ens = jax.vmap(one)(x0l, v0l)                      # (E_local,)
+        per_ens = jax.vmap(one)(*state0l)                      # (E_local,)
         total = lax.psum(jnp.sum(per_ens), "dp")
         count = lax.psum(per_ens.shape[0] * 1.0, "dp")
         return total / count
 
     spec_state = P("dp", "sp", None)
+    state_specs = ((spec_state, spec_state, P("dp", "sp")) if unicycle
+                   else (spec_state, spec_state))
     wrapped = shard_map(
         local_loss, mesh,
-        in_specs=(P(), spec_state, spec_state),
+        in_specs=(P(),) + state_specs,
         out_specs=P(),
     )
     return wrapped
@@ -150,18 +157,19 @@ def make_train_step(cfg: swarm_scenario.Config, mesh,
                     tc: TrainConfig = TrainConfig()):
     """Build (train_step, optimizer).
 
-    ``train_step(params, opt_state, x0, v0) -> (params, opt_state, loss)``
+    ``train_step(params, opt_state, *state) -> (params, opt_state, loss)``
     is one full jitted training step: sharded rollout loss, backward pass
-    through the collectives, optax update. Initialize state with
-    ``optimizer.init(params)`` — use the returned optimizer, not a rebuilt
-    one, so the update rule and state always match.
+    through the collectives, optax update. ``state`` is (x0, v0) — plus
+    theta0 in unicycle mode. Initialize with ``optimizer.init(params)`` —
+    use the returned optimizer, not a rebuilt one, so the update rule and
+    state always match.
     """
     loss_fn = make_loss_fn(cfg, mesh, tc)
     optimizer = optax.adam(tc.learning_rate)
 
     @jax.jit
-    def train_step(params: TunableParams, opt_state, x0, v0):
-        loss, grads = jax.value_and_grad(loss_fn)(params, x0, v0)
+    def train_step(params: TunableParams, opt_state, *state):
+        loss, grads = jax.value_and_grad(loss_fn)(params, *state)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

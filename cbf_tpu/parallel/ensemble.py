@@ -37,7 +37,7 @@ from cbf_tpu.core.filter import CBFParams, safe_controls
 from cbf_tpu.ops import pallas_knn
 from cbf_tpu.parallel.alltoall import exchange_knn
 from cbf_tpu.scenarios import swarm as swarm_scenario
-from cbf_tpu.utils.math import l2_cap, safe_norm
+from cbf_tpu.utils.math import l2_cap, match_vma, safe_norm
 
 
 class EnsembleMetrics(NamedTuple):
@@ -47,6 +47,11 @@ class EnsembleMetrics(NamedTuple):
     # (E, steps) in-radius neighbors dropped by k-NN truncation, summed over
     # agents — the sharded twin of StepOutputs.gating_dropped_count.
     dropped_count: jax.Array
+    # (E, steps) joint-certificate ADMM primal residual — 0.0 when the
+    # second layer is off (the sharded twin of
+    # StepOutputs.certificate_residual; convergence is asserted by the
+    # caller, never assumed).
+    certificate_residual: jax.Array
 
 
 def ensemble_initial_states(cfg: swarm_scenario.Config, seeds):
@@ -144,6 +149,26 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
     engaged = jnp.any(mask, axis=1)
     u = jnp.where(engaged[:, None], u_safe, u0)
 
+    cert_res = jnp.zeros((), x.dtype)
+    if cfg.certificate:
+        # The joint second layer couples ALL of a swarm's agents — pin the
+        # dp-only invariant at the unsafe operation itself (trace-time,
+        # zero runtime cost), not just at today's one validated caller: an
+        # sp-sharded call would otherwise certify only local sub-swarms
+        # and silently report small residuals for them.
+        if lax.axis_size(axis_name) != 1:
+            raise NotImplementedError(
+                "certificate=True requires the whole swarm on one device "
+                "(sp axis size 1); got sp size "
+                f"{lax.axis_size(axis_name)}")
+        # Each member's whole swarm is on one device, so the joint second
+        # layer applies per member exactly as in the scenario step. The
+        # joint QP's internal constants can demote the varying-manual-axes
+        # type under shard_map — re-align with the carry (utils.match_vma).
+        u, cert_res = swarm_scenario.apply_certificate(cfg, u, x)
+        u = match_vma(u, x)
+    cert_res = match_vma(cert_res, x)
+
     theta_new = None
     if unicycle:
         x_new, theta_new, p_new = swarm_scenario.unicycle_apply(
@@ -158,6 +183,7 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
             lax.psum(jnp.sum(engaged), axis_name),
             lax.psum(jnp.sum(~info.feasible & engaged), axis_name),
             lax.psum(jnp.sum(dropped), axis_name),
+            lax.pmax(cert_res, axis_name),
         )
     return x_new, v_new, theta_new, metrics, nearest1
 
@@ -179,19 +205,19 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
     (E, N, 2) / (E, N) global shapes, EnsembleMetrics).
     """
     steps = cfg.steps if steps is None else steps
-    if cfg.certificate:
-        raise NotImplementedError(
-            "the joint-certificate second layer is scenario-level (its 2N-"
-            "variable QP couples all agents and is not sp-shardable as "
-            "built) — run certificate configs via scenarios.swarm / "
-            "rollout_chunked; the sharded ensemble would otherwise return "
-            "uncertified trajectories under a certificate=True config")
     if cbf is None:
         cbf = swarm_scenario.default_cbf(cfg)
     unicycle = cfg.dynamics == "unicycle"
     parts = 3 if unicycle else 2
     E = len(seeds)
     n_dp, n_sp = mesh.shape["dp"], mesh.shape["sp"]
+    if cfg.certificate and n_sp > 1:
+        raise NotImplementedError(
+            "the joint-certificate second layer couples ALL of a swarm's "
+            "agents (2N-variable QP) and is not sp-shardable — run "
+            "certificate ensembles dp-only (n_sp=1: each member whole on "
+            "its device), where it applies per member exactly as in the "
+            "scenario step")
     if E % n_dp or cfg.n % n_sp:
         raise ValueError(
             f"E={E} must divide by dp={n_dp} and N={cfg.n} by sp={n_sp}")
@@ -244,7 +270,8 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
         local_rollout, mesh,
         in_specs=in_specs,
         out_specs=in_specs + (
-            (spec_metric, spec_metric, spec_metric, spec_metric),),
+            (spec_metric, spec_metric, spec_metric, spec_metric,
+             spec_metric),),
     )
     out = jax.jit(fn)(*state0)
     return tuple(out[:parts]), EnsembleMetrics(*out[parts])

@@ -578,6 +578,23 @@ def unicycle_apply(cfg: Config, body_xy, theta, u_si):
             new_poses[2], p_new)
 
 
+def apply_certificate(cfg: Config, u, x):
+    """The joint second layer over already-filtered si velocities (see
+    Config.certificate). Shared by the scenario step and the dp-sharded
+    ensemble (each member's whole swarm on one device). Returns
+    (u_certified (N, 2), primal_residual scalar)."""
+    from cbf_tpu.sim.certificates import (CertificateParams,
+                                          si_barrier_certificate)
+    half = cfg.spawn_half_width * 1.5
+    pairs = (cfg.certificate_pairs if cfg.certificate_pairs is not None
+             else 8 * cfg.n)
+    u_cert, cinfo = si_barrier_certificate(
+        u.T, x.T, CertificateParams(magnitude_limit=cfg.speed_limit),
+        max_pairs=pairs, with_info=True,
+        arena=(-half, half, -half, half))
+    return u_cert.T, cinfo.primal_residual
+
+
 def integrate(cfg: Config, x, v, u):
     """(x_new, v_new) for the configured dynamics: semi-implicit Euler in
     double mode (the update the barrier rows discretize exactly), the
@@ -730,18 +747,7 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
         if cfg.certificate:
             # Second layer of the reference's stack: the joint certificate
             # over the already-filtered si velocities (see Config).
-            from cbf_tpu.sim.certificates import (CertificateParams,
-                                                  si_barrier_certificate)
-            half = cfg.spawn_half_width * 1.5
-            pairs = (cfg.certificate_pairs if cfg.certificate_pairs
-                     is not None else 8 * cfg.n)
-            u_cert, cinfo = si_barrier_certificate(
-                u.T, x.T, CertificateParams(
-                    magnitude_limit=cfg.speed_limit),
-                max_pairs=pairs, with_info=True,
-                arena=(-half, half, -half, half))
-            u = u_cert.T
-            cert_residual = cinfo.primal_residual
+            u, cert_residual = apply_certificate(cfg, u, x)
 
         deficit = ()
         if unicycle:

@@ -79,9 +79,15 @@ def solve_box_qp_admm(P, q, A, l, u, settings: ADMMSettings = ADMMSettings()):
         y_new = y + rho * (Ax_relaxed - z_new)
         return (x_new, z_new, y_new)
 
-    x0 = jnp.zeros((n,), dtype)
-    z0 = jnp.zeros((m,), dtype)
-    y0 = jnp.zeros((m,), dtype)
+    # Under shard_map the zero-initialized carries are 'invariant' while
+    # the problem data is device-varying; the fori_loop carry then changes
+    # type across iterations and tracing fails — align up front (no-op
+    # outside shard_map; see utils.math.match_vma).
+    from cbf_tpu.utils.math import match_vma
+
+    x0 = match_vma(jnp.zeros((n,), dtype), q)
+    z0 = match_vma(jnp.zeros((m,), dtype), A[:, 0])
+    y0 = match_vma(jnp.zeros((m,), dtype), A[:, 0])
     x, z, y = lax.fori_loop(0, settings.iters, step, (x0, z0, y0))
 
     Ax = A_orig @ x

@@ -123,18 +123,38 @@ def test_unicycle_fast_obstacles_bounded_and_surfaced():
     assert float(np.asarray(outs.saturation_deficit).max()) > 0.05
 
 
-def test_unicycle_validation_and_trainer_guard():
+def test_unicycle_validation():
     with pytest.raises(ValueError, match="projection_distance"):
         swarm.make(swarm.Config(n=8, dynamics="unicycle",
                                 projection_distance=0.0))
     # The safety contract requires commands boxed at what wheels can do.
     with pytest.raises(ValueError, match="wheel-realizable"):
         swarm.make(swarm.Config(n=8, dynamics="unicycle", speed_limit=0.5))
+
+
+def test_unicycle_training_descends_through_pose_state():
+    """The trainer carries the heading as a third sharded state array and
+    differentiates through the si<->uni trig maps and the wheel-saturation
+    scaling: finite losses, moving parameters."""
     from cbf_tpu.learn import tuning
     from cbf_tpu.parallel import make_mesh
-    with pytest.raises(NotImplementedError, match="unicycle"):
-        tuning.make_loss_fn(swarm.Config(n=8, dynamics="unicycle"),
-                            make_mesh(n_dp=1, n_sp=1))
+    from cbf_tpu.parallel.ensemble import ensemble_initial_states
+
+    cfg = swarm.Config(n=32, steps=0, dynamics="unicycle",
+                       spawn_half_width_override=0.6)
+    mesh = make_mesh(n_dp=4, n_sp=2)
+    ts, opt = tuning.make_train_step(cfg, mesh,
+                                     tuning.TrainConfig(steps=6,
+                                                        unroll_relax=2))
+    params = tuning.init_params()
+    state0 = ensemble_initial_states(cfg, list(range(4)))
+    st = opt.init(params)
+    losses = []
+    for _ in range(3):
+        params, st, loss = ts(params, st, *state0)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert float(params.gamma_raw) != float(tuning.init_params().gamma_raw)
 
 
 def test_unicycle_initial_state_laws_match():
