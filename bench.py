@@ -31,8 +31,9 @@ Modes / env knobs:
     swarm (workload is labeled in the metric + record; its vs_baseline is
     still against the obstacle-free target rate).
   BENCH_DYNAMICS (single) — dynamics family; "double" benches the
-    acceleration-controlled model (labeled in metric + record, gated at
-    its own documented floor).
+    acceleration-controlled model, "unicycle" the wheel-saturated
+    Robotarium model (each labeled in metric + record and gated at its
+    own calibrated floor; any other value is rejected up front).
   BENCH_PROFILE=<dir> — capture a jax.profiler device trace of the
     measured window (TensorBoard trace-viewer format) into <dir>; the
     wall number still excludes warmup but includes tracing overhead, so
@@ -72,6 +73,28 @@ SAFETY_FLOOR = 0.13
 # ~0.0003, so 0.08 passes every measured transient with margin while
 # rejecting any collapse unambiguously.
 SAFETY_FLOOR_DOUBLE = 0.08
+# dynamics="unicycle": min distance is measured on the projection points
+# the filter guarantees; wheel saturation erodes it slightly below the
+# single-mode L1 floor but it does NOT decay with scale (measured
+# transient mins 0.1272 at N=1024 and 0.1273 at N=4096 x 1000 CPU steps,
+# zero infeasible — docs/BENCH_LOG.md round-4 calibration; >=0.138 at
+# N<=256, tests/test_unicycle_swarm.py). 0.11 passes every measured
+# transient with margin while rejecting any collapse.
+SAFETY_FLOOR_UNICYCLE = 0.11
+
+
+def _dynamics_floor(dynamics: str) -> float:
+    """The calibrated safety floor for a BENCH_DYNAMICS value — and the
+    validation choke point: an unknown family must fail loudly (ValueError
+    = permanent, no retry) rather than fall through to a floor that was
+    never measured for it."""
+    floors = {"single": SAFETY_FLOOR, "double": SAFETY_FLOOR_DOUBLE,
+              "unicycle": SAFETY_FLOOR_UNICYCLE}
+    if dynamics not in floors:
+        raise ValueError(
+            f"BENCH_DYNAMICS={dynamics!r} has no calibrated safety floor "
+            f"(known: {sorted(floors)})")
+    return floors[dynamics]
 
 RC_RETRYABLE = 2      # wedge/timeout/init failure — try again
 RC_PERMANENT = 3      # safety violation or real error — don't retry
@@ -262,6 +285,7 @@ def _child_single(n: int, steps: int) -> dict:
     gating = os.environ.get("BENCH_GATING", "auto")
     n_obstacles = _env_int("BENCH_N_OBSTACLES", 0)
     dynamics = os.environ.get("BENCH_DYNAMICS", "single")
+    _dynamics_floor(dynamics)   # validate BEFORE the run, not after it
     cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
                        gating=gating, n_obstacles=n_obstacles,
                        dynamics=dynamics)
@@ -307,9 +331,7 @@ def _child_single(n: int, steps: int) -> dict:
           f"{compile_and_first:.1f}s), min_dist={min_dist:.4f}, "
           f"infeasible={infeasible}, knn_dropped={dropped}", file=sys.stderr)
 
-    err = _check_safety(min_dist, infeasible,
-                        floor=(SAFETY_FLOOR_DOUBLE if dynamics == "double"
-                               else SAFETY_FLOOR))
+    err = _check_safety(min_dist, infeasible, floor=_dynamics_floor(dynamics))
     if err:
         return {"error": err, "retryable": False}
 
@@ -356,6 +378,7 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
     mesh = make_mesh(n_dp=chips, n_sp=1, devices=devices)
     n_obstacles = _env_int("BENCH_N_OBSTACLES", 0)
     dynamics = os.environ.get("BENCH_DYNAMICS", "single")
+    _dynamics_floor(dynamics)   # validate BEFORE the run, not after it
     cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
                        n_obstacles=n_obstacles, dynamics=dynamics)
     seeds = list(range(E))
@@ -384,9 +407,7 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
 
     # Gate on safety before spending two more rollouts on the efficiency
     # baseline — a violating run is a permanent failure either way.
-    err = _check_safety(min_dist, infeasible,
-                        floor=(SAFETY_FLOOR_DOUBLE if dynamics == "double"
-                               else SAFETY_FLOOR))
+    err = _check_safety(min_dist, infeasible, floor=_dynamics_floor(dynamics))
     if err:
         print(f"bench: wall={wall:.3f}s, min_dist={min_dist:.4f}, "
               f"infeasible={infeasible}", file=sys.stderr)

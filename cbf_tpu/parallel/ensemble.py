@@ -8,7 +8,9 @@ embarrassingly parallel), and each swarm's agents optionally sharded over
 pairwise neighbor search — one ``all_gather`` of the compact states at
 practical sizes, the ``ppermute`` ring beyond the slab-memory threshold.
 The only cross-device traffic is that exchange collective (ICI), the
-per-step psum for the global centroid, and pmin metric reductions.
+per-step psum for the global centroid, pmin metric reductions, and — when
+the joint certificate layer is on — one (N, 4)-sized all_gather per step
+feeding the replicated joint solve (see _local_swarm_step).
 """
 
 from __future__ import annotations
@@ -52,6 +54,16 @@ class EnsembleMetrics(NamedTuple):
     # StepOutputs.certificate_residual; convergence is asserted by the
     # caller, never assumed).
     certificate_residual: jax.Array
+    # (E, steps) sparse-certificate k-slot truncation count (the sharded
+    # twin of StepOutputs.certificate_dropped_count; 0 when the second
+    # layer is off or dense).
+    certificate_dropped: jax.Array
+    # (E, steps) max over agents of ||commanded - realized|| si velocity —
+    # 0.0 outside unicycle mode (the sharded twin of
+    # StepOutputs.saturation_deficit: wheel saturation erodes the filtered
+    # command, and the erosion must be as observable sharded as it is in
+    # the scenario step).
+    saturation_deficit: jax.Array
 
 
 def ensemble_initial_states(cfg: swarm_scenario.Config, seeds):
@@ -150,30 +162,44 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
     u = jnp.where(engaged[:, None], u_safe, u0)
 
     cert_res = jnp.zeros((), x.dtype)
+    cert_dropped = jnp.zeros((), jnp.int32)
     if cfg.certificate:
-        # The joint second layer couples ALL of a swarm's agents — pin the
-        # dp-only invariant at the unsafe operation itself (trace-time,
-        # zero runtime cost), not just at today's one validated caller: an
-        # sp-sharded call would otherwise certify only local sub-swarms
-        # and silently report small residuals for them.
-        if lax.axis_size(axis_name) != 1:
-            raise NotImplementedError(
-                "certificate=True requires the whole swarm on one device "
-                "(sp axis size 1); got sp size "
-                f"{lax.axis_size(axis_name)}")
-        # Each member's whole swarm is on one device, so the joint second
-        # layer applies per member exactly as in the scenario step. The
-        # joint QP's internal constants can demote the varying-manual-axes
-        # type under shard_map — re-align with the carry (utils.match_vma).
-        u, cert_res = swarm_scenario.apply_certificate(cfg, u, x)
+        # The joint second layer couples ALL of a swarm's agents, so it can
+        # never run on a local sub-swarm (that would certify fragments and
+        # report small residuals for them). sp size 1: each member's whole
+        # swarm is on one device and the joint layer applies per member
+        # exactly as in the scenario step. sp > 1: all-gather the (tiny)
+        # joint-QP inputs — (N, 2) positions + (N, 2) filtered velocities —
+        # and solve the SAME joint QP replicated on every sp shard, each
+        # keeping its local slice. Replication costs sp-fold redundant
+        # certificate compute but zero in-loop communication (one gather
+        # per step), and is exactly the dp-only math — the sparse backend
+        # (Config.certificate_backend) keeps that redundant solve O(N*k).
+        if lax.axis_size(axis_name) == 1:
+            u, cert_res, cert_dropped = \
+                swarm_scenario.apply_certificate(cfg, u, x)
+        else:
+            xg = lax.all_gather(x, axis_name, axis=0, tiled=True)
+            ug = lax.all_gather(u, axis_name, axis=0, tiled=True)
+            ug, cert_res, cert_dropped = \
+                swarm_scenario.apply_certificate(cfg, ug, xg)
+            i0 = lax.axis_index(axis_name) * x.shape[0]
+            u = lax.dynamic_slice_in_dim(ug, i0, x.shape[0], axis=0)
+        # The joint QP's internal constants can demote the varying-manual-
+        # axes type under shard_map — re-align with the carry
+        # (utils.match_vma).
         u = match_vma(u, x)
     cert_res = match_vma(cert_res, x)
 
     theta_new = None
+    deficit = jnp.zeros((), x.dtype)
     if unicycle:
         x_new, theta_new, p_new = swarm_scenario.unicycle_apply(
             cfg, body, theta, u)
         v_new = (p_new - x) / cfg.dt
+        # Wheel saturation erodes the filtered command (scenario step's
+        # saturation_deficit) — same observable, sharded.
+        deficit = jnp.max(safe_norm(u - v_new))
     else:
         x_new, v_new = swarm_scenario.integrate(cfg, x, v, u)
     metrics = None
@@ -184,6 +210,10 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
             lax.psum(jnp.sum(~info.feasible & engaged), axis_name),
             lax.psum(jnp.sum(dropped), axis_name),
             lax.pmax(cert_res, axis_name),
+            # pmax, not psum: under sp > 1 every shard computes the SAME
+            # replicated joint solve — summing would sp-fold-count it.
+            lax.pmax(match_vma(cert_dropped, x), axis_name),
+            lax.pmax(match_vma(deficit, x), axis_name),
         )
     return x_new, v_new, theta_new, metrics, nearest1
 
@@ -211,13 +241,6 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
     parts = 3 if unicycle else 2
     E = len(seeds)
     n_dp, n_sp = mesh.shape["dp"], mesh.shape["sp"]
-    if cfg.certificate and n_sp > 1:
-        raise NotImplementedError(
-            "the joint-certificate second layer couples ALL of a swarm's "
-            "agents (2N-variable QP) and is not sp-shardable — run "
-            "certificate ensembles dp-only (n_sp=1: each member whole on "
-            "its device), where it applies per member exactly as in the "
-            "scenario step")
     if E % n_dp or cfg.n % n_sp:
         raise ValueError(
             f"E={E} must divide by dp={n_dp} and N={cfg.n} by sp={n_sp}")
@@ -270,8 +293,7 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
         local_rollout, mesh,
         in_specs=in_specs,
         out_specs=in_specs + (
-            (spec_metric, spec_metric, spec_metric, spec_metric,
-             spec_metric),),
+            (spec_metric,) * len(EnsembleMetrics._fields),),
     )
     out = jax.jit(fn)(*state0)
     return tuple(out[:parts]), EnsembleMetrics(*out[parts])

@@ -46,6 +46,11 @@ class StepOutputs(NamedTuple):
     # convergence is asserted from this, never assumed); () where no
     # certificate runs.
     certificate_residual: Any = ()
+    # Sparse-certificate k-slot truncation: in-binding-radius pairs that
+    # did not fit an agent's certificate_k rows this step (the farthest =
+    # slackest rows, but a dropped pair is a weaker QP — observable, never
+    # swallowed); () where no certificate runs, 0 on the dense backend.
+    certificate_dropped_count: Any = ()
     # Unicycle mode: worst per-agent |commanded - realized| si speed this
     # step — wheel saturation truncating a commanded evasion is an
     # actuation deficit the filter cannot see, so it must be observable

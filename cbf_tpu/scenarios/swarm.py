@@ -160,9 +160,16 @@ class Config:
     # boundary rows use the swarm's own spawn box, not the 3.2 m x 2 m
     # Robotarium arena the crowd outgrows. Velocity-space: valid for
     # single/unicycle commands, rejected for double (accelerations).
-    # Practical to mid N (the dense joint QP is quadratic in N).
     certificate: bool = False
     certificate_pairs: int | None = None   # None = 8*n heuristic
+    # Joint-QP backend: "dense" (solvers.admm — materialized rows +
+    # Cholesky, quadratic in N), "sparse" (solvers.sparse_admm — each
+    # agent owns certificate_k rows to its nearest sub-half-meter
+    # neighbors, matrix-free ADMM+CG, O(N*k) — the swarm-scale path), or
+    # "auto": dense to n=128 (bit-parity with the scenario-scale tests),
+    # sparse beyond (where dense memory/factorization walls out).
+    certificate_backend: str = "auto"
+    certificate_k: int = 16
     # Double mode only: short-range separation term in the nominal (see
     # separation_bias). sep_target is the spacing below which pairs repel —
     # default = the packed-disk design spacing (pack density 1/(pi r^2)
@@ -327,6 +334,18 @@ def barrier_dynamics(cfg: Config, dtype):
             "rescales the first layer's evasive commands (the post-filter-"
             "saturation pathology Config.speed_limit documents) — the "
             "obstacle barrier would erode with no signal")
+    if cfg.certificate and cfg.certificate_backend not in ("auto", "dense",
+                                                           "sparse"):
+        raise ValueError(
+            f"certificate_backend must be auto|dense|sparse, got "
+            f"{cfg.certificate_backend!r}")
+    if (cfg.certificate and cfg.certificate_pairs is not None
+            and certificate_backend(cfg) == "sparse"):
+        raise ValueError(
+            "certificate_pairs tunes the DENSE backend's tightest-pairs "
+            "pruning; the resolved backend here is sparse, which prunes "
+            "per-agent — set certificate_k instead (or force "
+            "certificate_backend='dense')")
     if cfg.certificate:
         # The certificate's boundary box (1.5x the spawn half-width, see
         # make()) must be able to CONTAIN n agents at the certified
@@ -551,6 +570,15 @@ def relax_tiers(cfg: Config, mask, priority):
     agent-vs-obstacle tiering needs an uncapped tier to stay feasible, so
     it is a single-mode refinement — not applied here).
 
+    Unicycle mode intentionally shares the uniform eps tier: its
+    *realized* si authority is also actuation-bounded (the wheel-speed
+    saturation in unicycle_apply can erode the commanded velocity, see
+    StepOutputs.saturation_deficit), so the same squeezed-agent physics
+    applies and a one-round +1 relax could neuter rows it cannot actually
+    honor. The obstacle-priority tier and per-row relax cap remain
+    single-mode refinements — their feasibility argument leans on velocity
+    control's full per-step authority, which neither family has.
+
     Single mode: obstacle rows (when present) are the priority tier and
     agent rows carry the per-row relax cap.
     """
@@ -578,21 +606,38 @@ def unicycle_apply(cfg: Config, body_xy, theta, u_si):
             new_poses[2], p_new)
 
 
+def certificate_backend(cfg: Config) -> str:
+    """Resolve Config.certificate_backend ("auto" -> dense to n=128,
+    sparse beyond — see the Config field comment)."""
+    if cfg.certificate_backend == "auto":
+        return "dense" if cfg.n <= 128 else "sparse"
+    return cfg.certificate_backend
+
+
 def apply_certificate(cfg: Config, u, x):
     """The joint second layer over already-filtered si velocities (see
-    Config.certificate). Shared by the scenario step and the dp-sharded
-    ensemble (each member's whole swarm on one device). Returns
-    (u_certified (N, 2), primal_residual scalar)."""
+    Config.certificate). Shared by the scenario step and the sharded
+    ensemble. Returns (u_certified (N, 2), primal_residual scalar,
+    dropped_count int32 scalar — sparse-backend k-slot truncation of
+    in-binding-radius pairs, the one degradation signal that backend
+    emits; 0 on the dense backend, whose max_pairs pruning keeps the
+    globally tightest rows and is covered by its own exactness test)."""
     from cbf_tpu.sim.certificates import (CertificateParams,
-                                          si_barrier_certificate)
+                                          si_barrier_certificate,
+                                          si_barrier_certificate_sparse)
     half = cfg.spawn_half_width * 1.5
+    params = CertificateParams(magnitude_limit=cfg.speed_limit)
+    arena = (-half, half, -half, half)
+    if certificate_backend(cfg) == "sparse":
+        u_cert, cinfo = si_barrier_certificate_sparse(
+            u.T, x.T, params, k=cfg.certificate_k, with_info=True,
+            arena=arena)
+        return u_cert.T, cinfo.primal_residual, cinfo.dropped_count
     pairs = (cfg.certificate_pairs if cfg.certificate_pairs is not None
              else 8 * cfg.n)
     u_cert, cinfo = si_barrier_certificate(
-        u.T, x.T, CertificateParams(magnitude_limit=cfg.speed_limit),
-        max_pairs=pairs, with_info=True,
-        arena=(-half, half, -half, half))
-    return u_cert.T, cinfo.primal_residual
+        u.T, x.T, params, max_pairs=pairs, with_info=True, arena=arena)
+    return u_cert.T, cinfo.primal_residual, jnp.zeros((), jnp.int32)
 
 
 def integrate(cfg: Config, x, v, u):
@@ -744,10 +789,11 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
         u = jnp.where(engaged[:, None], u_safe, u0)
 
         cert_residual = ()
+        cert_dropped = ()
         if cfg.certificate:
             # Second layer of the reference's stack: the joint certificate
             # over the already-filtered si velocities (see Config).
-            u, cert_residual = apply_certificate(cfg, u, x)
+            u, cert_residual, cert_dropped = apply_certificate(cfg, u, x)
 
         deficit = ()
         if unicycle:
@@ -771,6 +817,7 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
             gating_overflow_count=overflow_count,
             gating_dropped_count=jnp.sum(dropped),
             certificate_residual=cert_residual,
+            certificate_dropped_count=cert_dropped,
             saturation_deficit=deficit,
         )
         return new_state, out

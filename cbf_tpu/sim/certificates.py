@@ -24,14 +24,28 @@ import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
+from cbf_tpu.ops.pairwise import pairwise_distances
 from cbf_tpu.sim.robotarium import ARENA
 from cbf_tpu.solvers.admm import ADMMSettings, solve_box_qp_admm
+from cbf_tpu.solvers.sparse_admm import (SparseADMMSettings,
+                                         solve_pair_box_qp_admm)
 
 
 class CertificateParams(NamedTuple):
     barrier_gain: float = 100.0
     safety_radius: float = 0.12     # scenarios pass 0.12 (meet_at_center.py:58)
     magnitude_limit: float = 0.2
+
+
+class SparseCertificateInfo(NamedTuple):
+    primal_residual: jnp.ndarray
+    dual_residual: jnp.ndarray
+    # In-binding-radius pairs covered by NEITHER endpoint's k row slots
+    # (a pair kept from either side is fully enforced — the rows are
+    # identical), each lost pair counted once: the truncation the sparse
+    # path applies relative to the dense all-pairs rows; callers surface
+    # it, never swallow it.
+    dropped_count: jnp.ndarray
 
 
 def si_barrier_certificate(dxi, x, params: CertificateParams = CertificateParams(),
@@ -120,4 +134,129 @@ def si_barrier_certificate(dxi, x, params: CertificateParams = CertificateParams
     out = u.reshape(N, 2).T
     if with_info:
         return out, info
+    return out
+
+
+def binding_pair_radius(params: CertificateParams,
+                        headroom: float = 1.25) -> float:
+    """Smallest separation beyond which a pair row can NEVER bind, from the
+    params themselves (not a hard-coded default): the row's LHS is bounded
+    by ``|2 err . (u_I - u_J)| <= 4 d m`` (d = separation, m = the
+    magnitude pre-limit) while its margin is ``gain (d^2 - r^2)^3`` —
+    cubic beats linear, so past the crossing the constraint is
+    structurally slack whatever the solver does. Host-side bisection at
+    trace time (static — shapes depend on it only through the caller's k),
+    with multiplicative ``headroom`` on top. This is the same slack
+    argument the dense path's ``max_pairs`` pruning rests on; deriving it
+    from (gain, r, m) keeps the sparse backend exact for *any* caller
+    magnitude limit (e.g. swarm configs raising speed_limit), where a
+    fixed 0.5 m would silently under-constrain."""
+    gain, r, m = params.barrier_gain, params.safety_radius, \
+        params.magnitude_limit
+    lo = r
+    hi = max(4.0 * r, 1.0)
+    while gain * (hi * hi - r * r) ** 3 < 4.0 * hi * m:
+        hi *= 2.0
+        if hi > 1e6:   # degenerate params (gain ~ 0): nothing ever slack
+            return float("inf")
+    for _ in range(60):
+        mid = 0.5 * (lo + hi)
+        if gain * (mid * mid - r * r) ** 3 < 4.0 * mid * m:
+            lo = mid
+        else:
+            hi = mid
+    return float(hi * headroom)
+
+
+def si_barrier_certificate_sparse(
+        dxi, x, params: CertificateParams = CertificateParams(),
+        settings: SparseADMMSettings = SparseADMMSettings(),
+        k: int = 32, pair_radius: float | None = None,
+        with_info: bool = False, arena: tuple | None = ARENA):
+    """Swarm-scale joint certificate: same guarantee surface as
+    :func:`si_barrier_certificate`, O(N*k) instead of O(N^2).
+
+    Each agent owns ``k`` constraint rows to its nearest in-radius
+    neighbors (pairs may appear twice — once from each endpoint — which
+    leaves the QP's feasible set and minimizer unchanged), the arena rows
+    become a per-component box, and the whole thing solves matrix-free
+    (:mod:`cbf_tpu.solvers.sparse_admm`): no (R, 2N) matrix, no 2N x 2N
+    factorization. ``pair_radius`` defaults to
+    :func:`binding_pair_radius` — the separation past which the cubic
+    margin makes a row structurally slack for THESE params — so with
+    adequate ``k`` the solution matches the dense certificate; in-radius
+    pairs covered by NEITHER endpoint's k slots are counted in the
+    returned info, each lost pair once (a pair kept from either side is
+    fully enforced; lost pairs are the *farthest* = slackest rows, the
+    gating.knn_gating degradation argument) and callers must surface
+    that count.
+
+    The neighbor search is one exact (N, N) distance matrix + top_k — the
+    same O(N^2) scaling wall as the scenario's jnp gating path; wiring the
+    Pallas k-NN kernel in here is the marked TPU follow-up, the solver
+    itself is already O(N*k).
+
+    Args/returns mirror the dense function: dxi (2, N), x (2, N) ->
+    certified (2, N)[, SparseCertificateInfo].
+    """
+    N = x.shape[1]
+    dtype = jnp.result_type(dxi, x)
+    if pair_radius is None:
+        pair_radius = binding_pair_radius(params)
+
+    norms = jnp.linalg.norm(dxi, axis=0)
+    scale = jnp.maximum(1.0, norms / params.magnitude_limit)
+    u_nom = (dxi / scale[None, :]).T                         # (N, 2)
+
+    xt = x.T                                                 # (N, 2)
+    k = min(k, N - 1)
+    # Exact difference-form distances (shared helper): the MXU expansion's
+    # absolute d^2 error at ~13 m swarm coordinates exceeds the gating
+    # threshold scale on TPU (ops/pairwise.py docstring — measured), which
+    # would silently drop binding pairs AND corrupt the dropped count
+    # derived from the same mask. Same O(N^2) scaling class as the
+    # scenario's jnp gating path; the Pallas k-NN kernel is the marked
+    # TPU follow-up for both.
+    dist = pairwise_distances(xt)                            # (N, N)
+    eligible = (dist < pair_radius) & ~jnp.eye(N, dtype=bool)
+    keyed = jnp.where(eligible, dist, jnp.inf)
+    neg_d, idx = lax.top_k(-keyed, k)                        # (N, k)
+    mask = jnp.isfinite(neg_d)
+    # True coverage gap, not directed slot overflow: pair (i, j) is in the
+    # QP if it fits EITHER endpoint's k slots (the rows are identical), so
+    # count eligible pairs covered by neither — each uncovered pair once.
+    rows = jnp.broadcast_to(jnp.arange(N)[:, None], (N, k))
+    selected = jnp.zeros((N, N), bool).at[
+        rows.reshape(-1), idx.reshape(-1)].max(mask.reshape(-1))
+    covered = selected | selected.T
+    dropped = jnp.sum(eligible & ~covered, dtype=jnp.int32) // 2
+
+    I = jnp.broadcast_to(jnp.arange(N)[:, None], (N, k)).reshape(-1)
+    J = idx.reshape(-1)
+    maskf = mask.reshape(-1)
+    err = xt[I] - xt[J]                                      # (R, 2)
+    h = jnp.sum(err * err, axis=1) - params.safety_radius**2
+    coef = jnp.where(maskf[:, None], -2.0 * err, 0.0).astype(dtype)
+    b_pair = jnp.where(maskf, params.barrier_gain * h**3,
+                       jnp.inf).astype(dtype)
+
+    if arena is not None:
+        xmin, xmax, ymin, ymax = arena
+        r2 = params.safety_radius / 2.0
+        gb = 0.4 * params.barrier_gain
+        hi = jnp.stack([gb * (xmax - r2 - xt[:, 0]) ** 3,
+                        gb * (ymax - r2 - xt[:, 1]) ** 3], axis=1)
+        lo = jnp.stack([-gb * (xt[:, 0] - xmin - r2) ** 3,
+                        -gb * (xt[:, 1] - ymin - r2) ** 3], axis=1)
+        lo, hi = lo.astype(dtype), hi.astype(dtype)
+    else:
+        hi = jnp.full((N, 2), jnp.inf, dtype)
+        lo = -hi
+
+    u, info = solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
+                                     settings)
+    out = u.T
+    if with_info:
+        return out, SparseCertificateInfo(info.primal_residual,
+                                          info.dual_residual, dropped)
     return out

@@ -103,4 +103,31 @@ def restore(directory: str, like: Any, step: int | None = None):
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {directory}")
         abstract = jax.tree.map(_abstract, like)
-        return mgr.restore(step, args=ocp.args.StandardRestore(abstract)), step
+        try:
+            return (mgr.restore(step, args=ocp.args.StandardRestore(abstract)),
+                    step)
+        except Exception as e:
+            # Forward compatibility for grown state pytrees: State gained a
+            # third field (theta, () outside unicycle mode) in round 3, so a
+            # checkpoint written by the 2-field State fails StandardRestore's
+            # structure match against the 3-field template even though the
+            # new field holds no arrays. Retry with the leafless fields
+            # pruned and graft the empty values back. A genuine failure
+            # (shape mismatch, corrupt checkpoint, IO) fails the pruned
+            # retry too — then the ORIGINAL error surfaces, so real errors
+            # are never masked and the detection doesn't depend on parsing
+            # orbax's (version-dependent) mismatch message.
+            empty = [f for f in getattr(like, "_fields", ())
+                     if not jax.tree.leaves(getattr(like, f))]
+            if not empty:
+                raise
+            pruned = {f: getattr(abstract, f) for f in like._fields
+                      if f not in empty}
+            try:
+                restored = mgr.restore(
+                    step, args=ocp.args.StandardRestore(pruned))
+            except Exception:
+                raise e
+            return (type(like)(**restored,
+                               **{f: getattr(like, f) for f in empty}),
+                    step)

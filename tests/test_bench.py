@@ -136,7 +136,10 @@ import time
 json.dump({"metric": "m", "value": 2.5}, open(result_path, "w"))
 time.sleep(60)   # hung release tail; parent kills us at the deadline
 """)
-    result, retryable = bench._run_attempt(2.0, ensemble=False)
+    # Deadline long enough for child startup under a loaded host (the write
+    # must land BEFORE the kill for the salvage to be testable), short
+    # enough to keep the test quick.
+    result, retryable = bench._run_attempt(6.0, ensemble=False)
     assert result == {"metric": "m", "value": 2.5}
     assert retryable is False
 
@@ -149,7 +152,8 @@ json.dump({"error": "safety violation: boom", "retryable": False},
           open(result_path, "w"))
 time.sleep(60)
 """)
-    result, retryable = bench._run_attempt(2.0, ensemble=False)
+    result, retryable = bench._run_attempt(6.0, ensemble=False)   # see above
+
     assert result["error"].startswith("safety violation")
     assert retryable is False
 
@@ -262,3 +266,21 @@ def test_bench_end_to_end_ensemble_mode_cpu():
     # (e.g. a wrong chip-count divisor inflating efficiency ~4x).
     assert 0 < out["scaling_efficiency"] <= 3.0
     assert "knn_dropped=" in stderr
+
+
+def test_dynamics_floor_known_and_rejected():
+    """Every BENCH_DYNAMICS family gates at its own calibrated floor; an
+    unknown value is rejected up front (ValueError = permanent failure)
+    instead of falling through to a floor never measured for it."""
+    assert bench._dynamics_floor("single") == bench.SAFETY_FLOOR
+    assert bench._dynamics_floor("double") == bench.SAFETY_FLOOR_DOUBLE
+    assert bench._dynamics_floor("unicycle") == bench.SAFETY_FLOOR_UNICYCLE
+    with pytest.raises(ValueError, match="no calibrated safety floor"):
+        bench._dynamics_floor("quadrotor")
+
+
+def test_bench_end_to_end_unicycle_dynamics_cpu():
+    out, stderr = _run_bench_e2e({"BENCH_DYNAMICS": "unicycle",
+                                  "BENCH_STEPS": "60"})
+    assert "[dynamics=unicycle]" in out["metric"]
+    assert out["dynamics"] == "unicycle"

@@ -145,3 +145,43 @@ def test_sharded_rollout_rejects_bad_initial_state_shape():
     bad = np.zeros((3, 16, 2), np.float32)
     with pytest.raises(ValueError, match="initial_state"):
         sharded_swarm_rollout(cfg, mesh, [0, 1], initial_state=(bad, bad))
+
+
+def test_restore_pre_theta_checkpoint(tmp_path):
+    """Format-compatibility: a checkpoint written before State gained the
+    theta field (a 2-field pytree) restores against today's 3-field State
+    template — theta is leafless (()) outside unicycle mode, so restore
+    prunes it for the structure match and grafts it back."""
+    import typing
+
+    import jax.numpy as jnp
+
+    class PreThetaState(typing.NamedTuple):   # the round-2 State layout
+        x: jnp.ndarray
+        v: jnp.ndarray
+
+    d = str(tmp_path / "old")
+    old = PreThetaState(x=2 * jnp.ones((4, 2)), v=jnp.ones((4, 2)))
+    ckpt.save(d, 7, old)
+
+    like = swarm.State(x=jnp.zeros((4, 2)), v=jnp.zeros((4, 2)))
+    restored, step = ckpt.restore(d, like)
+    assert step == 7
+    assert isinstance(restored, swarm.State) and restored.theta == ()
+    np.testing.assert_array_equal(np.asarray(restored.x), np.asarray(old.x))
+    np.testing.assert_array_equal(np.asarray(restored.v), np.asarray(old.v))
+
+
+def test_restore_real_errors_not_masked_by_compat_retry(tmp_path):
+    """The pre-theta compatibility retry fires ONLY on the grown-pytree
+    structure mismatch: a genuine error (here: template shapes that don't
+    match the stored arrays) must surface as itself, not as a confusing
+    second restore attempt."""
+    import jax.numpy as jnp
+
+    d = str(tmp_path / "c")
+    ckpt.save(d, 3, swarm.State(x=jnp.ones((4, 2)), v=jnp.ones((4, 2))))
+    bad_like = swarm.State(x=jnp.zeros((9, 2)), v=jnp.zeros((9, 2)))
+    with pytest.raises(Exception) as ei:
+        ckpt.restore(d, bad_like)
+    assert "MISSING" not in str(ei.value)

@@ -1,0 +1,131 @@
+"""Swarm-scale joint certificate: the sparse matrix-free backend
+(solvers.sparse_admm + sim.certificates.si_barrier_certificate_sparse)
+and the sp-sharded replicated joint solve it enables.
+
+The reference's second safety layer (cross_and_rescue.py:162-163) is a
+joint QP over ALL agents; the dense backend materializes O(N^2) rows and
+factors a 2N x 2N system. The sparse backend keeps the same guarantee
+surface at O(N*k) — these tests pin the equivalence and the scale-up.
+"""
+
+import numpy as np
+import pytest
+
+from cbf_tpu.scenarios import swarm
+
+
+def test_sparse_matches_dense_solution():
+    """All-pairs sparse == dense (same constraint set, different solver),
+    and the default pruning (k=16, 0.5 m radius) reproduces it at scenario
+    densities with zero dropped pairs."""
+    import jax.numpy as jnp
+
+    from cbf_tpu.sim.certificates import (si_barrier_certificate,
+                                          si_barrier_certificate_sparse)
+
+    rng = np.random.default_rng(0)
+    N = 48
+    x = jnp.asarray(rng.uniform(-1.2, 1.2, (2, N))
+                    * np.array([[1.0], [0.7]]), jnp.float32)
+    dxi = jnp.asarray(rng.normal(0, 0.3, (2, N)), jnp.float32)
+
+    ud, infod = si_barrier_certificate(dxi, x, with_info=True)
+    us, infos = si_barrier_certificate_sparse(
+        dxi, x, k=N - 1, pair_radius=np.inf, with_info=True)
+    assert float(infod.primal_residual) < 1e-5
+    assert float(infos.primal_residual) < 1e-5
+    np.testing.assert_allclose(np.asarray(us), np.asarray(ud), atol=1e-4)
+
+    up, infop = si_barrier_certificate_sparse(dxi, x, with_info=True)
+    assert int(infop.dropped_count) == 0
+    np.testing.assert_allclose(np.asarray(up), np.asarray(ud), atol=1e-4)
+
+
+def test_sparse_certificate_binds_like_dense():
+    """A genuinely binding configuration (pairs inside the 0.12 m
+    certificate radius moving toward each other): both backends must
+    actually separate the pair, not just agree on slack problems."""
+    import jax.numpy as jnp
+
+    from cbf_tpu.sim.certificates import (si_barrier_certificate,
+                                          si_barrier_certificate_sparse)
+
+    x = jnp.asarray([[-0.05, 0.05, 0.4], [0.0, 0.0, 0.0]], jnp.float32)
+    dxi = jnp.asarray([[0.2, -0.2, 0.0], [0.0, 0.0, 0.0]], jnp.float32)
+
+    ud = si_barrier_certificate(dxi, x)
+    us = si_barrier_certificate_sparse(dxi, x, k=2)
+    np.testing.assert_allclose(np.asarray(us), np.asarray(ud), atol=1e-4)
+    # The head-on closing pair really was stopped (certificate binds).
+    closing = float(us[0, 0] - us[0, 1])
+    assert closing < 0.02, f"pair still closing at {closing}"
+
+
+def test_swarm_certificate_sparse_backend_at_scale():
+    """certificate=True beyond the dense cutoff (auto -> sparse): the
+    certified spacing holds, residuals converge, zero infeasible."""
+    cfg = swarm.Config(n=256, steps=80, certificate=True)
+    assert swarm.certificate_backend(cfg) == "sparse"
+    final, outs = swarm.run(cfg)
+    md = np.asarray(outs.min_pairwise_distance)
+    assert md.min() > 0.138
+    assert float(np.asarray(outs.certificate_residual).max()) < 1e-4
+    assert int(np.asarray(outs.infeasible_count).sum()) == 0
+
+
+def test_swarm_certificate_backends_agree_at_crossover():
+    """Dense and sparse backends produce matching trajectories at the same
+    N (the auto cutoff must not be a behavior cliff)."""
+    base = dict(n=64, steps=40, certificate=True)
+    fd, _ = swarm.run(swarm.Config(**base, certificate_backend="dense"))
+    fs, _ = swarm.run(swarm.Config(**base, certificate_backend="sparse"))
+    np.testing.assert_allclose(np.asarray(fs.x), np.asarray(fd.x), atol=5e-4)
+
+
+def test_certificate_ensemble_sp_sharded_matches_dp_only():
+    """The lifted sp-guard: an sp-sharded certificate ensemble all-gathers
+    the joint-QP inputs and solves the SAME joint QP replicated per shard
+    — member trajectories must match the dp-only (whole-swarm-per-device)
+    run, and the certified spacing must hold."""
+    from cbf_tpu.parallel import make_mesh
+    from cbf_tpu.parallel.ensemble import sharded_swarm_rollout
+
+    cfg = swarm.Config(n=32, steps=60, certificate=True)
+    (x_sp, _), mets_sp = sharded_swarm_rollout(
+        cfg, make_mesh(n_dp=2, n_sp=4), seeds=[0, 1])
+    (x_dp, _), mets_dp = sharded_swarm_rollout(
+        cfg, make_mesh(n_dp=2, n_sp=1), seeds=[0, 1])
+    np.testing.assert_allclose(np.asarray(x_sp), np.asarray(x_dp),
+                               atol=2e-5)
+    assert float(np.asarray(mets_sp.certificate_residual).max()) < 1e-4
+    assert np.asarray(mets_sp.nearest_distance).min() > 0.138
+
+
+def test_binding_pair_radius_tracks_params():
+    """The pair-pruning radius is derived from the params, not hard-coded:
+    a larger magnitude limit (rows can push harder) or smaller gain (margins
+    shallower) must widen it."""
+    from cbf_tpu.sim.certificates import CertificateParams, binding_pair_radius
+
+    base = binding_pair_radius(CertificateParams())
+    assert 0.4 < base < 0.7, base          # defaults land near the old 0.5
+    wider = binding_pair_radius(
+        CertificateParams(magnitude_limit=1.0))
+    assert wider > base
+    assert binding_pair_radius(
+        CertificateParams(barrier_gain=1.0)) > base
+
+
+def test_certificate_dropped_count_surfaced():
+    """A too-small certificate_k at packed density must show up in
+    StepOutputs.certificate_dropped_count — the sparse backend's truncation
+    is observable, never swallowed (and the solve still converges, since
+    dropped rows are the slackest)."""
+    cfg = swarm.Config(n=256, steps=25, certificate=True, certificate_k=2)
+    final, outs = swarm.run(cfg)
+    assert int(np.asarray(outs.certificate_dropped_count).sum()) > 0
+    assert float(np.asarray(outs.certificate_residual).max()) < 1e-4
+    # And the default k at the same density does not truncate.
+    cfg2 = swarm.Config(n=256, steps=25, certificate=True)
+    _, outs2 = swarm.run(cfg2)
+    assert int(np.asarray(outs2.certificate_dropped_count).sum()) == 0
