@@ -30,10 +30,19 @@ Modes / env knobs:
   BENCH_N_OBSTACLES (0) — orbit that many moving obstacles through the
     swarm (workload is labeled in the metric + record; its vs_baseline is
     still against the obstacle-free target rate).
+  BENCH_CHECKPOINT=0 — keep the chunked path but skip the orbax boundary
+    writer (record + banner labeled checkpointed=false). With
+    BENCH_CHUNK=steps this gives the 3-point attribution matrix for the
+    chunked-vs-bare-scan gap: chunking cost, writer cost, fetch cost.
   BENCH_DYNAMICS (single) — dynamics family; "double" benches the
     acceleration-controlled model, "unicycle" the wheel-saturated
     Robotarium model (each labeled in metric + record and gated at its
     own calibrated floor; any other value is rejected up front).
+  BENCH_CERTIFICATE=1 — stack the joint barrier certificate (the second
+    QP of the reference's two-layer stack) on every step; the sparse
+    matrix-free backend engages automatically beyond N=128. Labeled in
+    metric + record; additionally gated on per-step ADMM convergence
+    (max primal residual < 1e-4) and surfacing the dropped-pair count.
   BENCH_PROFILE=<dir> — capture a jax.profiler device trace of the
     measured window (TensorBoard trace-viewer format) into <dir>; the
     wall number still excludes warmup but includes tracing overhead, so
@@ -286,16 +295,19 @@ def _child_single(n: int, steps: int) -> dict:
     n_obstacles = _env_int("BENCH_N_OBSTACLES", 0)
     dynamics = os.environ.get("BENCH_DYNAMICS", "single")
     _dynamics_floor(dynamics)   # validate BEFORE the run, not after it
+    certificate = os.environ.get("BENCH_CERTIFICATE", "0") == "1"
     cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
                        gating=gating, n_obstacles=n_obstacles,
-                       dynamics=dynamics)
+                       dynamics=dynamics, certificate=certificate)
     state0, step = swarm.make(cfg)
     chunk = min(_env_int("BENCH_CHUNK", 1000), steps)
     unroll = _env_int("BENCH_UNROLL", 1)
+    checkpointing = os.environ.get("BENCH_CHECKPOINT", "1") != "0"
 
     print(f"bench: swarm N={n}, steps={steps} (chunk={chunk}, "
           f"unroll={unroll}, gating={gating}, obstacles={n_obstacles}, "
-          f"checkpointed), devices={jax.devices()}", file=sys.stderr)
+          f"checkpointed={checkpointing}), devices={jax.devices()}",
+          file=sys.stderr)
 
     # Warmup: compile every executable the measured run will use — the
     # full-size chunk and, when steps % chunk != 0, the trailing partial
@@ -310,7 +322,7 @@ def _child_single(n: int, steps: int) -> dict:
 
     prof, profiled = _profile_ctx()
 
-    ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
+    ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_") if checkpointing else None
     try:
         with prof:
             t0 = time.time()
@@ -320,7 +332,8 @@ def _child_single(n: int, steps: int) -> dict:
             jax.block_until_ready(final.x)
             wall = time.time() - t0
     finally:
-        shutil.rmtree(ckpt_dir, ignore_errors=True)
+        if ckpt_dir:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
 
     min_dist = float(np.asarray(outs.min_pairwise_distance).min())
     infeasible = int(np.asarray(outs.infeasible_count).sum())
@@ -334,6 +347,16 @@ def _child_single(n: int, steps: int) -> dict:
     err = _check_safety(min_dist, infeasible, floor=_dynamics_floor(dynamics))
     if err:
         return {"error": err, "retryable": False}
+    if certificate:
+        # Fixed-iteration ADMM: convergence is a gate, never an assumption.
+        cert_res = float(np.asarray(outs.certificate_residual).max())
+        cert_dropped = int(np.asarray(outs.certificate_dropped_count).sum())
+        print(f"bench: certificate max_residual={cert_res:.2e}, "
+              f"pairs_dropped={cert_dropped}", file=sys.stderr)
+        if not (cert_res < 1e-4):
+            return {"error": "certificate ADMM did not converge: max "
+                             f"primal residual {cert_res:.2e}",
+                    "retryable": False}
 
     result = {
         "metric": "agent-QP-steps/sec/chip (swarm N=%d)" % n,
@@ -343,7 +366,7 @@ def _child_single(n: int, steps: int) -> dict:
         "steps": steps,
         "chunk": chunk,
         "wall_s": round(wall, 3),
-        "checkpointed": True,
+        "checkpointed": checkpointing,
     }
     if profiled:
         result["profiled"] = True
@@ -358,6 +381,11 @@ def _child_single(n: int, steps: int) -> dict:
         # Same labeling contract for the dynamics family.
         result["metric"] += " [dynamics=%s]" % dynamics
         result["dynamics"] = dynamics
+    if certificate:
+        result["metric"] += " [certificate]"
+        result["certificate"] = True
+        result["certificate_max_residual"] = cert_res
+        result["certificate_pairs_dropped"] = cert_dropped
     return result
 
 

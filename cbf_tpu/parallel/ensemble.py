@@ -15,6 +15,7 @@ feeding the replicated joint solve (see _local_swarm_step).
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import numpy as np
@@ -262,9 +263,32 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
     else:
         state0 = ensemble_initial_states(cfg, seeds)
 
-    E_local = E // n_dp
+    out = _rollout_executable(cfg, mesh, E, steps)(
+        jnp.asarray(t0, jnp.int32), cbf, *state0)
+    return tuple(out[:parts]), EnsembleMetrics(*out[parts])
 
-    def local_rollout(*state0l):
+
+@functools.lru_cache(maxsize=64)
+def _rollout_executable(cfg: swarm_scenario.Config, mesh, E: int, steps: int):
+    """The jitted sharded-rollout program for one (cfg, mesh, E, steps)
+    key — cached so repeat calls re-DISPATCH instead of re-TRACING.
+
+    Rebuilding the shard_map closure + jax.jit per call re-traced and
+    re-lowered the whole multi-hundred-step scan every invocation (~5 s of
+    host work at N=1024 x 200 steps on CPU — 3x the actual compute; the
+    round-3 TPU ensemble bench's 7x per-chip deficit vs the single-swarm
+    path was largely this, since its timed "run" was one such call).
+    ``t0`` and the CBFParams pytree are traced ARGUMENTS, not baked-in
+    constants: resumed chunked runs at different start steps and swept /
+    tuned filter parameters (CBFParams documents its leaves as dynamic,
+    possibly jax.Arrays — unhashable, so they must not be cache-key
+    parts) all share one executable. The key parts that remain are
+    hashable by value (frozen dataclass Config, jax Mesh).
+    """
+    unicycle = cfg.dynamics == "unicycle"
+    E_local = E // mesh.shape["dp"]
+
+    def local_rollout(t0, cbf, *state0l):
         def one(*state0i):
             def body(carry, t):
                 th = carry[2] if unicycle else None
@@ -291,9 +315,8 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
                 else (spec_state, spec_state))
     fn = shard_map(
         local_rollout, mesh,
-        in_specs=in_specs,
+        in_specs=(P(), P()) + in_specs,
         out_specs=in_specs + (
             (spec_metric,) * len(EnsembleMetrics._fields),),
     )
-    out = jax.jit(fn)(*state0)
-    return tuple(out[:parts]), EnsembleMetrics(*out[parts])
+    return jax.jit(fn)

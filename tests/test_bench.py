@@ -284,3 +284,34 @@ def test_bench_end_to_end_unicycle_dynamics_cpu():
                                   "BENCH_STEPS": "60"})
     assert "[dynamics=unicycle]" in out["metric"]
     assert out["dynamics"] == "unicycle"
+
+
+def test_bench_end_to_end_certificate_cpu():
+    """BENCH_CERTIFICATE=1 runs the two-layer stack, labels the record,
+    and gates on ADMM convergence + surfaces the dropped-pair count."""
+    out, stderr = _run_bench_e2e({"BENCH_CERTIFICATE": "1",
+                                  "BENCH_STEPS": "30"})
+    assert "[certificate]" in out["metric"]
+    assert out["certificate"] is True
+    assert out["certificate_max_residual"] < 1e-4
+    assert "certificate max_residual=" in stderr
+
+
+def test_bench_end_to_end_certificate_sparse_cpu():
+    """The certificate bench at N > 128 (auto -> SPARSE backend): exercises
+    the matrix-free joint solve plus its certificate_dropped_count plumbing
+    through the chunked path + gate — the exact program the planned
+    N>=1024 TPU measurement runs (the N=64 test covers only dense)."""
+    out, stderr = _run_bench_e2e({"BENCH_CERTIFICATE": "1", "BENCH_N": "160",
+                                  "BENCH_STEPS": "30"})
+    assert "[certificate]" in out["metric"]
+    assert out["certificate_max_residual"] < 1e-4
+    assert out["certificate_pairs_dropped"] >= 0   # sparse count, surfaced
+
+
+def test_bench_checkpoint_off_labels_record():
+    """BENCH_CHECKPOINT=0 (the chunked-gap attribution knob) must label
+    both the record and the stderr banner as uncheckpointed."""
+    out, stderr = _run_bench_e2e({"BENCH_CHECKPOINT": "0"})
+    assert out["checkpointed"] is False
+    assert "checkpointed=False" in stderr
