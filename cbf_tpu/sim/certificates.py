@@ -172,7 +172,8 @@ def si_barrier_certificate_sparse(
         dxi, x, params: CertificateParams = CertificateParams(),
         settings: SparseADMMSettings = SparseADMMSettings(),
         k: int = 32, pair_radius: float | None = None,
-        with_info: bool = False, arena: tuple | None = ARENA):
+        with_info: bool = False, arena: tuple | None = ARENA,
+        neighbor_backend: str = "auto", pallas_interpret: bool = False):
     """Swarm-scale joint certificate: same guarantee surface as
     :func:`si_barrier_certificate`, O(N*k) instead of O(N^2).
 
@@ -191,14 +192,22 @@ def si_barrier_certificate_sparse(
     gating.knn_gating degradation argument) and callers must surface
     that count.
 
-    The neighbor search is one exact (N, N) distance matrix + top_k — the
-    same O(N^2) scaling wall as the scenario's jnp gating path; wiring the
-    Pallas k-NN kernel in here is the marked TPU follow-up, the solver
-    itself is already O(N*k).
+    Neighbor search: the fused Pallas k-NN kernel on TPU
+    (``neighbor_backend="auto"`` -> ops.pallas_knn when supported), else
+    one exact (N, N) difference-form distance matrix + top_k — the same
+    O(N^2) scaling class as the scenario's jnp gating path (the MXU
+    expansion form is NOT used: its absolute d^2 error at ~13 m swarm
+    coordinates exceeds the threshold scale on TPU, ops/pairwise.py).
+    The kernel excludes exact coincidences (d > 0, the reference's
+    self-exclusion); the jnp path excludes by index — coincident agents
+    cannot occur under the first layer's floor, so the paths agree on
+    every reachable state.
 
     Args/returns mirror the dense function: dxi (2, N), x (2, N) ->
     certified (2, N)[, SparseCertificateInfo].
     """
+    from cbf_tpu.ops import pallas_knn
+
     N = x.shape[1]
     dtype = jnp.result_type(dxi, x)
     if pair_radius is None:
@@ -210,29 +219,43 @@ def si_barrier_certificate_sparse(
 
     xt = x.T                                                 # (N, 2)
     k = min(k, N - 1)
-    # Exact difference-form distances (shared helper): the MXU expansion's
-    # absolute d^2 error at ~13 m swarm coordinates exceeds the gating
-    # threshold scale on TPU (ops/pairwise.py docstring — measured), which
-    # would silently drop binding pairs AND corrupt the dropped count
-    # derived from the same mask. Same O(N^2) scaling class as the
-    # scenario's jnp gating path; the Pallas k-NN kernel is the marked
-    # TPU follow-up for both.
-    dist = pairwise_distances(xt)                            # (N, N)
-    eligible = (dist < pair_radius) & ~jnp.eye(N, dtype=bool)
-    keyed = jnp.where(eligible, dist, jnp.inf)
-    neg_d, idx = lax.top_k(-keyed, k)                        # (N, k)
-    mask = jnp.isfinite(neg_d)
-    # True coverage gap, not directed slot overflow: pair (i, j) is in the
-    # QP if it fits EITHER endpoint's k slots (the rows are identical), so
-    # count eligible pairs covered by neither — each uncovered pair once.
-    rows = jnp.broadcast_to(jnp.arange(N)[:, None], (N, k))
-    selected = jnp.zeros((N, N), bool).at[
-        rows.reshape(-1), idx.reshape(-1)].max(mask.reshape(-1))
-    covered = selected | selected.T
-    dropped = jnp.sum(eligible & ~covered, dtype=jnp.int32) // 2
+    use_pallas = (neighbor_backend == "pallas"
+                  or (neighbor_backend == "auto"
+                      and pallas_knn.supported(N)))
+    if use_pallas:
+        # Same fused-vs-streaming dispatch as knn_gating_pallas: the fused
+        # kernel is VMEM-bound to MAX_N_FUSED; beyond it the blocked
+        # streaming kernel covers supported()'s full range.
+        fn = (pallas_knn.knn_neighbors if N <= pallas_knn.MAX_N_FUSED
+              else pallas_knn.knn_neighbors_blocked)
+        idx, dist_k, _, count = fn(xt, pair_radius, k,
+                                   interpret=pallas_interpret)
+        mask = jnp.isfinite(dist_k)                          # (N, k)
+    else:
+        dist = pairwise_distances(xt)                        # (N, N)
+        eligible = (dist < pair_radius) & ~jnp.eye(N, dtype=bool)
+        keyed = jnp.where(eligible, dist, jnp.inf)
+        neg_d, idx = lax.top_k(-keyed, k)                    # (N, k)
+        mask = jnp.isfinite(neg_d)
+        count = jnp.sum(eligible, axis=1, dtype=jnp.int32)
 
-    I = jnp.broadcast_to(jnp.arange(N)[:, None], (N, k)).reshape(-1)
+    # True coverage gap, not directed slot overflow: pair (i, j) is in the
+    # QP if it fits EITHER endpoint's k slots (the rows are identical).
+    # Eligibility is symmetric, so directed-eligible D = 2 * eligible
+    # pairs; kept entries S include mutual pairs twice, so unordered
+    # covered = S - M/2 with M = kept entries whose reverse is also kept.
+    # O(N*k^2) — no (N, N) scatter, works identically for both backends.
+    rows = jnp.broadcast_to(jnp.arange(N)[:, None], (N, k))
+    I = rows.reshape(-1)
     J = idx.reshape(-1)
+    D = jnp.sum(count)
+    S = jnp.sum(mask, dtype=jnp.int32)
+    rev_idx = idx[J]                                         # (N*k, k)
+    rev_mask = mask[J]
+    mutual = mask.reshape(-1) & jnp.any(
+        (rev_idx == I[:, None]) & rev_mask, axis=1)
+    M = jnp.sum(mutual, dtype=jnp.int32)
+    dropped = D // 2 - (S - M // 2)
     maskf = mask.reshape(-1)
     err = xt[I] - xt[J]                                      # (R, 2)
     h = jnp.sum(err * err, axis=1) - params.safety_radius**2
