@@ -27,6 +27,9 @@ Modes / env knobs:
     BASELINE.md ladder rung as written). BENCH_CHUNK (1000) — compiled-chunk
     length of the checkpointed single-swarm path. BENCH_UNROLL (1) — scan
     unrolling. BENCH_GATING (auto) — neighbor-search backend.
+  BENCH_K_NEIGHBORS (config default 8) — k-NN gating slots; non-default
+    values are labeled in the metric + record (the k-sweep's rate axis;
+    floors for k in {8,12,16} are calibrated in docs/BENCH_LOG.md).
   BENCH_N_OBSTACLES (0) — orbit that many moving obstacles through the
     swarm (workload is labeled in the metric + record; its vs_baseline is
     still against the obstacle-free target rate).
@@ -296,9 +299,12 @@ def _child_single(n: int, steps: int) -> dict:
     dynamics = os.environ.get("BENCH_DYNAMICS", "single")
     _dynamics_floor(dynamics)   # validate BEFORE the run, not after it
     certificate = os.environ.get("BENCH_CERTIFICATE", "0") == "1"
+    base_cfg = swarm.Config()
+    k_neighbors = _env_int("BENCH_K_NEIGHBORS", base_cfg.k_neighbors)
     cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
                        gating=gating, n_obstacles=n_obstacles,
-                       dynamics=dynamics, certificate=certificate)
+                       dynamics=dynamics, certificate=certificate,
+                       k_neighbors=k_neighbors)
     state0, step = swarm.make(cfg)
     chunk = min(_env_int("BENCH_CHUNK", 1000), steps)
     unroll = _env_int("BENCH_UNROLL", 1)
@@ -398,6 +404,9 @@ def _child_single(n: int, steps: int) -> dict:
         # Same labeling contract for the dynamics family.
         result["metric"] += " [dynamics=%s]" % dynamics
         result["dynamics"] = dynamics
+    if k_neighbors != base_cfg.k_neighbors:
+        result["metric"] += " [k=%d]" % k_neighbors
+        result["k_neighbors"] = k_neighbors
     if certificate:
         result["metric"] += " [certificate]"
         result["certificate"] = True
@@ -424,8 +433,10 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
     n_obstacles = _env_int("BENCH_N_OBSTACLES", 0)
     dynamics = os.environ.get("BENCH_DYNAMICS", "single")
     _dynamics_floor(dynamics)   # validate BEFORE the run, not after it
+    k_neighbors = _env_int("BENCH_K_NEIGHBORS", swarm.Config().k_neighbors)
     cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
-                       n_obstacles=n_obstacles, dynamics=dynamics)
+                       n_obstacles=n_obstacles, dynamics=dynamics,
+                       k_neighbors=k_neighbors)
     seeds = list(range(E))
 
     print(f"bench: ensemble E={E} x swarm N={n}, steps={steps}, "
@@ -499,6 +510,9 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
     if dynamics != "single":
         result["metric"] += " [dynamics=%s]" % dynamics
         result["dynamics"] = dynamics
+    if k_neighbors != swarm.Config().k_neighbors:
+        result["metric"] += " [k=%d]" % k_neighbors
+        result["k_neighbors"] = k_neighbors
     return result
 
 

@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# Round-4 TPU measurement sweep (docs/BENCH_LOG.md list) — run top-down at
+# the first healthy probe; each line is independent so a mid-sweep wedge
+# still leaves the earlier results on disk. Output: one timestamped raw
+# log under docs/sweeps/ (transcribe highlights into docs/BENCH_LOG.md).
+set -u -o pipefail   # pipefail: probe()'s exit code must survive the tee
+cd "$(dirname "$0")/.."
+mkdir -p docs/sweeps
+LOG="docs/sweeps/tpu_sweep_$(date +%Y%m%d_%H%M%S).log"
+run() {
+  echo "=== ${*:-defaults} ===" | tee -a "$LOG"
+  env "$@" python bench.py 2>&1 | tee -a "$LOG"
+  echo | tee -a "$LOG"
+}
+probe() {
+  echo "=== probe ===" | tee -a "$LOG"
+  python -c "
+import sys
+import bench
+ok, reason = bench.probe_device_subprocess(timeout_s=120)
+print((ok, reason))
+sys.exit(0 if ok else 1)
+" 2>&1 | tee -a "$LOG"
+}
+
+# Abort on a wedged tunnel: each bench invocation would otherwise retry
+# against the dead device for up to BENCH_TOTAL_TIMEOUT (1500 s) x 11
+# items — hours of guaranteed failures.
+probe || { echo "device wedged — aborting sweep (see $LOG)"; exit 2; }
+# 1. Wedge-fix validation: default run, then probe again immediately.
+run
+probe || { echo "DEVICE WEDGED AFTER DEFAULT RUN — the exit-wedge fix did
+NOT hold; aborting (see $LOG)"; exit 3; }
+# 2. Ensemble rate (post retrace-fix + E_local==1 fast path).
+run BENCH_ENSEMBLE=1
+# 3. Dynamics families.
+run BENCH_DYNAMICS=double
+run BENCH_DYNAMICS=unicycle
+# 4. Chunked-gap attribution matrix (writer / chunking+fetch / bare-equiv).
+run BENCH_CHECKPOINT=0
+run BENCH_CHECKPOINT=0 BENCH_CHUNK=10000
+# 5. Certificate-on (sparse backend at ladder N, then mid N).
+run BENCH_CERTIFICATE=1 BENCH_N=1024 BENCH_STEPS=2000
+run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=1000
+# 6. k-NN k-sweep rates (floors already calibrated on CPU; k=8 = default run).
+run BENCH_K_NEIGHBORS=12 BENCH_STEPS=2000
+run BENCH_K_NEIGHBORS=16 BENCH_STEPS=2000
+# 7. Profile trace for kernel tuning (tuning run, not a record).
+run BENCH_PROFILE=/tmp/tpu_trace_r04
+probe
+echo "sweep complete -> $LOG"

@@ -315,3 +315,20 @@ def test_bench_checkpoint_off_labels_record():
     out, stderr = _run_bench_e2e({"BENCH_CHECKPOINT": "0"})
     assert out["checkpointed"] is False
     assert "checkpointed=False" in stderr
+
+
+def test_bench_k_neighbors_knob_labels_record():
+    """BENCH_K_NEIGHBORS (the k-sweep rate axis) must reach the config and
+    label the record; the default k leaves the metric unlabeled."""
+    out, stderr = _run_bench_e2e({"BENCH_K_NEIGHBORS": "12"})
+    assert "[k=12]" in out["metric"]
+    assert out["k_neighbors"] == 12
+
+
+def test_bench_k_neighbors_knob_reaches_ensemble_mode():
+    """The k knob must reach the ensemble child too (an unlabeled
+    default-k rate must never masquerade as a swept-k one)."""
+    out, stderr = _run_bench_e2e({"BENCH_ENSEMBLE": "1",
+                                  "BENCH_K_NEIGHBORS": "12"})
+    assert "[k=12]" in out["metric"]
+    assert out["k_neighbors"] == 12

@@ -169,3 +169,19 @@ def test_unicycle_initial_state_laws_match():
                                atol=1e-6)
     np.testing.assert_allclose(np.asarray(s0.theta), np.asarray(th0)[0],
                                atol=1e-6)
+
+
+def test_unicycle_bench_floor_calibration_n1024():
+    """Regression pin for bench.SAFETY_FLOOR_UNICYCLE (0.11): the N=1024
+    floor does not decay with scale the way the double family's does
+    (round-4 calibration measured 0.1272 at N=1024 and 0.1273 at N=4096
+    x 1000 CPU steps — docs/BENCH_LOG.md). 300 steps cover the packing
+    transient where the minimum occurs."""
+    import bench
+
+    cfg = swarm.Config(n=1024, steps=300, dynamics="unicycle",
+                       record_trajectory=False)
+    final, outs = swarm.run(cfg)
+    md = np.asarray(outs.min_pairwise_distance)
+    assert md.min() > bench.SAFETY_FLOOR_UNICYCLE, md.min()
+    assert int(np.asarray(outs.infeasible_count).sum()) == 0
