@@ -92,11 +92,15 @@ def make_loss_fn(cfg: swarm_scenario.Config, mesh, tc: TrainConfig = TrainConfig
     that includes the si<->uni trig maps and the wheel-saturation scaling
     (piecewise-smooth; subgradients at the saturation knee).
     """
-    if cfg.certificate:
+    if cfg.certificate and \
+            swarm_scenario.certificate_backend(cfg) != "sparse":
         raise NotImplementedError(
-            "certificate=True training is not supported: differentiating "
-            "the joint ADMM's fixed 250-iteration inner loop through the "
-            "rollout is unvalidated and memory-heavy — train with "
+            "certificate=True training requires the SPARSE backend "
+            "(solvers.sparse_admm: scan-based iterations with a "
+            "finite-difference-validated gradient — "
+            "tests/test_sparse_certificate.py); the dense backend's "
+            "fori_loop solver is not reverse-differentiable. Set "
+            "certificate_backend='sparse' (any n) or train with "
             "certificate=False (filter parameters transfer; the second "
             "layer is parameter-free)")
 

@@ -176,14 +176,17 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
         # certificate compute but zero in-loop communication (one gather
         # per step), and is exactly the dp-only math — the sparse backend
         # (Config.certificate_backend) keeps that redundant solve O(N*k).
+        diff = unroll_relax > 0
         if lax.axis_size(axis_name) == 1:
             u, cert_res, cert_dropped = \
-                swarm_scenario.apply_certificate(cfg, u, x)
+                swarm_scenario.apply_certificate(cfg, u, x,
+                                                 differentiable=diff)
         else:
             xg = lax.all_gather(x, axis_name, axis=0, tiled=True)
             ug = lax.all_gather(u, axis_name, axis=0, tiled=True)
             ug, cert_res, cert_dropped = \
-                swarm_scenario.apply_certificate(cfg, ug, xg)
+                swarm_scenario.apply_certificate(cfg, ug, xg,
+                                                 differentiable=diff)
             i0 = lax.axis_index(axis_name) * x.shape[0]
             u = lax.dynamic_slice_in_dim(ug, i0, x.shape[0], axis=0)
         # The joint QP's internal constants can demote the varying-manual-

@@ -614,14 +614,18 @@ def certificate_backend(cfg: Config) -> str:
     return cfg.certificate_backend
 
 
-def apply_certificate(cfg: Config, u, x):
+def apply_certificate(cfg: Config, u, x, differentiable: bool = False):
     """The joint second layer over already-filtered si velocities (see
     Config.certificate). Shared by the scenario step and the sharded
     ensemble. Returns (u_certified (N, 2), primal_residual scalar,
     dropped_count int32 scalar — sparse-backend k-slot truncation of
     in-binding-radius pairs, the one degradation signal that backend
     emits; 0 on the dense backend, whose max_pairs pruning keeps the
-    globally tightest rows and is covered by its own exactness test)."""
+    globally tightest rows and is covered by its own exactness test).
+
+    ``differentiable=True`` (the trainer's unrolled path) pins the sparse
+    backend's neighbor search to the jnp form — the Pallas kernel has no
+    AD rule (same exclusion the gating makes under unroll_relax)."""
     from cbf_tpu.sim.certificates import (CertificateParams,
                                           si_barrier_certificate,
                                           si_barrier_certificate_sparse)
@@ -631,7 +635,8 @@ def apply_certificate(cfg: Config, u, x):
     if certificate_backend(cfg) == "sparse":
         u_cert, cinfo = si_barrier_certificate_sparse(
             u.T, x.T, params, k=cfg.certificate_k, with_info=True,
-            arena=arena)
+            arena=arena,
+            neighbor_backend="jnp" if differentiable else "auto")
         return u_cert.T, cinfo.primal_residual, cinfo.dropped_count
     pairs = (cfg.certificate_pairs if cfg.certificate_pairs is not None
              else 8 * cfg.n)

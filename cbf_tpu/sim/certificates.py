@@ -26,6 +26,7 @@ from jax import lax
 
 from cbf_tpu.ops.pairwise import pairwise_distances
 from cbf_tpu.sim.robotarium import ARENA
+from cbf_tpu.utils.math import safe_norm
 from cbf_tpu.solvers.admm import ADMMSettings, solve_box_qp_admm
 from cbf_tpu.solvers.sparse_admm import (SparseADMMSettings,
                                          solve_pair_box_qp_admm)
@@ -213,7 +214,11 @@ def si_barrier_certificate_sparse(
     if pair_radius is None:
         pair_radius = binding_pair_radius(params)
 
-    norms = jnp.linalg.norm(dxi, axis=0)
+    # safe_norm, not jnp.linalg.norm: this function is on the trainer's
+    # reverse-mode path and an exactly-zero command column (an unengaged
+    # agent at its target) would make d||x||/dx a NaN that poisons every
+    # parameter through the optimizer while the loss itself stays finite.
+    norms = safe_norm(dxi, axis=0)
     scale = jnp.maximum(1.0, norms / params.magnitude_limit)
     u_nom = (dxi / scale[None, :]).T                         # (N, 2)
 

@@ -56,11 +56,17 @@ class SparseADMMInfo(NamedTuple):
 
 
 def _cg(apply_K, rhs, x0, iters):
-    """Fixed-iteration CG for SPD K (no early exit — one XLA program)."""
+    """Fixed-iteration CG for SPD K (no early exit — one XLA program).
+
+    ``lax.scan`` rather than ``fori_loop`` (identical rolled lowering for
+    a carry-only loop) so the solve is reverse-differentiable: training
+    with the certificate layer unrolls these iterations, which at
+    convergence is the standard fixed-point approximation of the implicit
+    gradient."""
     r0 = rhs - apply_K(x0)
     rs0 = jnp.vdot(r0, r0)
 
-    def body(_, carry):
+    def body(carry, _):
         x, r, p, rs = carry
         Kp = apply_K(p)
         a = rs / jnp.maximum(jnp.vdot(p, Kp), 1e-30)
@@ -68,9 +74,9 @@ def _cg(apply_K, rhs, x0, iters):
         r = r - a * Kp
         rs_new = jnp.vdot(r, r)
         p = r + (rs_new / jnp.maximum(rs, 1e-30)) * p
-        return (x, r, p, rs_new)
+        return (x, r, p, rs_new), None
 
-    x, *_ = lax.fori_loop(0, iters, body, (x0, r0, r0, rs0))
+    (x, *_), _ = lax.scan(body, (x0, r0, r0, rs0), None, length=iters)
     return x
 
 
@@ -116,7 +122,7 @@ def solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
 
     q = -u_nom.reshape(-1)
 
-    def step(_, carry):
+    def step(carry, _):
         x, z_p, z_b, y_p, y_b = carry
         # rhs = sigma x - q + A^T (rho z - y), split over the two blocks.
         rhs = (sigma * x - q
@@ -132,7 +138,7 @@ def solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
                            lo.reshape(-1), hi.reshape(-1))
         y_p_new = y_p + rho * (Axr_p - z_p_new)
         y_b_new = y_b + rho * (Axr_b - z_b_new)
-        return (x_new, z_p_new, z_b_new, y_p_new, y_b_new)
+        return (x_new, z_p_new, z_b_new, y_p_new, y_b_new), None
 
     R = I.shape[0]
     # match_vma: see solvers.admm — zero carries must match the problem
@@ -140,8 +146,9 @@ def solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
     x0 = match_vma(jnp.zeros((2 * N,), dtype), q)
     zp0 = match_vma(jnp.zeros((R,), dtype), coef_s[:, 0])
     zb0 = match_vma(jnp.zeros((2 * N,), dtype), q)
-    x, z_p, z_b, y_p, y_b = lax.fori_loop(
-        0, settings.iters, step, (x0, zp0, zb0, zp0, zb0))
+    # scan, not fori_loop: reverse-differentiable (see _cg).
+    (x, z_p, z_b, y_p, y_b), _ = lax.scan(
+        step, (x0, zp0, zb0, zp0, zb0), None, length=settings.iters)
 
     u = x.reshape(N, 2)
     # Residuals in the ORIGINAL row geometry (d > 0 leaves the feasible set
