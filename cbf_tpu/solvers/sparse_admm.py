@@ -25,13 +25,15 @@ by the caller from the returned residuals, never assumed.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from cbf_tpu.utils.math import match_vma
+from cbf_tpu.utils.math import match_vma, safe_norm
 
 
 class SparseADMMSettings(NamedTuple):
@@ -55,15 +57,22 @@ class SparseADMMInfo(NamedTuple):
     dual_residual: jax.Array
 
 
-def _cg(apply_K, rhs, x0, iters):
-    """Fixed-iteration CG for SPD K (no early exit — one XLA program).
+def _cg(apply_K, rhs, iters, vma_ref=None):
+    """Fixed-iteration zero-start CG for SPD K (no early exit — one XLA
+    program). Callers needing a warm start solve for the DELTA from their
+    guess (see the x-update below) — that keeps this kernel zero-start,
+    so :func:`_solve_K`'s backward rule can reuse it verbatim for the
+    cotangent solve.
 
-    ``lax.scan`` rather than ``fori_loop`` (identical rolled lowering for
-    a carry-only loop) so the solve is reverse-differentiable: training
-    with the certificate layer unrolls these iterations, which at
-    convergence is the standard fixed-point approximation of the implicit
-    gradient."""
-    r0 = rhs - apply_K(x0)
+    ``vma_ref``: under shard_map, K's operands can carry MORE varying
+    manual axes than ``rhs`` (e.g. the backward solve's cotangent), and a
+    scan carry must enter with its steady-state type — pass any array
+    carrying K's axes (a scalar slice of the pair coefficients) and the
+    carry is pre-aligned (see utils.math.match_vma; chaining unions the
+    axes). This costs nothing — no probe matvec."""
+    r0 = rhs if vma_ref is None else match_vma(rhs, vma_ref)
+    p0 = r0
+    x0 = match_vma(jnp.zeros_like(rhs), r0)
     rs0 = jnp.vdot(r0, r0)
 
     def body(carry, _):
@@ -76,8 +85,80 @@ def _cg(apply_K, rhs, x0, iters):
         p = r + (rs_new / jnp.maximum(rs, 1e-30)) * p
         return (x, r, p, rs_new), None
 
-    (x, *_), _ = lax.scan(body, (x0, r0, r0, rs0), None, length=iters)
+    (x, *_), _ = lax.scan(body, (x0, r0, p0, rs0), None, length=iters)
     return x
+
+
+def _make_apply_K(coef_s, I, J, rho, sigma, dtype=None):
+    """The x-update operator K = (1 + sigma + rho) I + rho A_pair^T A_pair
+    (+ rho I from the identity box block), matrix-free over flattened
+    (2N,) vectors — the ONE definition of the pair operator, shared by
+    the ADMM iteration, the implicit-gradient solve, and its backward
+    rule (a drifted duplicate would silently solve a different K)."""
+    dtype = coef_s.dtype if dtype is None else dtype
+
+    def A_pair(v):                                   # (N, 2) -> (R,)
+        return jnp.sum(coef_s * (v[I] - v[J]), axis=1)
+
+    def A_pair_T(y, n):                              # (R,) -> (N, 2)
+        contrib = coef_s * y[:, None]
+        z = jnp.zeros((n, 2), dtype)
+        return z.at[I].add(contrib).at[J].add(-contrib)
+
+    def apply_K(v2):
+        v = v2.reshape(-1, 2)
+        out = (1.0 + sigma + rho) * v + rho * A_pair_T(A_pair(v), v.shape[0])
+        return out.reshape(-1)
+
+    return apply_K, A_pair, A_pair_T
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _solve_K(iters, rho_sigma, coef_s, I, J, rhs, x_warm):
+    """Warm-started SPD solve x = K^{-1} rhs with an IMPLICIT gradient.
+
+    Forward: x = x_warm + CG(K, rhs - K x_warm) — the warm start enters as
+    a delta, so the CG kernel is zero-start. Backward (custom_vjp, below):
+    one more CG solve K w = cotangent, then closed-form cotangents for
+    rhs (= w) and for the pair coefficients (via dL/dK = -w x^T restricted
+    to K's sparse parameterization). Differentiating THROUGH the unrolled
+    CG iterations instead is numerically explosive in f32 — past
+    convergence the Polak-step denominators underflow and their ~1e30
+    reciprocal factors turn the whole parameter gradient NaN (measured on
+    the two-layer trainer) — and jax's custom_linear_solve machinery
+    trips shard_map's varying-manual-axes checking, so the rule is
+    written out by hand."""
+    rho, sigma = rho_sigma
+    apply_K, _, _ = _make_apply_K(coef_s, I, J, rho, sigma)
+    return x_warm + _cg(apply_K, rhs - apply_K(x_warm), iters,
+                        vma_ref=coef_s[0, 0])
+
+
+def _solve_K_fwd(iters, rho_sigma, coef_s, I, J, rhs, x_warm):
+    x = _solve_K(iters, rho_sigma, coef_s, I, J, rhs, x_warm)
+    return x, (coef_s, I, J, x)
+
+
+def _solve_K_bwd(iters, rho_sigma, res, ct):
+    coef_s, I, J, x = res
+    rho, sigma = rho_sigma
+    apply_K, _, _ = _make_apply_K(coef_s, I, J, rho, sigma)
+    w = _cg(apply_K, ct, iters,                      # K w = ct (K symmetric)
+            vma_ref=coef_s[0, 0])
+    xv, wv = x.reshape(-1, 2), w.reshape(-1, 2)
+    dx_p, dw_p = xv[I] - xv[J], wv[I] - wv[J]        # (R, 2)
+    Ax = jnp.sum(coef_s * dx_p, axis=1)              # (R,)
+    Aw = jnp.sum(coef_s * dw_p, axis=1)
+    # dL = -w^T dK x + w^T drhs; for K's rho*A^T A block,
+    # w^T K x = ... + rho * sum_r (c_r . dw_r)(c_r . dx_r).
+    d_coef = -rho * (Aw[:, None] * dx_p + Ax[:, None] * dw_p)
+    d_rhs = w
+    d_x_warm = jnp.zeros_like(x)     # x = K^{-1} rhs: no x_warm dependence
+    f0 = lambda a: np.zeros(a.shape, dtype=jax.dtypes.float0)
+    return (d_coef, f0(I), f0(J), d_rhs, d_x_warm)
+
+
+_solve_K.defvjp(_solve_K_fwd, _solve_K_bwd)
 
 
 def solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
@@ -101,24 +182,19 @@ def solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
 
     # Row equilibration (same lesson as the dense solver: mixed row scales
     # stall fixed-rho ADMM). Pair row norm = ||(-c, +c)|| = sqrt(2)*||c||;
-    # box rows are unit already. Zero (padding) rows get d=1 and stay inert.
-    c_norm = jnp.sqrt(2.0) * jnp.linalg.norm(coef, axis=1)
+    # box rows are unit already. Zero (padding) rows get d=1 and stay
+    # inert — via safe_norm: ||.||'s raw gradient at an exactly-zero row
+    # is 0/0, and on the trainer's reverse path that NaN would poison the
+    # whole parameter gradient even though the `where` takes the other
+    # branch (0 * NaN = NaN through the norm primitive's VJP).
+    c_norm = jnp.sqrt(2.0) * safe_norm(coef, axis=1)
     d = jnp.where(c_norm > 1e-10, 1.0 / jnp.maximum(c_norm, 1e-10), 1.0)
     coef_s = coef * d[:, None]
     b_s = jnp.where(jnp.isfinite(b_pair), b_pair * d, b_pair)
 
-    def A_pair(v):                                   # (N,2) -> (R,)
-        return jnp.sum(coef_s * (v[I] - v[J]), axis=1)
-
-    def A_pair_T(y):                                 # (R,) -> (N,2)
-        contrib = coef_s * y[:, None]
-        z = jnp.zeros((N, 2), dtype)
-        return z.at[I].add(contrib).at[J].add(-contrib)
-
-    def apply_K(v2):                                 # flattened (2N,)
-        v = v2.reshape(N, 2)
-        out = (1.0 + sigma + rho) * v + rho * A_pair_T(A_pair(v))
-        return out.reshape(-1)
+    _, A_pair, _A_pair_T = _make_apply_K(coef_s, I, J, rho, sigma,
+                                         dtype=dtype)
+    A_pair_T = lambda y: _A_pair_T(y, N)             # noqa: E731
 
     q = -u_nom.reshape(-1)
 
@@ -128,7 +204,8 @@ def solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
         rhs = (sigma * x - q
                + A_pair_T(rho * z_p - y_p).reshape(-1)
                + (rho * z_b - y_b))
-        x_new = _cg(apply_K, rhs, x, settings.cg_iters)
+        x_new = _solve_K(settings.cg_iters, (rho, sigma),
+                         coef_s, I, J, rhs, x)
         Ax_p = A_pair(x_new.reshape(N, 2))
         Ax_b = x_new
         Axr_p = alpha * Ax_p + (1.0 - alpha) * z_p

@@ -53,7 +53,8 @@ def _save_loss_curve(losses, path_base):
     plt.close(fig)
 
 
-def main(opt_steps: int = 40, horizon: int = 100, media_dir: str = MEDIA):
+def main(opt_steps: int = 40, horizon: int = 100, media_dir: str = MEDIA,
+         certificate: bool = False):
     if opt_steps < 1:
         raise SystemExit(f"--steps must be >= 1, got {opt_steps}")
     from cbf_tpu.learn import TrainConfig, init_params, make_train_step
@@ -72,8 +73,13 @@ def main(opt_steps: int = 40, horizon: int = 100, media_dir: str = MEDIA):
     # default spread spawn the CBF params get zero gradient signal.)
     n = 8 * n_sp
     side = int(np.ceil(np.sqrt(n)))
+    # --certificate: train THROUGH the two-layer stack (per-agent filter +
+    # the joint barrier certificate) — requires the sparse backend, whose
+    # scan-based iterations carry a validated gradient (learn.tuning).
     cfg = swarm.Config(n=n, steps=horizon, k_neighbors=4, pack_spacing=0.02,
-                       spawn_half_width_override=0.15 * max(side - 1, 1))
+                       spawn_half_width_override=0.15 * max(side - 1, 1),
+                       certificate=certificate,
+                       certificate_backend="sparse" if certificate else "auto")
     tc = TrainConfig(steps=horizon, learning_rate=3e-2)
     train_step, optimizer = make_train_step(cfg, mesh, tc)
 
@@ -114,5 +120,7 @@ if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=40)
     p.add_argument("--horizon", type=int, default=100)
+    p.add_argument("--certificate", action="store_true",
+                   help="train through the two-layer stack (sparse backend)")
     a = p.parse_args()
-    main(a.steps, a.horizon)
+    main(a.steps, a.horizon, certificate=a.certificate)
