@@ -10,7 +10,9 @@ practical because each scan step is rematerialized (jax.checkpoint) on the
 backward pass, keeping activation memory O(1) in the horizon.
 
 Artifacts: the loss curve is written to examples/media/training_loss.csv
-and (if matplotlib is available) examples/media/training_loss.png.
+and (if matplotlib is available) examples/media/training_loss.png —
+training_loss_two_layer.* when --certificate trains through the full
+two-layer stack (per-agent filter + sparse joint certificate).
 
 Run: ``python examples/train_safety_params.py [--steps 40]``
 (CPU-friendly; set XLA_FLAGS=--xla_force_host_platform_device_count=8 to
@@ -111,8 +113,8 @@ def main(opt_steps: int = 40, horizon: int = 100, media_dir: str = MEDIA,
     if not np.isfinite(losses[-1]):
         raise SystemExit("non-finite loss")
     os.makedirs(media_dir, exist_ok=True)
-    _save_loss_curve(np.asarray(losses),
-                     os.path.join(media_dir, "training_loss"))
+    base = "training_loss_two_layer" if certificate else "training_loss"
+    _save_loss_curve(np.asarray(losses), os.path.join(media_dir, base))
     return losses[0], losses[-1]
 
 
