@@ -20,7 +20,11 @@ only emitted for a *correct* run: the child asserts the safety invariants
 before reporting — a collapsed swarm is a non-retryable failure, not a
 number.
 
-Prints exactly ONE JSON line to stdout.
+Prints exactly ONE JSON line to stdout. When every attempt fails, the
+failure record additionally carries ``last_verified`` — the best
+driver-verified on-hardware measurement, read from the committed
+``docs/verified_bench.json`` — so a wedged round still yields a
+machine-readable pointer to the verified state.
 
 Modes / env knobs:
   BENCH_N (4096), BENCH_STEPS (10000) — problem size (defaults = the
@@ -46,6 +50,7 @@ Modes / env knobs:
     matrix-free backend engages automatically beyond N=128. Labeled in
     metric + record; additionally gated on per-step ADMM convergence
     (max primal residual < 1e-4) and surfacing the dropped-pair count.
+    Honored by BOTH modes (single and ensemble) with the same gate.
   BENCH_PROFILE=<dir> — capture a jax.profiler device trace of the
     measured window (TensorBoard trace-viewer format) into <dir>; the
     wall number still excludes warmup but includes tracing overhead, so
@@ -110,6 +115,89 @@ def _dynamics_floor(dynamics: str) -> float:
 
 RC_RETRYABLE = 2      # wedge/timeout/init failure — try again
 RC_PERMANENT = 3      # safety violation or real error — don't retry
+
+# Machine-readable record of the best driver-verified on-hardware run.
+# Embedded as `last_verified` in the failure JSON when every attempt
+# wedges — a zeroed round then still carries the best verified state
+# (metric, value, round, provenance) instead of a prose pointer.
+LAST_VERIFIED_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                  "docs", "verified_bench.json")
+
+
+def _read_last_verified_raw() -> dict | None:
+    try:
+        with open(LAST_VERIFIED_PATH) as fh:
+            rec = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+    # Valid-JSON non-dict must not raise (this runs on the failure path
+    # AND after a successful run — a crash here would break the
+    # one-JSON-line contract either way).
+    return rec if isinstance(rec, dict) else None
+
+
+_LAST_VERIFIED_KEYS = ("metric", "value", "unit", "vs_baseline", "round",
+                       "provenance", "steps", "chunk", "checkpointed")
+
+
+def _load_last_verified() -> dict | None:
+    rec = _read_last_verified_raw()
+    if rec is None:
+        return None
+    return {k: rec[k] for k in _LAST_VERIFIED_KEYS if k in rec}
+
+
+# The headline record tracks exactly one axis: the single-swarm filter
+# workload. Mode labels ([certificate], [dynamics=...], obstacle counts,
+# ensemble) are different axes and must never seed or replace it — checked
+# against the metric SHAPE, not the previous record, so a missing/corrupt
+# file can't let a labeled run become the headline. Within the axis,
+# chunk/steps/checkpoint variants ARE eligible (the record means "best
+# verified on-hardware state", and the r02 seed itself is a bare 500-step
+# scan) — those workload facts are stored in the record's own fields, so
+# nothing about the winning configuration is silent. Profiled runs are the
+# one intra-axis exclusion: their wall includes tracing overhead (tuning
+# data, not records — see the BENCH_PROFILE docstring).
+_HEADLINE_METRIC_RE = r"^agent-QP-steps/sec/chip \(swarm N=\d+\)$"
+
+
+def _maybe_update_last_verified(result: dict) -> None:
+    """After a verified (safety-gated) TPU run, refresh the committed
+    last-verified record if this run beats it. Best-effort: a failure here
+    must never fail the bench."""
+    import re
+
+    try:
+        if result.get("platform") not in ("tpu", "axon"):
+            return
+        if not re.match(_HEADLINE_METRIC_RE, result.get("metric", "")):
+            return
+        if "profiled" in result:
+            return
+        # One read serves both the comparison and the rewrite (no window
+        # where they diverge); unknown keys (the file's self-documenting
+        # "comment") are preserved.
+        rec = _read_last_verified_raw() or {}
+        if rec.get("metric") and rec["metric"] != result["metric"]:
+            return   # e.g. a different BENCH_N than the recorded headline
+        if result.get("value", 0) <= rec.get("value", 0):
+            return
+        rec.update({k: result[k]
+                    for k in ("metric", "value", "unit", "vs_baseline",
+                              "steps", "chunk", "checkpointed")
+                    if k in result})
+        rec["round"] = "r05+"
+        rec["provenance"] = ("bench.py self-recorded verified TPU run "
+                             f"(wall {result.get('wall_s')}s)")
+        # Atomic write: a mid-write death must not leave truncated JSON
+        # where the verified-state fallback used to be.
+        tmp = LAST_VERIFIED_PATH + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(rec, fh, indent=2)
+            fh.write("\n")
+        os.replace(tmp, LAST_VERIFIED_PATH)
+    except Exception as e:   # never fail a successful bench over this
+        print(f"bench: last_verified update failed: {e!r}", file=sys.stderr)
 
 
 def _env_float(name: str, default: float) -> float:
@@ -263,6 +351,34 @@ def probe_device_subprocess(
     return False, f"device init failed: {proc.stderr.strip()[-400:]}"
 
 
+def _gate_certificate(residual, dropped) -> tuple[str | None, float, int]:
+    """The fixed-iteration ADMM convergence gate shared by BOTH bench modes
+    (convergence is asserted, never assumed — a single divergence point
+    would let the two modes gate at different thresholds). Returns
+    (error_or_None, max_primal_residual, dropped_pair_count)."""
+    import numpy as np
+
+    cert_res = float(np.asarray(residual).max())
+    cert_dropped = int(np.asarray(dropped).sum())
+    print(f"bench: certificate max_residual={cert_res:.2e}, "
+          f"pairs_dropped={cert_dropped}", file=sys.stderr)
+    if not (cert_res < 1e-4):
+        return ("certificate ADMM did not converge: max primal residual "
+                f"{cert_res:.2e}"), cert_res, cert_dropped
+    return None, cert_res, cert_dropped
+
+
+def _label_certificate(result: dict, cert_res: float,
+                       cert_dropped: int) -> None:
+    """Append the certificate labels. Must run AFTER every other label —
+    in particular after the obstacle block, which REPLACES the metric
+    string and would wipe an earlier-appended tag."""
+    result["metric"] += " [certificate]"
+    result["certificate"] = True
+    result["certificate_max_residual"] = cert_res
+    result["certificate_pairs_dropped"] = cert_dropped
+
+
 def _profile_ctx():
     """(context manager, bool) for the BENCH_PROFILE knob: a jax.profiler
     trace of the measured window, or a null context. Shared by both bench
@@ -371,15 +487,10 @@ def _child_single(n: int, steps: int) -> dict:
     if err:
         return {"error": err, "retryable": False}
     if certificate:
-        # Fixed-iteration ADMM: convergence is a gate, never an assumption.
-        cert_res = float(np.asarray(outs.certificate_residual).max())
-        cert_dropped = int(np.asarray(outs.certificate_dropped_count).sum())
-        print(f"bench: certificate max_residual={cert_res:.2e}, "
-              f"pairs_dropped={cert_dropped}", file=sys.stderr)
-        if not (cert_res < 1e-4):
-            return {"error": "certificate ADMM did not converge: max "
-                             f"primal residual {cert_res:.2e}",
-                    "retryable": False}
+        cert_err, cert_res, cert_dropped = _gate_certificate(
+            outs.certificate_residual, outs.certificate_dropped_count)
+        if cert_err:
+            return {"error": cert_err, "retryable": False}
 
     result = {
         "metric": "agent-QP-steps/sec/chip (swarm N=%d)" % n,
@@ -390,6 +501,7 @@ def _child_single(n: int, steps: int) -> dict:
         "chunk": chunk,
         "wall_s": round(wall, 3),
         "checkpointed": checkpointing,
+        "platform": jax.devices()[0].platform,
     }
     if profiled:
         result["profiled"] = True
@@ -408,10 +520,7 @@ def _child_single(n: int, steps: int) -> dict:
         result["metric"] += " [k=%d]" % k_neighbors
         result["k_neighbors"] = k_neighbors
     if certificate:
-        result["metric"] += " [certificate]"
-        result["certificate"] = True
-        result["certificate_max_residual"] = cert_res
-        result["certificate_pairs_dropped"] = cert_dropped
+        _label_certificate(result, cert_res, cert_dropped)
     return result
 
 
@@ -433,10 +542,14 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
     n_obstacles = _env_int("BENCH_N_OBSTACLES", 0)
     dynamics = os.environ.get("BENCH_DYNAMICS", "single")
     _dynamics_floor(dynamics)   # validate BEFORE the run, not after it
+    # Same contract as _child_single: the certificate knob must either be
+    # honored or rejected — silently benching a certificate-free rollout
+    # under BENCH_CERTIFICATE=1 would mislabel the transcribed rate.
+    certificate = os.environ.get("BENCH_CERTIFICATE", "0") == "1"
     k_neighbors = _env_int("BENCH_K_NEIGHBORS", swarm.Config().k_neighbors)
     cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
                        n_obstacles=n_obstacles, dynamics=dynamics,
-                       k_neighbors=k_neighbors)
+                       k_neighbors=k_neighbors, certificate=certificate)
     seeds = list(range(E))
 
     print(f"bench: ensemble E={E} x swarm N={n}, steps={steps}, "
@@ -468,6 +581,11 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
         print(f"bench: wall={wall:.3f}s, min_dist={min_dist:.4f}, "
               f"infeasible={infeasible}", file=sys.stderr)
         return {"error": err, "retryable": False}
+    if certificate:
+        cert_err, cert_res, cert_dropped = _gate_certificate(
+            mets.certificate_residual, mets.certificate_dropped)
+        if cert_err:
+            return {"error": cert_err, "retryable": False}
 
     if chips == 1:
         efficiency = 1.0   # vs itself by construction — skip the extra runs
@@ -498,6 +616,7 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
         "vs_baseline": round(rate_per_chip / TARGET_RATE_PER_CHIP, 3),
         "chips": chips,
         "scaling_efficiency": round(efficiency, 3),
+        "platform": jax.devices()[0].platform,
     }
     if profiled:
         result["profiled"] = True
@@ -513,6 +632,8 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
     if k_neighbors != swarm.Config().k_neighbors:
         result["metric"] += " [k=%d]" % k_neighbors
         result["k_neighbors"] = k_neighbors
+    if certificate:
+        _label_certificate(result, cert_res, cert_dropped)
     return result
 
 
@@ -642,6 +763,7 @@ def main() -> None:
               f"(timeout {min(attempt_timeout, budget):.0f}s)", file=sys.stderr)
         result, retryable = _run_attempt(min(attempt_timeout, budget), ensemble)
         if result and "error" not in result:
+            _maybe_update_last_verified(result)
             print(json.dumps(result))
             return
         last_error = (result or {}).get(
@@ -656,14 +778,25 @@ def main() -> None:
 
     label = ("ensemble x N=%d" if ensemble else "swarm N=%d") \
         % _env_int("BENCH_N", 4096)
-    print(json.dumps({
+    record = {
         "metric": f"agent-QP-steps/sec/chip ({label})",
         "value": 0,
         "unit": "agent_qp_steps_per_sec_per_chip",
         "vs_baseline": 0,
-        "error": f"{last_error} — no verified measurement; last good "
-                 "numbers are in README.md",
-    }))
+        "error": f"{last_error} — no verified measurement this run",
+    }
+    last = _load_last_verified()
+    if last:
+        # A wedged round still yields a machine-readable record of the
+        # best verified state (metric/value/round/provenance), not a prose
+        # pointer (docs/verified_bench.json is the committed source).
+        record["last_verified"] = last
+    else:
+        # The committed file is missing/corrupt: keep at least the prose
+        # pointer so the record never goes dark on the verified state.
+        record["error"] += ("; docs/verified_bench.json unavailable — last "
+                            "good numbers are in README.md")
+    print(json.dumps(record))
     sys.exit(2)
 
 

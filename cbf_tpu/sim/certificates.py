@@ -139,30 +139,52 @@ def si_barrier_certificate(dxi, x, params: CertificateParams = CertificateParams
 
 
 def binding_pair_radius(params: CertificateParams,
-                        headroom: float = 1.25) -> float:
+                        headroom: float = 1.25,
+                        solution_speed_cap: float | None = None) -> float:
     """Smallest separation beyond which a pair row can NEVER bind, from the
     params themselves (not a hard-coded default): the row's LHS is bounded
-    by ``|2 err . (u_I - u_J)| <= 4 d m`` (d = separation, m = the
-    magnitude pre-limit) while its margin is ``gain (d^2 - r^2)^3`` —
-    cubic beats linear, so past the crossing the constraint is
-    structurally slack whatever the solver does. Host-side bisection at
-    trace time (static — shapes depend on it only through the caller's k),
-    with multiplicative ``headroom`` on top. This is the same slack
-    argument the dense path's ``max_pairs`` pruning rests on; deriving it
-    from (gain, r, m) keeps the sparse backend exact for *any* caller
-    magnitude limit (e.g. swarm configs raising speed_limit), where a
-    fixed 0.5 m would silently under-constrain."""
-    gain, r, m = params.barrier_gain, params.safety_radius, \
-        params.magnitude_limit
+    by ``|2 err . (u_I - u_J)| <= 4 d c`` (d = separation, c = a bound on
+    the CERTIFIED per-agent speed) while its margin is
+    ``gain (d^2 - r^2)^3`` — cubic beats linear, so past the crossing the
+    constraint is structurally slack whatever the solver does. Host-side
+    bisection at trace time (static — shapes depend on it only through the
+    caller's k), with multiplicative ``headroom`` on top. This is the same
+    slack argument the dense path's ``max_pairs`` pruning rests on;
+    deriving it from (gain, r, c) keeps the sparse backend exact for *any*
+    caller magnitude limit (e.g. swarm configs raising speed_limit), where
+    a fixed 0.5 m would silently under-constrain.
+
+    ``solution_speed_cap`` (c): the QP pre-limits only the NOMINAL to
+    ``magnitude_limit`` (m); the projected solution can exceed m, and the
+    arena box bounds components only by ``0.4 gain (wall margin)^3`` —
+    far too large to cap speed. No per-agent O(m) bound on the solution
+    exists in the worst case (with all pairs separated, u = 0 is feasible,
+    so the JOINT deviation obeys ``||u* - u_nom||_2 <= ||u_nom||_2 <=
+    m sqrt(N)`` — but one agent may absorb much of it). The default
+    ``c = 2 m`` is therefore an assumption, not a theorem, and it is
+    backstopped twice: (a) the multiplicative headroom — margin grows
+    ~d^6 vs the LHS's ~d past the crossing, so the returned radius
+    tolerates solution speeds up to ``~headroom^6 / headroom ~= 3x`` the
+    cap before an excluded row could bind (~6 m at defaults); (b) in
+    practice the certificate runs *below* the first layer, whose filtered
+    commands the pre-limit clamps to m, and every measured rollout
+    (tests/test_sparse_certificate.py dense-vs-sparse equality at N=64,
+    full-horizon scenario parity) stays far inside it. Callers with a
+    genuinely faster regime must pass their own cap — pairs beyond the
+    radius are excluded from the QP *and* from ``dropped_count``, so an
+    undersized radius degrades silently."""
+    gain, r = params.barrier_gain, params.safety_radius
+    c = (2.0 * params.magnitude_limit if solution_speed_cap is None
+         else solution_speed_cap)
     lo = r
     hi = max(4.0 * r, 1.0)
-    while gain * (hi * hi - r * r) ** 3 < 4.0 * hi * m:
+    while gain * (hi * hi - r * r) ** 3 < 4.0 * hi * c:
         hi *= 2.0
         if hi > 1e6:   # degenerate params (gain ~ 0): nothing ever slack
             return float("inf")
     for _ in range(60):
         mid = 0.5 * (lo + hi)
-        if gain * (mid * mid - r * r) ** 3 < 4.0 * mid * m:
+        if gain * (mid * mid - r * r) ** 3 < 4.0 * mid * c:
             lo = mid
         else:
             hi = mid
