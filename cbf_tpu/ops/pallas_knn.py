@@ -414,6 +414,82 @@ def supported(n: int) -> bool:
     return jax.default_backend() == "tpu"
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def knn_select(x, radius, k: int, interpret: bool = False):
+    """The Pallas k-NN kernels as a SELECTION ORACLE with a defined (zero)
+    gradient — the differentiable-path entry (the raw kernels have no AD
+    rule and error under jax.grad).
+
+    Returns (idx (N, k) int32, dist (N, k), nearest_all (N,), count (N,))
+    with the fused/streaming dispatch of :func:`knn_gating_pallas`. The
+    zero cotangent is the TRUE gradient of the selection itself: which
+    neighbors are kept is piecewise-constant in the positions (a.e. zero
+    derivative). But the returned ``dist``/``nearest_all`` VALUES are not
+    constants in x — under AD this wrapper silently zeroes their position
+    gradient, so a consumer on a gradient path must use them only for
+    masking/selection and recompute any value it differentiates from the
+    positions via ``idx`` (jnp gather — see :func:`knn_gating_pallas_diff`
+    and sim.certificates.si_barrier_certificate_sparse, whose row geometry
+    is already rebuilt from gathered positions)."""
+    fn = knn_neighbors if x.shape[0] <= MAX_N_FUSED else knn_neighbors_blocked
+    return fn(x, radius, k, interpret=interpret)
+
+
+def _knn_select_fwd(x, radius, k, interpret):
+    # Residual = x itself (residuals must be JAX types; (N, 2) is tiny) —
+    # only its shape/dtype are consumed, to build the zero cotangent.
+    return knn_select(x, radius, k, interpret), x
+
+
+def _knn_select_bwd(radius, k, interpret, x, _ct):
+    return (jnp.zeros_like(x),)
+
+
+knn_select.defvjp(_knn_select_fwd, _knn_select_bwd)
+
+
+def _gating_epilogue(states4, idx, dist, count, k: int):
+    """(obs, mask, dropped) from a kernel selection — the ONE epilogue
+    shared by the diff and non-diff gating twins (drifted dropped/mask
+    accounting between them would be invisible to CI, which exercises the
+    diff twin only in interpret mode)."""
+    mask = jnp.isfinite(dist)
+    obs = jnp.take(states4, idx, axis=0)
+    dropped = jnp.maximum(count - k, 0)
+    return obs, mask, dropped
+
+
+def knn_gating_pallas_diff(states4, radius, k: int, *,
+                           interpret: bool = False):
+    """Differentiable twin of :func:`knn_gating_pallas`: Pallas selects,
+    jnp recomputes everything a gradient flows through.
+
+    The trainer's loss differentiates through BOTH the gathered neighbor
+    rows (QP geometry) and the nearest-neighbor distance (the separation
+    hinge, learn.tuning) — so the kernel runs as :func:`knn_select` and
+    this wrapper rebuilds (a) the obs slab by jnp gather (gradient to the
+    kept pairs' states) and (b) the per-agent gated nearest distance from
+    those gathered positions (gradient to the argmin pair — the same
+    subgradient the jnp exchange path yields; equality is pinned by
+    tests/test_pallas_knn.py's interpret-mode gradient test). The mask
+    stays kernel-derived: it is boolean (no gradient exists on any path).
+
+    Returns (obs (N, k, 4), mask (N, k), nearest1 (N,) — GATED top-1
+    distance, inf when nothing is in radius (the exchange contract's
+    form, not knn_gating_pallas's nearest-any), dropped (N,) int32).
+    """
+    from cbf_tpu.utils.math import safe_norm
+
+    idx, dist, _, count = knn_select(states4[:, :2], radius, k, interpret)
+    obs, mask, dropped = _gating_epilogue(states4, idx, dist, count, k)
+    # safe_norm: an exactly-coincident kept pair (unreachable under the
+    # first layer's floor, reachable in adversarial training states) has a
+    # 0/0 norm gradient that would NaN the whole parameter gradient.
+    d = safe_norm(states4[:, None, :2] - obs[..., :2], axis=-1)
+    nearest1 = jnp.min(jnp.where(mask, d, jnp.inf), axis=1)
+    return obs, mask, nearest1, dropped
+
+
 def knn_gating_pallas(states4, radius, k: int, *, interpret: bool = False):
     """Drop-in for :func:`cbf_tpu.rollout.gating.knn_gating` (all-row
     self-exclusion form) + the nearest-any metric.
@@ -422,14 +498,14 @@ def knn_gating_pallas(states4, radius, k: int, *, interpret: bool = False):
     nearest_all (N,), dropped (N,) int32 — in-radius candidates beyond the
     k slots, i.e. the truncation vs. the reference's exact danger scan;
     callers must surface it (StepOutputs.gating_dropped_count)).
+
+    Routed through :func:`knn_select` so the fused-vs-blocked dispatch and
+    the epilogue exist once (the custom_vjp is inert outside AD; this
+    non-diff path's gradients are undefined by contract anyway).
     """
-    n = states4.shape[0]
-    fn = knn_neighbors if n <= MAX_N_FUSED else knn_neighbors_blocked
-    idx, dist, nearest, count = fn(states4[:, :2], radius, k,
-                                   interpret=interpret)
-    mask = jnp.isfinite(dist)
-    obs = jnp.take(states4, idx, axis=0)
-    dropped = jnp.maximum(count - k, 0)
+    idx, dist, nearest, count = knn_select(states4[:, :2], radius, k,
+                                           interpret)
+    obs, mask, dropped = _gating_epilogue(states4, idx, dist, count, k)
     return obs, mask, nearest, dropped
 
 

@@ -122,20 +122,29 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
     double = cfg.dynamics == "double"
     vslots = v if (double or not discrete) else jnp.zeros_like(v)
     states4 = jnp.concatenate([x, vslots], axis=1)
-    if (lax.axis_size(axis_name) == 1 and unroll_relax == 0
-            and pallas_knn.supported(cfg.n)):
+    if (lax.axis_size(axis_name) == 1 and pallas_knn.supported(cfg.n)):
         # dp-only sharding: each swarm is whole on its device, so the
         # single-device fused Pallas kernel applies — ~8x the dense
-        # top_k exchange at N=4096 (measured on the TPU bench). Excluded
-        # from the differentiable (unroll_relax > 0) path: the kernel has
-        # no AD rule.
-        obs_slab, mask, nearest_all, dropped = pallas_knn.knn_gating_pallas(
-            states4, cfg.safety_distance, K)
-        # The exchange contract's "nearest" is the top-1 gated distance
-        # (inf when nothing is in radius); the kernel's nearest-any equals
-        # it within the radius, and every consumer clips at the radius.
-        nearest1 = jnp.where(nearest_all < cfg.safety_distance,
-                             nearest_all, jnp.inf)
+        # top_k exchange at N=4096 (measured on the TPU bench). The
+        # differentiable (unroll_relax > 0) trainer path uses the
+        # selection-oracle twin: the kernel has no AD rule, so Pallas
+        # selects and jnp recomputes the slab gather + the gated nearest
+        # distance the separation hinge differentiates through
+        # (ops.pallas_knn.knn_gating_pallas_diff — same gradients as the
+        # exchange path, finite-difference-tested).
+        if unroll_relax > 0:
+            obs_slab, mask, nearest1, dropped = \
+                pallas_knn.knn_gating_pallas_diff(
+                    states4, cfg.safety_distance, K)
+        else:
+            obs_slab, mask, nearest_all, dropped = \
+                pallas_knn.knn_gating_pallas(states4, cfg.safety_distance, K)
+            # The exchange contract's "nearest" is the top-1 gated distance
+            # (inf when nothing is in radius); the kernel's nearest-any
+            # equals it within the radius, and every consumer clips at the
+            # radius.
+            nearest1 = jnp.where(nearest_all < cfg.safety_distance,
+                                 nearest_all, jnp.inf)
     else:
         # exchange_knn picks all-gather vs ppermute-ring by gathered size
         # (Ulysses-vs-ring duality — parallel.alltoall).
@@ -171,22 +180,33 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
         # swarm is on one device and the joint layer applies per member
         # exactly as in the scenario step. sp > 1: all-gather the (tiny)
         # joint-QP inputs — (N, 2) positions + (N, 2) filtered velocities —
-        # and solve the SAME joint QP replicated on every sp shard, each
-        # keeping its local slice. Replication costs sp-fold redundant
-        # certificate compute but zero in-loop communication (one gather
-        # per step), and is exactly the dp-only math — the sparse backend
-        # (Config.certificate_backend) keeps that redundant solve O(N*k).
+        # then either ROW-PARTITION the sparse solve over sp (each shard
+        # owns its local agents' pair rows, O(N*k/sp) row work per device
+        # — scenarios.swarm.apply_certificate_sharded, the default) or
+        # solve the SAME joint QP replicated on every shard (the dense
+        # backend, the differentiable path, and the
+        # certificate_partition="replicate" escape hatch — sp-fold
+        # redundant compute, zero in-loop communication).
         diff = unroll_relax > 0
         if lax.axis_size(axis_name) == 1:
             u, cert_res, cert_dropped = \
-                swarm_scenario.apply_certificate(cfg, u, x,
-                                                 differentiable=diff)
+                swarm_scenario.apply_certificate(cfg, u, x)
         else:
             xg = lax.all_gather(x, axis_name, axis=0, tiled=True)
             ug = lax.all_gather(u, axis_name, axis=0, tiled=True)
-            ug, cert_res, cert_dropped = \
-                swarm_scenario.apply_certificate(cfg, ug, xg,
-                                                 differentiable=diff)
+            # The differentiable (trainer) path keeps the replicated
+            # solve: the partitioned solver's custom_vjp under shard_map
+            # cotangents is unproven (and the trainer today runs sp-small).
+            partitioned = (
+                cfg.certificate_partition == "auto" and not diff
+                and swarm_scenario.certificate_backend(cfg) == "sparse")
+            if partitioned:
+                ug, cert_res, cert_dropped = \
+                    swarm_scenario.apply_certificate_sharded(
+                        cfg, ug, xg, axis_name)
+            else:
+                ug, cert_res, cert_dropped = \
+                    swarm_scenario.apply_certificate(cfg, ug, xg)
             i0 = lax.axis_index(axis_name) * x.shape[0]
             u = lax.dynamic_slice_in_dim(ug, i0, x.shape[0], axis=0)
         # The joint QP's internal constants can demote the varying-manual-
@@ -214,8 +234,10 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
             lax.psum(jnp.sum(~info.feasible & engaged), axis_name),
             lax.psum(jnp.sum(dropped), axis_name),
             lax.pmax(cert_res, axis_name),
-            # pmax, not psum: under sp > 1 every shard computes the SAME
-            # replicated joint solve — summing would sp-fold-count it.
+            # pmax, not psum: under sp > 1 every shard carries the same
+            # GLOBAL value — the replicated path because each solves the
+            # whole problem, the partitioned path because its counts are
+            # already psummed inside — so summing would sp-fold-count it.
             lax.pmax(match_vma(cert_dropped, x), axis_name),
             lax.pmax(match_vma(deficit, x), axis_name),
         )

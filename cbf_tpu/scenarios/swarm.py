@@ -170,6 +170,14 @@ class Config:
     # sparse beyond (where dense memory/factorization walls out).
     certificate_backend: str = "auto"
     certificate_k: int = 16
+    # sp > 1 ensembles only: "auto" row-partitions the sparse backend's
+    # joint solve over the sp axis (each shard owns its local agents' pair
+    # rows — O(N*k/sp) row work per device; parallel.ensemble), falling
+    # back to the replicated whole-problem solve for the dense backend and
+    # the differentiable path; "replicate" forces the fallback everywhere
+    # (the round-4 behavior — kept as the escape hatch the partitioned
+    # path is tested against).
+    certificate_partition: str = "auto"
     # Double mode only: short-range separation term in the nominal (see
     # separation_bias). sep_target is the spacing below which pairs repel —
     # default = the packed-disk design spacing (pack density 1/(pi r^2)
@@ -339,6 +347,11 @@ def barrier_dynamics(cfg: Config, dtype):
         raise ValueError(
             f"certificate_backend must be auto|dense|sparse, got "
             f"{cfg.certificate_backend!r}")
+    if cfg.certificate and cfg.certificate_partition not in ("auto",
+                                                             "replicate"):
+        raise ValueError(
+            f"certificate_partition must be auto|replicate, got "
+            f"{cfg.certificate_partition!r}")
     if (cfg.certificate and cfg.certificate_pairs is not None
             and certificate_backend(cfg) == "sparse"):
         raise ValueError(
@@ -614,7 +627,18 @@ def certificate_backend(cfg: Config) -> str:
     return cfg.certificate_backend
 
 
-def apply_certificate(cfg: Config, u, x, differentiable: bool = False):
+def _certificate_problem(cfg: Config):
+    """(CertificateParams, arena) for the joint second layer — the ONE
+    derivation shared by the replicated and row-partitioned appliers (a
+    drifted duplicate would certify against different constraint sets per
+    execution path)."""
+    from cbf_tpu.sim.certificates import CertificateParams
+    half = cfg.spawn_half_width * 1.5
+    return (CertificateParams(magnitude_limit=cfg.speed_limit),
+            (-half, half, -half, half))
+
+
+def apply_certificate(cfg: Config, u, x):
     """The joint second layer over already-filtered si velocities (see
     Config.certificate). Shared by the scenario step and the sharded
     ensemble. Returns (u_certified (N, 2), primal_residual scalar,
@@ -623,26 +647,45 @@ def apply_certificate(cfg: Config, u, x, differentiable: bool = False):
     emits; 0 on the dense backend, whose max_pairs pruning keeps the
     globally tightest rows and is covered by its own exactness test).
 
-    ``differentiable=True`` (the trainer's unrolled path) pins the sparse
-    backend's neighbor search to the jnp form — the Pallas kernel has no
-    AD rule (same exclusion the gating makes under unroll_relax)."""
-    from cbf_tpu.sim.certificates import (CertificateParams,
-                                          si_barrier_certificate,
+    Differentiable as-is (no mode flag): the sparse path's kernel runs as
+    a selection oracle (ops.pallas_knn.knn_select — zero cotangent, the
+    true a.e. gradient of a selection) and its row-geometry gradients
+    flow through jnp gathers of the positions, so the trainer keeps the
+    Pallas search at scale (finite-difference-validated; the round-4 jnp
+    pinning made large-N training O(N^2)-bound). The DENSE backend stays
+    non-differentiable (fori_loop solver) — learn.tuning guards it."""
+    from cbf_tpu.sim.certificates import (si_barrier_certificate,
                                           si_barrier_certificate_sparse)
-    half = cfg.spawn_half_width * 1.5
-    params = CertificateParams(magnitude_limit=cfg.speed_limit)
-    arena = (-half, half, -half, half)
+    params, arena = _certificate_problem(cfg)
     if certificate_backend(cfg) == "sparse":
         u_cert, cinfo = si_barrier_certificate_sparse(
             u.T, x.T, params, k=cfg.certificate_k, with_info=True,
-            arena=arena,
-            neighbor_backend="jnp" if differentiable else "auto")
+            arena=arena)
         return u_cert.T, cinfo.primal_residual, cinfo.dropped_count
     pairs = (cfg.certificate_pairs if cfg.certificate_pairs is not None
              else 8 * cfg.n)
     u_cert, cinfo = si_barrier_certificate(
         u.T, x.T, params, max_pairs=pairs, with_info=True, arena=arena)
     return u_cert.T, cinfo.primal_residual, jnp.zeros((), jnp.int32)
+
+
+def apply_certificate_sharded(cfg: Config, u, x, axis_name: str):
+    """Row-partitioned twin of :func:`apply_certificate` for sp-sharded
+    ensembles (sparse backend only — the dense solver factorizes the full
+    2N system and cannot partition by rows): same problem derivation
+    (:func:`_certificate_problem`), same return contract, but the joint
+    solve's O(N*k) row work splits over ``axis_name`` instead of being
+    replicated per shard (see
+    certificates.si_barrier_certificate_sparse_sharded). Inputs u, x are
+    the GLOBAL (N, 2) arrays, replicated across the axis (the caller's
+    all-gather); callers choose this path via Config.certificate_partition
+    (parallel.ensemble)."""
+    from cbf_tpu.sim.certificates import si_barrier_certificate_sparse_sharded
+    params, arena = _certificate_problem(cfg)
+    u_cert, cinfo = si_barrier_certificate_sparse_sharded(
+        u.T, x.T, axis_name, params, k=cfg.certificate_k, with_info=True,
+        arena=arena)
+    return u_cert.T, cinfo.primal_residual, cinfo.dropped_count
 
 
 def integrate(cfg: Config, x, v, u):

@@ -250,13 +250,14 @@ def si_barrier_certificate_sparse(
                   or (neighbor_backend == "auto"
                       and pallas_knn.supported(N)))
     if use_pallas:
-        # Same fused-vs-streaming dispatch as knn_gating_pallas: the fused
-        # kernel is VMEM-bound to MAX_N_FUSED; beyond it the blocked
-        # streaming kernel covers supported()'s full range.
-        fn = (pallas_knn.knn_neighbors if N <= pallas_knn.MAX_N_FUSED
-              else pallas_knn.knn_neighbors_blocked)
-        idx, dist_k, _, count = fn(xt, pair_radius, k,
-                                   interpret=pallas_interpret)
+        # knn_select: the oracle wrapper (fused-vs-streaming dispatch
+        # inside) — differentiable callers are safe because nothing
+        # downstream differentiates the kernel's OUTPUT VALUES: idx/count
+        # are integers, dist_k feeds only the boolean mask, and the row
+        # geometry gradients flow through _pair_row_geometry's jnp gathers
+        # of xt (finite-difference-tested with this backend).
+        idx, dist_k, _, count = pallas_knn.knn_select(
+            xt, pair_radius, k, pallas_interpret)
         mask = jnp.isfinite(dist_k)                          # (N, k)
     else:
         dist = pairwise_distances(xt)                        # (N, N)
@@ -284,24 +285,8 @@ def si_barrier_certificate_sparse(
     M = jnp.sum(mutual, dtype=jnp.int32)
     dropped = D // 2 - (S - M // 2)
     maskf = mask.reshape(-1)
-    err = xt[I] - xt[J]                                      # (R, 2)
-    h = jnp.sum(err * err, axis=1) - params.safety_radius**2
-    coef = jnp.where(maskf[:, None], -2.0 * err, 0.0).astype(dtype)
-    b_pair = jnp.where(maskf, params.barrier_gain * h**3,
-                       jnp.inf).astype(dtype)
-
-    if arena is not None:
-        xmin, xmax, ymin, ymax = arena
-        r2 = params.safety_radius / 2.0
-        gb = 0.4 * params.barrier_gain
-        hi = jnp.stack([gb * (xmax - r2 - xt[:, 0]) ** 3,
-                        gb * (ymax - r2 - xt[:, 1]) ** 3], axis=1)
-        lo = jnp.stack([-gb * (xt[:, 0] - xmin - r2) ** 3,
-                        -gb * (xt[:, 1] - ymin - r2) ** 3], axis=1)
-        lo, hi = lo.astype(dtype), hi.astype(dtype)
-    else:
-        hi = jnp.full((N, 2), jnp.inf, dtype)
-        lo = -hi
+    coef, b_pair = _pair_row_geometry(xt, I, J, maskf, params, dtype)
+    lo, hi = _arena_box(xt, params, arena, dtype)
 
     u, info = solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
                                      settings)
@@ -309,4 +294,131 @@ def si_barrier_certificate_sparse(
     if with_info:
         return out, SparseCertificateInfo(info.primal_residual,
                                           info.dual_residual, dropped)
+    return out
+
+
+def _pair_row_geometry(xt, I, J, maskf, params: CertificateParams, dtype):
+    """(coef, b_pair) for pair rows I->J over global positions xt (N, 2) —
+    the ONE definition of the sparse certificate's row geometry, shared by
+    the replicated and row-partitioned builders (a drifted duplicate would
+    certify different constraints per path)."""
+    err = xt[I] - xt[J]                                      # (R, 2)
+    h = jnp.sum(err * err, axis=1) - params.safety_radius**2
+    coef = jnp.where(maskf[:, None], -2.0 * err, 0.0).astype(dtype)
+    b_pair = jnp.where(maskf, params.barrier_gain * h**3,
+                       jnp.inf).astype(dtype)
+    return coef, b_pair
+
+
+def _arena_box(xt, params: CertificateParams, arena, dtype):
+    """(lo, hi) (N, 2) component box from the arena-boundary rows (shared
+    between the sparse builders, see _pair_row_geometry)."""
+    N = xt.shape[0]
+    if arena is None:
+        hi = jnp.full((N, 2), jnp.inf, dtype)
+        return -hi, hi
+    xmin, xmax, ymin, ymax = arena
+    r2 = params.safety_radius / 2.0
+    gb = 0.4 * params.barrier_gain
+    hi = jnp.stack([gb * (xmax - r2 - xt[:, 0]) ** 3,
+                    gb * (ymax - r2 - xt[:, 1]) ** 3], axis=1)
+    lo = jnp.stack([-gb * (xt[:, 0] - xmin - r2) ** 3,
+                    -gb * (xt[:, 1] - ymin - r2) ** 3], axis=1)
+    return lo.astype(dtype), hi.astype(dtype)
+
+
+def si_barrier_certificate_sparse_sharded(
+        dxi, x, axis_name: str,
+        params: CertificateParams = CertificateParams(),
+        settings: SparseADMMSettings = SparseADMMSettings(),
+        k: int = 32, pair_radius: float | None = None,
+        with_info: bool = False, arena: tuple | None = ARENA):
+    """Row-partitioned twin of :func:`si_barrier_certificate_sparse` for
+    use INSIDE ``shard_map``: the joint QP still couples all N agents (it
+    can never be solved on a fragment — that would certify fragments), but
+    each sp shard builds and iterates only the pair rows its LOCAL agents
+    own, so the O(N*k) row work — neighbor search, row geometry, and the
+    ADMM's per-row state updates, the dominant cost — scales 1/sp instead
+    of being duplicated per shard (the round-4 replicated design's
+    limitation). The (N, 2) velocity iterate stays replicated: it is
+    microscopic (16 B/agent) next to the row state, and keeping it
+    replicated reduces the collective footprint to one (2N,) psum per CG
+    matvec + scalar reductions (see solve_pair_box_qp_admm's axis_name
+    contract). Same guarantee surface, same solution (up to psum summation
+    order in f32), same dropped-pair accounting as the replicated path —
+    asserted by tests/test_sparse_certificate.py at N=1024 on the virtual
+    mesh.
+
+    Args: dxi, x — GLOBAL (2, N) arrays, replicated across ``axis_name``
+    (the ensemble path already all-gathers them for gating); N must
+    divide the axis size. Returns the full certified (2, N) (replicated)
+    [, SparseCertificateInfo with globally-reduced residuals/dropped].
+
+    Neighbor search is the exact jnp form on a rectangular (n_local, N)
+    block — each shard searches only its own rows, so the search is
+    sharded too (a rectangular-query Pallas kernel would fuse it on TPU;
+    the full-query kernels in ops.pallas_knn assume query set == candidate
+    set). Gradient support: not claimed — the trainer runs the replicated
+    path (see scenarios.swarm.apply_certificate).
+    """
+    N = x.shape[1]
+    n_shards = lax.axis_size(axis_name)
+    if N % n_shards:
+        raise ValueError(f"N={N} must be divisible by the {axis_name!r} "
+                         f"axis size {n_shards}")
+    n_local = N // n_shards
+    dtype = jnp.result_type(dxi, x)
+    if pair_radius is None:
+        pair_radius = binding_pair_radius(params)
+    k = min(k, N - 1)
+
+    # Magnitude pre-limit on the full replicated nominal (O(N) — cheap;
+    # safe_norm for the same trainer-NaN reason as the replicated path).
+    norms = safe_norm(dxi, axis=0)
+    scale = jnp.maximum(1.0, norms / params.magnitude_limit)
+    u_nom = (dxi / scale[None, :]).T                         # (N, 2)
+
+    xt = x.T                                                 # (N, 2)
+    i0 = lax.axis_index(axis_name) * n_local
+    gI = i0 + jnp.arange(n_local)                            # global rows
+    xt_local = lax.dynamic_slice_in_dim(xt, i0, n_local)
+    dist = pairwise_distances(xt_local, xt)                  # (n_local, N)
+    eligible = ((dist < pair_radius)
+                & (jnp.arange(N)[None, :] != gI[:, None]))
+    keyed = jnp.where(eligible, dist, jnp.inf)
+    neg_d, idx = lax.top_k(-keyed, k)                        # (n_local, k)
+    mask = jnp.isfinite(neg_d)
+    count = jnp.sum(eligible, axis=1, dtype=jnp.int32)
+
+    # Symmetric coverage accounting (see the replicated path for the
+    # formula): the reverse-row lookup needs every shard's kept slots, so
+    # gather the (tiny) idx/mask tables once; counts psum to the same
+    # global D/S/M the replicated path computes.
+    idx_g = lax.all_gather(idx, axis_name, axis=0, tiled=True)    # (N, k)
+    mask_g = lax.all_gather(mask, axis_name, axis=0, tiled=True)
+    I = jnp.broadcast_to(gI[:, None], (n_local, k)).reshape(-1)
+    J = idx.reshape(-1)
+    maskf = mask.reshape(-1)
+    mutual = maskf & jnp.any(
+        (idx_g[J] == I[:, None]) & mask_g[J], axis=1)
+    D = lax.psum(jnp.sum(count), axis_name)
+    S = lax.psum(jnp.sum(mask, dtype=jnp.int32), axis_name)
+    M = lax.psum(jnp.sum(mutual, dtype=jnp.int32), axis_name)
+    dropped = D // 2 - (S - M // 2)
+
+    coef, b_pair = _pair_row_geometry(xt, I, J, maskf, params, dtype)
+    lo, hi = _arena_box(xt, params, arena, dtype)
+
+    u, info = solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
+                                     settings, axis_name=axis_name)
+    # The solve's outputs are numerically replicated across the axis but
+    # TYPED varying (its carries were vma-promoted by the sharded row
+    # data); one pmax per output re-asserts the replicated type so caller
+    # out_specs can state what the contract states. Cost: a single (N, 2)
+    # reduction against the ~iters * cg_iters psums inside the solve.
+    out = lax.pmax(u, axis_name).T
+    if with_info:
+        return out, SparseCertificateInfo(
+            lax.pmax(info.primal_residual, axis_name),
+            lax.pmax(info.dual_residual, axis_name), dropped)
     return out

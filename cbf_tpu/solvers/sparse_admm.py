@@ -21,6 +21,14 @@ vmaps across ensemble members, and contains no data-dependent shapes.
 
 Same fixed-iteration contract as the dense solver: convergence is asserted
 by the caller from the returned residuals, never assumed.
+
+Row-partitioned mode (``axis_name``, round 5): inside ``shard_map`` each
+shard passes only the pair rows its local agents own; the scatter-add
+transpose is completed by one (2N,) psum per K application while the tiny
+(2N,) iterate stays replicated — so the dominant O(R) row work scales
+1/sp across the mesh instead of being replicated per shard (see
+solve_pair_box_qp_admm's axis_name contract and
+sim.certificates.si_barrier_certificate_sparse_sharded).
 """
 
 from __future__ import annotations
@@ -89,21 +97,31 @@ def _cg(apply_K, rhs, iters, vma_ref=None):
     return x
 
 
-def _make_apply_K(coef_s, I, J, rho, sigma, dtype=None):
+def _make_apply_K(coef_s, I, J, rho, sigma, dtype=None, axis_name=None):
     """The x-update operator K = (1 + sigma + rho) I + rho A_pair^T A_pair
     (+ rho I from the identity box block), matrix-free over flattened
     (2N,) vectors — the ONE definition of the pair operator, shared by
     the ADMM iteration, the implicit-gradient solve, and its backward
-    rule (a drifted duplicate would silently solve a different K)."""
+    rule (a drifted duplicate would silently solve a different K).
+
+    ``axis_name``: row-partitioned mode (see solve_pair_box_qp_admm) —
+    this shard holds only its own rows (I, J index the FULL variable
+    vector), so the transpose's scatter-add is completed by one psum over
+    the mesh axis. A_pair stays collective-free (local rows, replicated
+    v), and apply_K's output is replicated — CG dot products then need no
+    collectives of their own."""
     dtype = coef_s.dtype if dtype is None else dtype
 
-    def A_pair(v):                                   # (N, 2) -> (R,)
+    def A_pair(v):                                   # (N, 2) -> (R_local,)
         return jnp.sum(coef_s * (v[I] - v[J]), axis=1)
 
-    def A_pair_T(y, n):                              # (R,) -> (N, 2)
+    def A_pair_T(y, n):                              # (R_local,) -> (N, 2)
         contrib = coef_s * y[:, None]
         z = jnp.zeros((n, 2), dtype)
-        return z.at[I].add(contrib).at[J].add(-contrib)
+        z = z.at[I].add(contrib).at[J].add(-contrib)
+        if axis_name is not None:
+            z = lax.psum(z, axis_name)
+        return z
 
     def apply_K(v2):
         v = v2.reshape(-1, 2)
@@ -114,7 +132,7 @@ def _make_apply_K(coef_s, I, J, rho, sigma, dtype=None):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
-def _solve_K(iters, rho_sigma, coef_s, I, J, rhs, x_warm):
+def _solve_K(iters, rho_sigma_axis, coef_s, I, J, rhs, x_warm):
     """Warm-started SPD solve x = K^{-1} rhs with an IMPLICIT gradient.
 
     Forward: x = x_warm + CG(K, rhs - K x_warm) — the warm start enters as
@@ -127,22 +145,30 @@ def _solve_K(iters, rho_sigma, coef_s, I, J, rhs, x_warm):
     reciprocal factors turn the whole parameter gradient NaN (measured on
     the two-layer trainer) — and jax's custom_linear_solve machinery
     trips shard_map's varying-manual-axes checking, so the rule is
-    written out by hand."""
-    rho, sigma = rho_sigma
-    apply_K, _, _ = _make_apply_K(coef_s, I, J, rho, sigma)
+    written out by hand.
+
+    ``rho_sigma_axis`` = (rho, sigma, axis_name) — all static (axis_name
+    None outside row-partitioned mode). The backward rule solves with the
+    SAME (possibly psummed) operator; in partitioned mode its closed-form
+    coef cotangent is per-local-row, which is exactly this shard's slice
+    of the global gradient (row ownership is a partition of the rows)."""
+    rho, sigma, axis_name = rho_sigma_axis
+    apply_K, _, _ = _make_apply_K(coef_s, I, J, rho, sigma,
+                                  axis_name=axis_name)
     return x_warm + _cg(apply_K, rhs - apply_K(x_warm), iters,
                         vma_ref=coef_s[0, 0])
 
 
-def _solve_K_fwd(iters, rho_sigma, coef_s, I, J, rhs, x_warm):
-    x = _solve_K(iters, rho_sigma, coef_s, I, J, rhs, x_warm)
+def _solve_K_fwd(iters, rho_sigma_axis, coef_s, I, J, rhs, x_warm):
+    x = _solve_K(iters, rho_sigma_axis, coef_s, I, J, rhs, x_warm)
     return x, (coef_s, I, J, x)
 
 
-def _solve_K_bwd(iters, rho_sigma, res, ct):
+def _solve_K_bwd(iters, rho_sigma_axis, res, ct):
     coef_s, I, J, x = res
-    rho, sigma = rho_sigma
-    apply_K, _, _ = _make_apply_K(coef_s, I, J, rho, sigma)
+    rho, sigma, axis_name = rho_sigma_axis
+    apply_K, _, _ = _make_apply_K(coef_s, I, J, rho, sigma,
+                                  axis_name=axis_name)
     w = _cg(apply_K, ct, iters,                      # K w = ct (K symmetric)
             vma_ref=coef_s[0, 0])
     xv, wv = x.reshape(-1, 2), w.reshape(-1, 2)
@@ -162,7 +188,8 @@ _solve_K.defvjp(_solve_K_fwd, _solve_K_bwd)
 
 
 def solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
-                           settings: SparseADMMSettings = SparseADMMSettings()):
+                           settings: SparseADMMSettings = SparseADMMSettings(),
+                           axis_name: str | None = None):
     """Solve the neighbor-pair QP above. Returns (u (N, 2), SparseADMMInfo).
 
     Args:
@@ -175,6 +202,17 @@ def solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
         A zero row (with b_pair >= 0) is inert padding.
       b_pair: (R,) upper bounds; pair rows are one-sided (lower = -inf).
       lo, hi: (N, 2) component box from the arena rows (+-inf = unbounded).
+      axis_name: ROW-PARTITIONED mode, for use inside shard_map: each
+        shard passes only the rows it owns (I/J still index the full
+        variable vector; u_nom/lo/hi replicated across the axis) and the
+        row-coupled work — the O(R) gathers, scatter-adds, and the z/y
+        updates, which dominate at R = N*k — splits 1/axis_size per
+        device. The (2N,) iterate itself stays replicated: at 8 bytes per
+        agent it is microscopic next to the row state, and replicating it
+        turns ALL of CG's dot products local, leaving exactly one (2N,)
+        psum per K application (cg_iters + 1 per ADMM iteration) + the
+        final residual reductions as the collective footprint. The
+        returned u and residuals are replicated across the axis.
     """
     N = u_nom.shape[0]
     dtype = jnp.result_type(u_nom, coef)
@@ -193,7 +231,7 @@ def solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
     b_s = jnp.where(jnp.isfinite(b_pair), b_pair * d, b_pair)
 
     _, A_pair, _A_pair_T = _make_apply_K(coef_s, I, J, rho, sigma,
-                                         dtype=dtype)
+                                         dtype=dtype, axis_name=axis_name)
     A_pair_T = lambda y: _A_pair_T(y, N)             # noqa: E731
 
     q = -u_nom.reshape(-1)
@@ -204,7 +242,7 @@ def solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
         rhs = (sigma * x - q
                + A_pair_T(rho * z_p - y_p).reshape(-1)
                + (rho * z_b - y_b))
-        x_new = _solve_K(settings.cg_iters, (rho, sigma),
+        x_new = _solve_K(settings.cg_iters, (rho, sigma, axis_name),
                          coef_s, I, J, rhs, x)
         Ax_p = A_pair(x_new.reshape(N, 2))
         Ax_b = x_new
@@ -219,10 +257,12 @@ def solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
 
     R = I.shape[0]
     # match_vma: see solvers.admm — zero carries must match the problem
-    # data's varying-manual-axes type under shard_map.
-    x0 = match_vma(jnp.zeros((2 * N,), dtype), q)
+    # data's varying-manual-axes type under shard_map. In row-partitioned
+    # mode the x/z_b carries additionally pick up coef_s's axes through
+    # _cg's vma_ref, so pre-align them with both (chaining unions axes).
+    x0 = match_vma(match_vma(jnp.zeros((2 * N,), dtype), q), coef_s[0, 0])
     zp0 = match_vma(jnp.zeros((R,), dtype), coef_s[:, 0])
-    zb0 = match_vma(jnp.zeros((2 * N,), dtype), q)
+    zb0 = match_vma(match_vma(jnp.zeros((2 * N,), dtype), q), coef_s[0, 0])
     # scan, not fori_loop: reverse-differentiable (see _cg).
     (x, z_p, z_b, y_p, y_b), _ = lax.scan(
         step, (x0, zp0, zb0, zp0, zb0), None, length=settings.iters)
@@ -230,8 +270,12 @@ def solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
     u = x.reshape(N, 2)
     # Residuals in the ORIGINAL row geometry (d > 0 leaves the feasible set
     # unchanged; the dual residual is scale-invariant, cf. solvers.admm).
+    # Partitioned mode: viol_p sees only local rows -> pmax completes it;
+    # the dual vector's A^T term is already psummed inside A_pair_T.
     Ax_orig = jnp.sum(coef * (u[I] - u[J]), axis=1)
     viol_p = jnp.max(jnp.maximum(Ax_orig - b_pair, 0.0), initial=0.0)
+    if axis_name is not None:
+        viol_p = lax.pmax(viol_p, axis_name)
     viol_b = jnp.max(jnp.maximum(
         jnp.maximum(lo.reshape(-1) - x, x - hi.reshape(-1)), 0.0),
         initial=0.0)
