@@ -414,6 +414,13 @@ def supported(n: int) -> bool:
     return jax.default_backend() == "tpu"
 
 
+def _kernel_dispatch(x, radius, k: int, interpret: bool):
+    """Fused-vs-streaming kernel dispatch — the ONE routing decision,
+    shared by the oracle (knn_select) and the raw non-diff gating path."""
+    fn = knn_neighbors if x.shape[0] <= MAX_N_FUSED else knn_neighbors_blocked
+    return fn(x, radius, k, interpret=interpret)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
 def knn_select(x, radius, k: int, interpret: bool = False):
     """The Pallas k-NN kernels as a SELECTION ORACLE with a defined (zero)
@@ -431,8 +438,7 @@ def knn_select(x, radius, k: int, interpret: bool = False):
     positions via ``idx`` (jnp gather — see :func:`knn_gating_pallas_diff`
     and sim.certificates.si_barrier_certificate_sparse, whose row geometry
     is already rebuilt from gathered positions)."""
-    fn = knn_neighbors if x.shape[0] <= MAX_N_FUSED else knn_neighbors_blocked
-    return fn(x, radius, k, interpret=interpret)
+    return _kernel_dispatch(x, radius, k, interpret)
 
 
 def _knn_select_fwd(x, radius, k, interpret):
@@ -499,12 +505,16 @@ def knn_gating_pallas(states4, radius, k: int, *, interpret: bool = False):
     k slots, i.e. the truncation vs. the reference's exact danger scan;
     callers must surface it (StepOutputs.gating_dropped_count)).
 
-    Routed through :func:`knn_select` so the fused-vs-blocked dispatch and
-    the epilogue exist once (the custom_vjp is inert outside AD; this
-    non-diff path's gradients are undefined by contract anyway).
+    Calls the RAW kernel dispatch, not the knn_select oracle: this path's
+    gradients are undefined by contract, and the raw kernel keeps the
+    failure LOUD — jax.grad through it raises "no AD rule" at trace time,
+    where the oracle would silently return zero cotangents for the
+    nearest/dist values (a loss on min_dist would train on wrong
+    gradients with no error). Differentiable callers use
+    :func:`knn_gating_pallas_diff`.
     """
-    idx, dist, nearest, count = knn_select(states4[:, :2], radius, k,
-                                           interpret)
+    idx, dist, nearest, count = _kernel_dispatch(states4[:, :2], radius, k,
+                                                 interpret)
     obs, mask, dropped = _gating_epilogue(states4, idx, dist, count, k)
     return obs, mask, nearest, dropped
 

@@ -288,8 +288,11 @@ def si_barrier_certificate_sparse(
     coef, b_pair = _pair_row_geometry(xt, I, J, maskf, params, dtype)
     lo, hi = _arena_box(xt, params, arena, dtype)
 
+    # agent_k: the rows built above are agent-major by construction
+    # (I = repeat(arange(N), k)) — declare it so the solver's transpose
+    # runs the I side as a dense reshape-sum instead of a scatter.
     u, info = solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
-                                     settings)
+                                     settings, agent_k=k)
     out = u.T
     if with_info:
         return out, SparseCertificateInfo(info.primal_residual,
@@ -409,8 +412,12 @@ def si_barrier_certificate_sparse_sharded(
     coef, b_pair = _pair_row_geometry(xt, I, J, maskf, params, dtype)
     lo, hi = _arena_box(xt, params, arena, dtype)
 
+    # agent_k/rows_start: this shard's rows are agent-major starting at
+    # its block offset (I = i0 + repeat(arange(n_local), k)) — the
+    # solver's I-side transpose then needs no scatter.
     u, info = solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
-                                     settings, axis_name=axis_name)
+                                     settings, axis_name=axis_name,
+                                     agent_k=k, rows_start=i0)
     # The solve's outputs are numerically replicated across the axis but
     # TYPED varying (its carries were vma-promoted by the sharded row
     # data); one pmax per output re-asserts the replicated type so caller

@@ -97,7 +97,8 @@ def _cg(apply_K, rhs, iters, vma_ref=None):
     return x
 
 
-def _make_apply_K(coef_s, I, J, rho, sigma, dtype=None, axis_name=None):
+def _make_apply_K(coef_s, I, J, rho, sigma, dtype=None, axis_name=None,
+                  agent_k=None, rows_start=0):
     """The x-update operator K = (1 + sigma + rho) I + rho A_pair^T A_pair
     (+ rho I from the identity box block), matrix-free over flattened
     (2N,) vectors — the ONE definition of the pair operator, shared by
@@ -109,7 +110,19 @@ def _make_apply_K(coef_s, I, J, rho, sigma, dtype=None, axis_name=None):
     vector), so the transpose's scatter-add is completed by one psum over
     the mesh axis. A_pair stays collective-free (local rows, replicated
     v), and apply_K's output is replicated — CG dot products then need no
-    collectives of their own."""
+    collectives of their own.
+
+    ``agent_k``: declares the row structure the certificate builder
+    emits — R = m*agent_k rows with ``I = rows_start +
+    repeat(arange(m), agent_k)`` (row owner blocks contiguous, sorted).
+    Then the I side of the transpose is a dense reshape-sum placed by ONE
+    contiguous dynamic_update_slice — no scatter — leaving only the J
+    side as a true scatter-add. XLA lowers scatter-adds serially on TPU,
+    and the transpose runs inside every CG matvec, so halving the
+    scattered volume attacks the certificate solve's predicted dominant
+    cost (docs/BENCH_LOG.md "MFU / roofline"; exactness vs the generic
+    path is pinned by tests). ``rows_start`` is the owning block's global
+    offset (traced; 0 unsharded)."""
     dtype = coef_s.dtype if dtype is None else dtype
 
     def A_pair(v):                                   # (N, 2) -> (R_local,)
@@ -118,7 +131,13 @@ def _make_apply_K(coef_s, I, J, rho, sigma, dtype=None, axis_name=None):
     def A_pair_T(y, n):                              # (R_local,) -> (N, 2)
         contrib = coef_s * y[:, None]
         z = jnp.zeros((n, 2), dtype)
-        z = z.at[I].add(contrib).at[J].add(-contrib)
+        if agent_k is not None:
+            block = jnp.sum(contrib.reshape(-1, agent_k, 2), axis=1)
+            z = lax.dynamic_update_slice_in_dim(z, block, rows_start,
+                                                axis=0)
+            z = z.at[J].add(-contrib)
+        else:
+            z = z.at[I].add(contrib).at[J].add(-contrib)
         if axis_name is not None:
             z = lax.psum(z, axis_name)
         return z
@@ -132,7 +151,7 @@ def _make_apply_K(coef_s, I, J, rho, sigma, dtype=None, axis_name=None):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
-def _solve_K(iters, rho_sigma_axis, coef_s, I, J, rhs, x_warm):
+def _solve_K(iters, rho_sigma_axis, coef_s, I, J, rows_start, rhs, x_warm):
     """Warm-started SPD solve x = K^{-1} rhs with an IMPLICIT gradient.
 
     Forward: x = x_warm + CG(K, rhs - K x_warm) — the warm start enters as
@@ -147,28 +166,34 @@ def _solve_K(iters, rho_sigma_axis, coef_s, I, J, rhs, x_warm):
     trips shard_map's varying-manual-axes checking, so the rule is
     written out by hand.
 
-    ``rho_sigma_axis`` = (rho, sigma, axis_name) — all static (axis_name
-    None outside row-partitioned mode). The backward rule solves with the
-    SAME (possibly psummed) operator; in partitioned mode its closed-form
-    coef cotangent is per-local-row, which is exactly this shard's slice
-    of the global gradient (row ownership is a partition of the rows)."""
-    rho, sigma, axis_name = rho_sigma_axis
+    ``rho_sigma_axis`` = (rho, sigma, axis_name, agent_k) — all static
+    (axis_name None outside row-partitioned mode; agent_k None outside
+    the agent-major transpose fast path, whose traced block offset rides
+    the ``rows_start`` argument). The backward rule solves with the SAME
+    (possibly psummed) operator; in partitioned mode its closed-form coef
+    cotangent is per-local-row, which is exactly this shard's slice of
+    the global gradient (row ownership is a partition of the rows)."""
+    rho, sigma, axis_name, agent_k = rho_sigma_axis
     apply_K, _, _ = _make_apply_K(coef_s, I, J, rho, sigma,
-                                  axis_name=axis_name)
+                                  axis_name=axis_name, agent_k=agent_k,
+                                  rows_start=rows_start)
     return x_warm + _cg(apply_K, rhs - apply_K(x_warm), iters,
                         vma_ref=coef_s[0, 0])
 
 
-def _solve_K_fwd(iters, rho_sigma_axis, coef_s, I, J, rhs, x_warm):
-    x = _solve_K(iters, rho_sigma_axis, coef_s, I, J, rhs, x_warm)
-    return x, (coef_s, I, J, x)
+def _solve_K_fwd(iters, rho_sigma_axis, coef_s, I, J, rows_start, rhs,
+                 x_warm):
+    x = _solve_K(iters, rho_sigma_axis, coef_s, I, J, rows_start, rhs,
+                 x_warm)
+    return x, (coef_s, I, J, rows_start, x)
 
 
 def _solve_K_bwd(iters, rho_sigma_axis, res, ct):
-    coef_s, I, J, x = res
-    rho, sigma, axis_name = rho_sigma_axis
+    coef_s, I, J, rows_start, x = res
+    rho, sigma, axis_name, agent_k = rho_sigma_axis
     apply_K, _, _ = _make_apply_K(coef_s, I, J, rho, sigma,
-                                  axis_name=axis_name)
+                                  axis_name=axis_name, agent_k=agent_k,
+                                  rows_start=rows_start)
     w = _cg(apply_K, ct, iters,                      # K w = ct (K symmetric)
             vma_ref=coef_s[0, 0])
     xv, wv = x.reshape(-1, 2), w.reshape(-1, 2)
@@ -181,7 +206,7 @@ def _solve_K_bwd(iters, rho_sigma_axis, res, ct):
     d_rhs = w
     d_x_warm = jnp.zeros_like(x)     # x = K^{-1} rhs: no x_warm dependence
     f0 = lambda a: np.zeros(a.shape, dtype=jax.dtypes.float0)
-    return (d_coef, f0(I), f0(J), d_rhs, d_x_warm)
+    return (d_coef, f0(I), f0(J), f0(rows_start), d_rhs, d_x_warm)
 
 
 _solve_K.defvjp(_solve_K_fwd, _solve_K_bwd)
@@ -189,7 +214,8 @@ _solve_K.defvjp(_solve_K_fwd, _solve_K_bwd)
 
 def solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
                            settings: SparseADMMSettings = SparseADMMSettings(),
-                           axis_name: str | None = None):
+                           axis_name: str | None = None,
+                           agent_k: int | None = None, rows_start=0):
     """Solve the neighbor-pair QP above. Returns (u (N, 2), SparseADMMInfo).
 
     Args:
@@ -213,10 +239,18 @@ def solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
         psum per K application (cg_iters + 1 per ADMM iteration) + the
         final residual reductions as the collective footprint. The
         returned u and residuals are replicated across the axis.
+      agent_k / rows_start: opt-in agent-major transpose fast path — the
+        caller guarantees ``I == rows_start + repeat(arange(R // agent_k),
+        agent_k)`` (the certificate builders' layout), letting the I-side
+        transpose run as a dense reshape-sum instead of a scatter-add
+        (see _make_apply_K). Exactness vs the generic path is tested; a
+        caller passing agent_k with a DIFFERENT row layout gets silently
+        wrong answers, so only declare what the builder constructs.
     """
     N = u_nom.shape[0]
     dtype = jnp.result_type(u_nom, coef)
     rho, sigma, alpha = settings.rho, settings.sigma, settings.alpha
+    rows_start = jnp.asarray(rows_start, jnp.int32)
 
     # Row equilibration (same lesson as the dense solver: mixed row scales
     # stall fixed-rho ADMM). Pair row norm = ||(-c, +c)|| = sqrt(2)*||c||;
@@ -231,7 +265,9 @@ def solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
     b_s = jnp.where(jnp.isfinite(b_pair), b_pair * d, b_pair)
 
     _, A_pair, _A_pair_T = _make_apply_K(coef_s, I, J, rho, sigma,
-                                         dtype=dtype, axis_name=axis_name)
+                                         dtype=dtype, axis_name=axis_name,
+                                         agent_k=agent_k,
+                                         rows_start=rows_start)
     A_pair_T = lambda y: _A_pair_T(y, N)             # noqa: E731
 
     q = -u_nom.reshape(-1)
@@ -242,8 +278,9 @@ def solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
         rhs = (sigma * x - q
                + A_pair_T(rho * z_p - y_p).reshape(-1)
                + (rho * z_b - y_b))
-        x_new = _solve_K(settings.cg_iters, (rho, sigma, axis_name),
-                         coef_s, I, J, rhs, x)
+        x_new = _solve_K(settings.cg_iters,
+                         (rho, sigma, axis_name, agent_k),
+                         coef_s, I, J, rows_start, rhs, x)
         Ax_p = A_pair(x_new.reshape(N, 2))
         Ax_b = x_new
         Axr_p = alpha * Ax_p + (1.0 - alpha) * z_p

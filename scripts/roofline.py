@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import sys
 
 import jax
@@ -86,36 +87,11 @@ def main(n: int = 4096) -> dict:
     pallas_total_agent = (pallas_ops_step
                           + (full["flops"] - knn["flops"])) / n
 
-    # r02 driver-verified rate (committed record).
-    with open(os.path.join(ROOT, "docs", "verified_bench.json")) as fh:
-        rate = json.load(fh)["value"]
-
-    ops_s_jnp = rate * flops_agent_jnp
-    ops_s_pallas = rate * pallas_total_agent
-    steps_s = rate / n
     # jnp path HBM traffic: the materialized (N, N) distance matrix and
     # difference tensors (the reason the Pallas kernel exists); Pallas
     # path: (N, 4) states in, (N, K) x2 + (N,) out per step.
     jnp_hbm_step = full["bytes accessed"]
     pallas_hbm_step = n * 4 * 4 + n * K * 8 + n * 4
-
-    out = {
-        "n": n, "k": K,
-        "flops_per_agent_step_full_jnp": flops_agent_jnp,
-        "flops_per_agent_step_knn_jnp": knn["flops"] / n,
-        "flops_per_agent_step_filter": filt["flops"] / n,
-        "vpu_ops_per_agent_step_pallas_path": pallas_total_agent,
-        "bytes_hlo_per_agent_step_jnp": jnp_hbm_step / n,
-        "bytes_hbm_per_step_pallas": pallas_hbm_step,
-        "verified_rate": rate,
-        "vpu_utilization_fma_peak": ops_s_pallas / V5E_VPU_FMA_PEAK,
-        "vpu_utilization_realistic": ops_s_pallas / V5E_VPU_REALISTIC,
-        "mxu_mfu": 0.0,
-        "hbm_fraction_pallas": steps_s * pallas_hbm_step / (V5E_HBM_GBS * 1e9),
-        "hbm_fraction_if_jnp": steps_s * jnp_hbm_step / (V5E_HBM_GBS * 1e9),
-        "ceiling_rate_at_realistic_vpu":
-            V5E_VPU_REALISTIC / pallas_total_agent,
-    }
 
     print(f"== one swarm agent-step, N={n}, k={K} (XLA cost model, CPU "
           "lowering; flop counts are optimized-HLO properties) ==")
@@ -128,9 +104,50 @@ def main(n: int = 4096) -> dict:
     print(f"pallas path (analytic kernel model + XLA rest): "
           f"{pallas_total_agent:,.0f} VPU-ops/agent-step, "
           f"~{pallas_hbm_step / 1e6:.2f} MB HBM/step")
+
+    out = {
+        "n": n, "k": K,
+        "flops_per_agent_step_full_jnp": flops_agent_jnp,
+        "flops_per_agent_step_knn_jnp": knn["flops"] / n,
+        "flops_per_agent_step_filter": filt["flops"] / n,
+        "vpu_ops_per_agent_step_pallas_path": pallas_total_agent,
+        "bytes_hlo_per_agent_step_jnp": jnp_hbm_step / n,
+        "bytes_hbm_per_step_pallas": pallas_hbm_step,
+    }
+
+    # Driver-verified rate (committed record) — only comparable to THIS
+    # run's per-agent-step work model when N matches the N it was
+    # measured at (per-agent work is O(N), so a mismatched N would price
+    # a configuration nobody measured).
+    with open(os.path.join(ROOT, "docs", "verified_bench.json")) as fh:
+        verified = json.load(fh)
+    rate = verified["value"]
+    m = re.search(r"swarm N=(\d+)", verified.get("metric", ""))
+    verified_n = int(m.group(1)) if m else None
+    if verified_n != n:
+        print(f"\nWARNING: the verified rate was measured at "
+              f"N={verified_n}, not N={n} — the work model above is "
+              "valid, but a roofline placement would price an unmeasured "
+              "configuration; skipping it.")
+        return out
+
+    ops_s_pallas = rate * pallas_total_agent
+    steps_s = rate / n
+    out.update({
+        "verified_rate": rate,
+        "vpu_utilization_fma_peak": ops_s_pallas / V5E_VPU_FMA_PEAK,
+        "vpu_utilization_realistic": ops_s_pallas / V5E_VPU_REALISTIC,
+        "mxu_mfu": 0.0,
+        "hbm_fraction_pallas": steps_s * pallas_hbm_step / (V5E_HBM_GBS * 1e9),
+        "hbm_fraction_if_jnp": steps_s * jnp_hbm_step / (V5E_HBM_GBS * 1e9),
+        "ceiling_rate_at_realistic_vpu":
+            V5E_VPU_REALISTIC / pallas_total_agent,
+    })
+
     print()
     print(f"== rooflines at the driver-verified rate "
-          f"({rate:,.0f} agent-QP-steps/s/chip, r02) ==")
+          f"({rate:,.0f} agent-QP-steps/s/chip, "
+          f"{verified.get('round', '?')}) ==")
     print(f"VPU: {ops_s_pallas / 1e12:.2f} T op/s = "
           f"{out['vpu_utilization_fma_peak']:.1%} of FMA peak "
           f"({V5E_VPU_FMA_PEAK / 1e12:.1f} T), "
