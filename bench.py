@@ -34,6 +34,11 @@ Modes / env knobs:
   BENCH_K_NEIGHBORS (config default 8) — k-NN gating slots; non-default
     values are labeled in the metric + record (the k-sweep's rate axis;
     floors for k in {8,12,16} are calibrated in docs/BENCH_LOG.md).
+  BENCH_GATING_SKIN (0 = off) — Verlet neighbor-cache skin in meters
+    (Config.gating_rebuild_skin): reuse the k-NN selection until any
+    agent moves skin/2, attacking the O(N^2) search the roofline names
+    as 63% of step flops. Labeled in metric + record (single mode only;
+    measured 3.3x on CPU at N=2048 at skin=0.1, docs/BENCH_LOG.md).
   BENCH_N_OBSTACLES (0) — orbit that many moving obstacles through the
     swarm (workload is labeled in the metric + record; its vs_baseline is
     still against the obstacle-free target rate).
@@ -417,10 +422,12 @@ def _child_single(n: int, steps: int) -> dict:
     certificate = os.environ.get("BENCH_CERTIFICATE", "0") == "1"
     base_cfg = swarm.Config()
     k_neighbors = _env_int("BENCH_K_NEIGHBORS", base_cfg.k_neighbors)
+    gating_skin = _env_float("BENCH_GATING_SKIN", 0.0)
     cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
                        gating=gating, n_obstacles=n_obstacles,
                        dynamics=dynamics, certificate=certificate,
-                       k_neighbors=k_neighbors)
+                       k_neighbors=k_neighbors,
+                       gating_rebuild_skin=gating_skin)
     state0, step = swarm.make(cfg)
     chunk = min(_env_int("BENCH_CHUNK", 1000), steps)
     unroll = _env_int("BENCH_UNROLL", 1)
@@ -519,6 +526,11 @@ def _child_single(n: int, steps: int) -> dict:
     if k_neighbors != base_cfg.k_neighbors:
         result["metric"] += " [k=%d]" % k_neighbors
         result["k_neighbors"] = k_neighbors
+    if gating_skin:
+        # A cached-selection rate is a different workload axis than the
+        # exact-search headline — label it like the k-sweep.
+        result["metric"] += " [skin=%g]" % gating_skin
+        result["gating_skin"] = gating_skin
     if certificate:
         _label_certificate(result, cert_res, cert_dropped)
     return result

@@ -418,3 +418,12 @@ def test_failure_record_carries_last_verified(tmp_path):
     assert out["value"] == 0
     lv = out["last_verified"]
     assert lv["value"] > 0 and lv["round"] and lv["provenance"]
+
+
+def test_bench_gating_skin_knob_labels_record():
+    """BENCH_GATING_SKIN (the Verlet-cache rate axis) must reach the
+    config and label the record — a cached-selection rate must never
+    masquerade as the exact-search headline."""
+    out, stderr = _run_bench_e2e({"BENCH_GATING_SKIN": "0.15"})
+    assert "[skin=0.15]" in out["metric"]
+    assert out["gating_skin"] == 0.15
