@@ -558,6 +558,14 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
     # honored or rejected — silently benching a certificate-free rollout
     # under BENCH_CERTIFICATE=1 would mislabel the transcribed rate.
     certificate = os.environ.get("BENCH_CERTIFICATE", "0") == "1"
+    if _env_float("BENCH_GATING_SKIN", 0.0):
+        # Honored-or-rejected, same contract: the ensemble step keeps the
+        # exact per-step search (no Verlet cache), so accepting the knob
+        # here would transcribe an exact-search rate as a cached one.
+        raise ValueError(
+            "BENCH_GATING_SKIN is single-swarm-mode only (the sharded "
+            "ensemble step has no Verlet cache); unset it or drop "
+            "BENCH_ENSEMBLE")
     k_neighbors = _env_int("BENCH_K_NEIGHBORS", swarm.Config().k_neighbors)
     cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
                        n_obstacles=n_obstacles, dynamics=dynamics,

@@ -885,12 +885,15 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
             d = jnp.sqrt(jnp.sum(
                 (x[:, None, :] - obs_slab[..., :2]) ** 2, axis=-1))
             # 0 < d excludes self rows and exact coincidences (the
-            # kernels' own eligibility rule). Filler slots on agents with
-            # fewer than Kc build-time candidates point at index 0 (the
-            # kernel's convention) or an arbitrary agent (jnp top_k ties)
-            # — NOT at self: if such an agent is genuinely in radius the
-            # slot becomes a TRUE duplicate row (fresh geometry; the
-            # dedup assembly absorbs it), never a false or stale one.
+            # kernels' own eligibility rule) — and it is the guard that
+            # makes filler slots safe: agents with fewer than Kc
+            # build-time candidates carry fillers pointing at index 0
+            # (the kernel's convention) or, on the jnp path, at an
+            # arbitrary LOW index from top_k's -inf tie-break — which for
+            # low-index agents CAN be self (d == 0, masked here). A
+            # filler that points at a genuinely-in-radius other agent
+            # becomes a TRUE duplicate row (fresh geometry; the dedup
+            # assembly absorbs it), never a false or stale one.
             mask = (d > 0.0) & (d < cfg.safety_distance)
             # Sound floor metric: the seen minimum over the cached slots
             # at the BUILD radius, combined with a lower bound on every

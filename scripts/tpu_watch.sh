@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Probe the tunneled TPU on a loop; at the FIRST healthy probe run the
+# whole measurement sweep (scripts/tpu_sweep.sh) and exit. Launch once in
+# the background at session start — it catches a recovery window whenever
+# it happens, instead of relying on a human/agent to probe at the right
+# moment (the round-4 lesson: the tunnel was wedged for the entire
+# session, and any healthy minutes between manual probes went unused).
+#
+#   nohup bash scripts/tpu_watch.sh > docs/sweeps/watch.log 2>&1 &
+#
+# Interval 15 min (a probe against a wedged tunnel burns a 120 s child
+# timeout; 15 min keeps the duty cycle ~13% while bounding the worst-case
+# missed-window latency). Stops after MAX_HOURS regardless.
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL="${TPU_WATCH_INTERVAL_S:-900}"
+MAX_HOURS="${TPU_WATCH_MAX_HOURS:-12}"
+deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
+n=0
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  n=$((n + 1))
+  echo "[tpu_watch] probe #$n at $(date -u +%H:%M:%SZ)"
+  if python -c "
+import sys
+import bench
+ok, reason = bench.probe_device_subprocess(timeout_s=120)
+print('[tpu_watch]', (ok, reason))
+sys.exit(0 if ok else 1)
+"; then
+    echo "[tpu_watch] HEALTHY — running sweep"
+    bash scripts/tpu_sweep.sh
+    echo "[tpu_watch] sweep finished rc=$? — exiting"
+    exit 0
+  fi
+  sleep "$INTERVAL"
+done
+echo "[tpu_watch] deadline reached without a healthy probe"
+exit 2

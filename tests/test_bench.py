@@ -200,7 +200,7 @@ def test_probe_device_subprocess_cpu(monkeypatch):
     assert ok, reason
 
 
-def _run_bench_e2e(extra_env):
+def _run_bench_e2e(extra_env, expect_rc: int = 0):
     env = dict(os.environ)
     env.update({"BENCH_FORCE_PLATFORM": "cpu", "BENCH_N": "64",
                 "BENCH_STEPS": "30", "BENCH_ATTEMPTS": "1",
@@ -209,13 +209,14 @@ def _run_bench_e2e(extra_env):
     proc = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
                           capture_output=True, text=True, timeout=280,
                           cwd=ROOT, env=env)
-    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert proc.returncode == expect_rc, proc.stderr[-2000:]
     lines = [l for l in proc.stdout.splitlines() if l.strip()]
     assert len(lines) == 1, f"bench must print exactly one line: {lines}"
     out = json.loads(lines[0])
     assert out["unit"] == "agent_qp_steps_per_sec_per_chip"
-    assert out["value"] > 0 and math.isfinite(out["value"])
-    assert "error" not in out
+    if expect_rc == 0:
+        assert out["value"] > 0 and math.isfinite(out["value"])
+        assert "error" not in out
     return out, proc.stderr
 
 
@@ -427,3 +428,13 @@ def test_bench_gating_skin_knob_labels_record():
     out, stderr = _run_bench_e2e({"BENCH_GATING_SKIN": "0.15"})
     assert "[skin=0.15]" in out["metric"]
     assert out["gating_skin"] == 0.15
+
+
+def test_bench_gating_skin_rejected_in_ensemble_mode():
+    """The ensemble step has no Verlet cache — the knob must be rejected
+    loudly (honored-or-rejected contract), never silently ignored."""
+    out, stderr = _run_bench_e2e({"BENCH_ENSEMBLE": "1",
+                                  "BENCH_GATING_SKIN": "0.1"},
+                                 expect_rc=2)
+    assert out["value"] == 0
+    assert "single-swarm-mode only" in out["error"]
