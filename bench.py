@@ -37,8 +37,10 @@ Modes / env knobs:
   BENCH_GATING_SKIN (0 = off) — Verlet neighbor-cache skin in meters
     (Config.gating_rebuild_skin): reuse the k-NN selection until any
     agent moves skin/2, attacking the O(N^2) search the roofline names
-    as 63% of step flops. Labeled in metric + record (single mode only;
-    measured 3.3x on CPU at N=2048 at skin=0.1, docs/BENCH_LOG.md).
+    as 63% of step flops. Labeled in metric + record. Single mode, and
+    ensemble mode at BENCH_ENSEMBLE_E=1 (one swarm per device — the
+    multi-chip configuration; other shapes are rejected). Measured 3.3x
+    on CPU at N=2048 at skin=0.1, docs/BENCH_LOG.md.
   BENCH_N_OBSTACLES (0) — orbit that many moving obstacles through the
     swarm (workload is labeled in the metric + record; its vs_baseline is
     still against the obstacle-free target rate).
@@ -558,18 +560,20 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
     # honored or rejected — silently benching a certificate-free rollout
     # under BENCH_CERTIFICATE=1 would mislabel the transcribed rate.
     certificate = os.environ.get("BENCH_CERTIFICATE", "0") == "1"
-    if _env_float("BENCH_GATING_SKIN", 0.0):
-        # Honored-or-rejected, same contract: the ensemble step keeps the
-        # exact per-step search (no Verlet cache), so accepting the knob
-        # here would transcribe an exact-search rate as a cached one.
+    gating_skin = _env_float("BENCH_GATING_SKIN", 0.0)
+    if gating_skin and per_device != 1:
+        # Honored-or-rejected: the Verlet cache needs one whole swarm per
+        # device (under vmap the rebuild cond executes both branches), so
+        # accepting the knob at E_local > 1 would transcribe an
+        # exact-search rate as a cached one.
         raise ValueError(
-            "BENCH_GATING_SKIN is single-swarm-mode only (the sharded "
-            "ensemble step has no Verlet cache); unset it or drop "
-            "BENCH_ENSEMBLE")
+            "BENCH_GATING_SKIN with BENCH_ENSEMBLE=1 requires "
+            f"BENCH_ENSEMBLE_E=1 (one swarm per device), got {per_device}")
     k_neighbors = _env_int("BENCH_K_NEIGHBORS", swarm.Config().k_neighbors)
     cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
                        n_obstacles=n_obstacles, dynamics=dynamics,
-                       k_neighbors=k_neighbors, certificate=certificate)
+                       k_neighbors=k_neighbors, certificate=certificate,
+                       gating_rebuild_skin=gating_skin)
     seeds = list(range(E))
 
     print(f"bench: ensemble E={E} x swarm N={n}, steps={steps}, "
@@ -652,6 +656,10 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
     if k_neighbors != swarm.Config().k_neighbors:
         result["metric"] += " [k=%d]" % k_neighbors
         result["k_neighbors"] = k_neighbors
+    if gating_skin:
+        # Same labeling contract as _child_single.
+        result["metric"] += " [skin=%g]" % gating_skin
+        result["gating_skin"] = gating_skin
     if certificate:
         _label_certificate(result, cert_res, cert_dropped)
     return result

@@ -104,6 +104,14 @@ def make_loss_fn(cfg: swarm_scenario.Config, mesh, tc: TrainConfig = TrainConfig
             "certificate=False (filter parameters transfer; the second "
             "layer is parameter-free)")
 
+    if cfg.gating_rebuild_skin:
+        raise ValueError(
+            "gating_rebuild_skin is not supported on the differentiable "
+            "trainer path (the Verlet rebuild cond + kernels have no "
+            "gradient) — train with gating_rebuild_skin=0; the tuned "
+            "parameters transfer (the cache changes neighbor SELECTION "
+            "only, and only above truncation density)")
+
     unicycle = cfg.dynamics == "unicycle"
 
     def local_loss(params: TunableParams, *state0l):
@@ -118,7 +126,7 @@ def make_loss_fn(cfg: swarm_scenario.Config, mesh, tc: TrainConfig = TrainConfig
             def body(carry, t):
                 x, v = carry[0], carry[1]
                 th = carry[2] if unicycle else None
-                x2, v2, th2, _, nearest = _local_swarm_step(
+                x2, v2, th2, _, nearest, _cache = _local_swarm_step(
                     x, v, cfg, cbf, "sp", unroll_relax=tc.unroll_relax,
                     compute_metrics=False, t=t, theta=th)
                 # Hinge on separation: per-agent nearest-neighbor distance

@@ -86,7 +86,8 @@ def ensemble_initial_states(cfg: swarm_scenario.Config, seeds):
 
 def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
                       axis_name: str, unroll_relax: int = 0,
-                      compute_metrics: bool = True, t=0, theta=None):
+                      compute_metrics: bool = True, t=0, theta=None,
+                      gating_cache=None):
     """One agent-sharded swarm step. x, v: (n_local, 2). Differentiable when
     ``unroll_relax > 0`` (see solvers.exact2d) and ``compute_metrics=False``
     (the metric reductions use pmin, which has no differentiation rule).
@@ -96,8 +97,17 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
     center and the filter works on the projection points, mirroring the
     scenario step.
 
+    ``gating_cache``: opt-in Verlet neighbor cache (the scenario's
+    Config.gating_rebuild_skin scheme, one shared implementation —
+    scenarios.swarm.verlet_gating). Whole-swarm-per-device only (sp size
+    1: the cache indexes the full swarm) and non-differentiable (the
+    rebuild cond + kernels); the caller threads the returned cache
+    through its scan carry. The nearest-distance metric then reports the
+    truncation-SOUND floor scalar instead of the per-agent seen minimum.
+
     Returns (x_new, v_new, theta_new_or_None, metrics_or_None,
-    nearest_d_local) — v_new is the applied (si) velocity.
+    nearest_d_local, new_cache_or_None) — v_new is the applied (si)
+    velocity.
     """
     dt_ = x.dtype
     f, g, discrete = swarm_scenario.barrier_dynamics(cfg, dt_)
@@ -122,7 +132,30 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
     double = cfg.dynamics == "double"
     vslots = v if (double or not discrete) else jnp.zeros_like(v)
     states4 = jnp.concatenate([x, vslots], axis=1)
-    if (lax.axis_size(axis_name) == 1 and pallas_knn.supported(cfg.n)):
+    min_floor = None
+    new_cache = None
+    if gating_cache is not None:
+        if lax.axis_size(axis_name) != 1:
+            raise ValueError(
+                "gating_cache requires the whole swarm on one device "
+                "(sp size 1) — the Verlet index set spans all N agents")
+        if unroll_relax > 0:
+            raise ValueError("the Verlet cache path is not differentiable "
+                             "(rebuild cond + kernels) — train with "
+                             "gating_rebuild_skin=0")
+        if cfg.gating == "banded":
+            # Same incompatibility the scenario's make() rejects.
+            raise ValueError("gating_rebuild_skin requires the pallas/jnp "
+                             "gating backends (see scenarios.swarm.make)")
+        # Honor cfg.gating exactly as the scenario does — the shared
+        # verlet_gating exists so the two paths select identical sets.
+        use_p = (pallas_knn.supported(cfg.n) if cfg.gating == "auto"
+                 else cfg.gating == "pallas")
+        obs_slab, mask, nearest1, min_floor, dropped, new_cache = \
+            swarm_scenario.verlet_gating(
+                cfg, x, states4, gating_cache, K, use_p,
+                jax.default_backend() != "tpu")
+    elif (lax.axis_size(axis_name) == 1 and pallas_knn.supported(cfg.n)):
         # dp-only sharding: each swarm is whole on its device, so the
         # single-device fused Pallas kernel applies — ~8x the dense
         # top_k exchange at N=4096 (measured on the TPU bench). The
@@ -160,6 +193,11 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
         obs_slab, mask, priority = swarm_scenario.attach_obstacle_rows(
             obs_slab, mask, obstacles4, d_o, cfg.safety_distance)
         nearest1 = jnp.minimum(nearest1, jnp.min(d_o, axis=1))
+        if min_floor is not None:
+            # The Verlet soundness bound covers agent-agent pairs only —
+            # obstacle distances (computed exactly every step) must fold
+            # into the reported floor here too, as in the scenario step.
+            min_floor = jnp.minimum(min_floor, jnp.min(d_o))
 
     priority, cap = swarm_scenario.relax_tiers(cfg, mask, priority)
     plain_box = double or unicycle
@@ -229,7 +267,10 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
     metrics = None
     if compute_metrics:
         metrics = (
-            lax.pmin(jnp.min(nearest1), axis_name),
+            # Verlet path: the truncation-sound floor scalar (see
+            # swarm.verlet_gating), not the seen-only per-agent minimum.
+            lax.pmin(jnp.min(nearest1) if min_floor is None else min_floor,
+                     axis_name),
             lax.psum(jnp.sum(engaged), axis_name),
             lax.psum(jnp.sum(~info.feasible & engaged), axis_name),
             lax.psum(jnp.sum(dropped), axis_name),
@@ -241,7 +282,7 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
             lax.pmax(match_vma(cert_dropped, x), axis_name),
             lax.pmax(match_vma(deficit, x), axis_name),
         )
-    return x_new, v_new, theta_new, metrics, nearest1
+    return x_new, v_new, theta_new, metrics, nearest1, new_cache
 
 
 def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
@@ -270,6 +311,13 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
     if E % n_dp or cfg.n % n_sp:
         raise ValueError(
             f"E={E} must divide by dp={n_dp} and N={cfg.n} by sp={n_sp}")
+    if cfg.gating_rebuild_skin and (n_sp != 1 or E != n_dp):
+        raise ValueError(
+            "gating_rebuild_skin in ensembles requires one whole swarm "
+            f"per device (E == dp and sp == 1; got E={E}, dp={n_dp}, "
+            f"sp={n_sp}): under vmap the Verlet rebuild cond executes "
+            "BOTH branches (no saving), and the cached index set needs "
+            "the full swarm on-device")
 
     if initial_state is not None:
         if len(initial_state) != parts:
@@ -311,19 +359,39 @@ def _rollout_executable(cfg: swarm_scenario.Config, mesh, E: int, steps: int):
     hashable by value (frozen dataclass Config, jax Mesh).
     """
     unicycle = cfg.dynamics == "unicycle"
+    parts = 3 if unicycle else 2
     E_local = E // mesh.shape["dp"]
+    # Verlet cache: validated upstream (sharded_swarm_rollout) to the one
+    # shape where it pays — whole swarm per device, no vmap.
+    use_cache = (cfg.gating_rebuild_skin > 0 and E_local == 1
+                 and mesh.shape["sp"] == 1)
 
     def local_rollout(t0, cbf, *state0l):
         def one(*state0i):
             def body(carry, t):
-                th = carry[2] if unicycle else None
-                x2, v2, th2, met, _ = _local_swarm_step(
-                    carry[0], carry[1], cfg, cbf, "sp", t=t, theta=th)
+                if use_cache:
+                    st, cache = carry[:-1], carry[-1]
+                else:
+                    st, cache = carry, None
+                th = st[2] if unicycle else None
+                x2, v2, th2, met, _, cache2 = _local_swarm_step(
+                    st[0], st[1], cfg, cbf, "sp", t=t, theta=th,
+                    gating_cache=cache)
                 new = (x2, v2, th2) if unicycle else (x2, v2)
+                if use_cache:
+                    new = new + (cache2,)
                 return new, met
 
-            final, mets = lax.scan(body, state0i, t0 + jnp.arange(steps))
-            return final + (mets,)
+            init = tuple(state0i)
+            if use_cache:
+                # match_vma: the seed constants must enter the scan with
+                # the device-varying type they leave it with (cf. the
+                # solver carries).
+                init = init + (tuple(
+                    match_vma(a, state0i[0])
+                    for a in swarm_scenario.verlet_cache_seed(cfg)),)
+            final, mets = lax.scan(body, init, t0 + jnp.arange(steps))
+            return final[:parts] + (mets,)   # cache is internal state
 
         if E_local == 1:
             # One member per device: skip the vmap wrapper — identical math,

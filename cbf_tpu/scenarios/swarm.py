@@ -43,7 +43,7 @@ from cbf_tpu.ops.pairwise import pairwise_distances
 from cbf_tpu.ops.pallas_knn import knn_gating_banded, knn_gating_pallas
 from cbf_tpu.rollout.engine import StepOutputs, rollout
 from cbf_tpu.rollout.gating import knn_gating
-from cbf_tpu.utils.math import l2_cap, safe_norm
+from cbf_tpu.utils.math import l2_cap, match_vma, safe_norm
 
 
 @dataclasses.dataclass(frozen=True)
@@ -209,8 +209,11 @@ class Config:
     # exact per-step search near capacity — dropped counts stay surfaced
     # (frozen at the last rebuild, counted vs the build radius: an upper
     # bound) and the floor gates remain the safety authority. 0 = exact
-    # per-step search (default). Scenario/bench path only (the sharded
-    # ensemble keeps exact search); incompatible with gating="banded".
+    # per-step search (default). Supported on the scenario/bench path and
+    # on whole-swarm-per-device ensembles (E == dp, sp == 1 — the bench's
+    # multi-chip configuration; other ensemble shapes reject it:
+    # parallel.ensemble). Incompatible with gating="banded" and the
+    # differentiable trainer path.
     gating_rebuild_skin: float = 0.0
     dtype: type = jnp.float32
 
@@ -549,17 +552,7 @@ def initial_state(cfg: Config) -> State:
     theta0 = ()
     if cfg.dynamics == "unicycle":
         theta0 = heading_spawn(cfg, cfg.seed)
-    cache = ()
-    if cfg.gating_rebuild_skin:
-        # x_build = +inf: infinite displacement forces a rebuild on the
-        # first step, so the zero idx/min_dkth seeds are never consumed.
-        # Clamped K, matching the step's rebuild branches (the exact
-        # jnp path clamps the same way — rollout/gating.py).
-        kc = min(cfg.k_neighbors, cfg.n - 1)
-        cache = (jnp.zeros((cfg.n, kc), jnp.int32),
-                 jnp.full((cfg.n, 2), jnp.inf, cfg.dtype),
-                 jnp.zeros((), jnp.int32),
-                 jnp.zeros((), cfg.dtype))
+    cache = verlet_cache_seed(cfg) if cfg.gating_rebuild_skin else ()
     return State(x=x0, v=jnp.zeros_like(x0), theta=theta0,
                  gating_cache=cache)
 
@@ -772,6 +765,103 @@ def default_cbf(cfg: Config) -> CBFParams:
     return CBFParams(max_speed=cfg.max_speed, k=0.0)
 
 
+def verlet_cache_seed(cfg: Config):
+    """Fresh Verlet-cache pytree (see State.gating_cache): x_build = +inf
+    forces a rebuild on the first step, so the zero idx/min_dkth seeds
+    are never consumed. Shared by initial_state and the sharded
+    ensemble's carry so the two starts cannot drift."""
+    kc = min(cfg.k_neighbors, cfg.n - 1)
+    return (jnp.zeros((cfg.n, kc), jnp.int32),
+            jnp.full((cfg.n, 2), jnp.inf, cfg.dtype),
+            jnp.zeros((), jnp.int32),
+            jnp.zeros((), cfg.dtype))
+
+
+def verlet_gating(cfg: Config, x, states4, cache, K: int,
+                  use_pallas: bool, pallas_interpret: bool):
+    """One Verlet-cached gating step (Config.gating_rebuild_skin) — the
+    ONE implementation, shared by the scenario step and the sharded
+    ensemble's whole-swarm-per-device path (a drifted duplicate would
+    gate different neighbor sets, or worse, diverge on the metric's
+    soundness bound).
+
+    Rebuilds the k-NN under the inflated radius only when any agent has
+    moved > skin/2 since the last build (triangle inequality: a pair
+    within safety_distance now was within safety_distance + skin at
+    build time, hence eligible); otherwise re-gathers fresh states by
+    cached index. The per-step mask re-checks the TRUE radius on fresh
+    positions, so stale geometry never enters the QP — only the
+    SELECTION is stale.
+
+    Returns (obs_slab (N, K', 4), mask, nearest_seen (N,) — per-agent
+    gated seen nearest distance, min_dist_sound scalar, dropped scalar
+    int32 — frozen at the last rebuild, counted vs the build radius (an
+    upper bound), new_cache). ``min_dist_sound`` is the
+    truncation-sound floor metric: the seen minimum at the build radius
+    combined with a lower bound on every unseen pair (build-time-
+    truncated pairs started >= the min k-th kept build distance and two
+    endpoints close by at most 2x the max displacement since build;
+    beyond-build-radius pairs are still >= r_build - 2*disp >=
+    safety_distance) — a truncation blind spot CANNOT leave the
+    reported floor high: the unseen bound dips first. Not
+    differentiable (the rebuild cond + kernels); trainer paths keep the
+    exact search.
+    """
+    cache_skin = float(cfg.gating_rebuild_skin)
+    dt_ = x.dtype
+    r_build = cfg.safety_distance + cache_skin
+    Kc = min(K, cfg.n - 1)   # exact jnp path clamps the same way
+    # Under shard_map the freshly seeded cache (constants) is vma-
+    # invariant while the rebuild branch's outputs vary with the device
+    # data — align the carry side so the cond branches type-match
+    # (no-op outside shard_map; cf. solvers.sparse_admm).
+    idx_c, xb_c, dropped_c, dkth_c = (match_vma(a, x) for a in cache)
+
+    def _rebuild(_):
+        if use_pallas:
+            idx, bdist, _n, count = pallas_knn.knn_select(
+                states4[:, :2], r_build, Kc, pallas_interpret)
+            slot = jnp.isfinite(bdist)
+        else:
+            dist = pairwise_distances(x)
+            eligible = (dist < r_build) & ~jnp.eye(cfg.n, dtype=bool)
+            neg, idx = lax.top_k(jnp.where(eligible, -dist, -jnp.inf), Kc)
+            bdist, slot = -neg, jnp.isfinite(neg)
+            count = jnp.sum(eligible, axis=1, dtype=jnp.int32)
+        dropped = jnp.sum(jnp.maximum(count - Kc, 0))
+        # Every build-time-truncated in-radius pair was at least as far
+        # as BOTH endpoints' k-th kept distance — the min of those over
+        # truncating agents floors the unseen set.
+        d_kth = jnp.max(jnp.where(slot, bdist, -jnp.inf), axis=1)
+        min_dkth = jnp.min(jnp.where(count > Kc, d_kth, jnp.inf))
+        return idx, x, dropped, min_dkth.astype(dt_)
+
+    disp2 = jnp.max(jnp.sum((x - xb_c) ** 2, axis=1))
+    idx_c, xb_c, dropped_c, dkth_c = lax.cond(
+        disp2 > (0.5 * cache_skin) ** 2, _rebuild,
+        lambda _: (idx_c, xb_c, dropped_c, dkth_c), None)
+    obs_slab = jnp.take(states4, idx_c, axis=0)            # fresh states
+    d = jnp.sqrt(jnp.sum(
+        (x[:, None, :] - obs_slab[..., :2]) ** 2, axis=-1))
+    # 0 < d excludes self rows and exact coincidences (the kernels' own
+    # eligibility rule) — and it is the guard that makes filler slots
+    # safe: agents with fewer than Kc build-time candidates carry
+    # fillers pointing at index 0 (the kernel's convention) or, on the
+    # jnp path, at an arbitrary LOW index from top_k's -inf tie-break —
+    # which for low-index agents CAN be self (d == 0, masked here). A
+    # filler that points at a genuinely-in-radius other agent becomes a
+    # TRUE duplicate row (fresh geometry; the dedup assembly absorbs
+    # it), never a false or stale one.
+    mask = (d > 0.0) & (d < cfg.safety_distance)
+    nearest_seen = jnp.min(jnp.where(mask, d, jnp.inf), axis=1)
+    seen_min = jnp.min(jnp.where((d > 0.0) & (d < r_build), d, jnp.inf))
+    disp_now = jnp.sqrt(jnp.max(jnp.sum((x - xb_c) ** 2, axis=1)))
+    unseen_floor = dkth_c - 2.0 * disp_now
+    min_dist = jnp.minimum(seen_min, unseen_floor)
+    return (obs_slab, mask, nearest_seen, min_dist, dropped_c,
+            (idx_c, xb_c, dropped_c, dkth_c))
+
+
 def make(cfg: Config = Config(), cbf: CBFParams | None = None):
     dt_ = cfg.dtype
     f, g, discrete = barrier_dynamics(cfg, dt_)   # validates cfg.dynamics
@@ -843,73 +933,9 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
         overflow_count = ()
         new_cache = ()
         if cache_skin:
-            # Verlet neighbor cache (Config.gating_rebuild_skin): rebuild
-            # the k-NN under the inflated radius only when any agent has
-            # moved > skin/2 since the last build; otherwise re-gather
-            # fresh states by cached index. Soundness: a pair within
-            # safety_distance now was within (safety_distance + skin) at
-            # build time (each endpoint moved <= skin/2), so it was
-            # eligible then; the per-step mask below re-checks the TRUE
-            # radius on fresh positions, so stale geometry never enters
-            # the QP — only the SELECTION is stale.
-            r_build = cfg.safety_distance + cache_skin
-            Kc = min(K, cfg.n - 1)   # exact jnp path clamps the same way
-            idx_c, xb_c, dropped_c, dkth_c = state.gating_cache
-
-            def _rebuild(_):
-                if use_pallas:
-                    idx, bdist, _n, count = pallas_knn.knn_select(
-                        states4[:, :2], r_build, Kc, pallas_interpret)
-                    slot = jnp.isfinite(bdist)
-                else:
-                    dist = pairwise_distances(x)
-                    eligible = ((dist < r_build)
-                                & ~jnp.eye(cfg.n, dtype=bool))
-                    neg, idx = lax.top_k(jnp.where(eligible, -dist,
-                                                   -jnp.inf), Kc)
-                    bdist, slot = -neg, jnp.isfinite(neg)
-                    count = jnp.sum(eligible, axis=1, dtype=jnp.int32)
-                dropped = jnp.sum(jnp.maximum(count - Kc, 0))
-                # Every build-time-truncated in-radius pair was at least
-                # as far as BOTH endpoints' k-th kept distance — the min
-                # of those over truncating agents floors the unseen set.
-                d_kth = jnp.max(jnp.where(slot, bdist, -jnp.inf), axis=1)
-                min_dkth = jnp.min(jnp.where(count > Kc, d_kth, jnp.inf))
-                return idx, x, dropped, min_dkth.astype(dt_)
-
-            disp2 = jnp.max(jnp.sum((x - xb_c) ** 2, axis=1))
-            idx_c, xb_c, dropped_c, dkth_c = lax.cond(
-                disp2 > (0.5 * cache_skin) ** 2, _rebuild,
-                lambda _: (idx_c, xb_c, dropped_c, dkth_c), None)
-            obs_slab = jnp.take(states4, idx_c, axis=0)    # fresh states
-            d = jnp.sqrt(jnp.sum(
-                (x[:, None, :] - obs_slab[..., :2]) ** 2, axis=-1))
-            # 0 < d excludes self rows and exact coincidences (the
-            # kernels' own eligibility rule) — and it is the guard that
-            # makes filler slots safe: agents with fewer than Kc
-            # build-time candidates carry fillers pointing at index 0
-            # (the kernel's convention) or, on the jnp path, at an
-            # arbitrary LOW index from top_k's -inf tie-break — which for
-            # low-index agents CAN be self (d == 0, masked here). A
-            # filler that points at a genuinely-in-radius other agent
-            # becomes a TRUE duplicate row (fresh geometry; the dedup
-            # assembly absorbs it), never a false or stale one.
-            mask = (d > 0.0) & (d < cfg.safety_distance)
-            # Sound floor metric: the seen minimum over the cached slots
-            # at the BUILD radius, combined with a lower bound on every
-            # pair the cache cannot see — build-time-truncated pairs
-            # started >= dkth_c and two endpoints close by at most
-            # 2*max-displacement since build; pairs beyond the build
-            # radius are still >= r_build - 2*disp >= safety_distance.
-            # A truncation-blind-spot approach therefore CANNOT leave the
-            # reported floor high: unseen_floor dips first.
-            seen_min = jnp.min(jnp.where((d > 0.0) & (d < r_build), d,
-                                         jnp.inf))
-            disp_now = jnp.sqrt(jnp.max(jnp.sum((x - xb_c) ** 2, axis=1)))
-            unseen_floor = dkth_c - 2.0 * disp_now
-            min_dist = jnp.minimum(seen_min, unseen_floor)
-            dropped = dropped_c
-            new_cache = (idx_c, xb_c, dropped_c, dkth_c)
+            (obs_slab, mask, _nearest_seen, min_dist, dropped,
+             new_cache) = verlet_gating(cfg, x, states4, state.gating_cache,
+                                        K, use_pallas, pallas_interpret)
         elif use_banded:
             # O(N*W) y-sorted banded kernel; window overflow (possible
             # missed neighbors) is surfaced, never swallowed.

@@ -430,11 +430,31 @@ def test_bench_gating_skin_knob_labels_record():
     assert out["gating_skin"] == 0.15
 
 
-def test_bench_gating_skin_rejected_in_ensemble_mode():
-    """The ensemble step has no Verlet cache — the knob must be rejected
-    loudly (honored-or-rejected contract), never silently ignored."""
+def test_bench_gating_skin_in_ensemble_mode():
+    """Ensemble + Verlet cache: supported at one swarm per device (the
+    multi-chip configuration) with the record labeled; rejected loudly at
+    E_local > 1, where the vmap'd rebuild cond would execute both
+    branches and the knob would mislabel an exact-search rate."""
     out, stderr = _run_bench_e2e({"BENCH_ENSEMBLE": "1",
+                                  "BENCH_GATING_SKIN": "0.1"})
+    assert "[skin=0.1]" in out["metric"]
+    assert out["gating_skin"] == 0.1
+
+    out, stderr = _run_bench_e2e({"BENCH_ENSEMBLE": "1",
+                                  "BENCH_ENSEMBLE_E": "2",
                                   "BENCH_GATING_SKIN": "0.1"},
                                  expect_rc=2)
     assert out["value"] == 0
-    assert "single-swarm-mode only" in out["error"]
+    assert "BENCH_ENSEMBLE_E=1" in out["error"]
+
+
+def test_bench_end_to_end_ensemble_certificate_cpu():
+    """BENCH_ENSEMBLE=1 + BENCH_CERTIFICATE=1 (advisor r4: the combo was
+    silently certificate-free): the two-layer ensemble runs, gates on
+    convergence, and labels the record."""
+    out, stderr = _run_bench_e2e({"BENCH_ENSEMBLE": "1",
+                                  "BENCH_CERTIFICATE": "1",
+                                  "BENCH_STEPS": "20"})
+    assert "[certificate]" in out["metric"]
+    assert out["certificate_max_residual"] < 1e-4
+    assert "certificate max_residual=" in stderr

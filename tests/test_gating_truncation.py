@@ -377,3 +377,31 @@ def test_verlet_cache_rejects_banded():
 
     with pytest.raises(ValueError, match="banded"):
         sw.make(sw.Config(n=64, gating="banded", gating_rebuild_skin=0.1))
+
+
+def test_verlet_cache_ensemble_matches_exact_below_truncation():
+    """The ensemble's one-swarm-per-device Verlet path (shared
+    swarm.verlet_gating implementation): identical trajectories to the
+    exact ensemble below truncation, sound floor surfaced in the metric,
+    and unsupported shapes rejected loudly."""
+    import pytest as _pytest
+
+    from cbf_tpu.parallel import make_mesh
+    from cbf_tpu.parallel.ensemble import sharded_swarm_rollout
+    from cbf_tpu.scenarios import swarm as sw
+
+    base = dict(n=128, steps=80, k_neighbors=16)
+    mesh = make_mesh(n_dp=2, n_sp=1)
+    (x_e, _), mets_e = sharded_swarm_rollout(
+        sw.Config(**base), mesh, seeds=[0, 1])
+    (x_c, _), mets_c = sharded_swarm_rollout(
+        sw.Config(**base, gating_rebuild_skin=0.15), mesh, seeds=[0, 1])
+    np.testing.assert_array_equal(np.asarray(x_c), np.asarray(x_e))
+    assert (float(np.asarray(mets_c.nearest_distance).min())
+            > 0.13)
+    assert int(np.asarray(mets_c.infeasible_count).sum()) == 0
+
+    with _pytest.raises(ValueError, match="one whole swarm per device"):
+        sharded_swarm_rollout(
+            sw.Config(**base, gating_rebuild_skin=0.15),
+            make_mesh(n_dp=2, n_sp=1), seeds=[0, 1, 2, 3])
