@@ -58,6 +58,16 @@ Modes / env knobs:
     metric + record; additionally gated on per-step ADMM convergence
     (max primal residual < 1e-4) and surfacing the dropped-pair count.
     Honored by BOTH modes (single and ensemble) with the same gate.
+  BENCH_CERT_SKIN (0 = off) — Verlet cache for the certificate's own
+    neighbor search (97% of the certificate step's flops at N=4096 —
+    Config.certificate_rebuild_skin). Labeled in metric + record;
+    single mode + BENCH_CERTIFICATE=1 only.
+  BENCH_CERT_ITERS / BENCH_CERT_CG (solver defaults 100/8) — the sparse
+    ADMM budget (Config.certificate_iters/certificate_cg_iters): the
+    certificate's wall is the iteration chain's LENGTH, and 50/6 still
+    converges ~200x under the gate on contract states (measured 1.55x
+    with the cache at N=4096 CPU, docs/BENCH_LOG.md). Labeled in
+    metric + record; the 1e-4 residual gate still asserts convergence.
   BENCH_PROFILE=<dir> — capture a jax.profiler device trace of the
     measured window (TensorBoard trace-viewer format) into <dir>; the
     wall number still excludes warmup but includes tracing overhead, so
@@ -425,11 +435,19 @@ def _child_single(n: int, steps: int) -> dict:
     base_cfg = swarm.Config()
     k_neighbors = _env_int("BENCH_K_NEIGHBORS", base_cfg.k_neighbors)
     gating_skin = _env_float("BENCH_GATING_SKIN", 0.0)
+    cert_skin = _env_float("BENCH_CERT_SKIN", 0.0)
+    cert_iters = _env_int("BENCH_CERT_ITERS", 0) or None
+    cert_cg = _env_int("BENCH_CERT_CG", 0) or None
+    if (cert_skin or cert_iters or cert_cg) and not certificate:
+        raise ValueError("BENCH_CERT_SKIN/ITERS/CG need BENCH_CERTIFICATE=1")
     cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
                        gating=gating, n_obstacles=n_obstacles,
                        dynamics=dynamics, certificate=certificate,
                        k_neighbors=k_neighbors,
-                       gating_rebuild_skin=gating_skin)
+                       gating_rebuild_skin=gating_skin,
+                       certificate_rebuild_skin=cert_skin,
+                       certificate_iters=cert_iters,
+                       certificate_cg_iters=cert_cg)
     state0, step = swarm.make(cfg)
     chunk = min(_env_int("BENCH_CHUNK", 1000), steps)
     unroll = _env_int("BENCH_UNROLL", 1)
@@ -533,6 +551,14 @@ def _child_single(n: int, steps: int) -> dict:
         # exact-search headline — label it like the k-sweep.
         result["metric"] += " [skin=%g]" % gating_skin
         result["gating_skin"] = gating_skin
+    if cert_skin:
+        result["metric"] += " [cert_skin=%g]" % cert_skin
+        result["cert_skin"] = cert_skin
+    if cert_iters or cert_cg:
+        result["metric"] += " [cert_budget=%s/%s]" % (cert_iters or "d",
+                                                      cert_cg or "d")
+        result["cert_iters"] = cert_iters
+        result["cert_cg_iters"] = cert_cg
     if certificate:
         _label_certificate(result, cert_res, cert_dropped)
     return result
@@ -569,11 +595,22 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
         raise ValueError(
             "BENCH_GATING_SKIN with BENCH_ENSEMBLE=1 requires "
             f"BENCH_ENSEMBLE_E=1 (one swarm per device), got {per_device}")
+    if _env_float("BENCH_CERT_SKIN", 0.0):
+        # Honored-or-rejected: the ensemble certificate paths run the
+        # exact search (certificate_rebuild_skin is scenario-path only).
+        raise ValueError("BENCH_CERT_SKIN is single-swarm-mode only; "
+                         "unset it or drop BENCH_ENSEMBLE")
+    cert_iters = _env_int("BENCH_CERT_ITERS", 0) or None
+    cert_cg = _env_int("BENCH_CERT_CG", 0) or None
+    if (cert_iters or cert_cg) and not certificate:
+        raise ValueError("BENCH_CERT_ITERS/CG need BENCH_CERTIFICATE=1")
     k_neighbors = _env_int("BENCH_K_NEIGHBORS", swarm.Config().k_neighbors)
     cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
                        n_obstacles=n_obstacles, dynamics=dynamics,
                        k_neighbors=k_neighbors, certificate=certificate,
-                       gating_rebuild_skin=gating_skin)
+                       gating_rebuild_skin=gating_skin,
+                       certificate_iters=cert_iters,
+                       certificate_cg_iters=cert_cg)
     seeds = list(range(E))
 
     print(f"bench: ensemble E={E} x swarm N={n}, steps={steps}, "
@@ -660,6 +697,11 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
         # Same labeling contract as _child_single.
         result["metric"] += " [skin=%g]" % gating_skin
         result["gating_skin"] = gating_skin
+    if cert_iters or cert_cg:
+        result["metric"] += " [cert_budget=%s/%s]" % (cert_iters or "d",
+                                                      cert_cg or "d")
+        result["cert_iters"] = cert_iters
+        result["cert_cg_iters"] = cert_cg
     if certificate:
         _label_certificate(result, cert_res, cert_dropped)
     return result

@@ -104,13 +104,14 @@ def make_loss_fn(cfg: swarm_scenario.Config, mesh, tc: TrainConfig = TrainConfig
             "certificate=False (filter parameters transfer; the second "
             "layer is parameter-free)")
 
-    if cfg.gating_rebuild_skin:
+    if cfg.gating_rebuild_skin or cfg.certificate_rebuild_skin:
         raise ValueError(
-            "gating_rebuild_skin is not supported on the differentiable "
-            "trainer path (the Verlet rebuild cond + kernels have no "
-            "gradient) — train with gating_rebuild_skin=0; the tuned "
-            "parameters transfer (the cache changes neighbor SELECTION "
-            "only, and only above truncation density)")
+            "the Verlet caches (gating_rebuild_skin / "
+            "certificate_rebuild_skin) are not supported on the "
+            "differentiable trainer path (the rebuild cond has no "
+            "gradient) — train with both at 0; the tuned parameters "
+            "transfer (the caches change neighbor SELECTION only, and "
+            "only above truncation density)")
 
     unicycle = cfg.dynamics == "unicycle"
 

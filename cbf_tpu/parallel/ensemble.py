@@ -318,6 +318,14 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
             f"sp={n_sp}): under vmap the Verlet rebuild cond executes "
             "BOTH branches (no saving), and the cached index set needs "
             "the full swarm on-device")
+    if cfg.certificate_rebuild_skin:
+        # Honored-or-rejected: the ensemble certificate paths (replicated
+        # and row-partitioned) run the exact search — silently ignoring
+        # the knob would misattribute a rate.
+        raise ValueError(
+            "certificate_rebuild_skin is scenario/bench-path only (the "
+            "ensemble certificate keeps the exact search); set it to 0 "
+            "for sharded rollouts")
 
     if initial_state is not None:
         if len(initial_state) != parts:

@@ -171,6 +171,27 @@ class Config:
     # sparse beyond (where dense memory/factorization walls out).
     certificate_backend: str = "auto"
     certificate_k: int = 16
+    # Verlet cache for the CERTIFICATE's own neighbor search (the same
+    # scheme as gating_rebuild_skin, applied to the second layer): at
+    # N=4096 that search is 97% of the certificate step's flops (XLA
+    # cost model, docs/BENCH_LOG.md), so rebuilding it only after skin/2
+    # of travel attacks the two-layer stack's dominant cost. The QP rows
+    # and the per-step residual gate stay exact for the kept set (fresh
+    # geometry + fresh-radius mask); the dropped-pair diagnostic freezes
+    # at each rebuild, counted vs the build radius (an upper bound).
+    # Requires the sparse backend; scenario/bench path only (ensembles
+    # and the differentiable trainer reject it); 0 = exact (default).
+    certificate_rebuild_skin: float = 0.0
+    # Sparse-backend ADMM budget (solvers.sparse_admm defaults). The
+    # certificate's wall-clock is dominated by the iteration chain's
+    # LENGTH, not its flops (measured: ~700 ms/step at N=4096 CPU with
+    # the search only 97% of FLOPs — the iters*(cg+2) dependent tiny ops
+    # serialize); on feasible-by-contract states 50/6 already converges
+    # to ~5e-8 (round-4 sweep, the settings docstring), so these knobs
+    # trade margin for latency with the per-step 1e-4 residual gate
+    # still asserting convergence. None = the solver's defaults (100/8).
+    certificate_iters: int | None = None
+    certificate_cg_iters: int | None = None
     # sp > 1 ensembles only: "auto" row-partitions the sparse backend's
     # joint solve over the sp axis (each shard owns its local agents' pair
     # rows — O(N*k/sp) row work per device; parallel.ensemble), falling
@@ -255,6 +276,10 @@ class State(NamedTuple):
     # fresh rollout re-seeds it with x_build=inf so step 0 always
     # rebuilds.
     gating_cache: tuple = ()
+    # Verlet cache for the certificate's neighbor search —
+    # Config.certificate_rebuild_skin > 0 only (same conventions as
+    # gating_cache; seeded by sim.certificates.certificate_cache_seed).
+    certificate_cache: tuple = ()
 
 
 def spawn_positions(cfg: Config, seed) -> jnp.ndarray:
@@ -386,6 +411,35 @@ def barrier_dynamics(cfg: Config, dtype):
         raise ValueError(
             f"certificate_partition must be auto|replicate, got "
             f"{cfg.certificate_partition!r}")
+    if cfg.certificate_rebuild_skin:
+        if cfg.certificate_rebuild_skin < 0:
+            raise ValueError("certificate_rebuild_skin must be >= 0")
+        if not cfg.certificate:
+            raise ValueError(
+                "certificate_rebuild_skin needs certificate=True")
+        if certificate_backend(cfg) != "sparse":
+            raise ValueError(
+                "certificate_rebuild_skin requires the SPARSE certificate "
+                "backend (the dense path's max_pairs pruning has no cached "
+                f"form); resolved backend here is "
+                f"{certificate_backend(cfg)!r} — set "
+                "certificate_backend='sparse'")
+    if (cfg.certificate_iters is not None
+            or cfg.certificate_cg_iters is not None):
+        # Same honored-or-rejected contract as the sibling rebuild_skin:
+        # the budget knobs only reach the sparse ADMM — silently ignoring
+        # them on the dense backend (its fori_loop solver has its own
+        # fixed budget) would make a budget sweep measure nothing.
+        if not cfg.certificate:
+            raise ValueError(
+                "certificate_iters/certificate_cg_iters need "
+                "certificate=True")
+        if certificate_backend(cfg) != "sparse":
+            raise ValueError(
+                "certificate_iters/certificate_cg_iters tune the SPARSE "
+                "ADMM budget; resolved backend here is "
+                f"{certificate_backend(cfg)!r} — set "
+                "certificate_backend='sparse'")
     if (cfg.certificate and cfg.certificate_pairs is not None
             and certificate_backend(cfg) == "sparse"):
         raise ValueError(
@@ -553,8 +607,13 @@ def initial_state(cfg: Config) -> State:
     if cfg.dynamics == "unicycle":
         theta0 = heading_spawn(cfg, cfg.seed)
     cache = verlet_cache_seed(cfg) if cfg.gating_rebuild_skin else ()
+    ccache = ()
+    if cfg.certificate_rebuild_skin:
+        from cbf_tpu.sim.certificates import certificate_cache_seed
+        ccache = certificate_cache_seed(cfg.n, cfg.certificate_k,
+                                        cfg.dtype)
     return State(x=x0, v=jnp.zeros_like(x0), theta=theta0,
-                 gating_cache=cache)
+                 gating_cache=cache, certificate_cache=ccache)
 
 
 def separation_bias(cfg: Config, x, obs_slab, mask):
@@ -674,29 +733,55 @@ def _certificate_problem(cfg: Config):
             (-half, half, -half, half))
 
 
-def apply_certificate(cfg: Config, u, x):
+def _certificate_settings(cfg: Config):
+    """SparseADMMSettings from the Config budget knobs — shared by the
+    replicated and row-partitioned appliers so the two paths can never
+    silently run different iteration budgets."""
+    from cbf_tpu.solvers.sparse_admm import SparseADMMSettings
+    d = SparseADMMSettings()
+    return SparseADMMSettings(
+        iters=cfg.certificate_iters if cfg.certificate_iters is not None
+        else d.iters,
+        cg_iters=cfg.certificate_cg_iters
+        if cfg.certificate_cg_iters is not None else d.cg_iters)
+
+
+def apply_certificate(cfg: Config, u, x, neighbor_cache=None):
     """The joint second layer over already-filtered si velocities (see
     Config.certificate). Shared by the scenario step and the sharded
     ensemble. Returns (u_certified (N, 2), primal_residual scalar,
     dropped_count int32 scalar — sparse-backend k-slot truncation of
     in-binding-radius pairs, the one degradation signal that backend
     emits; 0 on the dense backend, whose max_pairs pruning keeps the
-    globally tightest rows and is covered by its own exactness test).
+    globally tightest rows and is covered by its own exactness test)
+    — plus a trailing new_cache when ``neighbor_cache`` is given (the
+    certificate_rebuild_skin Verlet path; scenario step only — the
+    caller threads it through its scan carry).
 
-    Differentiable as-is (no mode flag): the sparse path's kernel runs as
-    a selection oracle (ops.pallas_knn.knn_select — zero cotangent, the
-    true a.e. gradient of a selection) and its row-geometry gradients
-    flow through jnp gathers of the positions, so the trainer keeps the
-    Pallas search at scale (finite-difference-validated; the round-4 jnp
-    pinning made large-N training O(N^2)-bound). The DENSE backend stays
-    non-differentiable (fori_loop solver) — learn.tuning guards it."""
+    Differentiable as-is (no mode flag) on the EXACT path: the sparse
+    search's kernel runs as a selection oracle (ops.pallas_knn.knn_select
+    — zero cotangent, the true a.e. gradient of a selection) and its
+    row-geometry gradients flow through jnp gathers of the positions, so
+    the trainer keeps the Pallas search at scale (FD-validated; the
+    round-4 jnp pinning made large-N training O(N^2)-bound). The DENSE
+    backend and the Verlet path stay non-differentiable — learn.tuning
+    guards both."""
     from cbf_tpu.sim.certificates import (si_barrier_certificate,
                                           si_barrier_certificate_sparse)
     params, arena = _certificate_problem(cfg)
     if certificate_backend(cfg) == "sparse":
+        settings = _certificate_settings(cfg)
+        if neighbor_cache is not None:
+            u_cert, cinfo, new_cache = si_barrier_certificate_sparse(
+                u.T, x.T, params, settings=settings,
+                k=cfg.certificate_k, with_info=True, arena=arena,
+                rebuild_skin=cfg.certificate_rebuild_skin,
+                neighbor_cache=neighbor_cache)
+            return (u_cert.T, cinfo.primal_residual, cinfo.dropped_count,
+                    new_cache)
         u_cert, cinfo = si_barrier_certificate_sparse(
-            u.T, x.T, params, k=cfg.certificate_k, with_info=True,
-            arena=arena)
+            u.T, x.T, params, settings=settings, k=cfg.certificate_k,
+            with_info=True, arena=arena)
         return u_cert.T, cinfo.primal_residual, cinfo.dropped_count
     pairs = (cfg.certificate_pairs if cfg.certificate_pairs is not None
              else 8 * cfg.n)
@@ -719,8 +804,8 @@ def apply_certificate_sharded(cfg: Config, u, x, axis_name: str):
     from cbf_tpu.sim.certificates import si_barrier_certificate_sparse_sharded
     params, arena = _certificate_problem(cfg)
     u_cert, cinfo = si_barrier_certificate_sparse_sharded(
-        u.T, x.T, axis_name, params, k=cfg.certificate_k, with_info=True,
-        arena=arena)
+        u.T, x.T, axis_name, params, settings=_certificate_settings(cfg),
+        k=cfg.certificate_k, with_info=True, arena=arena)
     return u_cert.T, cinfo.primal_residual, cinfo.dropped_count
 
 
@@ -984,10 +1069,16 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
 
         cert_residual = ()
         cert_dropped = ()
+        new_ccache = ()
         if cfg.certificate:
             # Second layer of the reference's stack: the joint certificate
             # over the already-filtered si velocities (see Config).
-            u, cert_residual, cert_dropped = apply_certificate(cfg, u, x)
+            if cfg.certificate_rebuild_skin:
+                u, cert_residual, cert_dropped, new_ccache = \
+                    apply_certificate(cfg, u, x,
+                                      neighbor_cache=state.certificate_cache)
+            else:
+                u, cert_residual, cert_dropped = apply_certificate(cfg, u, x)
 
         deficit = ()
         if unicycle:
@@ -997,11 +1088,13 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
             # Applied si velocity at the projection point — the actual
             # velocity the continuous barrier's vslots carry next step.
             new_state = State(x=body_new, v=realized, theta=theta_new,
-                              gating_cache=new_cache)
+                              gating_cache=new_cache,
+                              certificate_cache=new_ccache)
             deficit = jnp.max(safe_norm(u - realized))
         else:
             x_new, v_new = integrate(cfg, x, state.v, u)
-            new_state = State(x=x_new, v=v_new, gating_cache=new_cache)
+            new_state = State(x=x_new, v=v_new, gating_cache=new_cache,
+                              certificate_cache=new_ccache)
 
         out = StepOutputs(
             min_pairwise_distance=min_dist,

@@ -191,12 +191,27 @@ def binding_pair_radius(params: CertificateParams,
     return float(hi * headroom)
 
 
+def certificate_cache_seed(N: int, k: int, dtype=jnp.float32):
+    """Fresh Verlet cache for the sparse certificate's neighbor search
+    (idx (N, kc) int32, x_build (N, 2) — +inf forces a first-step
+    rebuild, dropped () int32 — frozen build-time coverage gap). Same
+    scheme as the gating cache (scenarios.swarm.verlet_gating), applied
+    to the certificate's own search: at N=4096 that search is 97% of the
+    certificate step's flops (XLA cost model, docs/BENCH_LOG.md), so
+    amortizing it across steps attacks the two-layer stack's dominant
+    cost."""
+    return (jnp.zeros((N, min(k, N - 1)), jnp.int32),
+            jnp.full((N, 2), jnp.inf, dtype),
+            jnp.zeros((), jnp.int32))
+
+
 def si_barrier_certificate_sparse(
         dxi, x, params: CertificateParams = CertificateParams(),
         settings: SparseADMMSettings = SparseADMMSettings(),
         k: int = 32, pair_radius: float | None = None,
         with_info: bool = False, arena: tuple | None = ARENA,
-        neighbor_backend: str = "auto", pallas_interpret: bool = False):
+        neighbor_backend: str = "auto", pallas_interpret: bool = False,
+        rebuild_skin: float = 0.0, neighbor_cache=None):
     """Swarm-scale joint certificate: same guarantee surface as
     :func:`si_barrier_certificate`, O(N*k) instead of O(N^2).
 
@@ -228,6 +243,19 @@ def si_barrier_certificate_sparse(
 
     Args/returns mirror the dense function: dxi (2, N), x (2, N) ->
     certified (2, N)[, SparseCertificateInfo].
+
+    ``rebuild_skin`` > 0 with a ``neighbor_cache`` (from
+    :func:`certificate_cache_seed`) applies the Verlet scheme to THIS
+    search: build the k-NN under (pair_radius + skin), rebuild only when
+    any agent has moved > skin/2 since build (triangle inequality keeps
+    every in-pair_radius pair build-time eligible), re-gather and
+    re-check the true radius on fresh positions every step — stale
+    SELECTION, fresh geometry, so the QP rows and the per-step residual
+    gate stay exact for the kept set. The dropped count freezes at each
+    rebuild, counted vs the build radius (an upper bound on the
+    in-pair_radius gap: a bigger eligible set with the same k slots can
+    only uncover MORE pairs). Returns an extra trailing ``new_cache``.
+    NOT differentiable (the rebuild cond) — learn.tuning rejects it.
     """
     from cbf_tpu.ops import pallas_knn
 
@@ -249,41 +277,75 @@ def si_barrier_certificate_sparse(
     use_pallas = (neighbor_backend == "pallas"
                   or (neighbor_backend == "auto"
                       and pallas_knn.supported(N)))
-    if use_pallas:
-        # knn_select: the oracle wrapper (fused-vs-streaming dispatch
-        # inside) — differentiable callers are safe because nothing
-        # downstream differentiates the kernel's OUTPUT VALUES: idx/count
-        # are integers, dist_k feeds only the boolean mask, and the row
-        # geometry gradients flow through _pair_row_geometry's jnp gathers
-        # of xt (finite-difference-tested with this backend).
-        idx, dist_k, _, count = pallas_knn.knn_select(
-            xt, pair_radius, k, pallas_interpret)
-        mask = jnp.isfinite(dist_k)                          # (N, k)
-    else:
+
+    def _search(radius):
+        """(idx, mask, count) under ``radius`` — the one search both the
+        exact path and the Verlet rebuild use."""
+        if use_pallas:
+            # knn_select: the oracle wrapper (fused-vs-streaming dispatch
+            # inside) — differentiable callers are safe because nothing
+            # downstream differentiates the kernel's OUTPUT VALUES:
+            # idx/count are integers, dist_k feeds only the boolean mask,
+            # and the row geometry gradients flow through
+            # _pair_row_geometry's jnp gathers of xt (FD-tested).
+            idx, dist_k, _, count = pallas_knn.knn_select(
+                xt, radius, k, pallas_interpret)
+            return idx, jnp.isfinite(dist_k), count
         dist = pairwise_distances(xt)                        # (N, N)
-        eligible = (dist < pair_radius) & ~jnp.eye(N, dtype=bool)
+        eligible = (dist < radius) & ~jnp.eye(N, dtype=bool)
         keyed = jnp.where(eligible, dist, jnp.inf)
         neg_d, idx = lax.top_k(-keyed, k)                    # (N, k)
-        mask = jnp.isfinite(neg_d)
-        count = jnp.sum(eligible, axis=1, dtype=jnp.int32)
+        return idx, jnp.isfinite(neg_d), jnp.sum(eligible, axis=1,
+                                                 dtype=jnp.int32)
 
-    # True coverage gap, not directed slot overflow: pair (i, j) is in the
-    # QP if it fits EITHER endpoint's k slots (the rows are identical).
-    # Eligibility is symmetric, so directed-eligible D = 2 * eligible
-    # pairs; kept entries S include mutual pairs twice, so unordered
-    # covered = S - M/2 with M = kept entries whose reverse is also kept.
-    # O(N*k^2) — no (N, N) scatter, works identically for both backends.
-    rows = jnp.broadcast_to(jnp.arange(N)[:, None], (N, k))
-    I = rows.reshape(-1)
+    def _coverage_gap(idx, mask, count):
+        """True coverage gap, not directed slot overflow: pair (i, j) is
+        in the QP if it fits EITHER endpoint's k slots (the rows are
+        identical). Eligibility is symmetric, so directed-eligible D =
+        2 * eligible pairs; kept entries S include mutual pairs twice, so
+        unordered covered = S - M/2 with M = kept entries whose reverse
+        is also kept. O(N*k^2) — no (N, N) scatter, identical for both
+        backends."""
+        I = jnp.broadcast_to(jnp.arange(N)[:, None], (N, k)).reshape(-1)
+        J = idx.reshape(-1)
+        D = jnp.sum(count)
+        S = jnp.sum(mask, dtype=jnp.int32)
+        mutual = mask.reshape(-1) & jnp.any(
+            (idx[J] == I[:, None]) & mask[J], axis=1)
+        M = jnp.sum(mutual, dtype=jnp.int32)
+        return D // 2 - (S - M // 2)
+
+    new_cache = None
+    if rebuild_skin:
+        if neighbor_cache is None:
+            raise ValueError("rebuild_skin > 0 needs a neighbor_cache "
+                             "(certificate_cache_seed) threaded through "
+                             "the caller's scan carry")
+        r_build = pair_radius + float(rebuild_skin)
+        idx_c, xb_c, dropped_c = neighbor_cache
+
+        def _rebuild(_):
+            idx, bmask, count = _search(r_build)
+            return idx, xt, _coverage_gap(idx, bmask, count)
+
+        disp2 = jnp.max(jnp.sum((xt - xb_c) ** 2, axis=1))
+        idx_c, xb_c, dropped_c = lax.cond(
+            disp2 > (0.5 * float(rebuild_skin)) ** 2, _rebuild,
+            lambda _: (idx_c, xb_c, dropped_c), None)
+        idx = idx_c
+        d = jnp.sqrt(jnp.sum((xt[:, None, :] - xt[idx]) ** 2, axis=-1))
+        # Fresh-radius re-check (0 < d also masks self-pointing filler
+        # slots, cf. swarm.verlet_gating): rows beyond pair_radius stay
+        # excluded, keeping binding_pair_radius's exactness argument.
+        mask = (d > 0.0) & (d < pair_radius)
+        dropped = dropped_c
+        new_cache = (idx_c, xb_c, dropped_c)
+    else:
+        idx, mask, count = _search(pair_radius)
+        dropped = _coverage_gap(idx, mask, count)
+
+    I = jnp.broadcast_to(jnp.arange(N)[:, None], (N, k)).reshape(-1)
     J = idx.reshape(-1)
-    D = jnp.sum(count)
-    S = jnp.sum(mask, dtype=jnp.int32)
-    rev_idx = idx[J]                                         # (N*k, k)
-    rev_mask = mask[J]
-    mutual = mask.reshape(-1) & jnp.any(
-        (rev_idx == I[:, None]) & rev_mask, axis=1)
-    M = jnp.sum(mutual, dtype=jnp.int32)
-    dropped = D // 2 - (S - M // 2)
     maskf = mask.reshape(-1)
     coef, b_pair = _pair_row_geometry(xt, I, J, maskf, params, dtype)
     lo, hi = _arena_box(xt, params, arena, dtype)
@@ -294,9 +356,14 @@ def si_barrier_certificate_sparse(
     u, info = solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
                                      settings, agent_k=k)
     out = u.T
+    info_out = SparseCertificateInfo(info.primal_residual,
+                                     info.dual_residual, dropped)
+    if rebuild_skin:
+        if with_info:
+            return out, info_out, new_cache
+        return out, new_cache
     if with_info:
-        return out, SparseCertificateInfo(info.primal_residual,
-                                          info.dual_residual, dropped)
+        return out, info_out
     return out
 
 

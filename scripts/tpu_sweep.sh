@@ -39,9 +39,15 @@ run BENCH_DYNAMICS=unicycle
 # 4. Chunked-gap attribution matrix (writer / chunking+fetch / bare-equiv).
 run BENCH_CHECKPOINT=0
 run BENCH_CHECKPOINT=0 BENCH_CHUNK=10000
-# 5. Certificate-on (sparse backend at ladder N, then mid N).
+# 5. Certificate-on (sparse backend at ladder N, then mid N), plus the
+# round-5 levers: lean ADMM budget (50/6 converges ~200x under the gate
+# on contract states) + the certificate's own Verlet search cache —
+# 1.55x combined at N=4096 on CPU; the TPU split between iteration-chain
+# latency and search flops is what this pair of runs attributes.
 run BENCH_CERTIFICATE=1 BENCH_N=1024 BENCH_STEPS=2000
 run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=1000
+run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=1000 BENCH_CERT_ITERS=50 BENCH_CERT_CG=6
+run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=1000 BENCH_CERT_ITERS=50 BENCH_CERT_CG=6 BENCH_CERT_SKIN=0.1
 # 6. k-NN k-sweep rates (floors already calibrated on CPU; k=8 = default run).
 run BENCH_K_NEIGHBORS=12 BENCH_STEPS=2000
 run BENCH_K_NEIGHBORS=16 BENCH_STEPS=2000

@@ -458,3 +458,20 @@ def test_bench_end_to_end_ensemble_certificate_cpu():
     assert "[certificate]" in out["metric"]
     assert out["certificate_max_residual"] < 1e-4
     assert "certificate max_residual=" in stderr
+
+
+def test_bench_certificate_levers_label_record():
+    """BENCH_CERT_SKIN + BENCH_CERT_ITERS/CG (the round-5 certificate
+    levers) must reach the config and label the record; they reject
+    without BENCH_CERTIFICATE=1."""
+    out, stderr = _run_bench_e2e({"BENCH_CERTIFICATE": "1", "BENCH_N": "160",
+                                  "BENCH_STEPS": "20",
+                                  "BENCH_CERT_SKIN": "0.1",
+                                  "BENCH_CERT_ITERS": "50",
+                                  "BENCH_CERT_CG": "6"})
+    assert "[cert_skin=0.1]" in out["metric"]
+    assert "[cert_budget=50/6]" in out["metric"]
+    assert out["certificate_max_residual"] < 1e-4
+
+    out, stderr = _run_bench_e2e({"BENCH_CERT_SKIN": "0.1"}, expect_rc=2)
+    assert "BENCH_CERTIFICATE=1" in out["error"]
