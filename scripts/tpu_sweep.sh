@@ -48,18 +48,19 @@ run BENCH_CERTIFICATE=1 BENCH_N=1024 BENCH_STEPS=2000
 run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=1000
 run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=1000 BENCH_CERT_ITERS=50 BENCH_CERT_CG=6
 run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=1000 BENCH_CERT_ITERS=50 BENCH_CERT_CG=6 BENCH_CERT_SKIN=0.1
-# 6. k-NN k-sweep rates (floors already calibrated on CPU; k=8 = default run).
-run BENCH_K_NEIGHBORS=12 BENCH_STEPS=2000
-run BENCH_K_NEIGHBORS=16 BENCH_STEPS=2000
-# 6b. Verlet neighbor cache (round 5): the O(N^2) search is 63% of step
+# 6. Verlet neighbor cache (round 5): the O(N^2) search is 63% of step
 # flops (roofline) — the cached selection should recover most of it.
 # 3x+ measured on CPU at N=2048; the floor metric is truncation-sound,
 # so an over-aggressive skin FAILS the safety gate conservatively
 # instead of hiding a blind spot (measured: skin=0.1 certifies the
 # exact floor to N=1024 but dips to 0.1257 at the N=4096 ladder rung;
 # skin=0.05 certifies the ladder rung — CPU-validated end-to-end).
+# Ordered before the k-sweep: it is the round-5 headline lever.
 run BENCH_GATING_SKIN=0.05
 run BENCH_GATING_SKIN=0.1 BENCH_STEPS=2000 BENCH_N=1024
+# 6b. k-NN k-sweep rates (floors already calibrated on CPU; k=8 = default).
+run BENCH_K_NEIGHBORS=12 BENCH_STEPS=2000
+run BENCH_K_NEIGHBORS=16 BENCH_STEPS=2000
 # 7. Profile trace for kernel tuning (tuning run, not a record).
 run BENCH_PROFILE=/tmp/tpu_trace_r04
 probe
