@@ -449,7 +449,16 @@ def _child_single(n: int, steps: int) -> dict:
                        certificate_iters=cert_iters,
                        certificate_cg_iters=cert_cg)
     state0, step = swarm.make(cfg)
-    chunk = min(_env_int("BENCH_CHUNK", 1000), steps)
+    # Certificate steps are ~2 orders of magnitude slower than filter-only
+    # ones (the ADMM's dependent iteration chain — latency-, not
+    # flops-bound), and the tunneled worker KILLS any single device
+    # execution that runs too long (r05 bisect: a 1000-step certificate
+    # chunk at N=1024, ~190 s of device time, crashed the worker with
+    # "kernel fault" on every attempt; a 200-step ~38 s chunk ran clean).
+    # Size the default certificate chunk so one execution stays ~10 s at
+    # the measured per-step cost; BENCH_CHUNK still overrides explicitly.
+    default_chunk = max(10, 51200 // n) if certificate else 1000
+    chunk = min(_env_int("BENCH_CHUNK", default_chunk), steps)
     unroll = _env_int("BENCH_UNROLL", 1)
     checkpointing = os.environ.get("BENCH_CHECKPOINT", "1") != "0"
 
@@ -621,11 +630,23 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
     jax.block_until_ready(final[0])
     compile_and_first = time.time() - t0
 
+    # The timed run must (a) not be a bit-identical re-dispatch of the
+    # warmup call and (b) end in a real host transfer. The r05 sweep
+    # measured wall=0.008 s for 10k steps through this path (5.1e9
+    # "agent-steps/s" — physically impossible, ~50x the VPU peak) when it
+    # was identical-args + block_until_ready only: through the axon tunnel
+    # that combination does not observe remote completion. t0=1 shifts one
+    # traced scalar (identical compute — it only phases the closed-form
+    # obstacle ring, and obstacle-free configs ignore it); np.asarray
+    # forces bytes back through the tunnel, which cannot complete before
+    # the device does.
     prof, profiled = _profile_ctx()
     with prof:
         t0 = time.time()
-        final, mets = sharded_swarm_rollout(cfg, mesh, seeds, steps=steps)
+        final, mets = sharded_swarm_rollout(cfg, mesh, seeds, steps=steps,
+                                            t0=1)
         jax.block_until_ready(final[0])
+        np.asarray(final[0])
         wall = time.time() - t0
 
     # nearest_distance is each swarm's per-step min nearest-neighbor
@@ -658,9 +679,11 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
                                       steps=steps)
         jax.block_until_ready(f1[0])
         t0 = time.time()
+        # Same honest-timing treatment as the headline window above.
         f1, _ = sharded_swarm_rollout(cfg, mesh1, seeds[:per_device],
-                                      steps=steps)
+                                      steps=steps, t0=1)
         jax.block_until_ready(f1[0])
+        np.asarray(f1[0])
         wall1 = time.time() - t0
         rate1 = per_device * n * steps / wall1
         efficiency = rate_per_chip / rate1 if rate1 > 0 else 0.0

@@ -1,0 +1,157 @@
+"""Bisect the TPU worker crash in the certificate-on bench path.
+
+Sweep r05 finding: every BENCH_CERTIFICATE=1 run (N=1024 and N=4096) kills
+the TPU worker ("UNAVAILABLE: TPU worker process crashed or restarted ...
+kernel fault") while the certificate-free paths — including the same Pallas
+k-NN kernels at k=8 — run clean. This script runs ONE candidate piece of the
+certificate step per subprocess (clean PJRT release on every exit, the
+r03 wedge lesson), smallest first, so the crashing op is named by the first
+FAIL line.
+
+Usage: python scripts/cert_bisect.py <case>   (or with no arg: list cases)
+Each case prints OK/the exception and exits; run them one at a time from the
+shell so a worker crash never cascades into the next case.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _release():
+    # bench's watchdogged teardown, not a bare clear_backends(): a case
+    # that wedges the runtime (the scenario this tool exists to probe)
+    # would otherwise hang the release forever instead of returning rc=1.
+    import bench
+
+    err = bench._graceful_backend_teardown()
+    print(f"release: {err or 'clean'}", file=sys.stderr)
+
+
+def _states(n, seed=0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    # Spread agents at swarm-like density/coordinates (~13 m box like the
+    # bench swarm config) so the search/QP see realistic candidate counts.
+    x = rng.uniform(-6.5, 6.5, size=(2, n)).astype("float32")
+    u = rng.uniform(-0.2, 0.2, size=(2, n)).astype("float32")
+    return x, u
+
+
+def case_knn_k32(n=1024):
+    """The certificate's neighbor search alone: Pallas knn_select at the
+    certificate's k=32 (the gating path that runs clean uses k=8)."""
+    import jax.numpy as jnp
+
+    from cbf_tpu.ops.pallas_knn import knn_select
+    from cbf_tpu.sim.certificates import CertificateParams, binding_pair_radius
+
+    x, _ = _states(n)
+    r = binding_pair_radius(CertificateParams())
+    idx, dist, nearest, count = knn_select(jnp.asarray(x.T), r, 32)
+    print("knn_k32:", idx.shape, float(nearest.min()), int(count.sum()))
+
+
+def case_knn_k8(n=1024):
+    """Control: the same kernel at the gating path's k=8 (ran clean in the
+    sweep inside the full rollout — this pins it standalone)."""
+    import jax.numpy as jnp
+
+    from cbf_tpu.ops.pallas_knn import knn_select
+
+    x, _ = _states(n)
+    idx, dist, nearest, count = knn_select(jnp.asarray(x.T), 0.2, 8)
+    print("knn_k8:", idx.shape, float(nearest.min()), int(count.sum()))
+
+
+def case_sparse_jnp(n=1024):
+    """The full sparse certificate with the jnp (non-Pallas) search —
+    isolates the ADMM/CG solve from the kernel."""
+    import jax.numpy as jnp
+
+    from cbf_tpu.sim.certificates import si_barrier_certificate_sparse
+
+    x, u = _states(n)
+    out, info = si_barrier_certificate_sparse(
+        jnp.asarray(u), jnp.asarray(x), neighbor_backend="jnp",
+        with_info=True, arena=None)
+    print("sparse_jnp:", out.shape, float(info.primal_residual),
+          int(info.dropped_count))
+
+
+def case_sparse_pallas(n=1024):
+    """The full sparse certificate with the Pallas search — the bench
+    path's configuration (arena=None isolates it from the box rows)."""
+    import jax.numpy as jnp
+
+    from cbf_tpu.sim.certificates import si_barrier_certificate_sparse
+
+    x, u = _states(n)
+    out, info = si_barrier_certificate_sparse(
+        jnp.asarray(u), jnp.asarray(x), neighbor_backend="pallas",
+        with_info=True, arena=None)
+    print("sparse_pallas:", out.shape, float(info.primal_residual),
+          int(info.dropped_count))
+
+
+def case_scenario_step(n=1024):
+    """One full certificate-on scenario step (no scan) — the bench path
+    minus chunking/checkpointing/scan."""
+    import jax
+    import jax.numpy as jnp
+
+    from cbf_tpu.scenarios import swarm
+
+    cfg = swarm.Config(n=n, steps=1, record_trajectory=False,
+                       certificate=True)
+    state0, step = swarm.make(cfg)
+    s1, outs = jax.jit(step)(state0, jnp.asarray(0, jnp.int32))
+    jax.block_until_ready(s1.x)
+    print("scenario_step:", float(outs.min_pairwise_distance),
+          float(outs.certificate_residual))
+
+
+def case_scenario_scan(n=1024, steps=50):
+    """A short certificate-on scan — adds the scan dimension."""
+    import jax
+
+    from cbf_tpu.rollout.engine import rollout_chunked
+    from cbf_tpu.scenarios import swarm
+
+    cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
+                       certificate=True)
+    state0, step = swarm.make(cfg)
+    final, outs, _ = rollout_chunked(step, state0, steps, chunk=steps)
+    jax.block_until_ready(final.x)
+    print("scenario_scan:", float(outs.min_pairwise_distance.min()),
+          float(outs.certificate_residual.max()))
+
+
+CASES = {f.__name__[5:]: f for f in (
+    case_knn_k8, case_knn_k32, case_sparse_jnp, case_sparse_pallas,
+    case_scenario_step, case_scenario_scan)}
+
+
+def main():
+    if len(sys.argv) < 2 or sys.argv[1] not in CASES:
+        print("cases:", " ".join(CASES))
+        return 2
+    name = sys.argv[1]
+    try:
+        CASES[name]()
+        print(f"CASE {name}: OK")
+        rc = 0
+    except Exception as e:
+        print(f"CASE {name}: FAIL {type(e).__name__}: {e}")
+        rc = 1
+    _release()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

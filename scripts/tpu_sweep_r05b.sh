@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# Round-5 continuation sweep: the items the first r05 sweep didn't reach
+# (it was stopped after the certificate items' worker crashes — root cause
+# found: >~1 min single XLA executions get the tunneled worker killed;
+# bench.py now sizes certificate chunks to ~10 s executions) plus the
+# ensemble re-measure under the honest-timing fix.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+mkdir -p docs/sweeps
+LOG="docs/sweeps/tpu_sweep_$(date +%Y%m%d_%H%M%S).log"
+run() {
+  echo "=== ${*:-defaults} ===" | tee -a "$LOG"
+  env "$@" python bench.py 2>&1 | tee -a "$LOG"
+  echo | tee -a "$LOG"
+}
+probe() {
+  echo "=== probe ===" | tee -a "$LOG"
+  python -c "
+import sys
+import bench
+ok, reason = bench.probe_device_subprocess(timeout_s=120)
+print((ok, reason))
+sys.exit(0 if ok else 1)
+" 2>&1 | tee -a "$LOG"
+}
+
+probe || { echo "device wedged — aborting sweep (see $LOG)"; exit 2; }
+# 1. Ensemble rate under the honest-timing fix (r05 first capture was a
+# non-observing 0.008 s window).
+run BENCH_ENSEMBLE=1
+# 2. Certificate-on at safe chunk sizes (worker-kill workaround).
+run BENCH_CERTIFICATE=1 BENCH_N=1024 BENCH_STEPS=2000
+run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=200
+# 3. Round-5 certificate levers at N=4096.
+run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=200 BENCH_CERT_ITERS=50 BENCH_CERT_CG=6
+run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=200 BENCH_CERT_ITERS=50 BENCH_CERT_CG=6 BENCH_CERT_SKIN=0.1
+# The chunk-sizing workaround above is a hypothesis — if a certificate
+# item wedged the tunnel anyway, the remaining items would each retry
+# against the dead device for up to BENCH_TOTAL_TIMEOUT; bail instead.
+probe || { echo "DEVICE WEDGED AFTER CERTIFICATE ITEMS — aborting (see $LOG)"; exit 3; }
+# 4. Verlet gating cache at each rung's certified skin.
+run BENCH_GATING_SKIN=0.05
+run BENCH_GATING_SKIN=0.1 BENCH_STEPS=2000 BENCH_N=1024
+# 5. k-NN k-sweep rate column.
+run BENCH_K_NEIGHBORS=12 BENCH_STEPS=2000
+run BENCH_K_NEIGHBORS=16 BENCH_STEPS=2000
+# 6. Profile trace for kernel attribution (tuning run, not a record).
+run BENCH_PROFILE=/tmp/tpu_trace_r05
+probe
+echo "sweep complete -> $LOG"
