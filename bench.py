@@ -438,8 +438,12 @@ def _child_single(n: int, steps: int) -> dict:
     cert_skin = _env_float("BENCH_CERT_SKIN", 0.0)
     cert_iters = _env_int("BENCH_CERT_ITERS", 0) or None
     cert_cg = _env_int("BENCH_CERT_CG", 0) or None
-    if (cert_skin or cert_iters or cert_cg) and not certificate:
-        raise ValueError("BENCH_CERT_SKIN/ITERS/CG need BENCH_CERTIFICATE=1")
+    cert_warm = os.environ.get("BENCH_CERT_WARM", "0") == "1"
+    cert_tol = _env_float("BENCH_CERT_TOL", 0.0) or None
+    if (cert_skin or cert_iters or cert_cg or cert_warm or cert_tol) \
+            and not certificate:
+        raise ValueError("BENCH_CERT_SKIN/ITERS/CG/WARM/TOL need "
+                         "BENCH_CERTIFICATE=1")
     cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
                        gating=gating, n_obstacles=n_obstacles,
                        dynamics=dynamics, certificate=certificate,
@@ -447,7 +451,9 @@ def _child_single(n: int, steps: int) -> dict:
                        gating_rebuild_skin=gating_skin,
                        certificate_rebuild_skin=cert_skin,
                        certificate_iters=cert_iters,
-                       certificate_cg_iters=cert_cg)
+                       certificate_cg_iters=cert_cg,
+                       certificate_warm_start=cert_warm,
+                       certificate_tol=cert_tol)
     state0, step = swarm.make(cfg)
     # Certificate steps are ~2 orders of magnitude slower than filter-only
     # ones (the ADMM's dependent iteration chain — latency-, not
@@ -568,6 +574,14 @@ def _child_single(n: int, steps: int) -> dict:
                                                       cert_cg or "d")
         result["cert_iters"] = cert_iters
         result["cert_cg_iters"] = cert_cg
+    if cert_warm:
+        # Warm/adaptive runs are a different measurement axis than the
+        # cold fixed-budget headline — label them like the budget knobs.
+        result["metric"] += " [cert_warm]"
+        result["cert_warm_start"] = True
+    if cert_tol:
+        result["metric"] += " [cert_tol=%g]" % cert_tol
+        result["cert_tol"] = cert_tol
     if certificate:
         _label_certificate(result, cert_res, cert_dropped)
     return result

@@ -113,6 +113,16 @@ def make_loss_fn(cfg: swarm_scenario.Config, mesh, tc: TrainConfig = TrainConfig
             "transfer (the caches change neighbor SELECTION only, and "
             "only above truncation density)")
 
+    if cfg.certificate_warm_start or cfg.certificate_tol is not None:
+        raise ValueError(
+            "certificate_warm_start/certificate_tol are not supported on "
+            "the differentiable trainer path (the warm-start carry is "
+            "data, not a differentiable input, and the adaptive budget's "
+            "while_loop has no reverse rule) — train with both off; the "
+            "tuned parameters transfer (both knobs change solver "
+            "ITERATION SCHEDULING only, never the certified solution the "
+            "residual gate asserts)")
+
     unicycle = cfg.dynamics == "unicycle"
 
     def local_loss(params: TunableParams, *state0l):

@@ -326,6 +326,15 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
             "certificate_rebuild_skin is scenario/bench-path only (the "
             "ensemble certificate keeps the exact search); set it to 0 "
             "for sharded rollouts")
+    if cfg.certificate_warm_start or cfg.certificate_tol is not None:
+        # Same contract: the ensemble step does not thread the solver
+        # carry (warm start), and the adaptive while_loop's residual cond
+        # contains collectives on the row-partitioned path — unproven
+        # under shard_map. Rejecting beats silently benching a cold-start
+        # fixed-budget solve under a warm/adaptive label.
+        raise ValueError(
+            "certificate_warm_start/certificate_tol are scenario/bench-"
+            "path only; unset them for sharded rollouts")
 
     if initial_state is not None:
         if len(initial_state) != parts:

@@ -192,6 +192,27 @@ class Config:
     # still asserting convergence. None = the solver's defaults (100/8).
     certificate_iters: int | None = None
     certificate_cg_iters: int | None = None
+    # Warm-start the sparse ADMM from the previous step's final carry
+    # (threaded through State.certificate_solver_state). At the packed
+    # quasi-static equilibrium consecutive certificate QPs are nearly
+    # identical, so the duals barely move and most of the iteration
+    # budget is re-deriving what the last step already knew; any stale
+    # carry is SOUND (ADMM converges from every start and the per-step
+    # residual gate still asserts the result) — staleness only costs
+    # iterations. Pays off combined with certificate_tol (below), which
+    # actually skips the saved iterations. Sparse backend, scenario/bench
+    # path only (ensembles and the trainer reject it).
+    certificate_warm_start: bool = False
+    # Adaptive ADMM budget: > 0 runs check_every-iteration blocks until
+    # max(primal, dual) residual <= tol, capped at certificate_iters
+    # (rounded up to a whole 10-iteration block) —
+    # lean on easy states, escalated on hard late-horizon packed ones
+    # (r05 TPU: residual grows 2e-8 -> 2.6e-4 over a 2000-step horizon
+    # under the fixed default budget, and the solve is latency-bound on
+    # chain LENGTH, so adaptive trip count converts directly into both
+    # wall time and convergence). Set it <= the 1e-4 residual gate.
+    # None = fixed iterations (the differentiable-path requirement).
+    certificate_tol: float | None = None
     # sp > 1 ensembles only: "auto" row-partitions the sparse backend's
     # joint solve over the sp axis (each shard owns its local agents' pair
     # rows — O(N*k/sp) row work per device; parallel.ensemble), falling
@@ -280,6 +301,13 @@ class State(NamedTuple):
     # Config.certificate_rebuild_skin > 0 only (same conventions as
     # gating_cache; seeded by sim.certificates.certificate_cache_seed).
     certificate_cache: tuple = ()
+    # Previous step's final sparse-ADMM carry (x, z_p, z_b, y_p, y_b) —
+    # Config.certificate_warm_start only (seeded all-zero, which is
+    # exactly the solver's cold start, by
+    # sim.certificates.certificate_solver_seed). Opaque solver state:
+    # sound whatever the step did to the neighbor set (see the solver's
+    # warm_state contract), () when disabled.
+    certificate_solver_state: tuple = ()
 
 
 def spawn_positions(cfg: Config, seed) -> jnp.ndarray:
@@ -440,6 +468,21 @@ def barrier_dynamics(cfg: Config, dtype):
                 "ADMM budget; resolved backend here is "
                 f"{certificate_backend(cfg)!r} — set "
                 "certificate_backend='sparse'")
+    if cfg.certificate_warm_start or cfg.certificate_tol is not None:
+        # Honored-or-rejected like the sibling knobs: both only reach the
+        # sparse ADMM.
+        if not cfg.certificate:
+            raise ValueError("certificate_warm_start/certificate_tol need "
+                             "certificate=True")
+        if certificate_backend(cfg) != "sparse":
+            raise ValueError(
+                "certificate_warm_start/certificate_tol apply to the "
+                "SPARSE ADMM backend; resolved backend here is "
+                f"{certificate_backend(cfg)!r} — set "
+                "certificate_backend='sparse'")
+        if cfg.certificate_tol is not None and cfg.certificate_tol <= 0:
+            raise ValueError(
+                f"certificate_tol must be > 0, got {cfg.certificate_tol}")
     if (cfg.certificate and cfg.certificate_pairs is not None
             and certificate_backend(cfg) == "sparse"):
         raise ValueError(
@@ -612,8 +655,14 @@ def initial_state(cfg: Config) -> State:
         from cbf_tpu.sim.certificates import certificate_cache_seed
         ccache = certificate_cache_seed(cfg.n, cfg.certificate_k,
                                         cfg.dtype)
+    sstate = ()
+    if cfg.certificate_warm_start:
+        from cbf_tpu.sim.certificates import certificate_solver_seed
+        sstate = certificate_solver_seed(cfg.n, cfg.certificate_k,
+                                         cfg.dtype)
     return State(x=x0, v=jnp.zeros_like(x0), theta=theta0,
-                 gating_cache=cache, certificate_cache=ccache)
+                 gating_cache=cache, certificate_cache=ccache,
+                 certificate_solver_state=sstate)
 
 
 def separation_bias(cfg: Config, x, obs_slab, mask):
@@ -743,10 +792,13 @@ def _certificate_settings(cfg: Config):
         iters=cfg.certificate_iters if cfg.certificate_iters is not None
         else d.iters,
         cg_iters=cfg.certificate_cg_iters
-        if cfg.certificate_cg_iters is not None else d.cg_iters)
+        if cfg.certificate_cg_iters is not None else d.cg_iters,
+        tol=cfg.certificate_tol if cfg.certificate_tol is not None
+        else d.tol)
 
 
-def apply_certificate(cfg: Config, u, x, neighbor_cache=None):
+def apply_certificate(cfg: Config, u, x, neighbor_cache=None,
+                      solver_state=None):
     """The joint second layer over already-filtered si velocities (see
     Config.certificate). Shared by the scenario step and the sharded
     ensemble. Returns (u_certified (N, 2), primal_residual scalar,
@@ -755,8 +807,10 @@ def apply_certificate(cfg: Config, u, x, neighbor_cache=None):
     emits; 0 on the dense backend, whose max_pairs pruning keeps the
     globally tightest rows and is covered by its own exactness test)
     — plus a trailing new_cache when ``neighbor_cache`` is given (the
-    certificate_rebuild_skin Verlet path; scenario step only — the
-    caller threads it through its scan carry).
+    certificate_rebuild_skin Verlet path) and a trailing
+    new_solver_state when ``solver_state`` is given (the
+    certificate_warm_start path; both scenario-step only — the caller
+    threads them through its scan carry).
 
     Differentiable as-is (no mode flag) on the EXACT path: the sparse
     search's kernel runs as a selection oracle (ops.pallas_knn.knn_select
@@ -771,18 +825,15 @@ def apply_certificate(cfg: Config, u, x, neighbor_cache=None):
     params, arena = _certificate_problem(cfg)
     if certificate_backend(cfg) == "sparse":
         settings = _certificate_settings(cfg)
-        if neighbor_cache is not None:
-            u_cert, cinfo, new_cache = si_barrier_certificate_sparse(
-                u.T, x.T, params, settings=settings,
-                k=cfg.certificate_k, with_info=True, arena=arena,
-                rebuild_skin=cfg.certificate_rebuild_skin,
-                neighbor_cache=neighbor_cache)
-            return (u_cert.T, cinfo.primal_residual, cinfo.dropped_count,
-                    new_cache)
-        u_cert, cinfo = si_barrier_certificate_sparse(
-            u.T, x.T, params, settings=settings, k=cfg.certificate_k,
-            with_info=True, arena=arena)
-        return u_cert.T, cinfo.primal_residual, cinfo.dropped_count
+        out = si_barrier_certificate_sparse(
+            u.T, x.T, params, settings=settings,
+            k=cfg.certificate_k, with_info=True, arena=arena,
+            rebuild_skin=(cfg.certificate_rebuild_skin
+                          if neighbor_cache is not None else 0.0),
+            neighbor_cache=neighbor_cache, solver_state=solver_state)
+        u_cert, cinfo = out[0], out[1]
+        return (u_cert.T, cinfo.primal_residual,
+                cinfo.dropped_count) + tuple(out[2:])
     pairs = (cfg.certificate_pairs if cfg.certificate_pairs is not None
              else 8 * cfg.n)
     u_cert, cinfo = si_barrier_certificate(
@@ -1070,15 +1121,22 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
         cert_residual = ()
         cert_dropped = ()
         new_ccache = ()
+        new_sstate = ()
         if cfg.certificate:
             # Second layer of the reference's stack: the joint certificate
             # over the already-filtered si velocities (see Config).
+            res = apply_certificate(
+                cfg, u, x,
+                neighbor_cache=(state.certificate_cache
+                                if cfg.certificate_rebuild_skin else None),
+                solver_state=(state.certificate_solver_state
+                              if cfg.certificate_warm_start else None))
+            u, cert_residual, cert_dropped = res[:3]
+            rest = list(res[3:])
             if cfg.certificate_rebuild_skin:
-                u, cert_residual, cert_dropped, new_ccache = \
-                    apply_certificate(cfg, u, x,
-                                      neighbor_cache=state.certificate_cache)
-            else:
-                u, cert_residual, cert_dropped = apply_certificate(cfg, u, x)
+                new_ccache = rest.pop(0)
+            if cfg.certificate_warm_start:
+                new_sstate = rest.pop(0)
 
         deficit = ()
         if unicycle:
@@ -1089,12 +1147,14 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
             # velocity the continuous barrier's vslots carry next step.
             new_state = State(x=body_new, v=realized, theta=theta_new,
                               gating_cache=new_cache,
-                              certificate_cache=new_ccache)
+                              certificate_cache=new_ccache,
+                              certificate_solver_state=new_sstate)
             deficit = jnp.max(safe_norm(u - realized))
         else:
             x_new, v_new = integrate(cfg, x, state.v, u)
             new_state = State(x=x_new, v=v_new, gating_cache=new_cache,
-                              certificate_cache=new_ccache)
+                              certificate_cache=new_ccache,
+                              certificate_solver_state=new_sstate)
 
         out = StepOutputs(
             min_pairwise_distance=min_dist,

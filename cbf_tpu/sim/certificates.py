@@ -47,6 +47,10 @@ class SparseCertificateInfo(NamedTuple):
     # path applies relative to the dense all-pairs rows; callers surface
     # it, never swallow it.
     dropped_count: jnp.ndarray
+    # ADMM iterations actually run (solver's SparseADMMInfo.iterations):
+    # the observable that proves the adaptive tol mode trips early. () on
+    # callers predating the field.
+    iterations: jnp.ndarray = ()
 
 
 def si_barrier_certificate(dxi, x, params: CertificateParams = CertificateParams(),
@@ -205,13 +209,26 @@ def certificate_cache_seed(N: int, k: int, dtype=jnp.float32):
             jnp.zeros((), jnp.int32))
 
 
+def certificate_solver_seed(N: int, k: int, dtype=jnp.float32):
+    """All-zero sparse-ADMM carry (x, z_p, z_b, y_p, y_b) for
+    ``si_barrier_certificate_sparse(solver_state=...)`` — bitwise the
+    solver's own cold start, so a warm-started rollout's step 0 matches
+    the unwarmed one exactly. Shapes follow the certificate's agent-major
+    row layout: R = N * min(k, N-1) pair rows, 2N box/variable slots."""
+    R = N * min(k, N - 1)
+    z2n = jnp.zeros((2 * N,), dtype)
+    zr = jnp.zeros((R,), dtype)
+    return (z2n, zr, z2n, zr, z2n)
+
+
 def si_barrier_certificate_sparse(
         dxi, x, params: CertificateParams = CertificateParams(),
         settings: SparseADMMSettings = SparseADMMSettings(),
         k: int = 32, pair_radius: float | None = None,
         with_info: bool = False, arena: tuple | None = ARENA,
         neighbor_backend: str = "auto", pallas_interpret: bool = False,
-        rebuild_skin: float = 0.0, neighbor_cache=None):
+        rebuild_skin: float = 0.0, neighbor_cache=None,
+        solver_state=None):
     """Swarm-scale joint certificate: same guarantee surface as
     :func:`si_barrier_certificate`, O(N*k) instead of O(N^2).
 
@@ -256,6 +273,13 @@ def si_barrier_certificate_sparse(
     in-pair_radius gap: a bigger eligible set with the same k slots can
     only uncover MORE pairs). Returns an extra trailing ``new_cache``.
     NOT differentiable (the rebuild cond) — learn.tuning rejects it.
+
+    ``solver_state``: a previous call's final ADMM carry (from
+    :func:`certificate_solver_seed` on step 0) — warm-starts the solve
+    and appends the new carry as the LAST return element (after
+    new_cache when both are active). See the solver's warm_state
+    contract: sound for any stale carry, the residual gate still
+    asserts every step. Not differentiable through the carry.
     """
     from cbf_tpu.ops import pallas_knn
 
@@ -353,18 +377,26 @@ def si_barrier_certificate_sparse(
     # agent_k: the rows built above are agent-major by construction
     # (I = repeat(arange(N), k)) — declare it so the solver's transpose
     # runs the I side as a dense reshape-sum instead of a scatter.
-    u, info = solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
-                                     settings, agent_k=k)
+    solve = solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
+                                   settings, agent_k=k,
+                                   warm_state=solver_state or None,
+                                   with_state=solver_state is not None)
+    if solver_state is not None:
+        u, info, new_solver_state = solve
+    else:
+        u, info = solve
     out = u.T
     info_out = SparseCertificateInfo(info.primal_residual,
-                                     info.dual_residual, dropped)
-    if rebuild_skin:
-        if with_info:
-            return out, info_out, new_cache
-        return out, new_cache
+                                     info.dual_residual, dropped,
+                                     info.iterations)
+    ret = (out,)
     if with_info:
-        return out, info_out
-    return out
+        ret += (info_out,)
+    if rebuild_skin:
+        ret += (new_cache,)
+    if solver_state is not None:
+        ret += (new_solver_state,)
+    return ret if len(ret) > 1 else out
 
 
 def _pair_row_geometry(xt, I, J, maskf, params: CertificateParams, dtype):
@@ -494,5 +526,10 @@ def si_barrier_certificate_sparse_sharded(
     if with_info:
         return out, SparseCertificateInfo(
             lax.pmax(info.primal_residual, axis_name),
-            lax.pmax(info.dual_residual, axis_name), dropped)
+            lax.pmax(info.dual_residual, axis_name), dropped,
+            # No pmax: iterations is the static fixed budget here (the
+            # solver rejects tol > 0 in row-partitioned mode), identical
+            # and unvarying on every shard — pmax of an unvaried value
+            # trips shard_map's vma checking for nothing.
+            info.iterations)
     return out

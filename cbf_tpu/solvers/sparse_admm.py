@@ -52,17 +52,36 @@ class SparseADMMSettings(NamedTuple):
     less compute than the dense solver's 250-iteration convention. On
     out-of-contract states (interpenetrating spawns, h < 0) no budget
     converges well — the caller's per-step residual gate flags those
-    loudly at any setting."""
+    loudly at any setting.
+
+    ``tol`` > 0 switches the fixed-iteration scan to an adaptive
+    while_loop: run ``check_every``-iteration blocks, stop as soon as
+    max(primal, dual) residual <= tol, capped at ``iters`` rounded UP to
+    a whole block — lean on easy states, escalated on hard ones (the
+    r05 TPU finding: the solve is latency-bound on its serial iteration
+    chain, so skipped iterations convert 1:1 into wall time, and
+    long-horizon packed states need MORE than the fixed default budget —
+    residual 2.6e-4 at 2000 steps under 100x8). The residual check costs
+    one extra pair matvec per block. NOT reverse-differentiable
+    (while_loop); the trainer keeps tol=0."""
     rho: float = 1.0
     sigma: float = 1e-6
     alpha: float = 1.6       # over-relaxation
     iters: int = 100
     cg_iters: int = 8        # x-update CG steps (warm-started from prev x)
+    tol: float = 0.0         # 0 = fixed iters (differentiable path)
+    check_every: int = 10
 
 
 class SparseADMMInfo(NamedTuple):
     primal_residual: jax.Array
     dual_residual: jax.Array
+    # ADMM iterations actually run: settings.iters in fixed mode, the
+    # adaptive trip count (blocks * check_every) under tol > 0 — exposed
+    # so callers/tests can assert the adaptive mode actually trips early
+    # (a cond regression would otherwise silently run full budgets while
+    # every residual check stays green). () from older pickled infos.
+    iterations: jax.Array = ()
 
 
 def _cg(apply_K, rhs, iters, vma_ref=None):
@@ -215,7 +234,8 @@ _solve_K.defvjp(_solve_K_fwd, _solve_K_bwd)
 def solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
                            settings: SparseADMMSettings = SparseADMMSettings(),
                            axis_name: str | None = None,
-                           agent_k: int | None = None, rows_start=0):
+                           agent_k: int | None = None, rows_start=0,
+                           warm_state=None, with_state: bool = False):
     """Solve the neighbor-pair QP above. Returns (u (N, 2), SparseADMMInfo).
 
     Args:
@@ -246,6 +266,21 @@ def solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
         (see _make_apply_K). Exactness vs the generic path is tested; a
         caller passing agent_k with a DIFFERENT row layout gets silently
         wrong answers, so only declare what the builder constructs.
+      warm_state / with_state: cross-call warm starting. ``warm_state``
+        is a previous call's final ADMM carry (x, z_p, z_b, y_p, y_b —
+        opaque; obtain it via ``with_state=True``, which appends the
+        final carry to the return). Sound for ANY warm state — ADMM
+        converges from every starting point and the caller's residual
+        gate still asserts the result — but only USEFUL when the row set
+        (I, J, coef order) matches the call that produced it, e.g.
+        consecutive scan steps of a quasi-static swarm (duals barely
+        move step to step, so most of the iteration budget collapses;
+        pair it with tol > 0 to actually skip the saved iterations).
+        z_p/y_p are per-row, so a caller whose row MEANING changed
+        mid-stream (neighbor rebuild without a frozen index set) is
+        handing the solver a merely-suboptimal start, never a wrong
+        answer. Not differentiable through the carried state (the
+        scenario threads it through the scan carry as data).
     """
     N = u_nom.shape[0]
     dtype = jnp.result_type(u_nom, coef)
@@ -292,31 +327,84 @@ def solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
         y_b_new = y_b + rho * (Axr_b - z_b_new)
         return (x_new, z_p_new, z_b_new, y_p_new, y_b_new), None
 
+    def residuals(x, y_p, y_b):
+        """(primal, dual) in the ORIGINAL row geometry (d > 0 leaves the
+        feasible set unchanged; the dual residual is scale-invariant, cf.
+        solvers.admm). Partitioned mode: viol_p sees only local rows ->
+        pmax completes it; the dual vector's A^T term is already psummed
+        inside A_pair_T."""
+        u = x.reshape(N, 2)
+        Ax_orig = jnp.sum(coef * (u[I] - u[J]), axis=1)
+        viol_p = jnp.max(jnp.maximum(Ax_orig - b_pair, 0.0), initial=0.0)
+        if axis_name is not None:
+            viol_p = lax.pmax(viol_p, axis_name)
+        viol_b = jnp.max(jnp.maximum(
+            jnp.maximum(lo.reshape(-1) - x, x - hi.reshape(-1)), 0.0),
+            initial=0.0)
+        primal = jnp.maximum(viol_p, viol_b)
+        dual_vec = (x + q + A_pair_T(y_p).reshape(-1) + y_b)
+        dual = jnp.max(jnp.abs(dual_vec))
+        return primal, dual
+
     R = I.shape[0]
-    # match_vma: see solvers.admm — zero carries must match the problem
-    # data's varying-manual-axes type under shard_map. In row-partitioned
-    # mode the x/z_b carries additionally pick up coef_s's axes through
-    # _cg's vma_ref, so pre-align them with both (chaining unions axes).
-    x0 = match_vma(match_vma(jnp.zeros((2 * N,), dtype), q), coef_s[0, 0])
-    zp0 = match_vma(jnp.zeros((R,), dtype), coef_s[:, 0])
-    zb0 = match_vma(match_vma(jnp.zeros((2 * N,), dtype), q), coef_s[0, 0])
-    # scan, not fori_loop: reverse-differentiable (see _cg).
-    (x, z_p, z_b, y_p, y_b), _ = lax.scan(
-        step, (x0, zp0, zb0, zp0, zb0), None, length=settings.iters)
+    if warm_state is not None:
+        carry0 = warm_state
+    else:
+        # match_vma: see solvers.admm — zero carries must match the problem
+        # data's varying-manual-axes type under shard_map. In row-partitioned
+        # mode the x/z_b carries additionally pick up coef_s's axes through
+        # _cg's vma_ref, so pre-align them with both (chaining unions axes).
+        x0 = match_vma(match_vma(jnp.zeros((2 * N,), dtype), q),
+                       coef_s[0, 0])
+        zp0 = match_vma(jnp.zeros((R,), dtype), coef_s[:, 0])
+        carry0 = (x0, zp0, x0, zp0, x0)
+
+    if settings.tol > 0.0:
+        if axis_name is not None:
+            # The residual cond below contains collectives (pmax, and the
+            # psum inside A_pair_T) — collectives inside a while_loop cond
+            # are unproven under shard_map. Reject HERE, at the one place
+            # the incompatibility lives, so direct callers of the sharded
+            # certificate get a clear error instead of an obscure tracer
+            # failure (parallel.ensemble's config check is then a
+            # friendlier early copy, not load-bearing).
+            raise ValueError(
+                "SparseADMMSettings.tol > 0 (adaptive budget) is not "
+                "supported in row-partitioned mode (axis_name set): the "
+                "while_loop's residual cond would run collectives — use "
+                "a fixed iteration budget for sharded solves")
+        # Adaptive mode: check_every-iteration blocks inside a while_loop,
+        # stop at tol, capped at ceil(iters / check_every) blocks — the
+        # cap ROUNDS UP to a whole block when iters is not a multiple of
+        # check_every (a while_loop body needs a static scan length; the
+        # documented budget is the cap's upper bound, not an exact count).
+        # One XLA program, data-dependent trip count (legal in while_loop;
+        # NOT reverse-differentiable — the trainer keeps tol=0).
+        n_blocks = -(-settings.iters // settings.check_every)
+
+        def block(carry):
+            state, it = carry
+            state, _ = lax.scan(step, state, None,
+                                length=settings.check_every)
+            return state, it + 1
+
+        def cond(carry):
+            state, it = carry
+            p, dd = residuals(state[0], state[3], state[4])
+            return (it < n_blocks) & (jnp.maximum(p, dd) > settings.tol)
+
+        (x, z_p, z_b, y_p, y_b), blocks_run = lax.while_loop(
+            cond, block, (carry0, jnp.asarray(0, jnp.int32)))
+        iterations = blocks_run * settings.check_every
+    else:
+        # scan, not fori_loop: reverse-differentiable (see _cg).
+        (x, z_p, z_b, y_p, y_b), _ = lax.scan(
+            step, carry0, None, length=settings.iters)
+        iterations = jnp.asarray(settings.iters, jnp.int32)
 
     u = x.reshape(N, 2)
-    # Residuals in the ORIGINAL row geometry (d > 0 leaves the feasible set
-    # unchanged; the dual residual is scale-invariant, cf. solvers.admm).
-    # Partitioned mode: viol_p sees only local rows -> pmax completes it;
-    # the dual vector's A^T term is already psummed inside A_pair_T.
-    Ax_orig = jnp.sum(coef * (u[I] - u[J]), axis=1)
-    viol_p = jnp.max(jnp.maximum(Ax_orig - b_pair, 0.0), initial=0.0)
-    if axis_name is not None:
-        viol_p = lax.pmax(viol_p, axis_name)
-    viol_b = jnp.max(jnp.maximum(
-        jnp.maximum(lo.reshape(-1) - x, x - hi.reshape(-1)), 0.0),
-        initial=0.0)
-    primal = jnp.maximum(viol_p, viol_b)
-    dual_vec = (x + q + A_pair_T(y_p).reshape(-1) + y_b)
-    dual = jnp.max(jnp.abs(dual_vec))
-    return u, SparseADMMInfo(primal, dual)
+    primal, dual = residuals(x, y_p, y_b)
+    info = SparseADMMInfo(primal, dual, iterations)
+    if with_state:
+        return u, info, (x, z_p, z_b, y_p, y_b)
+    return u, info

@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Round-5 sweep, part 3: what r05b hadn't reached when it was stopped
+# (ensemble was captured; the certificate N=1024 x 2000 item failed its
+# own convergence gate — residual grows with horizon, see BENCH_LOG).
+# Adds a deep-budget rerun of that failed item to test the diagnosis.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+mkdir -p docs/sweeps
+LOG="docs/sweeps/tpu_sweep_$(date +%Y%m%d_%H%M%S).log"
+run() {
+  echo "=== ${*:-defaults} ===" | tee -a "$LOG"
+  env "$@" python bench.py 2>&1 | tee -a "$LOG"
+  echo | tee -a "$LOG"
+}
+probe() {
+  echo "=== probe ===" | tee -a "$LOG"
+  python -c "
+import sys
+import bench
+ok, reason = bench.probe_device_subprocess(timeout_s=120)
+print((ok, reason))
+sys.exit(0 if ok else 1)
+" 2>&1 | tee -a "$LOG"
+}
+
+probe || { echo "device wedged — aborting sweep (see $LOG)"; exit 2; }
+# 1. Certificate at N=4096 (short horizon — pre-packing states), default
+# then lean budget, then lean + Verlet search cache.
+run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=200
+run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=200 BENCH_CERT_ITERS=50 BENCH_CERT_CG=6
+run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=200 BENCH_CERT_ITERS=50 BENCH_CERT_CG=6 BENCH_CERT_SKIN=0.1
+# 2. The failed long-horizon item, deep budget: does 250x10 converge on
+# late packed states? (Diagnosis probe — labeled, not a headline.)
+run BENCH_ATTEMPT_TIMEOUT=1400 BENCH_ATTEMPTS=1 BENCH_CERTIFICATE=1 BENCH_N=1024 BENCH_STEPS=2000 BENCH_CERT_ITERS=250 BENCH_CERT_CG=10
+probe || { echo "DEVICE WEDGED AFTER CERTIFICATE ITEMS — aborting (see $LOG)"; exit 3; }
+# 3. Verlet gating cache at each rung's certified skin.
+run BENCH_GATING_SKIN=0.05
+run BENCH_GATING_SKIN=0.1 BENCH_STEPS=2000 BENCH_N=1024
+# 4. k-NN k-sweep rate column.
+run BENCH_K_NEIGHBORS=12 BENCH_STEPS=2000
+run BENCH_K_NEIGHBORS=16 BENCH_STEPS=2000
+# 5. Profile trace for kernel attribution (tuning run, not a record).
+run BENCH_PROFILE=/tmp/tpu_trace_r05
+probe
+echo "sweep complete -> $LOG"

@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# Round-5 sweep, final part: the items still unmeasured after the r05c
+# device wedge (the lean-budget item's 420 s timeout kill re-confirmed
+# the kill-mid-operation wedge pattern). Ordered safest/most-valuable
+# first; the one item that previously stalled runs LAST with a single
+# attempt so a hang costs one kill, not three.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+mkdir -p docs/sweeps
+LOG="docs/sweeps/tpu_sweep_$(date +%Y%m%d_%H%M%S).log"
+run() {
+  echo "=== ${*:-defaults} ===" | tee -a "$LOG"
+  env "$@" python bench.py 2>&1 | tee -a "$LOG"
+  echo | tee -a "$LOG"
+}
+probe() {
+  echo "=== probe ===" | tee -a "$LOG"
+  python -c "
+import sys
+import bench
+ok, reason = bench.probe_device_subprocess(timeout_s=120)
+print((ok, reason))
+sys.exit(0 if ok else 1)
+" 2>&1 | tee -a "$LOG"
+}
+
+probe || { echo "device wedged — aborting sweep (see $LOG)"; exit 2; }
+# 1. Verlet gating cache at each rung's certified skin (fast, filter-only).
+run BENCH_GATING_SKIN=0.05
+run BENCH_GATING_SKIN=0.1 BENCH_STEPS=2000 BENCH_N=1024
+# 2. k-NN k-sweep rate column.
+run BENCH_K_NEIGHBORS=12 BENCH_STEPS=2000
+run BENCH_K_NEIGHBORS=16 BENCH_STEPS=2000
+# 3. Profile trace for kernel attribution (tuning run, not a record).
+run BENCH_PROFILE=/tmp/tpu_trace_r05
+probe || { echo "DEVICE WEDGED — aborting (see $LOG)"; exit 3; }
+# 4. Certificate warm-start + adaptive tol — the round-5 lever AND the
+# fix candidate for the long-horizon residual-growth failure: the same
+# N=1024 x 2000 config that failed the 1e-4 gate cold now runs
+# warm+adaptive (tol 20x under the gate, iters cap at the default 100).
+run BENCH_CERTIFICATE=1 BENCH_N=1024 BENCH_STEPS=2000 BENCH_CERT_WARM=1 BENCH_CERT_TOL=5e-6
+# 5. Warm+tol at N=4096 (short horizon), comparable to the measured cold
+# 5.4k rate at the same shape.
+run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=200 BENCH_CERT_WARM=1 BENCH_CERT_TOL=5e-6
+probe || { echo "DEVICE WEDGED AFTER CERTIFICATE ITEMS — aborting (see $LOG)"; exit 3; }
+# 6. The lean-budget rerun that stalled in r05c (single attempt: a hang
+# costs one 900 s kill, not three).
+run BENCH_ATTEMPTS=1 BENCH_ATTEMPT_TIMEOUT=900 BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=200 BENCH_CERT_ITERS=50 BENCH_CERT_CG=6
+probe
+echo "sweep complete -> $LOG"

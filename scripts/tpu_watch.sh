@@ -15,6 +15,7 @@ set -u
 cd "$(dirname "$0")/.."
 INTERVAL="${TPU_WATCH_INTERVAL_S:-900}"
 MAX_HOURS="${TPU_WATCH_MAX_HOURS:-12}"
+SWEEP="${TPU_WATCH_SWEEP:-scripts/tpu_sweep.sh}"
 deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
 n=0
 while [ "$(date +%s)" -lt "$deadline" ]; do
@@ -27,8 +28,8 @@ ok, reason = bench.probe_device_subprocess(timeout_s=120)
 print('[tpu_watch]', (ok, reason))
 sys.exit(0 if ok else 1)
 "; then
-    echo "[tpu_watch] HEALTHY — running sweep"
-    bash scripts/tpu_sweep.sh
+    echo "[tpu_watch] HEALTHY — running $SWEEP"
+    bash "$SWEEP"
     echo "[tpu_watch] sweep finished rc=$? — exiting"
     exit 0
   fi
