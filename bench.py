@@ -623,6 +623,15 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
         # exact search (certificate_rebuild_skin is scenario-path only).
         raise ValueError("BENCH_CERT_SKIN is single-swarm-mode only; "
                          "unset it or drop BENCH_ENSEMBLE")
+    if (os.environ.get("BENCH_CERT_WARM", "0") == "1"
+            or _env_float("BENCH_CERT_TOL", 0.0)):
+        # Same contract: the ensemble step threads no solver carry and
+        # the adaptive while_loop is rejected on the sharded path —
+        # silently benching a cold fixed-budget solve under a
+        # warm/adaptive env label would mislabel the transcription.
+        raise ValueError("BENCH_CERT_WARM/BENCH_CERT_TOL are "
+                         "single-swarm-mode only; unset them or drop "
+                         "BENCH_ENSEMBLE")
     cert_iters = _env_int("BENCH_CERT_ITERS", 0) or None
     cert_cg = _env_int("BENCH_CERT_CG", 0) or None
     if (cert_iters or cert_cg) and not certificate:
