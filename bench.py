@@ -623,26 +623,25 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
         # exact search (certificate_rebuild_skin is scenario-path only).
         raise ValueError("BENCH_CERT_SKIN is single-swarm-mode only; "
                          "unset it or drop BENCH_ENSEMBLE")
-    if (os.environ.get("BENCH_CERT_WARM", "0") == "1"
-            or _env_float("BENCH_CERT_TOL", 0.0)):
-        # Same contract: the ensemble step threads no solver carry and
-        # the adaptive while_loop is rejected on the sharded path —
-        # silently benching a cold fixed-budget solve under a
-        # warm/adaptive env label would mislabel the transcription.
-        raise ValueError("BENCH_CERT_WARM/BENCH_CERT_TOL are "
-                         "single-swarm-mode only; unset them or drop "
-                         "BENCH_ENSEMBLE")
+    # Warm/tol are honored here: the ensemble mesh is always dp-only
+    # (n_sp=1, whole swarm per device), where the rollout threads the
+    # solver carry per member and the adaptive while_loop is legal.
+    cert_warm = os.environ.get("BENCH_CERT_WARM", "0") == "1"
+    cert_tol = _env_float("BENCH_CERT_TOL", 0.0) or None
     cert_iters = _env_int("BENCH_CERT_ITERS", 0) or None
     cert_cg = _env_int("BENCH_CERT_CG", 0) or None
-    if (cert_iters or cert_cg) and not certificate:
-        raise ValueError("BENCH_CERT_ITERS/CG need BENCH_CERTIFICATE=1")
+    if (cert_iters or cert_cg or cert_warm or cert_tol) and not certificate:
+        raise ValueError("BENCH_CERT_ITERS/CG/WARM/TOL need "
+                         "BENCH_CERTIFICATE=1")
     k_neighbors = _env_int("BENCH_K_NEIGHBORS", swarm.Config().k_neighbors)
     cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
                        n_obstacles=n_obstacles, dynamics=dynamics,
                        k_neighbors=k_neighbors, certificate=certificate,
                        gating_rebuild_skin=gating_skin,
                        certificate_iters=cert_iters,
-                       certificate_cg_iters=cert_cg)
+                       certificate_cg_iters=cert_cg,
+                       certificate_warm_start=cert_warm,
+                       certificate_tol=cert_tol)
     seeds = list(range(E))
 
     print(f"bench: ensemble E={E} x swarm N={n}, steps={steps}, "
@@ -748,6 +747,14 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
                                                       cert_cg or "d")
         result["cert_iters"] = cert_iters
         result["cert_cg_iters"] = cert_cg
+    if cert_warm:
+        # Same labeling contract as _child_single: warm/adaptive runs are
+        # a different measurement axis than the cold fixed-budget one.
+        result["metric"] += " [cert_warm]"
+        result["cert_warm_start"] = True
+    if cert_tol:
+        result["metric"] += " [cert_tol=%g]" % cert_tol
+        result["cert_tol"] = cert_tol
     if certificate:
         _label_certificate(result, cert_res, cert_dropped)
     return result

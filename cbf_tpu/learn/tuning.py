@@ -137,7 +137,7 @@ def make_loss_fn(cfg: swarm_scenario.Config, mesh, tc: TrainConfig = TrainConfig
             def body(carry, t):
                 x, v = carry[0], carry[1]
                 th = carry[2] if unicycle else None
-                x2, v2, th2, _, nearest, _cache = _local_swarm_step(
+                x2, v2, th2, _, nearest, _cache, _cstate = _local_swarm_step(
                     x, v, cfg, cbf, "sp", unroll_relax=tc.unroll_relax,
                     compute_metrics=False, t=t, theta=th)
                 # Hinge on separation: per-agent nearest-neighbor distance

@@ -87,7 +87,7 @@ def ensemble_initial_states(cfg: swarm_scenario.Config, seeds):
 def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
                       axis_name: str, unroll_relax: int = 0,
                       compute_metrics: bool = True, t=0, theta=None,
-                      gating_cache=None):
+                      gating_cache=None, cert_solver_state=None):
     """One agent-sharded swarm step. x, v: (n_local, 2). Differentiable when
     ``unroll_relax > 0`` (see solvers.exact2d) and ``compute_metrics=False``
     (the metric reductions use pmin, which has no differentiation rule).
@@ -105,9 +105,19 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
     through its scan carry. The nearest-distance metric then reports the
     truncation-SOUND floor scalar instead of the per-agent seen minimum.
 
+    ``cert_solver_state``: opt-in sparse-ADMM warm-start carry
+    (Config.certificate_warm_start — same contract as the scenario
+    step). Whole-swarm-per-device only: at sp == 1 the joint solve runs
+    per member exactly as in the scenario, so the carry is sound and
+    (with Config.certificate_tol) the adaptive while_loop contains no
+    collectives; at sp > 1 the caller must reject (the row-partitioned
+    solve's carries are vma-promoted by sharded row data, unproven with
+    a threaded cross-step state). Non-differentiable (the carry is
+    data); the caller threads the returned state through its scan carry.
+
     Returns (x_new, v_new, theta_new_or_None, metrics_or_None,
-    nearest_d_local, new_cache_or_None) — v_new is the applied (si)
-    velocity.
+    nearest_d_local, new_cache_or_None, new_cert_state_or_None) — v_new
+    is the applied (si) velocity.
     """
     dt_ = x.dtype
     f, g, discrete = swarm_scenario.barrier_dynamics(cfg, dt_)
@@ -211,6 +221,7 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
 
     cert_res = jnp.zeros((), x.dtype)
     cert_dropped = jnp.zeros((), jnp.int32)
+    new_cert_state = None
     if cfg.certificate:
         # The joint second layer couples ALL of a swarm's agents, so it can
         # never run on a local sub-swarm (that would certify fragments and
@@ -227,8 +238,17 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
         # redundant compute, zero in-loop communication).
         diff = unroll_relax > 0
         if lax.axis_size(axis_name) == 1:
-            u, cert_res, cert_dropped = \
-                swarm_scenario.apply_certificate(cfg, u, x)
+            if cert_solver_state is not None:
+                u, cert_res, cert_dropped, new_cert_state = \
+                    swarm_scenario.apply_certificate(
+                        cfg, u, x, solver_state=cert_solver_state)
+            else:
+                u, cert_res, cert_dropped = \
+                    swarm_scenario.apply_certificate(cfg, u, x)
+        elif cert_solver_state is not None:
+            raise ValueError(
+                "cert_solver_state (certificate warm start) requires the "
+                "whole swarm on one device (sp size 1)")
         else:
             xg = lax.all_gather(x, axis_name, axis=0, tiled=True)
             ug = lax.all_gather(u, axis_name, axis=0, tiled=True)
@@ -282,7 +302,8 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
             lax.pmax(match_vma(cert_dropped, x), axis_name),
             lax.pmax(match_vma(deficit, x), axis_name),
         )
-    return x_new, v_new, theta_new, metrics, nearest1, new_cache
+    return (x_new, v_new, theta_new, metrics, nearest1, new_cache,
+            new_cert_state)
 
 
 def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
@@ -326,15 +347,19 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
             "certificate_rebuild_skin is scenario/bench-path only (the "
             "ensemble certificate keeps the exact search); set it to 0 "
             "for sharded rollouts")
-    if cfg.certificate_warm_start or cfg.certificate_tol is not None:
-        # Same contract: the ensemble step does not thread the solver
-        # carry (warm start), and the adaptive while_loop's residual cond
-        # contains collectives on the row-partitioned path — unproven
-        # under shard_map. Rejecting beats silently benching a cold-start
-        # fixed-budget solve under a warm/adaptive label.
+    if ((cfg.certificate_warm_start or cfg.certificate_tol is not None)
+            and n_sp != 1):
+        # dp-only ensembles (whole swarm per device) run the joint solve
+        # per member exactly as the scenario does, so the warm-start
+        # carry threads through the rollout scan and the adaptive
+        # while_loop contains no collectives. sp > 1 stays rejected: the
+        # row-partitioned solve's cond would run collectives (the solver
+        # itself also raises) and its cross-step carry is unproven under
+        # shard_map vma promotion. Rejecting beats silently benching a
+        # cold-start fixed-budget solve under a warm/adaptive label.
         raise ValueError(
-            "certificate_warm_start/certificate_tol are scenario/bench-"
-            "path only; unset them for sharded rollouts")
+            "certificate_warm_start/certificate_tol require whole-swarm-"
+            f"per-device ensembles (sp == 1; got sp={n_sp})")
 
     if initial_state is not None:
         if len(initial_state) != parts:
@@ -382,21 +407,31 @@ def _rollout_executable(cfg: swarm_scenario.Config, mesh, E: int, steps: int):
     # shape where it pays — whole swarm per device, no vmap.
     use_cache = (cfg.gating_rebuild_skin > 0 and E_local == 1
                  and mesh.shape["sp"] == 1)
+    # Certificate warm-start carry: sp == 1 only (validated upstream);
+    # E_local > 1 is fine — under vmap the carry just gains a member axis
+    # (and a tol while_loop runs until every member converges).
+    use_warm = cfg.certificate_warm_start and mesh.shape["sp"] == 1
 
     def local_rollout(t0, cbf, *state0l):
         def one(*state0i):
             def body(carry, t):
+                st = carry
+                cstate = st[-1] if use_warm else None
+                if use_warm:
+                    st = st[:-1]
                 if use_cache:
-                    st, cache = carry[:-1], carry[-1]
+                    st, cache = st[:-1], st[-1]
                 else:
-                    st, cache = carry, None
+                    cache = None
                 th = st[2] if unicycle else None
-                x2, v2, th2, met, _, cache2 = _local_swarm_step(
+                x2, v2, th2, met, _, cache2, cstate2 = _local_swarm_step(
                     st[0], st[1], cfg, cbf, "sp", t=t, theta=th,
-                    gating_cache=cache)
+                    gating_cache=cache, cert_solver_state=cstate)
                 new = (x2, v2, th2) if unicycle else (x2, v2)
                 if use_cache:
                     new = new + (cache2,)
+                if use_warm:
+                    new = new + (cstate2,)
                 return new, met
 
             init = tuple(state0i)
@@ -407,8 +442,15 @@ def _rollout_executable(cfg: swarm_scenario.Config, mesh, E: int, steps: int):
                 init = init + (tuple(
                     match_vma(a, state0i[0])
                     for a in swarm_scenario.verlet_cache_seed(cfg)),)
+            if use_warm:
+                from cbf_tpu.sim.certificates import certificate_solver_seed
+                init = init + (tuple(
+                    match_vma(a, state0i[0])
+                    for a in certificate_solver_seed(cfg.n,
+                                                     cfg.certificate_k,
+                                                     cfg.dtype)),)
             final, mets = lax.scan(body, init, t0 + jnp.arange(steps))
-            return final[:parts] + (mets,)   # cache is internal state
+            return final[:parts] + (mets,)   # caches are internal state
 
         if E_local == 1:
             # One member per device: skip the vmap wrapper — identical math,

@@ -200,8 +200,9 @@ class Config:
     # carry is SOUND (ADMM converges from every start and the per-step
     # residual gate still asserts the result) — staleness only costs
     # iterations. Pays off combined with certificate_tol (below), which
-    # actually skips the saved iterations. Sparse backend, scenario/bench
-    # path only (ensembles and the trainer reject it).
+    # actually skips the saved iterations. Sparse backend; scenario/bench
+    # path and dp-only (sp == 1) ensembles — sp > 1 sharding and the
+    # trainer reject it.
     certificate_warm_start: bool = False
     # Adaptive ADMM budget: > 0 runs check_every-iteration blocks until
     # max(primal, dual) residual <= tol, capped at certificate_iters
@@ -212,6 +213,8 @@ class Config:
     # chain LENGTH, so adaptive trip count converts directly into both
     # wall time and convergence). Set it <= the 1e-4 residual gate.
     # None = fixed iterations (the differentiable-path requirement).
+    # Scenario/bench path and dp-only ensembles, like warm_start (the
+    # row-partitioned solve's cond would run collectives in a while_loop).
     certificate_tol: float | None = None
     # sp > 1 ensembles only: "auto" row-partitions the sparse backend's
     # joint solve over the sp axis (each shard owns its local agents' pair

@@ -689,7 +689,43 @@ def test_certificate_warm_tol_guards():
     cfg = swarm.Config(n=256, steps=5, certificate=True,
                        certificate_backend="sparse",
                        certificate_warm_start=True)
-    with pytest.raises(ValueError, match="scenario/bench"):
-        sharded_swarm_rollout(cfg, make_mesh(2, 1), seeds=[0, 1])
+    # sp > 1 rejected (row-partitioned solve: collectives in the adaptive
+    # cond, unproven cross-step carry); dp-only is ALLOWED — see
+    # test_certificate_warm_tol_ensemble_dp_only below.
+    with pytest.raises(ValueError, match="sp == 1"):
+        sharded_swarm_rollout(cfg, make_mesh(1, 2), seeds=[0])
     with pytest.raises(ValueError, match="trainer"):
         tuning.make_loss_fn(cfg, make_mesh(2, 1))
+    # The solver itself rejects tol in row-partitioned mode (the guard
+    # the ensemble check is a friendlier copy of).
+    from cbf_tpu.solvers.sparse_admm import (SparseADMMSettings,
+                                             solve_pair_box_qp_admm)
+    import jax.numpy as jnp
+    with pytest.raises(ValueError, match="row-partitioned"):
+        solve_pair_box_qp_admm(
+            jnp.zeros((4, 2)), jnp.zeros((4,), jnp.int32),
+            jnp.ones((4,), jnp.int32), jnp.ones((4, 2)), jnp.ones((4,)),
+            jnp.full((4, 2), -jnp.inf), jnp.full((4, 2), jnp.inf),
+            SparseADMMSettings(tol=1e-5), axis_name="sp")
+
+
+def test_certificate_warm_tol_ensemble_dp_only():
+    """dp-only ensembles (whole swarm per device) honor warm+tol: same
+    trajectories as the cold fixed-budget ensemble, residual gate held,
+    across both the E_local == 1 fast path and the vmapped E_local > 1
+    path (a batched while_loop runs until every member converges)."""
+    from cbf_tpu.parallel import make_mesh
+    from cbf_tpu.parallel.ensemble import sharded_swarm_rollout
+
+    base = dict(n=256, steps=20, certificate=True,
+                certificate_backend="sparse")
+    warm = dict(certificate_warm_start=True, certificate_tol=1e-5)
+    for n_dp, seeds in ((2, [0, 1]), (2, [0, 1, 2, 3])):   # E_local 1, 2
+        mesh = make_mesh(n_dp, 1)
+        (x_c, _), mets_c = sharded_swarm_rollout(
+            swarm.Config(**base), mesh, seeds=seeds)
+        (x_w, _), mets_w = sharded_swarm_rollout(
+            swarm.Config(**base, **warm), mesh, seeds=seeds)
+        np.testing.assert_allclose(np.asarray(x_w), np.asarray(x_c),
+                                   atol=2e-4)
+        assert float(np.asarray(mets_w.certificate_residual).max()) < 1e-4
