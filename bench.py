@@ -386,7 +386,7 @@ def _gate_certificate(residual, dropped) -> tuple[str | None, float, int]:
 
 
 def _label_certificate(result: dict, cert_res: float,
-                       cert_dropped: int) -> None:
+                       cert_dropped: int, cert_iters=None) -> None:
     """Append the certificate labels. Must run AFTER every other label —
     in particular after the obstacle block, which REPLACES the metric
     string and would wipe an earlier-appended tag."""
@@ -394,6 +394,15 @@ def _label_certificate(result: dict, cert_res: float,
     result["certificate"] = True
     result["certificate_max_residual"] = cert_res
     result["certificate_pairs_dropped"] = cert_dropped
+    if cert_iters is not None:
+        # Per-step ADMM iteration series: mean+max tell the adaptive-tol
+        # story (mean << cap on a warm quasi-static run; max = the
+        # escalation the hardest step needed).
+        import numpy as np
+        it = np.asarray(cert_iters)
+        if it.size:
+            result["certificate_iters_mean"] = round(float(it.mean()), 1)
+            result["certificate_iters_max"] = int(it.max())
 
 
 def _profile_ctx():
@@ -462,8 +471,11 @@ def _child_single(n: int, steps: int) -> dict:
     # chunk at N=1024, ~190 s of device time, crashed the worker with
     # "kernel fault" on every attempt; a 200-step ~38 s chunk ran clean).
     # Size the default certificate chunk so one execution stays ~10 s at
-    # the measured per-step cost; BENCH_CHUNK still overrides explicitly.
-    default_chunk = max(10, 51200 // n) if certificate else 1000
+    # the measured per-step cost (~0.19 s x N/1024, linear in N — so the
+    # floor is 1, not 10: at N=32768 a 10-step execution would already be
+    # ~60 s, back inside the kill window); BENCH_CHUNK still overrides
+    # explicitly.
+    default_chunk = max(1, 51200 // n) if certificate else 1000
     chunk = min(_env_int("BENCH_CHUNK", default_chunk), steps)
     unroll = _env_int("BENCH_UNROLL", 1)
     checkpointing = os.environ.get("BENCH_CHECKPOINT", "1") != "0"
@@ -561,6 +573,11 @@ def _child_single(n: int, steps: int) -> dict:
     if k_neighbors != base_cfg.k_neighbors:
         result["metric"] += " [k=%d]" % k_neighbors
         result["k_neighbors"] = k_neighbors
+    if gating != "auto":
+        # A forced neighbor-search backend (streaming/pallas/jnp/banded)
+        # is a different measurement axis than the auto headline.
+        result["metric"] += " [gating=%s]" % gating
+        result["gating"] = gating
     if gating_skin:
         # A cached-selection rate is a different workload axis than the
         # exact-search headline — label it like the k-sweep.
@@ -583,7 +600,8 @@ def _child_single(n: int, steps: int) -> dict:
         result["metric"] += " [cert_tol=%g]" % cert_tol
         result["cert_tol"] = cert_tol
     if certificate:
-        _label_certificate(result, cert_res, cert_dropped)
+        _label_certificate(result, cert_res, cert_dropped,
+                           outs.certificate_iterations)
     return result
 
 
@@ -756,7 +774,8 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
         result["metric"] += " [cert_tol=%g]" % cert_tol
         result["cert_tol"] = cert_tol
     if certificate:
-        _label_certificate(result, cert_res, cert_dropped)
+        _label_certificate(result, cert_res, cert_dropped,
+                           mets.certificate_iterations)
     return result
 
 

@@ -414,10 +414,22 @@ def supported(n: int) -> bool:
     return jax.default_backend() == "tpu"
 
 
-def _kernel_dispatch(x, radius, k: int, interpret: bool):
+def _kernel_dispatch(x, radius, k: int, interpret: bool,
+                     kernel: str = "auto"):
     """Fused-vs-streaming kernel dispatch — the ONE routing decision,
-    shared by the oracle (knn_select) and the raw non-diff gating path."""
-    fn = knn_neighbors if x.shape[0] <= MAX_N_FUSED else knn_neighbors_blocked
+    shared by the oracle (knn_select) and the raw non-diff gating path.
+
+    ``kernel="streaming"`` forces the streaming kernel below the fused
+    bound: the roofline names the fused kernel's k min-reduction passes
+    over the full slab as its dominant cost, while the streaming kernel
+    pays selection only for blocks holding an in-radius candidate (~1% at
+    swarm densities) — which of the two wins at a given N is a
+    measurement, not a constant (the bench's BENCH_GATING=streaming axis).
+    """
+    if kernel not in ("auto", "streaming"):
+        raise ValueError(f"kernel must be auto|streaming, got {kernel!r}")
+    use_fused = x.shape[0] <= MAX_N_FUSED and kernel != "streaming"
+    fn = knn_neighbors if use_fused else knn_neighbors_blocked
     return fn(x, radius, k, interpret=interpret)
 
 
@@ -496,7 +508,8 @@ def knn_gating_pallas_diff(states4, radius, k: int, *,
     return obs, mask, nearest1, dropped
 
 
-def knn_gating_pallas(states4, radius, k: int, *, interpret: bool = False):
+def knn_gating_pallas(states4, radius, k: int, *, interpret: bool = False,
+                      kernel: str = "auto"):
     """Drop-in for :func:`cbf_tpu.rollout.gating.knn_gating` (all-row
     self-exclusion form) + the nearest-any metric.
 
@@ -514,7 +527,7 @@ def knn_gating_pallas(states4, radius, k: int, *, interpret: bool = False):
     :func:`knn_gating_pallas_diff`.
     """
     idx, dist, nearest, count = _kernel_dispatch(states4[:, :2], radius, k,
-                                                 interpret)
+                                                 interpret, kernel)
     obs, mask, dropped = _gating_epilogue(states4, idx, dist, count, k)
     return obs, mask, nearest, dropped
 

@@ -65,6 +65,11 @@ class EnsembleMetrics(NamedTuple):
     # command, and the erosion must be as observable sharded as it is in
     # the scenario step).
     saturation_deficit: jax.Array
+    # (E, steps) sparse-certificate ADMM iterations run (the sharded twin
+    # of StepOutputs.certificate_iterations — fixed budget normally, the
+    # adaptive trip count under certificate_tol; 0 when the second layer
+    # is off or dense).
+    certificate_iterations: jax.Array = ()
 
 
 def ensemble_initial_states(cfg: swarm_scenario.Config, seeds):
@@ -180,8 +185,14 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
                 pallas_knn.knn_gating_pallas_diff(
                     states4, cfg.safety_distance, K)
         else:
+            # Honor gating="streaming" exactly as the scenario step does
+            # (forced streaming kernel; "auto"/"pallas" keep the N-based
+            # dispatch).
             obs_slab, mask, nearest_all, dropped = \
-                pallas_knn.knn_gating_pallas(states4, cfg.safety_distance, K)
+                pallas_knn.knn_gating_pallas(
+                    states4, cfg.safety_distance, K,
+                    kernel=("streaming" if cfg.gating == "streaming"
+                            else "auto"))
             # The exchange contract's "nearest" is the top-1 gated distance
             # (inf when nothing is in radius); the kernel's nearest-any
             # equals it within the radius, and every consumer clips at the
@@ -221,6 +232,7 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
 
     cert_res = jnp.zeros((), x.dtype)
     cert_dropped = jnp.zeros((), jnp.int32)
+    cert_iters = jnp.zeros((), jnp.int32)
     new_cert_state = None
     if cfg.certificate:
         # The joint second layer couples ALL of a swarm's agents, so it can
@@ -239,11 +251,11 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
         diff = unroll_relax > 0
         if lax.axis_size(axis_name) == 1:
             if cert_solver_state is not None:
-                u, cert_res, cert_dropped, new_cert_state = \
-                    swarm_scenario.apply_certificate(
-                        cfg, u, x, solver_state=cert_solver_state)
+                (u, cert_res, cert_dropped, cert_iters,
+                 new_cert_state) = swarm_scenario.apply_certificate(
+                    cfg, u, x, solver_state=cert_solver_state)
             else:
-                u, cert_res, cert_dropped = \
+                u, cert_res, cert_dropped, cert_iters = \
                     swarm_scenario.apply_certificate(cfg, u, x)
         elif cert_solver_state is not None:
             raise ValueError(
@@ -259,11 +271,11 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
                 cfg.certificate_partition == "auto" and not diff
                 and swarm_scenario.certificate_backend(cfg) == "sparse")
             if partitioned:
-                ug, cert_res, cert_dropped = \
+                ug, cert_res, cert_dropped, cert_iters = \
                     swarm_scenario.apply_certificate_sharded(
                         cfg, ug, xg, axis_name)
             else:
-                ug, cert_res, cert_dropped = \
+                ug, cert_res, cert_dropped, cert_iters = \
                     swarm_scenario.apply_certificate(cfg, ug, xg)
             i0 = lax.axis_index(axis_name) * x.shape[0]
             u = lax.dynamic_slice_in_dim(ug, i0, x.shape[0], axis=0)
@@ -301,6 +313,7 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
             # already psummed inside — so summing would sp-fold-count it.
             lax.pmax(match_vma(cert_dropped, x), axis_name),
             lax.pmax(match_vma(deficit, x), axis_name),
+            lax.pmax(match_vma(cert_iters, x), axis_name),
         )
     return (x_new, v_new, theta_new, metrics, nearest1, new_cache,
             new_cert_state)
@@ -332,6 +345,21 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
     if E % n_dp or cfg.n % n_sp:
         raise ValueError(
             f"E={E} must divide by dp={n_dp} and N={cfg.n} by sp={n_sp}")
+    if cfg.gating == "streaming" and not (
+            n_sp == 1 and pallas_knn.supported(cfg.n)):
+        # Honored-or-rejected: the forced streaming kernel only exists on
+        # the whole-swarm-per-device Pallas branch — the sp > 1 exchange
+        # path and non-TPU backends would silently run a different search
+        # under a streaming label.
+        raise ValueError(
+            "gating='streaming' in ensembles requires sp == 1 and a "
+            "TPU backend (the forced kernel lives on the per-device "
+            "Pallas branch)")
+    if cfg.gating == "streaming" and cfg.gating_rebuild_skin:
+        # Same incompatibility the scenario's make() rejects.
+        raise ValueError(
+            "gating_rebuild_skin keeps the auto kernel choice — unset it "
+            "or use gating='auto'")
     if cfg.gating_rebuild_skin and (n_sp != 1 or E != n_dp):
         raise ValueError(
             "gating_rebuild_skin in ensembles requires one whole swarm "

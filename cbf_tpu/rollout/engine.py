@@ -57,6 +57,12 @@ class StepOutputs(NamedTuple):
     # (the silent-erosion failure mode is a saturated robot vs a fast
     # obstacle); () elsewhere.
     saturation_deficit: Any = ()
+    # Sparse-certificate ADMM iterations actually run this step — the
+    # fixed budget normally, the adaptive trip count under
+    # Config.certificate_tol (the observable proving the while_loop trips
+    # early / escalates; bench reports its mean+max); () where no sparse
+    # certificate runs.
+    certificate_iterations: Any = ()
 
 
 @functools.partial(jax.jit, static_argnames=("step_fn", "steps", "unroll"))

@@ -235,7 +235,11 @@ class Config:
     # (fused <= 8192 agents, streaming beyond — ops.pallas_knn), else the
     # jnp path; "pallas"/"jnp" force (pallas runs in interpret mode off-TPU
     # — tests); "banded" opts into the O(N*W) y-sorted window kernel with
-    # overflow surfaced in StepOutputs.gating_overflow_count.
+    # overflow surfaced in StepOutputs.gating_overflow_count; "streaming"
+    # forces the streaming (flash-attention-pattern) kernel below the
+    # fused kernel's VMEM bound — the fused-vs-streaming measurement axis
+    # (the roofline predicts the fused kernel's k min-reduction passes
+    # dominate, which streaming skips for candidate-free blocks).
     gating: str = "auto"
     # Banded window in CTILE-column blocks; None = density heuristic from
     # the packed-state estimate (see make()).
@@ -822,7 +826,11 @@ def apply_certificate(cfg: Config, u, x, neighbor_cache=None,
     the trainer keeps the Pallas search at scale (FD-validated; the
     round-4 jnp pinning made large-N training O(N^2)-bound). The DENSE
     backend and the Verlet path stay non-differentiable — learn.tuning
-    guards both."""
+    guards both.
+
+    Fourth fixed return: ADMM iterations actually run (the adaptive
+    trip count under certificate_tol, the fixed budget otherwise; 0 on
+    the dense backend, whose solver doesn't report one)."""
     from cbf_tpu.sim.certificates import (si_barrier_certificate,
                                           si_barrier_certificate_sparse)
     params, arena = _certificate_problem(cfg)
@@ -835,13 +843,14 @@ def apply_certificate(cfg: Config, u, x, neighbor_cache=None,
                           if neighbor_cache is not None else 0.0),
             neighbor_cache=neighbor_cache, solver_state=solver_state)
         u_cert, cinfo = out[0], out[1]
-        return (u_cert.T, cinfo.primal_residual,
-                cinfo.dropped_count) + tuple(out[2:])
+        return (u_cert.T, cinfo.primal_residual, cinfo.dropped_count,
+                cinfo.iterations) + tuple(out[2:])
     pairs = (cfg.certificate_pairs if cfg.certificate_pairs is not None
              else 8 * cfg.n)
     u_cert, cinfo = si_barrier_certificate(
         u.T, x.T, params, max_pairs=pairs, with_info=True, arena=arena)
-    return u_cert.T, cinfo.primal_residual, jnp.zeros((), jnp.int32)
+    return (u_cert.T, cinfo.primal_residual, jnp.zeros((), jnp.int32),
+            jnp.zeros((), jnp.int32))
 
 
 def apply_certificate_sharded(cfg: Config, u, x, axis_name: str):
@@ -860,7 +869,8 @@ def apply_certificate_sharded(cfg: Config, u, x, axis_name: str):
     u_cert, cinfo = si_barrier_certificate_sparse_sharded(
         u.T, x.T, axis_name, params, settings=_certificate_settings(cfg),
         k=cfg.certificate_k, with_info=True, arena=arena)
-    return u_cert.T, cinfo.primal_residual, cinfo.dropped_count
+    return (u_cert.T, cinfo.primal_residual, cinfo.dropped_count,
+            cinfo.iterations)
 
 
 def integrate(cfg: Config, x, v, u):
@@ -1010,23 +1020,29 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
         cbf = default_cbf(cfg)
     K = cfg.k_neighbors
 
-    if cfg.gating not in ("auto", "pallas", "jnp", "banded"):
+    if cfg.gating not in ("auto", "pallas", "jnp", "banded", "streaming"):
         raise ValueError(
-            f"gating must be auto|pallas|jnp|banded, got {cfg.gating!r}")
+            f"gating must be auto|pallas|jnp|banded|streaming, "
+            f"got {cfg.gating!r}")
     M = cfg.n_obstacles
     use_banded = cfg.gating == "banded"
+    # "streaming" forces the streaming Pallas kernel below the fused
+    # bound (ops.pallas_knn._kernel_dispatch) — the measurement axis for
+    # fused-vs-streaming at mid N (BENCH_GATING=streaming).
+    kernel = "streaming" if cfg.gating == "streaming" else "auto"
     cache_skin = float(cfg.gating_rebuild_skin)
     if cache_skin < 0:
         raise ValueError(
             f"gating_rebuild_skin must be >= 0, got {cache_skin}")
-    if cache_skin and use_banded:
+    if cache_skin and (use_banded or kernel == "streaming"):
         raise ValueError(
             "gating_rebuild_skin requires the pallas/jnp gating backends "
-            "(the banded kernel's window bookkeeping has no cached form)")
+            "(the banded kernel's window bookkeeping has no cached form, "
+            "and the cache's rebuild search keeps the auto kernel choice)")
     if cfg.gating == "auto":
         use_pallas = pallas_knn.supported(cfg.n)
     else:
-        use_pallas = cfg.gating == "pallas"
+        use_pallas = cfg.gating in ("pallas", "streaming")
     pallas_interpret = jax.default_backend() != "tpu"
     if use_banded:
         if cfg.gating_window_blocks is not None:
@@ -1085,9 +1101,11 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
             overflow_count = jnp.sum(overflow)
         elif use_pallas:
             # Fused Pallas kernel: distances + k-NN + nearest-any metric in
-            # one VMEM-resident pass (ops.pallas_knn).
+            # one VMEM-resident pass (ops.pallas_knn) — or the streaming
+            # kernel when forced (gating="streaming").
             obs_slab, mask, nearest, dropped = knn_gating_pallas(
-                states4, cfg.safety_distance, K, interpret=pallas_interpret)
+                states4, cfg.safety_distance, K, interpret=pallas_interpret,
+                kernel=kernel)
             min_dist = jnp.min(nearest)
         else:
             # jnp path: one pairwise-distance computation feeds both the
@@ -1123,6 +1141,7 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
 
         cert_residual = ()
         cert_dropped = ()
+        cert_iters = ()
         new_ccache = ()
         new_sstate = ()
         if cfg.certificate:
@@ -1134,8 +1153,8 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
                                 if cfg.certificate_rebuild_skin else None),
                 solver_state=(state.certificate_solver_state
                               if cfg.certificate_warm_start else None))
-            u, cert_residual, cert_dropped = res[:3]
-            rest = list(res[3:])
+            u, cert_residual, cert_dropped, cert_iters = res[:4]
+            rest = list(res[4:])
             if cfg.certificate_rebuild_skin:
                 new_ccache = rest.pop(0)
             if cfg.certificate_warm_start:
@@ -1170,6 +1189,7 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
             certificate_residual=cert_residual,
             certificate_dropped_count=cert_dropped,
             saturation_deficit=deficit,
+            certificate_iterations=cert_iters,
         )
         return new_state, out
 

@@ -31,21 +31,25 @@ run BENCH_GATING_SKIN=0.1 BENCH_STEPS=2000 BENCH_N=1024
 # 2. k-NN k-sweep rate column.
 run BENCH_K_NEIGHBORS=12 BENCH_STEPS=2000
 run BENCH_K_NEIGHBORS=16 BENCH_STEPS=2000
-# 3. Profile trace for kernel attribution (tuning run, not a record).
+# 3. Streaming-vs-fused kernel at the headline N (the roofline predicts
+# the fused kernel's selection passes dominate; streaming skips them for
+# candidate-free blocks — which wins at N=4096 is this measurement).
+run BENCH_GATING=streaming BENCH_CHECKPOINT=0 BENCH_CHUNK=10000
+# 4. Profile trace for kernel attribution (tuning run, not a record).
 run BENCH_PROFILE=/tmp/tpu_trace_r05
 probe || { echo "DEVICE WEDGED — aborting (see $LOG)"; exit 3; }
-# 4. Certificate warm-start + adaptive tol — the round-5 lever AND the
+# 5. Certificate warm-start + adaptive tol — the round-5 lever AND the
 # long-horizon fix: the same N=1024 x 2000 config that failed the 1e-4
 # gate cold passes on CPU at warm+tol=5e-6 with the escalation cap at
 # 400 (max_res 2.8e-5; cap 100 still spiked to 1.4e-4 in the packing
 # transition), and runs FASTER than the cold fixed budget (95 vs
 # 110 ms/step CPU) because the quasi-static majority exits early.
 run BENCH_CERTIFICATE=1 BENCH_N=1024 BENCH_STEPS=2000 BENCH_CERT_WARM=1 BENCH_CERT_TOL=5e-6 BENCH_CERT_ITERS=400
-# 5. Warm+tol at N=4096 (short horizon), comparable to the measured cold
+# 6. Warm+tol at N=4096 (short horizon), comparable to the measured cold
 # 5.4k rate at the same shape.
 run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=200 BENCH_CERT_WARM=1 BENCH_CERT_TOL=5e-6 BENCH_CERT_ITERS=400
 probe || { echo "DEVICE WEDGED AFTER CERTIFICATE ITEMS — aborting (see $LOG)"; exit 3; }
-# 6. The lean-budget rerun that stalled in r05c (single attempt: a hang
+# 7. The lean-budget rerun that stalled in r05c (single attempt: a hang
 # costs one 900 s kill, not three).
 run BENCH_ATTEMPTS=1 BENCH_ATTEMPT_TIMEOUT=900 BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=200 BENCH_CERT_ITERS=50 BENCH_CERT_CG=6
 probe
