@@ -49,7 +49,14 @@ run BENCH_CERTIFICATE=1 BENCH_N=1024 BENCH_STEPS=2000 BENCH_CERT_WARM=1 BENCH_CE
 # 5.4k rate at the same shape.
 run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=200 BENCH_CERT_WARM=1 BENCH_CERT_TOL=5e-6 BENCH_CERT_ITERS=400
 probe || { echo "DEVICE WEDGED AFTER CERTIFICATE ITEMS — aborting (see $LOG)"; exit 3; }
-# 7. The lean-budget rerun that stalled in r05c (single attempt: a hang
+# 7. Batched certificate chains: the solve is latency-bound on its
+# serial iteration chain (192 ms/step at N=1024 regardless of VPU
+# width), so vmapping E members per device should amortize the chain —
+# E=4 at the same per-member shape prices the lever directly against
+# item 6's E=1-equivalent rate.
+run BENCH_ENSEMBLE=1 BENCH_ENSEMBLE_E=4 BENCH_CERTIFICATE=1 BENCH_N=1024 BENCH_STEPS=100
+run BENCH_ENSEMBLE=1 BENCH_ENSEMBLE_E=1 BENCH_CERTIFICATE=1 BENCH_N=1024 BENCH_STEPS=100
+# 8. The lean-budget rerun that stalled in r05c (single attempt: a hang
 # costs one 900 s kill, not three).
 run BENCH_ATTEMPTS=1 BENCH_ATTEMPT_TIMEOUT=900 BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=200 BENCH_CERT_ITERS=50 BENCH_CERT_CG=6
 probe
