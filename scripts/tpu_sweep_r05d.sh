@@ -52,10 +52,15 @@ probe || { echo "DEVICE WEDGED AFTER CERTIFICATE ITEMS — aborting (see $LOG)";
 # 7. Batched certificate chains: the solve is latency-bound on its
 # serial iteration chain (192 ms/step at N=1024 regardless of VPU
 # width), so vmapping E members per device should amortize the chain —
-# E=4 at the same per-member shape prices the lever directly against
-# item 6's E=1-equivalent rate.
-run BENCH_ENSEMBLE=1 BENCH_ENSEMBLE_E=4 BENCH_CERTIFICATE=1 BENCH_N=1024 BENCH_STEPS=100
-run BENCH_ENSEMBLE=1 BENCH_ENSEMBLE_E=1 BENCH_CERTIFICATE=1 BENCH_N=1024 BENCH_STEPS=100
+# the E=4 run prices the lever directly against its PAIRED E=1 run
+# below (same N/steps/budget). 25 steps: the ensemble path has no
+# chunking, so the whole run is ONE XLA execution — at the
+# unamortized worst case (4 x 25 x 192 ms ~= 19 s) it stays under the
+# tunneled worker's ~60 s execution kill limit even if the batching
+# hypothesis is wrong.
+run BENCH_ENSEMBLE=1 BENCH_ENSEMBLE_E=4 BENCH_CERTIFICATE=1 BENCH_N=1024 BENCH_STEPS=25
+run BENCH_ENSEMBLE=1 BENCH_ENSEMBLE_E=1 BENCH_CERTIFICATE=1 BENCH_N=1024 BENCH_STEPS=25
+probe || { echo "DEVICE WEDGED AFTER ENSEMBLE-CERTIFICATE ITEMS — aborting (see $LOG)"; exit 3; }
 # 8. The lean-budget rerun that stalled in r05c (single attempt: a hang
 # costs one 900 s kill, not three).
 run BENCH_ATTEMPTS=1 BENCH_ATTEMPT_TIMEOUT=900 BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=200 BENCH_CERT_ITERS=50 BENCH_CERT_CG=6
