@@ -185,3 +185,40 @@ def test_restore_real_errors_not_masked_by_compat_retry(tmp_path):
     with pytest.raises(Exception) as ei:
         ckpt.restore(d, bad_like)
     assert "MISSING" not in str(ei.value)
+
+
+def test_resume_preserves_certificate_warm_state(tmp_path):
+    """The warm-start solver carry (State.certificate_solver_state) must
+    survive a checkpoint/resume round trip bit-exactly: a resume that
+    silently reseeded it would cold-start the ADMM mid-run — sound (the
+    residual gate still asserts) but a durability regression the resumed
+    trajectory would reveal only as extra iterations. Equality with an
+    unbroken run is the strongest check."""
+    cfg = swarm.Config(n=256, steps=24, record_trajectory=False,
+                       certificate=True, certificate_backend="sparse",
+                       certificate_warm_start=True, certificate_tol=1e-5)
+    state0, step = swarm.make(cfg)
+    d = str(tmp_path / "ckpt")
+
+    ref_final, ref_outs, _ = rollout_chunked(step, state0, cfg.steps,
+                                             chunk=8)
+
+    mid, _, _ = rollout_chunked(step, state0, 16, chunk=8, checkpoint_dir=d)
+    assert ckpt.latest_step(d) == 16
+    # The carry is live (non-zero) at the interruption point.
+    assert any(float(np.abs(np.asarray(a)).max()) > 0
+               for a in mid.certificate_solver_state)
+
+    final, outs, start = rollout_chunked(step, state0, cfg.steps, chunk=8,
+                                         checkpoint_dir=d)
+    assert start == 16
+    np.testing.assert_array_equal(np.asarray(final.x),
+                                  np.asarray(ref_final.x))
+    for a, b in zip(final.certificate_solver_state,
+                    ref_final.certificate_solver_state):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # The resumed tail's iteration counts match the unbroken run's —
+    # the observable a silent cold-start would shift.
+    np.testing.assert_array_equal(
+        np.asarray(outs.certificate_iterations),
+        np.asarray(ref_outs.certificate_iterations)[16:])
