@@ -449,10 +449,11 @@ def _child_single(n: int, steps: int) -> dict:
     cert_cg = _env_int("BENCH_CERT_CG", 0) or None
     cert_warm = os.environ.get("BENCH_CERT_WARM", "0") == "1"
     cert_tol = _env_float("BENCH_CERT_TOL", 0.0) or None
-    if (cert_skin or cert_iters or cert_cg or cert_warm or cert_tol) \
-            and not certificate:
-        raise ValueError("BENCH_CERT_SKIN/ITERS/CG/WARM/TOL need "
-                         "BENCH_CERTIFICATE=1")
+    cert_check = _env_int("BENCH_CERT_CHECK_EVERY", 0) or None
+    if (cert_skin or cert_iters or cert_cg or cert_warm or cert_tol
+            or cert_check) and not certificate:
+        raise ValueError("BENCH_CERT_SKIN/ITERS/CG/WARM/TOL/CHECK_EVERY "
+                         "need BENCH_CERTIFICATE=1")
     cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
                        gating=gating, n_obstacles=n_obstacles,
                        dynamics=dynamics, certificate=certificate,
@@ -462,7 +463,8 @@ def _child_single(n: int, steps: int) -> dict:
                        certificate_iters=cert_iters,
                        certificate_cg_iters=cert_cg,
                        certificate_warm_start=cert_warm,
-                       certificate_tol=cert_tol)
+                       certificate_tol=cert_tol,
+                       certificate_check_every=cert_check)
     state0, step = swarm.make(cfg)
     # Certificate steps are ~2 orders of magnitude slower than filter-only
     # ones (the ADMM's dependent iteration chain — latency-, not
@@ -599,6 +601,9 @@ def _child_single(n: int, steps: int) -> dict:
     if cert_tol:
         result["metric"] += " [cert_tol=%g]" % cert_tol
         result["cert_tol"] = cert_tol
+    if cert_check:
+        result["metric"] += " [cert_check=%d]" % cert_check
+        result["cert_check_every"] = cert_check
     if certificate:
         _label_certificate(result, cert_res, cert_dropped,
                            outs.certificate_iterations)
@@ -646,10 +651,12 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
     # solver carry per member and the adaptive while_loop is legal.
     cert_warm = os.environ.get("BENCH_CERT_WARM", "0") == "1"
     cert_tol = _env_float("BENCH_CERT_TOL", 0.0) or None
+    cert_check = _env_int("BENCH_CERT_CHECK_EVERY", 0) or None
     cert_iters = _env_int("BENCH_CERT_ITERS", 0) or None
     cert_cg = _env_int("BENCH_CERT_CG", 0) or None
-    if (cert_iters or cert_cg or cert_warm or cert_tol) and not certificate:
-        raise ValueError("BENCH_CERT_ITERS/CG/WARM/TOL need "
+    if (cert_iters or cert_cg or cert_warm or cert_tol or cert_check) \
+            and not certificate:
+        raise ValueError("BENCH_CERT_ITERS/CG/WARM/TOL/CHECK_EVERY need "
                          "BENCH_CERTIFICATE=1")
     k_neighbors = _env_int("BENCH_K_NEIGHBORS", swarm.Config().k_neighbors)
     cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
@@ -659,7 +666,8 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
                        certificate_iters=cert_iters,
                        certificate_cg_iters=cert_cg,
                        certificate_warm_start=cert_warm,
-                       certificate_tol=cert_tol)
+                       certificate_tol=cert_tol,
+                       certificate_check_every=cert_check)
     seeds = list(range(E))
 
     print(f"bench: ensemble E={E} x swarm N={n}, steps={steps}, "
@@ -773,6 +781,9 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
     if cert_tol:
         result["metric"] += " [cert_tol=%g]" % cert_tol
         result["cert_tol"] = cert_tol
+    if cert_check:
+        result["metric"] += " [cert_check=%d]" % cert_check
+        result["cert_check_every"] = cert_check
     if certificate:
         _label_certificate(result, cert_res, cert_dropped,
                            mets.certificate_iterations)

@@ -216,6 +216,12 @@ class Config:
     # Scenario/bench path and dp-only ensembles, like warm_start (the
     # row-partitioned solve's cond would run collectives in a while_loop).
     certificate_tol: float | None = None
+    # Iterations per adaptive block (certificate_tol > 0 only): each
+    # block boundary pays one residual check (~one pair matvec of chain
+    # latency), so on TPU a larger interval trades check overhead for
+    # later exits — the tol mode's tuning partner. None = solver default
+    # (10).
+    certificate_check_every: int | None = None
     # sp > 1 ensembles only: "auto" row-partitions the sparse backend's
     # joint solve over the sp axis (each shard owns its local agents' pair
     # rows — O(N*k/sp) row work per device; parallel.ensemble), falling
@@ -490,6 +496,15 @@ def barrier_dynamics(cfg: Config, dtype):
         if cfg.certificate_tol is not None and cfg.certificate_tol <= 0:
             raise ValueError(
                 f"certificate_tol must be > 0, got {cfg.certificate_tol}")
+    if cfg.certificate_check_every is not None:
+        if cfg.certificate_tol is None:
+            raise ValueError(
+                "certificate_check_every tunes the ADAPTIVE budget — set "
+                "certificate_tol too (fixed-iteration mode never checks)")
+        if cfg.certificate_check_every < 1:
+            raise ValueError(
+                f"certificate_check_every must be >= 1, got "
+                f"{cfg.certificate_check_every}")
     if (cfg.certificate and cfg.certificate_pairs is not None
             and certificate_backend(cfg) == "sparse"):
         raise ValueError(
@@ -801,7 +816,9 @@ def _certificate_settings(cfg: Config):
         cg_iters=cfg.certificate_cg_iters
         if cfg.certificate_cg_iters is not None else d.cg_iters,
         tol=cfg.certificate_tol if cfg.certificate_tol is not None
-        else d.tol)
+        else d.tol,
+        check_every=cfg.certificate_check_every
+        if cfg.certificate_check_every is not None else d.check_every)
 
 
 def apply_certificate(cfg: Config, u, x, neighbor_cache=None,

@@ -686,6 +686,15 @@ def test_certificate_warm_tol_guards():
         swarm.make(swarm.Config(n=256, certificate=True,
                                 certificate_backend="sparse",
                                 certificate_tol=-1.0))
+    with pytest.raises(ValueError, match="ADAPTIVE"):
+        swarm.make(swarm.Config(n=256, certificate=True,
+                                certificate_backend="sparse",
+                                certificate_check_every=20))
+    with pytest.raises(ValueError, match=">= 1"):
+        swarm.make(swarm.Config(n=256, certificate=True,
+                                certificate_backend="sparse",
+                                certificate_tol=1e-5,
+                                certificate_check_every=0))
     cfg = swarm.Config(n=256, steps=5, certificate=True,
                        certificate_backend="sparse",
                        certificate_warm_start=True)
