@@ -68,6 +68,14 @@ Modes / env knobs:
     converges ~200x under the gate on contract states (measured 1.55x
     with the cache at N=4096 CPU, docs/BENCH_LOG.md). Labeled in
     metric + record; the 1e-4 residual gate still asserts convergence.
+  BENCH_CERT_FUSED=1 — fused sparse-ADMM iterations + Chebyshev K-solve
+    (Config.certificate_fused): the round-6 chain-depth attack on the
+    certificate's latency wall (serialized pair-op chain 7 -> 4 per
+    iteration, scripts/chain_depth.py; measured CPU speedups in
+    docs/BENCH_LOG.md "Fused iterations"). Labeled in metric + record;
+    both modes (the ensemble mesh is dp-only, where fused is legal —
+    sp-sharded solves keep the CG path and the solver rejects the
+    combination). The 1e-4 residual gate still asserts convergence.
   BENCH_PROFILE=<dir> — capture a jax.profiler device trace of the
     measured window (TensorBoard trace-viewer format) into <dir>; the
     wall number still excludes warmup but includes tracing overhead, so
@@ -204,8 +212,16 @@ def _maybe_update_last_verified(result: dict) -> None:
                               "steps", "chunk", "checkpointed")
                     if k in result})
         rec["round"] = "r05+"
-        rec["provenance"] = ("bench.py self-recorded verified TPU run "
-                             f"(wall {result.get('wall_s')}s)")
+        # Full provenance, not just the wall: date, device platform, and
+        # the workload facts — the record must stay auditable standalone
+        # (the r05 headline lost its context once; ADVICE r5 #4).
+        rec["provenance"] = (
+            time.strftime("%Y-%m-%d") + " bench.py self-recorded verified "
+            f"run on platform={result.get('platform')}: "
+            f"{result.get('metric')}, steps={result.get('steps')}, "
+            f"chunk={result.get('chunk')}, "
+            f"checkpointed={result.get('checkpointed')}, "
+            f"wall {result.get('wall_s')} s (after the safety gates)")
         # Atomic write: a mid-write death must not leave truncated JSON
         # where the verified-state fallback used to be.
         tmp = LAST_VERIFIED_PATH + ".tmp"
@@ -450,10 +466,11 @@ def _child_single(n: int, steps: int) -> dict:
     cert_warm = os.environ.get("BENCH_CERT_WARM", "0") == "1"
     cert_tol = _env_float("BENCH_CERT_TOL", 0.0) or None
     cert_check = _env_int("BENCH_CERT_CHECK_EVERY", 0) or None
+    cert_fused = os.environ.get("BENCH_CERT_FUSED", "0") == "1"
     if (cert_skin or cert_iters or cert_cg or cert_warm or cert_tol
-            or cert_check) and not certificate:
-        raise ValueError("BENCH_CERT_SKIN/ITERS/CG/WARM/TOL/CHECK_EVERY "
-                         "need BENCH_CERTIFICATE=1")
+            or cert_check or cert_fused) and not certificate:
+        raise ValueError("BENCH_CERT_SKIN/ITERS/CG/WARM/TOL/CHECK_EVERY/"
+                         "FUSED need BENCH_CERTIFICATE=1")
     cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
                        gating=gating, n_obstacles=n_obstacles,
                        dynamics=dynamics, certificate=certificate,
@@ -464,7 +481,8 @@ def _child_single(n: int, steps: int) -> dict:
                        certificate_cg_iters=cert_cg,
                        certificate_warm_start=cert_warm,
                        certificate_tol=cert_tol,
-                       certificate_check_every=cert_check)
+                       certificate_check_every=cert_check,
+                       certificate_fused=cert_fused)
     state0, step = swarm.make(cfg)
     # Certificate steps are ~2 orders of magnitude slower than filter-only
     # ones (the ADMM's dependent iteration chain — latency-, not
@@ -604,6 +622,11 @@ def _child_single(n: int, steps: int) -> dict:
     if cert_check:
         result["metric"] += " [cert_check=%d]" % cert_check
         result["cert_check_every"] = cert_check
+    if cert_fused:
+        # Same labeling contract as the sibling solver knobs: the fused
+        # iteration is a different measurement axis than the CG headline.
+        result["metric"] += " [cert_fused]"
+        result["cert_fused"] = True
     if certificate:
         _label_certificate(result, cert_res, cert_dropped,
                            outs.certificate_iterations)
@@ -654,10 +677,15 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
     cert_check = _env_int("BENCH_CERT_CHECK_EVERY", 0) or None
     cert_iters = _env_int("BENCH_CERT_ITERS", 0) or None
     cert_cg = _env_int("BENCH_CERT_CG", 0) or None
-    if (cert_iters or cert_cg or cert_warm or cert_tol or cert_check) \
-            and not certificate:
-        raise ValueError("BENCH_CERT_ITERS/CG/WARM/TOL/CHECK_EVERY need "
-                         "BENCH_CERTIFICATE=1")
+    # Fused is honored here too: the ensemble mesh is dp-only (sp == 1),
+    # the one ensemble shape the fused iteration supports — and with
+    # BENCH_ENSEMBLE_E > 1 the members' solves additionally run through
+    # the lockstep batched driver (parallel.ensemble).
+    cert_fused = os.environ.get("BENCH_CERT_FUSED", "0") == "1"
+    if (cert_iters or cert_cg or cert_warm or cert_tol or cert_check
+            or cert_fused) and not certificate:
+        raise ValueError("BENCH_CERT_ITERS/CG/WARM/TOL/CHECK_EVERY/FUSED "
+                         "need BENCH_CERTIFICATE=1")
     k_neighbors = _env_int("BENCH_K_NEIGHBORS", swarm.Config().k_neighbors)
     cfg = swarm.Config(n=n, steps=steps, record_trajectory=False,
                        n_obstacles=n_obstacles, dynamics=dynamics,
@@ -667,7 +695,8 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
                        certificate_cg_iters=cert_cg,
                        certificate_warm_start=cert_warm,
                        certificate_tol=cert_tol,
-                       certificate_check_every=cert_check)
+                       certificate_check_every=cert_check,
+                       certificate_fused=cert_fused)
     seeds = list(range(E))
 
     print(f"bench: ensemble E={E} x swarm N={n}, steps={steps}, "
@@ -784,6 +813,10 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
     if cert_check:
         result["metric"] += " [cert_check=%d]" % cert_check
         result["cert_check_every"] = cert_check
+    if cert_fused:
+        # Same labeling contract as _child_single.
+        result["metric"] += " [cert_fused]"
+        result["cert_fused"] = True
     if certificate:
         _label_certificate(result, cert_res, cert_dropped,
                            mets.certificate_iterations)

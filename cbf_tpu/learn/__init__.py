@@ -2,6 +2,7 @@ from cbf_tpu.learn.tuning import (  # noqa: F401
     TrainConfig,
     TunableParams,
     init_params,
+    make_loss_and_grad_fn,
     make_loss_fn,
     make_train_step,
 )

@@ -29,6 +29,7 @@ from jax.sharding import PartitionSpec as P
 import optax
 
 from cbf_tpu.core.filter import CBFParams
+from cbf_tpu.ops import pallas_knn
 from cbf_tpu.parallel.ensemble import _local_swarm_step, shard_map
 from cbf_tpu.scenarios import swarm as swarm_scenario
 from cbf_tpu.utils.math import safe_norm
@@ -82,16 +83,12 @@ def params_to_cbf(p: TunableParams, max_speed: float) -> CBFParams:
     )
 
 
-def make_loss_fn(cfg: swarm_scenario.Config, mesh, tc: TrainConfig = TrainConfig()):
-    """Build loss(params, *state0) -> scalar over the (dp, sp) mesh.
-
-    ``state0`` is (x0, v0) of (E, N, 2) arrays — plus an (E, N) theta0 in
-    unicycle mode (shard: dp x sp; matches
-    :func:`cbf_tpu.parallel.ensemble.ensemble_initial_states`). The
-    rollout differentiates through every family's physics — for unicycle
-    that includes the si<->uni trig maps and the wheel-saturation scaling
-    (piecewise-smooth; subgradients at the saturation knee).
-    """
+def _validated_loss_parts(cfg: swarm_scenario.Config, mesh,
+                          tc: TrainConfig = TrainConfig()):
+    """Validate the (cfg, mesh, tc) combination for the differentiable
+    path and return (local_loss, state_specs) — the shared front half of
+    :func:`make_loss_fn` and :func:`make_loss_and_grad_fn` (validation
+    must not drift between the value and gradient entries)."""
     if cfg.certificate and \
             swarm_scenario.certificate_backend(cfg) != "sparse":
         raise NotImplementedError(
@@ -123,7 +120,36 @@ def make_loss_fn(cfg: swarm_scenario.Config, mesh, tc: TrainConfig = TrainConfig
             "ITERATION SCHEDULING only, never the certified solution the "
             "residual gate asserts)")
 
+    if cfg.certificate_fused:
+        raise ValueError(
+            "certificate_fused is not supported on the differentiable "
+            "trainer path: the fused x-update differentiates through the "
+            "unrolled Chebyshev scan instead of the CG path's validated "
+            "implicit gradient — train with it off; the tuned parameters "
+            "transfer (the fused path changes iteration STRUCTURE, not "
+            "the certified solution the residual gate asserts)")
+
+    if cfg.gating == "streaming" and not (
+            mesh.shape["sp"] == 1 and pallas_knn.supported(cfg.n)):
+        # Same honored-or-rejected contract as sharded_swarm_rollout: the
+        # forced streaming kernel exists only on the whole-swarm-per-
+        # device Pallas branch — an sp > 1 trainer would silently run the
+        # exchange search under a streaming label (ADVICE r5 #1).
+        raise ValueError(
+            "gating='streaming' on the trainer path requires sp == 1 and "
+            "a TPU backend (the forced kernel lives on the per-device "
+            "Pallas branch)")
+
     unicycle = cfg.dynamics == "unicycle"
+    return _local_loss_and_specs(cfg, tc, unicycle)
+
+
+def _local_loss_and_specs(cfg: swarm_scenario.Config, tc: TrainConfig,
+                          unicycle: bool):
+    """(local_loss, state_specs): the per-device loss body and its state
+    partition specs — shared by the forward-only :func:`make_loss_fn`
+    wrapper and :func:`make_loss_and_grad_fn` (which differentiates the
+    body INSIDE the sharded region, see there)."""
 
     def local_loss(params: TunableParams, *state0l):
         # Mode-aware actuator box: in double mode max_speed is the QP's
@@ -168,12 +194,57 @@ def make_loss_fn(cfg: swarm_scenario.Config, mesh, tc: TrainConfig = TrainConfig
     spec_state = P("dp", "sp", None)
     state_specs = ((spec_state, spec_state, P("dp", "sp")) if unicycle
                    else (spec_state, spec_state))
-    wrapped = shard_map(
+    return local_loss, state_specs
+
+
+def make_loss_fn(cfg: swarm_scenario.Config, mesh,
+                 tc: TrainConfig = TrainConfig()):
+    """Build loss(params, *state0) -> scalar over the (dp, sp) mesh.
+
+    ``state0`` is (x0, v0) of (E, N, 2) arrays — plus an (E, N) theta0 in
+    unicycle mode (shard: dp x sp; matches
+    :func:`cbf_tpu.parallel.ensemble.ensemble_initial_states`). The
+    rollout differentiates through every family's physics — for unicycle
+    that includes the si<->uni trig maps and the wheel-saturation scaling
+    (piecewise-smooth; subgradients at the saturation knee).
+
+    Forward value only — to train, use :func:`make_loss_and_grad_fn`
+    (or :func:`make_train_step`), which differentiates the body inside
+    the sharded region instead of transposing this wrapper.
+    """
+    local_loss, state_specs = _validated_loss_parts(cfg, mesh, tc)
+    return shard_map(
         local_loss, mesh,
         in_specs=(P(),) + state_specs,
         out_specs=P(),
     )
-    return wrapped
+
+
+def make_loss_and_grad_fn(cfg: swarm_scenario.Config, mesh,
+                          tc: TrainConfig = TrainConfig()):
+    """Build value_and_grad(params, *state0) -> (loss, grads) over the
+    mesh, with the differentiation INSIDE the sharded region.
+
+    Each device runs reverse-mode over its local loss body (collectives
+    differentiate primitive-wise: psum/ppermute transpose locally) and the
+    per-device parameter cotangents — each device's partial sum of the
+    global objective's terms — are completed by one (dp, sp) psum. This
+    never transposes the shard_map wrapper itself, which keeps the trainer
+    off the experimental tracer's transpose path (older JAX misorders
+    residual/const cotangents there — _SpecError on the params) and on
+    every version avoids a second whole-rollout partial-eval pass."""
+    local_loss, state_specs = _validated_loss_parts(cfg, mesh, tc)
+
+    def local_value_and_grad(params: TunableParams, *state0l):
+        loss, grads = jax.value_and_grad(local_loss)(params, *state0l)
+        grads = jax.tree.map(lambda g: lax.psum(g, ("dp", "sp")), grads)
+        return loss, grads
+
+    return shard_map(
+        local_value_and_grad, mesh,
+        in_specs=(P(),) + state_specs,
+        out_specs=(P(), P()),
+    )
 
 
 def make_train_step(cfg: swarm_scenario.Config, mesh,
@@ -187,12 +258,12 @@ def make_train_step(cfg: swarm_scenario.Config, mesh,
     use the returned optimizer, not a rebuilt one, so the update rule and
     state always match.
     """
-    loss_fn = make_loss_fn(cfg, mesh, tc)
+    loss_and_grad_fn = make_loss_and_grad_fn(cfg, mesh, tc)
     optimizer = optax.adam(tc.learning_rate)
 
     @jax.jit
     def train_step(params: TunableParams, opt_state, *state):
-        loss, grads = jax.value_and_grad(loss_fn)(params, *state)
+        loss, grads = loss_and_grad_fn(params, *state)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

@@ -68,7 +68,11 @@ def _out_struct(shape, dtype, like):
     """ShapeDtypeStruct carrying ``like``'s varying-manual-axes type, so the
     kernels compose with ``shard_map(..., check_vma=True)`` (dp-only meshes
     run the fused kernel per device — parallel.ensemble)."""
-    vma = getattr(jax.typeof(like), "vma", None)
+    # hasattr guard: jax.typeof (and vma tracking) is newer-JAX API;
+    # older releases (this container's 0.4.x) have neither — plain
+    # structs are correct there (cf. utils.math.match_vma's no-op).
+    vma = (getattr(jax.typeof(like), "vma", None)
+           if hasattr(jax, "typeof") else None)
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)
@@ -433,8 +437,9 @@ def _kernel_dispatch(x, radius, k: int, interpret: bool,
     return fn(x, radius, k, interpret=interpret)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
-def knn_select(x, radius, k: int, interpret: bool = False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def knn_select(x, radius, k: int, interpret: bool = False,
+               kernel: str = "auto"):
     """The Pallas k-NN kernels as a SELECTION ORACLE with a defined (zero)
     gradient — the differentiable-path entry (the raw kernels have no AD
     rule and error under jax.grad).
@@ -449,17 +454,22 @@ def knn_select(x, radius, k: int, interpret: bool = False):
     masking/selection and recompute any value it differentiates from the
     positions via ``idx`` (jnp gather — see :func:`knn_gating_pallas_diff`
     and sim.certificates.si_barrier_certificate_sparse, whose row geometry
-    is already rebuilt from gathered positions)."""
-    return _kernel_dispatch(x, radius, k, interpret)
+    is already rebuilt from gathered positions).
+
+    ``kernel`` forwards to the same fused-vs-streaming dispatch as the
+    non-diff path (the honored-or-rejected convention: a caller forcing
+    gating="streaming" must get the streaming kernel on BOTH the diff and
+    non-diff branches, never silently the auto choice)."""
+    return _kernel_dispatch(x, radius, k, interpret, kernel)
 
 
-def _knn_select_fwd(x, radius, k, interpret):
+def _knn_select_fwd(x, radius, k, interpret, kernel):
     # Residual = x itself (residuals must be JAX types; (N, 2) is tiny) —
     # only its shape/dtype are consumed, to build the zero cotangent.
-    return knn_select(x, radius, k, interpret), x
+    return knn_select(x, radius, k, interpret, kernel), x
 
 
-def _knn_select_bwd(radius, k, interpret, x, _ct):
+def _knn_select_bwd(radius, k, interpret, kernel, x, _ct):
     return (jnp.zeros_like(x),)
 
 
@@ -478,7 +488,7 @@ def _gating_epilogue(states4, idx, dist, count, k: int):
 
 
 def knn_gating_pallas_diff(states4, radius, k: int, *,
-                           interpret: bool = False):
+                           interpret: bool = False, kernel: str = "auto"):
     """Differentiable twin of :func:`knn_gating_pallas`: Pallas selects,
     jnp recomputes everything a gradient flows through.
 
@@ -498,7 +508,8 @@ def knn_gating_pallas_diff(states4, radius, k: int, *,
     """
     from cbf_tpu.utils.math import safe_norm
 
-    idx, dist, _, count = knn_select(states4[:, :2], radius, k, interpret)
+    idx, dist, _, count = knn_select(states4[:, :2], radius, k, interpret,
+                                     kernel)
     obs, mask, dropped = _gating_epilogue(states4, idx, dist, count, k)
     # safe_norm: an exactly-coincident kept pair (unreachable under the
     # first layer's floor, reachable in adversarial training states) has a

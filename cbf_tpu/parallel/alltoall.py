@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from cbf_tpu.parallel.ring import ring_knn
-from cbf_tpu.utils.math import safe_norm
+from cbf_tpu.utils.math import axis_size, safe_norm
 
 # Above this per-device DISTANCE-SLAB byte size — the (n_local, N) matrix
 # all_gather_knn materializes, which dwarfs the 16 B/agent gather itself —
@@ -82,7 +82,7 @@ def exchange_knn(states4_local, k: int, radius, axis_name: str,
     axis size is available but n_local * size is computed here when None.
     """
     if n_total is None:
-        n_total = states4_local.shape[0] * lax.axis_size(axis_name)
+        n_total = states4_local.shape[0] * axis_size(axis_name)
     slab_bytes = (states4_local.shape[0] * n_total
                   * states4_local.dtype.itemsize)
     if slab_bytes <= ALL_GATHER_MAX_SLAB_BYTES:

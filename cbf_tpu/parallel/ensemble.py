@@ -27,20 +27,32 @@ from jax.sharding import PartitionSpec as P
 try:  # JAX >= 0.6 stable location, fall back to experimental
     from jax import shard_map as _shard_map
 
-    def shard_map(f, mesh, in_specs, out_specs):
+    def shard_map(f, mesh, in_specs, out_specs, check_rep=False):
+        # New-JAX vma tracking has rules for every primitive (including
+        # while) — check_rep is an old-tracer knob only, ignored here.
         return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map_exp
 
-    def shard_map(f, mesh, in_specs, out_specs):
+    def shard_map(f, mesh, in_specs, out_specs, check_rep=False):
+        # check_rep=False: the experimental tracer's replication checker
+        # predates rules for `while` (ring exchange fori hops, the
+        # adaptive certificate budget trip "No replication rule for
+        # while") and can't prove scan-carry replication without pcast
+        # (which old JAX lacks, making utils.math.match_vma a no-op).
+        # Nothing here needs the checked transpose either: the trainer
+        # differentiates INSIDE the sharded region
+        # (learn.tuning.make_loss_and_grad_fn), so this wrapper is never
+        # transposed. Replicated-output correctness is pinned by the
+        # sp-vs-dp parity tests.
         return _shard_map_exp(f, mesh=mesh, in_specs=in_specs,
-                              out_specs=out_specs)
+                              out_specs=out_specs, check_rep=check_rep)
 
 from cbf_tpu.core.filter import CBFParams, safe_controls
 from cbf_tpu.ops import pallas_knn
 from cbf_tpu.parallel.alltoall import exchange_knn
 from cbf_tpu.scenarios import swarm as swarm_scenario
-from cbf_tpu.utils.math import l2_cap, match_vma, safe_norm
+from cbf_tpu.utils.math import axis_size, l2_cap, match_vma, safe_norm
 
 
 class EnsembleMetrics(NamedTuple):
@@ -89,10 +101,28 @@ def ensemble_initial_states(cfg: swarm_scenario.Config, seeds):
     return x0, jnp.zeros_like(x0)
 
 
+class _PendingStep(NamedTuple):
+    """Everything a deferred (``defer_certificate=True``) step hands the
+    caller so the joint layer can run OUTSIDE the per-member vmap (the
+    lockstep-batched ensemble path) and :func:`_finish_swarm_step` can
+    then complete integration + metrics — one shared tail, so the
+    deferred and inline paths cannot drift."""
+    body: jax.Array            # original body centers (== x outside unicycle)
+    theta: object              # (n_local,) headings or None
+    v: jax.Array               # (n_local, 2) incoming si velocities
+    engaged: jax.Array         # (n_local,) filter-engagement mask
+    feasible: jax.Array        # (n_local,) per-agent QP feasibility
+    nearest1: jax.Array        # (n_local,) gated nearest distance
+    min_floor: object          # Verlet sound-floor scalar or None
+    dropped: jax.Array         # k-NN truncation counts
+    new_cache: object          # updated Verlet cache or None
+
+
 def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
                       axis_name: str, unroll_relax: int = 0,
                       compute_metrics: bool = True, t=0, theta=None,
-                      gating_cache=None, cert_solver_state=None):
+                      gating_cache=None, cert_solver_state=None,
+                      defer_certificate: bool = False):
     """One agent-sharded swarm step. x, v: (n_local, 2). Differentiable when
     ``unroll_relax > 0`` (see solvers.exact2d) and ``compute_metrics=False``
     (the metric reductions use pmin, which has no differentiation rule).
@@ -119,6 +149,15 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
     solve's carries are vma-promoted by sharded row data, unproven with
     a threaded cross-step state). Non-differentiable (the carry is
     data); the caller threads the returned state through its scan carry.
+
+    ``defer_certificate``: stop BEFORE the joint layer and return
+    (u_filtered, x_si, _PendingStep) instead — the lockstep-batched
+    ensemble path applies the certificate across stacked members outside
+    the per-member vmap (one shared ADMM loop,
+    scenarios.swarm.apply_certificate_batched) and completes the step
+    with :func:`_finish_swarm_step`. Only meaningful with
+    cfg.certificate on a whole-swarm shard (axis size 1); incompatible
+    with ``cert_solver_state`` (the caller owns the batched carry).
 
     Returns (x_new, v_new, theta_new_or_None, metrics_or_None,
     nearest_d_local, new_cache_or_None, new_cert_state_or_None) — v_new
@@ -150,7 +189,7 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
     min_floor = None
     new_cache = None
     if gating_cache is not None:
-        if lax.axis_size(axis_name) != 1:
+        if axis_size(axis_name) != 1:
             raise ValueError(
                 "gating_cache requires the whole swarm on one device "
                 "(sp size 1) — the Verlet index set spans all N agents")
@@ -170,7 +209,7 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
             swarm_scenario.verlet_gating(
                 cfg, x, states4, gating_cache, K, use_p,
                 jax.default_backend() != "tpu")
-    elif (lax.axis_size(axis_name) == 1 and pallas_knn.supported(cfg.n)):
+    elif (axis_size(axis_name) == 1 and pallas_knn.supported(cfg.n)):
         # dp-only sharding: each swarm is whole on its device, so the
         # single-device fused Pallas kernel applies — ~8x the dense
         # top_k exchange at N=4096 (measured on the TPU bench). The
@@ -181,9 +220,16 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
         # (ops.pallas_knn.knn_gating_pallas_diff — same gradients as the
         # exchange path, finite-difference-tested).
         if unroll_relax > 0:
+            # kernel override threaded here too (ADVICE r5 #1): the diff
+            # twin previously ignored gating='streaming' silently,
+            # breaking the honored-or-rejected convention the non-diff
+            # branch below enforces — a streaming-labeled trainer run
+            # would have measured the auto kernel.
             obs_slab, mask, nearest1, dropped = \
                 pallas_knn.knn_gating_pallas_diff(
-                    states4, cfg.safety_distance, K)
+                    states4, cfg.safety_distance, K,
+                    kernel=("streaming" if cfg.gating == "streaming"
+                            else "auto"))
         else:
             # Honor gating="streaming" exactly as the scenario step does
             # (forced streaming kernel; "auto"/"pallas" keep the N-based
@@ -230,6 +276,18 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
     engaged = jnp.any(mask, axis=1)
     u = jnp.where(engaged[:, None], u_safe, u0)
 
+    aux = _PendingStep(body=body, theta=theta, v=v, engaged=engaged,
+                       feasible=info.feasible, nearest1=nearest1,
+                       min_floor=min_floor, dropped=dropped,
+                       new_cache=new_cache)
+    if defer_certificate:
+        if cert_solver_state is not None:
+            raise ValueError(
+                "defer_certificate hands the joint layer to the caller — "
+                "the batched solver carry is the caller's, not this "
+                "step's (pass cert_solver_state=None)")
+        return u, x, aux
+
     cert_res = jnp.zeros((), x.dtype)
     cert_dropped = jnp.zeros((), jnp.int32)
     cert_iters = jnp.zeros((), jnp.int32)
@@ -249,7 +307,7 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
         # certificate_partition="replicate" escape hatch — sp-fold
         # redundant compute, zero in-loop communication).
         diff = unroll_relax > 0
-        if lax.axis_size(axis_name) == 1:
+        if axis_size(axis_name) == 1:
             if cert_solver_state is not None:
                 (u, cert_res, cert_dropped, cert_iters,
                  new_cert_state) = swarm_scenario.apply_certificate(
@@ -279,33 +337,48 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
                     swarm_scenario.apply_certificate(cfg, ug, xg)
             i0 = lax.axis_index(axis_name) * x.shape[0]
             u = lax.dynamic_slice_in_dim(ug, i0, x.shape[0], axis=0)
-        # The joint QP's internal constants can demote the varying-manual-
-        # axes type under shard_map — re-align with the carry
-        # (utils.match_vma).
-        u = match_vma(u, x)
+    out = _finish_swarm_step(cfg, axis_name, x, u, aux, cert_res,
+                             cert_dropped, cert_iters, compute_metrics)
+    return out[:5] + (aux.new_cache, new_cert_state)
+
+
+def _finish_swarm_step(cfg: swarm_scenario.Config, axis_name: str, x, u,
+                       aux: _PendingStep, cert_res, cert_dropped,
+                       cert_iters, compute_metrics: bool = True):
+    """Integration + metrics — the shared tail of the sharded step, used
+    by the inline path (:func:`_local_swarm_step`) and, per member under
+    vmap, by the lockstep-batched certificate path (a duplicated tail
+    would let the two paths integrate or report differently). ``x`` is
+    the si position set the filter acted on, ``u`` the (possibly
+    certified) command. Returns (x_new, v_new, theta_new_or_None,
+    metrics_or_None, nearest1)."""
+    # The joint QP's internal constants can demote the varying-manual-
+    # axes type under shard_map — re-align with the carry
+    # (utils.match_vma).
+    u = match_vma(u, x)
     cert_res = match_vma(cert_res, x)
 
     theta_new = None
     deficit = jnp.zeros((), x.dtype)
-    if unicycle:
+    if cfg.dynamics == "unicycle":
         x_new, theta_new, p_new = swarm_scenario.unicycle_apply(
-            cfg, body, theta, u)
+            cfg, aux.body, aux.theta, u)
         v_new = (p_new - x) / cfg.dt
         # Wheel saturation erodes the filtered command (scenario step's
         # saturation_deficit) — same observable, sharded.
         deficit = jnp.max(safe_norm(u - v_new))
     else:
-        x_new, v_new = swarm_scenario.integrate(cfg, x, v, u)
+        x_new, v_new = swarm_scenario.integrate(cfg, x, aux.v, u)
     metrics = None
     if compute_metrics:
         metrics = (
             # Verlet path: the truncation-sound floor scalar (see
             # swarm.verlet_gating), not the seen-only per-agent minimum.
-            lax.pmin(jnp.min(nearest1) if min_floor is None else min_floor,
-                     axis_name),
-            lax.psum(jnp.sum(engaged), axis_name),
-            lax.psum(jnp.sum(~info.feasible & engaged), axis_name),
-            lax.psum(jnp.sum(dropped), axis_name),
+            lax.pmin(jnp.min(aux.nearest1) if aux.min_floor is None
+                     else aux.min_floor, axis_name),
+            lax.psum(jnp.sum(aux.engaged), axis_name),
+            lax.psum(jnp.sum(~aux.feasible & aux.engaged), axis_name),
+            lax.psum(jnp.sum(aux.dropped), axis_name),
             lax.pmax(cert_res, axis_name),
             # pmax, not psum: under sp > 1 every shard carries the same
             # GLOBAL value — the replicated path because each solves the
@@ -315,14 +388,15 @@ def _local_swarm_step(x, v, cfg: swarm_scenario.Config, cbf: CBFParams,
             lax.pmax(match_vma(deficit, x), axis_name),
             lax.pmax(match_vma(cert_iters, x), axis_name),
         )
-    return (x_new, v_new, theta_new, metrics, nearest1, new_cache,
-            new_cert_state)
+    return (x_new, v_new, theta_new, metrics, aux.nearest1)
 
 
 def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
                           steps: int | None = None,
                           cbf: CBFParams | None = None,
-                          initial_state=None, t0: int = 0):
+                          initial_state=None, t0: int = 0,
+                          chunk: int | None = None,
+                          with_solver_state: bool = False):
     """Run len(seeds) independent swarms over the (dp, sp) mesh.
 
     ``initial_state``: optional (x0, v0) pair — (x0, v0, theta0) in
@@ -330,9 +404,29 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
     restored checkpoint) instead of the seeds' spawn grids — the resume
     path of a chunked/checkpointed ensemble run. Pass the matching ``t0``
     (global step of the restored state) so the closed-form moving-obstacle
-    ring resumes in phase.
+    ring resumes in phase. Under ``cfg.certificate_warm_start`` it may
+    carry ONE extra trailing element: the solver carry a previous call
+    returned via ``with_solver_state=True`` (5-tuple of (E, ...) leaves)
+    — without it a resumed run reseeds the carry cold (sound: any carry
+    is only a starting point and the residual gate still asserts every
+    step; the scenario path's bit-exact round-trip now has its ensemble
+    twin).
 
-    Returns ((x_final, v_final) — plus theta_final in unicycle mode — with
+    ``chunk``: run the scan in ``chunk``-step compiled segments and
+    offload each segment's metrics to the HOST between segments — the
+    single-swarm path's rollout_chunked pattern. Without it the
+    (E, steps, n_channels) metrics history is stacked on-device across
+    the whole horizon, which is part of the measured ensemble tax
+    (docs/BENCH_LOG.md "Ensemble tax"): device memory and the final
+    transfer grow with the horizon while the hot loop carries the
+    stacking. Chunked, each segment ends in one host transfer and the
+    next segment's compute overlaps nothing bigger than a chunk. State
+    (including the Verlet cache and the solver carry) threads through
+    segments EXACTLY — a chunked run computes the same trajectory as an
+    unchunked one. Metrics come back as host (numpy) arrays.
+
+    Returns ((x_final, v_final) — plus theta_final in unicycle mode, plus
+    the final solver carry when ``with_solver_state=True`` — with
     (E, N, 2) / (E, N) global shapes, EnsembleMetrics).
     """
     steps = cfg.steps if steps is None else steps
@@ -388,12 +482,40 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
         raise ValueError(
             "certificate_warm_start/certificate_tol require whole-swarm-"
             f"per-device ensembles (sp == 1; got sp={n_sp})")
+    if cfg.certificate_fused and n_sp != 1:
+        # The fused iteration is rejected by the row-partitioned solver
+        # (solvers.sparse_admm: the carried pair image is unproven under
+        # shard_map vma promotion) — reject the sp-sharded ensemble shape
+        # here with the friendlier message rather than at trace time.
+        raise ValueError(
+            "certificate_fused requires whole-swarm-per-device ensembles "
+            f"(sp == 1; got sp={n_sp}) — the row-partitioned solve keeps "
+            "the CG path")
+    if with_solver_state and not cfg.certificate_warm_start:
+        raise ValueError(
+            "with_solver_state returns the certificate warm-start carry — "
+            "set cfg.certificate_warm_start=True (without it no carry "
+            "exists to return)")
+    if chunk is not None and chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
 
+    use_warm = cfg.certificate_warm_start and n_sp == 1
+    E_local = E // n_dp
+    use_cache = (cfg.gating_rebuild_skin > 0 and E_local == 1
+                 and n_sp == 1)
+
+    solver_state0 = None
     if initial_state is not None:
-        if len(initial_state) != parts:
+        n_given = len(initial_state)
+        if n_given == parts + 1 and use_warm:
+            solver_state0 = tuple(initial_state[parts])
+            initial_state = tuple(initial_state[:parts])
+        elif n_given != parts:
+            extra = " (+1 solver carry under certificate_warm_start)" \
+                if use_warm else ""
             raise ValueError(
-                f"initial_state needs {parts} arrays for "
-                f"dynamics={cfg.dynamics!r}, got {len(initial_state)}")
+                f"initial_state needs {parts} arrays{extra} for "
+                f"dynamics={cfg.dynamics!r}, got {n_given}")
         if initial_state[0].shape != (E, cfg.n, 2):
             raise ValueError(
                 f"initial_state x0 shape {initial_state[0].shape} != "
@@ -406,9 +528,50 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
     else:
         state0 = ensemble_initial_states(cfg, seeds)
 
-    out = _rollout_executable(cfg, mesh, E, steps)(
-        jnp.asarray(t0, jnp.int32), cbf, *state0)
-    return tuple(out[:parts]), EnsembleMetrics(*out[parts])
+    # Full rollout carry = state parts + the cross-step caches, all as
+    # explicit (E-leading) executable arguments so chunked segments and
+    # resumed runs continue EXACTLY where the previous call stopped.
+    state_full = tuple(state0)
+    if use_cache:
+        seed = swarm_scenario.verlet_cache_seed(cfg)
+        state_full += (tuple(
+            jnp.broadcast_to(a[None], (E,) + a.shape) for a in seed),)
+    if use_warm:
+        if solver_state0 is None:
+            from cbf_tpu.sim.certificates import certificate_solver_seed
+            seed = certificate_solver_seed(cfg.n, cfg.certificate_k,
+                                           cfg.dtype)
+            solver_state0 = tuple(
+                jnp.broadcast_to(a[None], (E,) + a.shape) for a in seed)
+        state_full += (tuple(solver_state0),)
+    n_extra = int(use_cache) + int(use_warm)
+
+    def run(t_start, n_steps, carry):
+        out = _rollout_executable(cfg, mesh, E, n_steps)(
+            jnp.asarray(t_start, jnp.int32), cbf, *carry)
+        return tuple(out[:parts + n_extra]), EnsembleMetrics(*out[-1])
+
+    if chunk is None:
+        carry, mets = run(t0, steps, state_full)
+    else:
+        from cbf_tpu.rollout.engine import stack_host_chunks
+
+        carry, host_parts, t = state_full, [], t0
+        while t < t0 + steps:
+            n = min(chunk, t0 + steps - t)
+            carry, mets_c = run(t, n, carry)
+            # Eager host offload each segment (the single-swarm path's
+            # measured-best pattern, rollout/engine.rollout_chunked):
+            # bounds device memory for the metrics history and keeps the
+            # stacking off the hot loop.
+            host_parts.append(jax.device_get(mets_c))
+            t += n
+        mets = stack_host_chunks(host_parts, axis=1)   # (E, steps) leaves
+
+    state_out = carry[:parts]
+    if with_solver_state:
+        state_out += (carry[parts + n_extra - 1],)
+    return state_out, mets
 
 
 @functools.lru_cache(maxsize=64)
@@ -435,13 +598,27 @@ def _rollout_executable(cfg: swarm_scenario.Config, mesh, E: int, steps: int):
     # shape where it pays — whole swarm per device, no vmap.
     use_cache = (cfg.gating_rebuild_skin > 0 and E_local == 1
                  and mesh.shape["sp"] == 1)
-    # Certificate warm-start carry: sp == 1 only (validated upstream);
-    # E_local > 1 is fine — under vmap the carry just gains a member axis
-    # (and a tol while_loop runs until every member converges).
+    # Certificate warm-start carry: sp == 1 only (validated upstream).
     use_warm = cfg.certificate_warm_start and mesh.shape["sp"] == 1
+    # Several whole swarms per device: route the joint layer through the
+    # LOCKSTEP batched solver — the members' certificate solves share one
+    # ADMM loop (one while_loop under tol, max-residual exit), so the
+    # serial iteration chain's latency is paid once per device instead of
+    # once per member (scenarios.swarm.apply_certificate_batched). The
+    # per-member vmap-of-while alternative reaches the same fixed points
+    # (its batching rule also runs to the last member) but re-selects
+    # every carry per iteration and keeps the solves' op bodies thin.
+    use_batched_cert = (
+        cfg.certificate and E_local > 1 and mesh.shape["sp"] == 1
+        and swarm_scenario.certificate_backend(cfg) == "sparse")
 
-    def local_rollout(t0, cbf, *state0l):
-        def one(*state0i):
+    def local_rollout(t0, cbf, *args):
+        state0l = args[:parts]
+        extras = args[parts:]
+        cache0 = extras[0] if use_cache else None
+        cstate0 = extras[-1] if use_warm else None
+
+        def one(*state0i, cache0=None, cstate0=None):
             def body(carry, t):
                 st = carry
                 cstate = st[-1] if use_warm else None
@@ -463,29 +640,72 @@ def _rollout_executable(cfg: swarm_scenario.Config, mesh, E: int, steps: int):
                 return new, met
 
             init = tuple(state0i)
+            # match_vma: restored/seeded caches enter the scan as sharded
+            # inputs (dp-varying only) but must carry the device-varying
+            # type they leave the step with (cf. the solver carries).
             if use_cache:
-                # match_vma: the seed constants must enter the scan with
-                # the device-varying type they leave it with (cf. the
-                # solver carries).
-                init = init + (tuple(
-                    match_vma(a, state0i[0])
-                    for a in swarm_scenario.verlet_cache_seed(cfg)),)
+                init = init + (tuple(match_vma(a, state0i[0])
+                                     for a in cache0),)
             if use_warm:
-                from cbf_tpu.sim.certificates import certificate_solver_seed
-                init = init + (tuple(
-                    match_vma(a, state0i[0])
-                    for a in certificate_solver_seed(cfg.n,
-                                                     cfg.certificate_k,
-                                                     cfg.dtype)),)
+                init = init + (tuple(match_vma(a, state0i[0])
+                                     for a in cstate0),)
             final, mets = lax.scan(body, init, t0 + jnp.arange(steps))
-            return final[:parts] + (mets,)   # caches are internal state
+            return final + (mets,)
+
+        def one_batched(state0l, cstate0):
+            """E_local members, one scan: pre-certificate step and the
+            finishing tail vmap per member, the joint layer runs ONCE per
+            step across the stacked members through the lockstep batched
+            solver."""
+            def body(carry, t):
+                st = carry
+                cstate = st[-1] if use_warm else None
+                if use_warm:
+                    st = st[:-1]
+                if unicycle:
+                    u, xsi, aux = jax.vmap(
+                        lambda xm, vm, qm: _local_swarm_step(
+                            xm, vm, cfg, cbf, "sp", t=t, theta=qm,
+                            defer_certificate=True))(st[0], st[1], st[2])
+                else:
+                    u, xsi, aux = jax.vmap(
+                        lambda xm, vm: _local_swarm_step(
+                            xm, vm, cfg, cbf, "sp", t=t,
+                            defer_certificate=True))(st[0], st[1])
+                res = swarm_scenario.apply_certificate_batched(
+                    cfg, u, xsi, solver_state=cstate)
+                u2, cert_res, cert_dropped, cert_iters = res[:4]
+                x2, v2, th2, met, _ = jax.vmap(
+                    lambda um, xm, am, cr, cd, ci: _finish_swarm_step(
+                        cfg, "sp", xm, um, am, cr, cd, ci))(
+                    u2, xsi, aux, cert_res, cert_dropped, cert_iters)
+                new = (x2, v2, th2) if unicycle else (x2, v2)
+                if use_warm:
+                    new = new + (res[4],)
+                return new, met
+
+            init = tuple(state0l)
+            if use_warm:
+                init = init + (tuple(match_vma(a, state0l[0])
+                                     for a in cstate0),)
+            final, mets = lax.scan(body, init, t0 + jnp.arange(steps))
+            # scan stacks time-major (steps, E_local); the metrics
+            # contract is member-major.
+            mets = jax.tree.map(lambda m: jnp.swapaxes(m, 0, 1), mets)
+            return final + (mets,)
 
         if E_local == 1:
             # One member per device: skip the vmap wrapper — identical math,
             # but batched lowering of the Pallas neighbor kernel is not free
             # on TPU, and this is the bench's chips==E configuration.
-            out = one(*(p[0] for p in state0l))
+            out = one(*(p[0] for p in state0l),
+                      cache0=(jax.tree.map(lambda a: a[0], cache0)
+                              if use_cache else None),
+                      cstate0=(jax.tree.map(lambda a: a[0], cstate0)
+                               if use_warm else None))
             return tuple(jax.tree.map(lambda m: m[None], o) for o in out)
+        if use_batched_cert:
+            return one_batched(state0l, cstate0)
         return jax.vmap(one)(*state0l)
 
     spec_state = P("dp", "sp", None)
@@ -493,10 +713,15 @@ def _rollout_executable(cfg: swarm_scenario.Config, mesh, E: int, steps: int):
     spec_metric = P("dp", None)
     in_specs = ((spec_state, spec_state, spec_theta) if unicycle
                 else (spec_state, spec_state))
+    # Cache / solver-carry extras: member-major (E, ...) pytrees, sharded
+    # over dp only (both exist only at sp == 1) — P("dp") as a pytree
+    # prefix spec covers every leaf.
+    extra_specs = (P("dp"),) * (int(use_cache) + int(use_warm))
     fn = shard_map(
         local_rollout, mesh,
-        in_specs=(P(), P()) + in_specs,
-        out_specs=in_specs + (
+        in_specs=(P(), P()) + in_specs + extra_specs,
+        out_specs=in_specs + extra_specs + (
             (spec_metric,) * len(EnsembleMetrics._fields),),
+        check_rep=False,   # rollout bodies carry while/fori loops
     )
     return jax.jit(fn)

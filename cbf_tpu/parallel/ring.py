@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from cbf_tpu.utils.math import match_vma, safe_norm
+from cbf_tpu.utils.math import axis_size, match_vma, safe_norm
 
 
 def ring_knn(states4_local, k: int, radius, axis_name: str,
@@ -46,7 +46,7 @@ def ring_knn(states4_local, k: int, radius, axis_name: str,
     [, dropped], aligned with the single-device
     :func:`cbf_tpu.rollout.gating.knn_gating` contract.
     """
-    n_shards = lax.axis_size(axis_name)
+    n_shards = axis_size(axis_name)
     n_local = states4_local.shape[0]
     dtype = states4_local.dtype
 

@@ -138,8 +138,17 @@ def rollout_chunked(step_fn: Callable, state0, steps: int, *,
         return state, None, start
     # Chunk outputs live on host; the stacked history stays there (a
     # 10k-step trajectory need not fit HBM).
-    stacked = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *parts)
-    return state, stacked, start
+    return state, stack_host_chunks(parts, axis=0), start
+
+
+def stack_host_chunks(parts, axis: int = 0):
+    """Concatenate per-chunk host-offloaded output pytrees along the time
+    axis — the ONE stacking convention for chunked rollouts, shared by
+    :func:`rollout_chunked` (time-leading StepOutputs, axis 0) and the
+    ensemble path's chunked metrics (member-major EnsembleMetrics,
+    axis 1 — parallel.ensemble.sharded_swarm_rollout). The stacked
+    history stays on host: a 10k-step record never needs to fit HBM."""
+    return jax.tree.map(lambda *xs: np.concatenate(xs, axis=axis), *parts)
 
 
 def min_pairwise_distance(positions):

@@ -222,6 +222,18 @@ class Config:
     # later exits — the tol mode's tuning partner. None = solver default
     # (10).
     certificate_check_every: int | None = None
+    # Fused sparse-ADMM iterations (solvers.sparse_admm, round 6): the
+    # certificate solve is latency-bound on its serial per-iteration
+    # chain of ~9 tiny O(R) ops; the fused path restructures each
+    # iteration around a carried pair image + a reduction-free Chebyshev
+    # x-update so the dependent chain is <= 4 heavy ops (pinned by
+    # scripts/chain_depth.py's regression test) — same fixed point, the
+    # per-step 1e-4 residual gate still asserts every solve. Sparse
+    # backend only; scenario/bench path and sp == 1 ensembles (the
+    # row-partitioned solve keeps the CG path — the solver rejects
+    # fused+axis_name); the trainer rejects it (the Chebyshev unrolled
+    # gradient is unvalidated; tuned parameters transfer).
+    certificate_fused: bool = False
     # sp > 1 ensembles only: "auto" row-partitions the sparse backend's
     # joint solve over the sp axis (each shard owns its local agents' pair
     # rows — O(N*k/sp) row work per device; parallel.ensemble), falling
@@ -505,6 +517,17 @@ def barrier_dynamics(cfg: Config, dtype):
             raise ValueError(
                 f"certificate_check_every must be >= 1, got "
                 f"{cfg.certificate_check_every}")
+    if cfg.certificate_fused:
+        # Honored-or-rejected like the sibling knobs: fused iterations
+        # only exist in the sparse ADMM.
+        if not cfg.certificate:
+            raise ValueError("certificate_fused needs certificate=True")
+        if certificate_backend(cfg) != "sparse":
+            raise ValueError(
+                "certificate_fused restructures the SPARSE ADMM "
+                "iteration; resolved backend here is "
+                f"{certificate_backend(cfg)!r} — set "
+                "certificate_backend='sparse'")
     if (cfg.certificate and cfg.certificate_pairs is not None
             and certificate_backend(cfg) == "sparse"):
         raise ValueError(
@@ -818,7 +841,12 @@ def _certificate_settings(cfg: Config):
         tol=cfg.certificate_tol if cfg.certificate_tol is not None
         else d.tol,
         check_every=cfg.certificate_check_every
-        if cfg.certificate_check_every is not None else d.check_every)
+        if cfg.certificate_check_every is not None else d.check_every,
+        fused=cfg.certificate_fused,
+        # The fused path pairs with the reduction-free Chebyshev x-update
+        # (the chain-depth lever); power users wanting fused+CG call the
+        # solver directly.
+        ksolve="chebyshev" if cfg.certificate_fused else d.ksolve)
 
 
 def apply_certificate(cfg: Config, u, x, neighbor_cache=None,
@@ -868,6 +896,40 @@ def apply_certificate(cfg: Config, u, x, neighbor_cache=None,
         u.T, x.T, params, max_pairs=pairs, with_info=True, arena=arena)
     return (u_cert.T, cinfo.primal_residual, jnp.zeros((), jnp.int32),
             jnp.zeros((), jnp.int32))
+
+
+def apply_certificate_batched(cfg: Config, u, x, solver_state=None):
+    """Lockstep-batched twin of :func:`apply_certificate` for a stacked
+    member axis (sparse backend only): E members' joint certificates
+    through ONE shared ADMM loop, so the solve's serial iteration chain —
+    its latency wall — is paid once for all members instead of once per
+    member (sim.certificates.si_barrier_certificate_sparse_batched; the
+    dp-axis ensemble path routes here when it holds several whole swarms
+    per device, parallel.ensemble). Same problem derivation
+    (:func:`_certificate_problem`) and budget (:func:`_certificate_settings`)
+    as the per-member appliers.
+
+    Args: u, x (E, N, 2); ``solver_state`` an optional batched warm carry
+    (5-tuple of (E, ...) leaves). Returns (u_certified (E, N, 2),
+    primal_residual (E,), dropped (E,) int32, iterations (E,) int32)
+    [+ new_solver_state when ``solver_state`` is given]."""
+    from cbf_tpu.sim.certificates import si_barrier_certificate_sparse_batched
+    if certificate_backend(cfg) != "sparse":
+        raise ValueError(
+            "apply_certificate_batched is sparse-backend only (the dense "
+            "solver has no lockstep driver); resolved backend is "
+            f"{certificate_backend(cfg)!r}")
+    params, arena = _certificate_problem(cfg)
+    out = si_barrier_certificate_sparse_batched(
+        jnp.swapaxes(u, 1, 2), jnp.swapaxes(x, 1, 2), params,
+        settings=_certificate_settings(cfg), k=cfg.certificate_k,
+        with_info=True, arena=arena, solver_state=solver_state)
+    u_cert, cinfo = out[0], out[1]
+    ret = (jnp.swapaxes(u_cert, 1, 2), cinfo.primal_residual,
+           cinfo.dropped_count, cinfo.iterations)
+    if solver_state is not None and solver_state != ():
+        ret += (out[2],)
+    return ret
 
 
 def apply_certificate_sharded(cfg: Config, u, x, axis_name: str):

@@ -21,15 +21,17 @@ from __future__ import annotations
 from typing import NamedTuple
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 from jax import lax
 
 from cbf_tpu.ops.pairwise import pairwise_distances
 from cbf_tpu.sim.robotarium import ARENA
-from cbf_tpu.utils.math import safe_norm
+from cbf_tpu.utils.math import axis_size, safe_norm
 from cbf_tpu.solvers.admm import ADMMSettings, solve_box_qp_admm
 from cbf_tpu.solvers.sparse_admm import (SparseADMMSettings,
-                                         solve_pair_box_qp_admm)
+                                         solve_pair_box_qp_admm,
+                                         solve_pair_box_qp_admm_batched)
 
 
 class CertificateParams(NamedTuple):
@@ -281,12 +283,15 @@ def si_barrier_certificate_sparse(
     contract: sound for any stale carry, the residual gate still
     asserts every step. Not differentiable through the carry.
     """
-    from cbf_tpu.ops import pallas_knn
-
     N = x.shape[1]
     dtype = jnp.result_type(dxi, x)
     if pair_radius is None:
         pair_radius = binding_pair_radius(params)
+    # Empty tuple == absent (State.certificate_solver_state's disabled
+    # value is ()): normalize ONCE so the warm_state and with_state
+    # decisions below can never disagree — a caller passing () previously
+    # got a cold solve that still appended an unexpected state return.
+    solver_state = solver_state or None
 
     # safe_norm, not jnp.linalg.norm: this function is on the trainer's
     # reverse-mode path and an exactly-zero command column (an unengaged
@@ -298,46 +303,13 @@ def si_barrier_certificate_sparse(
 
     xt = x.T                                                 # (N, 2)
     k = min(k, N - 1)
-    use_pallas = (neighbor_backend == "pallas"
-                  or (neighbor_backend == "auto"
-                      and pallas_knn.supported(N)))
+    use_pallas = _use_pallas_search(neighbor_backend, N)
 
     def _search(radius):
-        """(idx, mask, count) under ``radius`` — the one search both the
-        exact path and the Verlet rebuild use."""
-        if use_pallas:
-            # knn_select: the oracle wrapper (fused-vs-streaming dispatch
-            # inside) — differentiable callers are safe because nothing
-            # downstream differentiates the kernel's OUTPUT VALUES:
-            # idx/count are integers, dist_k feeds only the boolean mask,
-            # and the row geometry gradients flow through
-            # _pair_row_geometry's jnp gathers of xt (FD-tested).
-            idx, dist_k, _, count = pallas_knn.knn_select(
-                xt, radius, k, pallas_interpret)
-            return idx, jnp.isfinite(dist_k), count
-        dist = pairwise_distances(xt)                        # (N, N)
-        eligible = (dist < radius) & ~jnp.eye(N, dtype=bool)
-        keyed = jnp.where(eligible, dist, jnp.inf)
-        neg_d, idx = lax.top_k(-keyed, k)                    # (N, k)
-        return idx, jnp.isfinite(neg_d), jnp.sum(eligible, axis=1,
-                                                 dtype=jnp.int32)
+        return _exact_search(xt, k, radius, use_pallas, pallas_interpret)
 
     def _coverage_gap(idx, mask, count):
-        """True coverage gap, not directed slot overflow: pair (i, j) is
-        in the QP if it fits EITHER endpoint's k slots (the rows are
-        identical). Eligibility is symmetric, so directed-eligible D =
-        2 * eligible pairs; kept entries S include mutual pairs twice, so
-        unordered covered = S - M/2 with M = kept entries whose reverse
-        is also kept. O(N*k^2) — no (N, N) scatter, identical for both
-        backends."""
-        I = jnp.broadcast_to(jnp.arange(N)[:, None], (N, k)).reshape(-1)
-        J = idx.reshape(-1)
-        D = jnp.sum(count)
-        S = jnp.sum(mask, dtype=jnp.int32)
-        mutual = mask.reshape(-1) & jnp.any(
-            (idx[J] == I[:, None]) & mask[J], axis=1)
-        M = jnp.sum(mutual, dtype=jnp.int32)
-        return D // 2 - (S - M // 2)
+        return _slot_coverage_gap(idx, mask, count, N, k)
 
     new_cache = None
     if rebuild_skin:
@@ -379,7 +351,7 @@ def si_barrier_certificate_sparse(
     # runs the I side as a dense reshape-sum instead of a scatter.
     solve = solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
                                    settings, agent_k=k,
-                                   warm_state=solver_state or None,
+                                   warm_state=solver_state,
                                    with_state=solver_state is not None)
     if solver_state is not None:
         u, info, new_solver_state = solve
@@ -396,6 +368,131 @@ def si_barrier_certificate_sparse(
         ret += (new_cache,)
     if solver_state is not None:
         ret += (new_solver_state,)
+    return ret if len(ret) > 1 else out
+
+
+def _use_pallas_search(neighbor_backend: str, N: int) -> bool:
+    """Resolve the certificate's neighbor-backend dispatch — the one
+    decision, shared by the replicated entry and the lockstep-batched
+    twin (a drifted threshold would make the two paths search with
+    different kernels at the same N)."""
+    from cbf_tpu.ops import pallas_knn
+
+    return (neighbor_backend == "pallas"
+            or (neighbor_backend == "auto" and pallas_knn.supported(N)))
+
+
+def _exact_search(xt, k: int, radius, use_pallas: bool,
+                  pallas_interpret: bool):
+    """(idx, mask, count) under ``radius`` over positions xt (N, 2) — the
+    ONE search the exact path, the Verlet rebuild, and the batched twin
+    all use."""
+    from cbf_tpu.ops import pallas_knn
+
+    N = xt.shape[0]
+    if use_pallas:
+        # knn_select: the oracle wrapper (fused-vs-streaming dispatch
+        # inside) — differentiable callers are safe because nothing
+        # downstream differentiates the kernel's OUTPUT VALUES:
+        # idx/count are integers, dist_k feeds only the boolean mask,
+        # and the row geometry gradients flow through
+        # _pair_row_geometry's jnp gathers of xt (FD-tested).
+        idx, dist_k, _, count = pallas_knn.knn_select(
+            xt, radius, k, pallas_interpret)
+        return idx, jnp.isfinite(dist_k), count
+    dist = pairwise_distances(xt)                        # (N, N)
+    eligible = (dist < radius) & ~jnp.eye(N, dtype=bool)
+    keyed = jnp.where(eligible, dist, jnp.inf)
+    neg_d, idx = lax.top_k(-keyed, k)                    # (N, k)
+    return idx, jnp.isfinite(neg_d), jnp.sum(eligible, axis=1,
+                                             dtype=jnp.int32)
+
+
+def _slot_coverage_gap(idx, mask, count, N: int, k: int):
+    """True coverage gap, not directed slot overflow: pair (i, j) is
+    in the QP if it fits EITHER endpoint's k slots (the rows are
+    identical). Eligibility is symmetric, so directed-eligible D =
+    2 * eligible pairs; kept entries S include mutual pairs twice, so
+    unordered covered = S - M/2 with M = kept entries whose reverse
+    is also kept. O(N*k^2) — no (N, N) scatter, identical for both
+    backends."""
+    I = jnp.broadcast_to(jnp.arange(N)[:, None], (N, k)).reshape(-1)
+    J = idx.reshape(-1)
+    D = jnp.sum(count)
+    S = jnp.sum(mask, dtype=jnp.int32)
+    mutual = mask.reshape(-1) & jnp.any(
+        (idx[J] == I[:, None]) & mask[J], axis=1)
+    M = jnp.sum(mutual, dtype=jnp.int32)
+    return D // 2 - (S - M // 2)
+
+
+def si_barrier_certificate_sparse_batched(
+        dxi, x, params: CertificateParams = CertificateParams(),
+        settings: SparseADMMSettings = SparseADMMSettings(),
+        k: int = 32, pair_radius: float | None = None,
+        with_info: bool = False, arena: tuple | None = ARENA,
+        neighbor_backend: str = "auto", pallas_interpret: bool = False,
+        solver_state=None):
+    """Lockstep-batched twin of :func:`si_barrier_certificate_sparse` over
+    a member axis: E independent joint certificates solved through ONE
+    shared ADMM loop (solvers.sparse_admm.solve_pair_box_qp_admm_batched).
+
+    The certificate solve is latency-bound on its serial iteration chain
+    — per-member solves (a vmap over whole solves, or one solve per
+    member per device) each pay that chain alone; the lockstep driver
+    packs the member axis into every op instead, so the chain's latency
+    amortizes E-fold and (under ``settings.tol`` > 0) one shared
+    max-residual exit drives all members: the loop runs until the WORST
+    member converges, members already under tol simply keep polishing
+    (sound — extra ADMM iterations never corrupt a converged solution,
+    and every member's residual is still returned for the caller's gate).
+
+    Args mirror the replicated entry with a leading member axis:
+    dxi, x (E, 2, N) -> certified (E, 2, N)[, SparseCertificateInfo with
+    (E,) leaves]. ``solver_state``: a previous call's batched carry
+    (5-tuple of (E, ...) leaves; () == absent) — appended to the return
+    when passed, exactly like the replicated entry's contract. No Verlet
+    cache (the ensemble paths run the exact search; parallel.ensemble
+    rejects the skin knob) and no row-partitioned mode (lockstep batching
+    amortizes the chain the OTHER way — across members, not across
+    shards).
+    """
+    E, _, N = x.shape
+    dtype = jnp.result_type(dxi, x)
+    if pair_radius is None:
+        pair_radius = binding_pair_radius(params)
+    solver_state = solver_state or None     # () == absent, cf. replicated
+    k = min(k, N - 1)
+    use_pallas = _use_pallas_search(neighbor_backend, N)
+    I = jnp.broadcast_to(jnp.arange(N)[:, None], (N, k)).reshape(-1)
+
+    def build(dxi_i, x_i):
+        norms = safe_norm(dxi_i, axis=0)
+        scale = jnp.maximum(1.0, norms / params.magnitude_limit)
+        u_nom = (dxi_i / scale[None, :]).T               # (N, 2)
+        xt = x_i.T
+        idx, mask, count = _exact_search(xt, k, pair_radius, use_pallas,
+                                         pallas_interpret)
+        dropped = _slot_coverage_gap(idx, mask, count, N, k)
+        J = idx.reshape(-1)
+        coef, b_pair = _pair_row_geometry(xt, I, J, mask.reshape(-1),
+                                          params, dtype)
+        lo, hi = _arena_box(xt, params, arena, dtype)
+        return u_nom, J, coef, b_pair, lo, hi, dropped
+
+    u_nom, J, coef, b_pair, lo, hi, dropped = jax.vmap(build)(dxi, x)
+    solve = solve_pair_box_qp_admm_batched(
+        u_nom, I, J, coef, b_pair, lo, hi, settings, agent_k=k,
+        warm_state=solver_state, with_state=solver_state is not None)
+    u, info = solve[0], solve[1]
+    out = jnp.swapaxes(u, 1, 2)                          # (E, 2, N)
+    ret = (out,)
+    if with_info:
+        ret += (SparseCertificateInfo(info.primal_residual,
+                                      info.dual_residual, dropped,
+                                      info.iterations),)
+    if solver_state is not None:
+        ret += (solve[2],)
     return ret if len(ret) > 1 else out
 
 
@@ -464,7 +561,7 @@ def si_barrier_certificate_sparse_sharded(
     path (see scenarios.swarm.apply_certificate).
     """
     N = x.shape[1]
-    n_shards = lax.axis_size(axis_name)
+    n_shards = axis_size(axis_name)
     if N % n_shards:
         raise ValueError(f"N={N} must be divisible by the {axis_name!r} "
                          f"axis size {n_shards}")

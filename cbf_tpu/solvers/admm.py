@@ -41,6 +41,22 @@ class ADMMInfo(NamedTuple):
     dual_residual: jax.Array
 
 
+def relaxed_zy_update(Ax, z, y, rho, alpha, project):
+    """One over-relaxed ADMM (z, y) block update — the ONE definition of
+    the splitting's projection step, shared by this dense solver and the
+    sparse solver's scan/fused/lockstep-batched drivers (a drifted alpha
+    convention between them would make the paths converge to different
+    fixed points while every individual residual check stays green).
+
+    ``project`` is the constraint-set projection for the block (a clip for
+    two-sided rows, a min for one-sided pair rows).
+    """
+    Ax_relaxed = alpha * Ax + (1.0 - alpha) * z
+    z_new = project(Ax_relaxed + y / rho)
+    y_new = y + rho * (Ax_relaxed - z_new)
+    return z_new, y_new
+
+
 @functools.partial(jax.jit, static_argnames=("settings",))
 def solve_box_qp_admm(P, q, A, l, u, settings: ADMMSettings = ADMMSettings()):
     """Solve one QP; vmap for batches. Returns (x, ADMMInfo).
@@ -74,9 +90,8 @@ def solve_box_qp_admm(P, q, A, l, u, settings: ADMMSettings = ADMMSettings()):
         rhs = sigma * x - q + A.T @ (rho * z - y)
         x_new = cho_solve(cf, rhs)
         Ax = A @ x_new
-        Ax_relaxed = alpha * Ax + (1.0 - alpha) * z
-        z_new = jnp.clip(Ax_relaxed + y / rho, l, u)
-        y_new = y + rho * (Ax_relaxed - z_new)
+        z_new, y_new = relaxed_zy_update(Ax, z, y, rho, alpha,
+                                         lambda w: jnp.clip(w, l, u))
         return (x_new, z_new, y_new)
 
     # Under shard_map the zero-initialized carries are 'invariant' while

@@ -29,6 +29,34 @@ transpose is completed by one (2N,) psum per K application while the tiny
 1/sp across the mesh instead of being replicated per shard (see
 solve_pair_box_qp_admm's axis_name contract and
 sim.certificates.si_barrier_certificate_sparse_sharded).
+
+Fused mode (``settings.fused``, round 6): the solve is LATENCY-bound on
+its serial per-iteration chain, not throughput-bound (r05 TPU: 192 ms/step
+at N=1024 — ~9 tiny dependent O(R) ops per iteration x ~100 iterations,
+each op microseconds of flops). The fused iteration makes every step of
+the chain heavy instead of tiny:
+
+  * the x-update's residual ``rhs - K x`` is formed DIRECTLY from the
+    carried pair image ``A x`` (recomputed exactly each iteration, never
+    accumulated), folding the rhs transpose and the warm-start K
+    application into ONE scatter: ``A^T(rho z_p - y_p - rho Ax)``;
+  * the transpose's two scatter-adds (I side, J side) collapse into one
+    concatenated-index scatter pass (generic rows; the agent-major
+    ``agent_k`` fast path keeps its dense I side — it trades chain depth
+    for scattered VOLUME, the opposite lever, and both are honored);
+  * ``ksolve="chebyshev"`` replaces CG with a fixed-degree Chebyshev
+    semi-iteration on provable spectral bounds (K >= (1+sigma+rho) I
+    exactly; lambda_max via the one-time ||A||_1 ||A||_inf bound) — no
+    vdots, so each inner step's dependent chain is the matvec alone;
+  * under ``tol > 0`` the primal residual check reuses the carried pair
+    image instead of paying a fresh pair matvec per adaptive block.
+
+Net dependent chain per ADMM iteration (generic rows): ~9 heavy O(R) ops
+down to <= 4 — pinned by scripts/chain_depth.py and its regression test.
+The batched entry (:func:`solve_pair_box_qp_admm_batched`) drives E
+members' solves through ONE shared while_loop (max-residual exit across
+members), so each serialized op additionally carries E members' rows —
+the dp-axis ensemble path's chain-latency amortization.
 """
 
 from __future__ import annotations
@@ -41,6 +69,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from cbf_tpu.solvers.admm import relaxed_zy_update
 from cbf_tpu.utils.math import match_vma, safe_norm
 
 
@@ -62,15 +91,32 @@ class SparseADMMSettings(NamedTuple):
     chain, so skipped iterations convert 1:1 into wall time, and
     long-horizon packed states need MORE than the fixed default budget —
     residual 2.6e-4 at 2000 steps under 100x8). The residual check costs
-    one extra pair matvec per block. NOT reverse-differentiable
-    (while_loop); the trainer keeps tol=0."""
+    one extra pair matvec per block (none in fused mode — the carried
+    pair image is reused). NOT reverse-differentiable (while_loop); the
+    trainer keeps tol=0.
+
+    ``fused`` restructures each iteration around the carried pair image
+    ``A x`` so the dependent chain is <= 4 heavy ops (module docstring);
+    same fixed point, residuals still asserted by the caller. Not
+    supported in row-partitioned mode (axis_name — the carried-image and
+    spectral-bound reductions are unproven under shard_map vma
+    promotion; sharded solves keep the CG path).
+
+    ``ksolve`` selects the x-update's inner solver: "cg" (warm-started
+    conjugate gradients — the default, adaptively optimal per matvec) or
+    "chebyshev" (fused mode only: a fixed-degree polynomial on provable
+    spectral bounds — slightly weaker per matvec, but reduction-free, so
+    the serialized chain per inner step is exactly one K application).
+    ``cg_iters`` is the inner budget for either."""
     rho: float = 1.0
     sigma: float = 1e-6
     alpha: float = 1.6       # over-relaxation
     iters: int = 100
-    cg_iters: int = 8        # x-update CG steps (warm-started from prev x)
+    cg_iters: int = 8        # x-update inner budget (CG or Chebyshev)
     tol: float = 0.0         # 0 = fixed iters (differentiable path)
     check_every: int = 10
+    fused: bool = False      # carried-Ax fused iteration (chain <= 4)
+    ksolve: str = "cg"       # "cg" | "chebyshev" (chebyshev needs fused)
 
 
 class SparseADMMInfo(NamedTuple):
@@ -116,8 +162,45 @@ def _cg(apply_K, rhs, iters, vma_ref=None):
     return x
 
 
+def _chebyshev(apply_K, rhs, iters, ev_lo, ev_hi, vma_ref=None):
+    """Fixed-degree Chebyshev semi-iteration ``x ~= K^{-1} rhs`` for SPD K
+    with spectrum inside [ev_lo, ev_hi] — the reduction-free twin of
+    :func:`_cg` (zero start). The classical three-term recurrence needs
+    NO inner products: each step's dependent chain is exactly one K
+    application plus axpys and a scalar recurrence, which is what drops
+    the fused iteration's serialized depth to the matvec alone.
+
+    The bounds need only be VALID: a loose ev_hi costs convergence rate,
+    never correctness, while an UNDER-estimate amplifies the eigenmodes
+    above it — which is why callers pass the provable one-time
+    ||A||_1 ||A||_inf bound from :func:`_prepare_ops`, not a power-method
+    estimate. ev_lo = 1 + sigma + rho is exact by construction (A^T A is
+    PSD). Differentiation: the recurrence is LINEAR in rhs with smooth
+    scalar coefficients, so plain reverse-mode through the unrolled scan
+    is benign (no Polak-step denominators — contrast _cg's hazard)."""
+    theta = 0.5 * (ev_hi + ev_lo)
+    delta = jnp.maximum(0.5 * (ev_hi - ev_lo), 1e-12 * theta)
+    sigma1 = theta / delta
+    rho0 = 1.0 / sigma1
+    r0 = rhs if vma_ref is None else match_vma(rhs, vma_ref)
+    d0 = r0 / theta
+    x0 = d0
+
+    def body(carry, _):
+        x, r, dvec, rho_prev = carry
+        r = r - apply_K(dvec)
+        rho_new = 1.0 / (2.0 * sigma1 - rho_prev)
+        dvec = rho_new * rho_prev * dvec + (2.0 * rho_new / delta) * r
+        x = x + dvec
+        return (x, r, dvec, rho_new), None
+
+    (x, *_), _ = lax.scan(body, (x0, r0, d0, rho0), None,
+                          length=max(int(iters), 1))
+    return x
+
+
 def _make_apply_K(coef_s, I, J, rho, sigma, dtype=None, axis_name=None,
-                  agent_k=None, rows_start=0):
+                  agent_k=None, rows_start=0, one_pass=False):
     """The x-update operator K = (1 + sigma + rho) I + rho A_pair^T A_pair
     (+ rho I from the identity box block), matrix-free over flattened
     (2N,) vectors — the ONE definition of the pair operator, shared by
@@ -141,7 +224,13 @@ def _make_apply_K(coef_s, I, J, rho, sigma, dtype=None, axis_name=None,
     scattered volume attacks the certificate solve's predicted dominant
     cost (docs/BENCH_LOG.md "MFU / roofline"; exactness vs the generic
     path is pinned by tests). ``rows_start`` is the owning block's global
-    offset (traced; 0 unsharded)."""
+    offset (traced; 0 unsharded).
+
+    ``one_pass`` (fused mode, generic rows only): collapse the
+    transpose's two chained scatter-adds into ONE concatenated-index
+    scatter — same sum, one serialized pass (summation order differs at
+    float level, which is why the default path keeps the two-scatter form
+    its equivalence tests were pinned against)."""
     dtype = coef_s.dtype if dtype is None else dtype
 
     def A_pair(v):                                   # (N, 2) -> (R_local,)
@@ -155,6 +244,9 @@ def _make_apply_K(coef_s, I, J, rho, sigma, dtype=None, axis_name=None,
             z = lax.dynamic_update_slice_in_dim(z, block, rows_start,
                                                 axis=0)
             z = z.at[J].add(-contrib)
+        elif one_pass:
+            idx = jnp.concatenate([I, J])
+            z = z.at[idx].add(jnp.concatenate([contrib, -contrib]))
         else:
             z = z.at[I].add(contrib).at[J].add(-contrib)
         if axis_name is not None:
@@ -231,6 +323,257 @@ def _solve_K_bwd(iters, rho_sigma_axis, res, ct):
 _solve_K.defvjp(_solve_K_fwd, _solve_K_bwd)
 
 
+class _PairOps(NamedTuple):
+    """Prepared per-problem operands for one ADMM drive. ``J`` rides here
+    (it is per-member under the lockstep batched driver, which vmaps the
+    whole structure at its leading axis); ``I`` stays a shared closure of
+    the iteration functions (identical across members in the certificate's
+    agent-major layout — batching it would only materialize copies)."""
+    J: jax.Array          # (R,) pair partners
+    coef_s: jax.Array     # (R, 2) equilibrated row directions
+    b_s: jax.Array        # (R,) equilibrated pair bounds
+    q: jax.Array          # (2N,) linear term (-u_nom flattened)
+    lo: jax.Array         # (2N,) box lower
+    hi: jax.Array         # (2N,) box upper
+    d: jax.Array          # (R,) row equilibration scales (> 0)
+    coef: jax.Array       # (R, 2) ORIGINAL rows (residual geometry)
+    b_pair: jax.Array     # (R,) original pair bounds
+    ev_hi: jax.Array      # () Chebyshev upper spectral bound for K
+
+
+def _prepare_ops(u_nom, I, J, coef, b_pair, lo, hi, settings,
+                 axis_name=None, agent_k=None, rows_start=0) -> _PairOps:
+    """Equilibrate rows and precompute everything the iteration consumes.
+
+    Row equilibration (same lesson as the dense solver: mixed row scales
+    stall fixed-rho ADMM). Pair row norm = ||(-c, +c)|| = sqrt(2)*||c||;
+    box rows are unit already. Zero (padding) rows get d=1 and stay
+    inert — via safe_norm: ||.||'s raw gradient at an exactly-zero row
+    is 0/0, and on the trainer's reverse path that NaN would poison the
+    whole parameter gradient even though the `where` takes the other
+    branch (0 * NaN = NaN through the norm primitive's VJP).
+
+    ``ev_hi`` (Chebyshev mode only; 0 otherwise): a PROVABLE upper bound
+    on lambda_max(K) via lambda_max(A^T A) <= ||A||_1 ||A||_inf, one
+    scatter-add of |coef_s| OUTSIDE the iteration chain. Overestimating
+    only slows Chebyshev convergence; underestimating would diverge —
+    hence a bound, not a power-method estimate."""
+    N = u_nom.shape[0]
+    dtype = jnp.result_type(u_nom, coef)
+    rho, sigma = settings.rho, settings.sigma
+
+    c_norm = jnp.sqrt(2.0) * safe_norm(coef, axis=1)
+    d = jnp.where(c_norm > 1e-10, 1.0 / jnp.maximum(c_norm, 1e-10), 1.0)
+    coef_s = coef * d[:, None]
+    b_s = jnp.where(jnp.isfinite(b_pair), b_pair * d, b_pair)
+    q = -u_nom.reshape(-1)
+
+    if settings.ksolve == "chebyshev":
+        a = jnp.abs(coef_s)
+        row_l1 = 2.0 * jnp.sum(a, axis=1)            # full-row L1 (−c, +c)
+        col = jnp.zeros((N, 2), dtype).at[I].add(a).at[J].add(a)
+        a_inf = jnp.max(row_l1, initial=0.0)
+        a_one = jnp.max(col, initial=0.0)
+        ev_hi = (1.0 + sigma + rho) + rho * a_inf * a_one
+    else:
+        ev_hi = jnp.zeros((), dtype)
+
+    return _PairOps(J=J, coef_s=coef_s, b_s=b_s, q=q,
+                    lo=jnp.broadcast_to(lo, (N, 2)).reshape(-1),
+                    hi=jnp.broadcast_to(hi, (N, 2)).reshape(-1),
+                    d=d, coef=coef, b_pair=b_pair, ev_hi=ev_hi)
+
+
+def _iteration_fns(I, N, settings, axis_name=None, agent_k=None,
+                   rows_start=0):
+    """(step, residuals, init_carry) over (_PairOps, carry) — the solver's
+    iteration machinery, factored so four drivers share ONE definition:
+    the single-problem scan/while in :func:`solve_pair_box_qp_admm`, the
+    lockstep batched driver (which vmaps these over the member axis), the
+    chain-depth analysis hook (:func:`admm_iteration_spec`), and tests.
+
+    Carry layout: (x, z_p, z_b, y_p, y_b) — plus a trailing ``Ax`` (the
+    scaled-geometry pair image of the CURRENT x, recomputed exactly from
+    x each iteration, never accumulated) in fused mode. The EXTERNAL
+    warm-state contract stays the 5-tuple: init_carry derives the pair
+    image from a 5-tuple warm state with one gather, and callers strip it
+    before returning a carry (certificate_solver_seed, checkpoints, and
+    the ensemble scan carry are all fused-agnostic)."""
+    rho, sigma, alpha = settings.rho, settings.sigma, settings.alpha
+    fused = settings.fused
+    ev_lo = 1.0 + sigma + rho
+
+    def _ops_K(ops):
+        apply_K, A_pair, _A_pair_T = _make_apply_K(
+            ops.coef_s, I, ops.J, rho, sigma, dtype=ops.coef_s.dtype,
+            axis_name=axis_name, agent_k=agent_k, rows_start=rows_start,
+            one_pass=fused)
+        return apply_K, A_pair, (lambda y: _A_pair_T(y, N))
+
+    def step(ops, carry):
+        apply_K, A_pair, A_pair_T = _ops_K(ops)
+        if fused:
+            x, z_p, z_b, y_p, y_b, Ax = carry
+            # rhs - K x in one transpose: the sigma*x proximal term and
+            # the (1+sigma+rho)x diagonal of K cancel to -(1+rho)x, and
+            # the carried pair image supplies K's A^T A term — no
+            # apply_K(x_warm) matvec, one fused scatter.
+            r0 = (A_pair_T(rho * z_p - y_p - rho * Ax).reshape(-1)
+                  + (rho * z_b - y_b) - ops.q - (1.0 + rho) * x)
+            if settings.ksolve == "chebyshev":
+                dx = _chebyshev(apply_K, r0, settings.cg_iters, ev_lo,
+                                ops.ev_hi, vma_ref=ops.coef_s[0, 0])
+            else:
+                dx = _cg(apply_K, r0, settings.cg_iters,
+                         vma_ref=ops.coef_s[0, 0])
+            x_new = x + dx
+        else:
+            x, z_p, z_b, y_p, y_b = carry
+            # rhs = sigma x - q + A^T (rho z - y), split over the blocks.
+            rhs = (sigma * x - ops.q
+                   + A_pair_T(rho * z_p - y_p).reshape(-1)
+                   + (rho * z_b - y_b))
+            x_new = _solve_K(settings.cg_iters,
+                             (rho, sigma, axis_name, agent_k),
+                             ops.coef_s, I, ops.J, rows_start, rhs, x)
+        Ax_p = A_pair(x_new.reshape(N, 2))
+        z_p_new, y_p_new = relaxed_zy_update(
+            Ax_p, z_p, y_p, rho, alpha, lambda w: jnp.minimum(w, ops.b_s))
+        z_b_new, y_b_new = relaxed_zy_update(
+            x_new, z_b, y_b, rho, alpha,
+            lambda w: jnp.clip(w, ops.lo, ops.hi))
+        new = (x_new, z_p_new, z_b_new, y_p_new, y_b_new)
+        return new + ((Ax_p,) if fused else ())
+
+    def residuals(ops, carry):
+        """(primal, dual) in the ORIGINAL row geometry (d > 0 leaves the
+        feasible set unchanged; the dual residual is scale-invariant, cf.
+        solvers.admm). Partitioned mode: viol_p sees only local rows ->
+        pmax completes it; the dual vector's A^T term is already psummed
+        inside A_pair_T. Fused mode: the carried pair image is EXACTLY
+        A_pair(x) in scaled geometry, so the primal check unscales it
+        (Ax_s = d * Ax_orig) instead of paying a fresh pair gather."""
+        x, y_p, y_b = carry[0], carry[3], carry[4]
+        _, _, A_pair_T = _ops_K(ops)
+        u = x.reshape(N, 2)
+        if fused:
+            Ax_orig = carry[5] / ops.d
+        else:
+            Ax_orig = jnp.sum(ops.coef * (u[I] - u[ops.J]), axis=1)
+        viol_p = jnp.max(jnp.maximum(Ax_orig - ops.b_pair, 0.0),
+                         initial=0.0)
+        if axis_name is not None:
+            viol_p = lax.pmax(viol_p, axis_name)
+        viol_b = jnp.max(jnp.maximum(
+            jnp.maximum(ops.lo - x, x - ops.hi), 0.0), initial=0.0)
+        primal = jnp.maximum(viol_p, viol_b)
+        dual_vec = (x + ops.q + A_pair_T(y_p).reshape(-1) + y_b)
+        dual = jnp.max(jnp.abs(dual_vec))
+        return primal, dual
+
+    def init_carry(ops, warm_state):
+        R = ops.J.shape[0]
+        dtype = ops.q.dtype
+        if warm_state is not None:
+            carry = tuple(warm_state)
+            if fused and len(carry) == 5:
+                _, A_pair, _ = _ops_K(ops)
+                carry = carry + (A_pair(carry[0].reshape(N, 2)),)
+            return carry
+        # match_vma: see solvers.admm — zero carries must match the problem
+        # data's varying-manual-axes type under shard_map. In row-partitioned
+        # mode the x/z_b carries additionally pick up coef_s's axes through
+        # _cg's vma_ref, so pre-align them with both (chaining unions axes).
+        x0 = match_vma(match_vma(jnp.zeros((2 * N,), dtype), ops.q),
+                       ops.coef_s[0, 0])
+        zp0 = match_vma(jnp.zeros((R,), dtype), ops.coef_s[:, 0])
+        carry = (x0, zp0, x0, zp0, x0)
+        if fused:
+            carry = carry + (zp0,)   # A_pair(0) == 0
+        return carry
+
+    return step, residuals, init_carry
+
+
+def _drive(step, residuals, ops, carry0, settings, vmapped=False):
+    """Run the ADMM loop — fixed scan (tol == 0, reverse-differentiable)
+    or adaptive while_loop of check_every-iteration blocks. ``vmapped``
+    turns it into the LOCKSTEP batched driver: step/residuals map over a
+    leading member axis while ONE shared while_loop drives all members —
+    exit when the WORST member's residual clears tol (sound: extra
+    iterations past a member's convergence only polish its solution), so
+    the serial chain's latency is paid once for E members' row work.
+
+    Returns (final_carry, iterations)."""
+    vstep = jax.vmap(step) if vmapped else step
+    vres = jax.vmap(residuals) if vmapped else residuals
+
+    if settings.tol > 0.0:
+        # Adaptive mode: check_every-iteration blocks inside a while_loop,
+        # stop at tol, capped at ceil(iters / check_every) blocks — the
+        # cap ROUNDS UP to a whole block when iters is not a multiple of
+        # check_every (a while_loop body needs a static scan length; the
+        # documented budget is the cap's upper bound, not an exact count).
+        # One XLA program, data-dependent trip count (legal in while_loop;
+        # NOT reverse-differentiable — the trainer keeps tol=0).
+        n_blocks = -(-settings.iters // settings.check_every)
+
+        def block(carry):
+            state, it = carry
+            state, _ = lax.scan(lambda s, _: (vstep(ops, s), None), state,
+                                None, length=settings.check_every)
+            return state, it + 1
+
+        def cond(carry):
+            state, it = carry
+            p, dd = vres(ops, state)
+            worst = jnp.max(jnp.maximum(p, dd))   # scalar or max over E
+            return (it < n_blocks) & (worst > settings.tol)
+
+        state, blocks_run = lax.while_loop(
+            cond, block, (carry0, jnp.asarray(0, jnp.int32)))
+        iterations = blocks_run * settings.check_every
+    else:
+        # scan, not fori_loop: reverse-differentiable (see _cg).
+        state, _ = lax.scan(lambda s, _: (vstep(ops, s), None), carry0,
+                            None, length=settings.iters)
+        iterations = jnp.asarray(settings.iters, jnp.int32)
+    return state, iterations
+
+
+def _validate_settings(settings, axis_name):
+    if settings.ksolve not in ("cg", "chebyshev"):
+        raise ValueError(
+            f"SparseADMMSettings.ksolve must be cg|chebyshev, got "
+            f"{settings.ksolve!r}")
+    if settings.ksolve == "chebyshev" and not settings.fused:
+        raise ValueError(
+            "SparseADMMSettings.ksolve='chebyshev' is the fused "
+            "iteration's inner solver — set fused=True (the unfused "
+            "x-update's implicit gradient is written against the CG "
+            "kernel)")
+    if settings.fused and axis_name is not None:
+        raise ValueError(
+            "SparseADMMSettings.fused is not supported in row-partitioned "
+            "mode (axis_name set): the carried pair image and the "
+            "spectral-bound reduction are unproven under shard_map "
+            "varying-manual-axes promotion — sharded solves keep the CG "
+            "path")
+    if settings.tol > 0.0 and axis_name is not None:
+        # The residual cond contains collectives (pmax, and the psum
+        # inside A_pair_T) — collectives inside a while_loop cond are
+        # unproven under shard_map. Reject HERE, at the one place the
+        # incompatibility lives, so direct callers of the sharded
+        # certificate get a clear error instead of an obscure tracer
+        # failure (parallel.ensemble's config check is then a friendlier
+        # early copy, not load-bearing).
+        raise ValueError(
+            "SparseADMMSettings.tol > 0 (adaptive budget) is not "
+            "supported in row-partitioned mode (axis_name set): the "
+            "while_loop's residual cond would run collectives — use "
+            "a fixed iteration budget for sharded solves")
+
+
 def solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
                            settings: SparseADMMSettings = SparseADMMSettings(),
                            axis_name: str | None = None,
@@ -280,131 +623,126 @@ def solve_pair_box_qp_admm(u_nom, I, J, coef, b_pair, lo, hi,
         mid-stream (neighbor rebuild without a frozen index set) is
         handing the solver a merely-suboptimal start, never a wrong
         answer. Not differentiable through the carried state (the
-        scenario threads it through the scan carry as data).
+        scenario threads it through the scan carry as data). The carry
+        format is fused-agnostic (always the 5-tuple): the fused path
+        derives its pair image with one gather at entry and strips it on
+        return.
     """
     N = u_nom.shape[0]
-    dtype = jnp.result_type(u_nom, coef)
-    rho, sigma, alpha = settings.rho, settings.sigma, settings.alpha
     rows_start = jnp.asarray(rows_start, jnp.int32)
+    _validate_settings(settings, axis_name)
 
-    # Row equilibration (same lesson as the dense solver: mixed row scales
-    # stall fixed-rho ADMM). Pair row norm = ||(-c, +c)|| = sqrt(2)*||c||;
-    # box rows are unit already. Zero (padding) rows get d=1 and stay
-    # inert — via safe_norm: ||.||'s raw gradient at an exactly-zero row
-    # is 0/0, and on the trainer's reverse path that NaN would poison the
-    # whole parameter gradient even though the `where` takes the other
-    # branch (0 * NaN = NaN through the norm primitive's VJP).
-    c_norm = jnp.sqrt(2.0) * safe_norm(coef, axis=1)
-    d = jnp.where(c_norm > 1e-10, 1.0 / jnp.maximum(c_norm, 1e-10), 1.0)
-    coef_s = coef * d[:, None]
-    b_s = jnp.where(jnp.isfinite(b_pair), b_pair * d, b_pair)
+    ops = _prepare_ops(u_nom, I, J, coef, b_pair, lo, hi, settings,
+                       axis_name=axis_name, agent_k=agent_k,
+                       rows_start=rows_start)
+    step, residuals, init_carry = _iteration_fns(
+        I, N, settings, axis_name=axis_name, agent_k=agent_k,
+        rows_start=rows_start)
+    carry0 = init_carry(ops, warm_state)
+    state, iterations = _drive(step, residuals, ops, carry0, settings)
 
-    _, A_pair, _A_pair_T = _make_apply_K(coef_s, I, J, rho, sigma,
-                                         dtype=dtype, axis_name=axis_name,
-                                         agent_k=agent_k,
-                                         rows_start=rows_start)
-    A_pair_T = lambda y: _A_pair_T(y, N)             # noqa: E731
-
-    q = -u_nom.reshape(-1)
-
-    def step(carry, _):
-        x, z_p, z_b, y_p, y_b = carry
-        # rhs = sigma x - q + A^T (rho z - y), split over the two blocks.
-        rhs = (sigma * x - q
-               + A_pair_T(rho * z_p - y_p).reshape(-1)
-               + (rho * z_b - y_b))
-        x_new = _solve_K(settings.cg_iters,
-                         (rho, sigma, axis_name, agent_k),
-                         coef_s, I, J, rows_start, rhs, x)
-        Ax_p = A_pair(x_new.reshape(N, 2))
-        Ax_b = x_new
-        Axr_p = alpha * Ax_p + (1.0 - alpha) * z_p
-        Axr_b = alpha * Ax_b + (1.0 - alpha) * z_b
-        z_p_new = jnp.minimum(Axr_p + y_p / rho, b_s)      # lower = -inf
-        z_b_new = jnp.clip(Axr_b + y_b / rho,
-                           lo.reshape(-1), hi.reshape(-1))
-        y_p_new = y_p + rho * (Axr_p - z_p_new)
-        y_b_new = y_b + rho * (Axr_b - z_b_new)
-        return (x_new, z_p_new, z_b_new, y_p_new, y_b_new), None
-
-    def residuals(x, y_p, y_b):
-        """(primal, dual) in the ORIGINAL row geometry (d > 0 leaves the
-        feasible set unchanged; the dual residual is scale-invariant, cf.
-        solvers.admm). Partitioned mode: viol_p sees only local rows ->
-        pmax completes it; the dual vector's A^T term is already psummed
-        inside A_pair_T."""
-        u = x.reshape(N, 2)
-        Ax_orig = jnp.sum(coef * (u[I] - u[J]), axis=1)
-        viol_p = jnp.max(jnp.maximum(Ax_orig - b_pair, 0.0), initial=0.0)
-        if axis_name is not None:
-            viol_p = lax.pmax(viol_p, axis_name)
-        viol_b = jnp.max(jnp.maximum(
-            jnp.maximum(lo.reshape(-1) - x, x - hi.reshape(-1)), 0.0),
-            initial=0.0)
-        primal = jnp.maximum(viol_p, viol_b)
-        dual_vec = (x + q + A_pair_T(y_p).reshape(-1) + y_b)
-        dual = jnp.max(jnp.abs(dual_vec))
-        return primal, dual
-
-    R = I.shape[0]
-    if warm_state is not None:
-        carry0 = warm_state
-    else:
-        # match_vma: see solvers.admm — zero carries must match the problem
-        # data's varying-manual-axes type under shard_map. In row-partitioned
-        # mode the x/z_b carries additionally pick up coef_s's axes through
-        # _cg's vma_ref, so pre-align them with both (chaining unions axes).
-        x0 = match_vma(match_vma(jnp.zeros((2 * N,), dtype), q),
-                       coef_s[0, 0])
-        zp0 = match_vma(jnp.zeros((R,), dtype), coef_s[:, 0])
-        carry0 = (x0, zp0, x0, zp0, x0)
-
-    if settings.tol > 0.0:
-        if axis_name is not None:
-            # The residual cond below contains collectives (pmax, and the
-            # psum inside A_pair_T) — collectives inside a while_loop cond
-            # are unproven under shard_map. Reject HERE, at the one place
-            # the incompatibility lives, so direct callers of the sharded
-            # certificate get a clear error instead of an obscure tracer
-            # failure (parallel.ensemble's config check is then a
-            # friendlier early copy, not load-bearing).
-            raise ValueError(
-                "SparseADMMSettings.tol > 0 (adaptive budget) is not "
-                "supported in row-partitioned mode (axis_name set): the "
-                "while_loop's residual cond would run collectives — use "
-                "a fixed iteration budget for sharded solves")
-        # Adaptive mode: check_every-iteration blocks inside a while_loop,
-        # stop at tol, capped at ceil(iters / check_every) blocks — the
-        # cap ROUNDS UP to a whole block when iters is not a multiple of
-        # check_every (a while_loop body needs a static scan length; the
-        # documented budget is the cap's upper bound, not an exact count).
-        # One XLA program, data-dependent trip count (legal in while_loop;
-        # NOT reverse-differentiable — the trainer keeps tol=0).
-        n_blocks = -(-settings.iters // settings.check_every)
-
-        def block(carry):
-            state, it = carry
-            state, _ = lax.scan(step, state, None,
-                                length=settings.check_every)
-            return state, it + 1
-
-        def cond(carry):
-            state, it = carry
-            p, dd = residuals(state[0], state[3], state[4])
-            return (it < n_blocks) & (jnp.maximum(p, dd) > settings.tol)
-
-        (x, z_p, z_b, y_p, y_b), blocks_run = lax.while_loop(
-            cond, block, (carry0, jnp.asarray(0, jnp.int32)))
-        iterations = blocks_run * settings.check_every
-    else:
-        # scan, not fori_loop: reverse-differentiable (see _cg).
-        (x, z_p, z_b, y_p, y_b), _ = lax.scan(
-            step, carry0, None, length=settings.iters)
-        iterations = jnp.asarray(settings.iters, jnp.int32)
-
-    u = x.reshape(N, 2)
-    primal, dual = residuals(x, y_p, y_b)
+    u = state[0].reshape(N, 2)
+    primal, dual = residuals(ops, state)
     info = SparseADMMInfo(primal, dual, iterations)
     if with_state:
-        return u, info, (x, z_p, z_b, y_p, y_b)
+        return u, info, tuple(state[:5])
     return u, info
+
+
+def solve_pair_box_qp_admm_batched(
+        u_nom, I, J, coef, b_pair, lo, hi,
+        settings: SparseADMMSettings = SparseADMMSettings(),
+        agent_k: int | None = None, warm_state=None,
+        with_state: bool = False):
+    """Lockstep-batched twin of :func:`solve_pair_box_qp_admm`: E members'
+    solves through ONE shared iteration loop.
+
+    The certificate solve is latency-bound on its serial per-iteration
+    chain (module docstring) — under a per-member vmap of the whole solve
+    each member pays that chain alone. Here the member axis is packed
+    INTO each op instead: step/residuals are vmapped over the leading
+    axis and a single scan/while_loop drives them, so every serialized
+    gather/scatter carries E members' rows and the chain's latency is
+    amortized E-fold. Under ``tol > 0`` the loop exits when the WORST
+    member's residual clears tol (max-residual exit): members that
+    converged earlier simply keep polishing — sound, since extra ADMM
+    iterations never leave the feasible-set fixed point — and the
+    reported per-member iteration count is the shared trip count.
+
+    Args: as the single-problem entry, with a leading member axis E on
+    ``u_nom`` (E, N, 2), ``J`` (E, R), ``coef`` (E, R, 2), ``b_pair``
+    (E, R), ``lo``/``hi`` (E, N, 2), and (optionally) each leaf of
+    ``warm_state``. ``I`` stays shared (R,) — the certificate's
+    agent-major layout is member-invariant, and that is what lets
+    ``agent_k`` apply to every member at once. Row-partitioned mode does
+    not compose (lockstep batching amortizes the chain the OTHER way);
+    no axis_name parameter.
+
+    Returns (u (E, N, 2), SparseADMMInfo with (E,) residuals and (E,)
+    iterations)[, carry — a 5-tuple of (E, ...) leaves].
+    """
+    if u_nom.ndim != 3:
+        raise ValueError(
+            f"batched solver needs (E, N, 2) nominals, got {u_nom.shape}")
+    if J.ndim != 2:
+        raise ValueError(
+            f"batched solver needs a member-batched (E, R) J, got "
+            f"{J.shape} (I stays shared — see the docstring)")
+    E, N = u_nom.shape[0], u_nom.shape[1]
+    _validate_settings(settings, None)
+    rows_start = jnp.asarray(0, jnp.int32)
+
+    ops = jax.vmap(
+        lambda un, j, c, b, l, h: _prepare_ops(
+            un, I, j, c, b, l, h, settings, axis_name=None,
+            agent_k=agent_k, rows_start=rows_start)
+    )(u_nom, J, coef, b_pair, lo, hi)
+    step, residuals, init_carry = _iteration_fns(
+        I, N, settings, axis_name=None, agent_k=agent_k,
+        rows_start=rows_start)
+    if warm_state is None:
+        carry0 = jax.vmap(lambda o: init_carry(o, None))(ops)
+    else:
+        carry0 = jax.vmap(init_carry)(ops, tuple(warm_state))
+    state, iterations = _drive(step, residuals, ops, carry0, settings,
+                               vmapped=True)
+
+    u = state[0].reshape(E, N, 2)
+    primal, dual = jax.vmap(residuals)(ops, state)
+    info = SparseADMMInfo(primal, dual,
+                          jnp.broadcast_to(iterations, (E,)))
+    if with_state:
+        return u, info, tuple(state[:5])
+    return u, info
+
+
+def admm_iteration_spec(N: int = 64, k: int = 8,
+                        settings: SparseADMMSettings = SparseADMMSettings(),
+                        agent_k: int | None = None):
+    """(step_fn, carry0): ONE ADMM iteration as a unary function of its
+    carry, on a deterministic synthetic agent-major pair problem — the
+    tracing hook for scripts/chain_depth.py and the chain-depth
+    regression test (tests/test_fused_batched.py). The synthetic rows use
+    the certificate builders' layout (I = repeat(arange(N), k), J never
+    self) with non-degenerate directions, so the traced jaxpr contains
+    exactly the production iteration's op structure."""
+    idx = np.arange(N * k)
+    I = jnp.asarray(np.repeat(np.arange(N), k), jnp.int32)
+    J = jnp.asarray((np.repeat(np.arange(N), k) + 1 + idx % (N - 1)) % N,
+                    jnp.int32)
+    ang = 0.1 + 0.7 * (idx % 13)
+    coef = jnp.asarray(np.stack([np.cos(ang), np.sin(ang)], axis=1),
+                       jnp.float32)
+    b_pair = jnp.full((N * k,), 0.5, jnp.float32)
+    t = np.arange(N)
+    u_nom = jnp.asarray(0.1 * np.stack([np.cos(t), np.sin(t)], axis=1),
+                        jnp.float32)
+    lo = jnp.full((N, 2), -1.0, jnp.float32)
+    hi = jnp.full((N, 2), 1.0, jnp.float32)
+    rows_start = jnp.asarray(0, jnp.int32)
+    _validate_settings(settings, None)
+    ops = _prepare_ops(u_nom, I, J, coef, b_pair, lo, hi, settings,
+                       agent_k=agent_k, rows_start=rows_start)
+    step, _, init_carry = _iteration_fns(I, N, settings, agent_k=agent_k,
+                                         rows_start=rows_start)
+    return (lambda carry: step(ops, carry)), init_carry(ops, None)

@@ -30,6 +30,19 @@ def l2_cap(x, limit, axis=-1):
     return x * jnp.minimum(1.0, limit / jnp.maximum(mag, 1e-9))
 
 
+def axis_size(axis_name) -> int:
+    """Static size of a named mesh axis, version-portable: newer JAX has
+    ``lax.axis_size``; older releases (this container's 0.4.x) spell the
+    same static query ``psum(1, axis)`` — special-cased for int literals
+    to fold to the axis size at trace time, no collective emitted. Every
+    shard_map body queries through here so the framework runs on both."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
 def match_vma(x, ref):
     """Give ``x`` the same varying-manual-axes type as ``ref``.
 

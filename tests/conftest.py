@@ -68,10 +68,16 @@ def _bounded_executable_accumulation():
 
 @pytest.fixture
 def x64():
-    """Enable float64 within a test (strict oracle parity)."""
+    """Enable float64 within a test (strict oracle parity). jax.enable_x64
+    is newer-JAX public API; older releases (this container's 0.4.x) keep
+    the same context manager under jax.experimental — resolve whichever
+    exists so the float64 parity tests run on both."""
     import jax
 
-    with jax.enable_x64(True):
+    enable = getattr(jax, "enable_x64", None)
+    if enable is None:
+        from jax.experimental import enable_x64 as enable
+    with enable(True):
         yield
 
 
