@@ -80,6 +80,14 @@ Modes / env knobs:
     measured window (TensorBoard trace-viewer format) into <dir>; the
     wall number still excludes warmup but includes tracing overhead, so
     profile runs are for tuning, not records.
+  BENCH_TELEMETRY=<dir> — stream in-flight telemetry (cbf_tpu.obs:
+    manifest + JSONL heartbeats, watchdog alerts) into a fresh run
+    directory under <dir>; tail it live with
+    `python -m cbf_tpu obs tail <dir> --latest --follow`.
+    BENCH_TELEMETRY_EVERY (50) sets the sampling interval. The measured
+    wall INCLUDES the tap (budgeted <= 3% — docs/BENCH_LOG.md Round 7);
+    like profiled runs, telemetry runs are labeled in the record and
+    excluded from the last-verified headline.
   BENCH_ENSEMBLE=1 (or --ensemble) — dp-sharded ensemble of independent
     swarms over all available devices (the multi-chip measurement path for
     the v4-8 ladder rung); adds "chips" + "scaling_efficiency" fields.
@@ -197,7 +205,7 @@ def _maybe_update_last_verified(result: dict) -> None:
             return
         if not re.match(_HEADLINE_METRIC_RE, result.get("metric", "")):
             return
-        if "profiled" in result:
+        if "profiled" in result or "telemetry" in result:
             return
         # One read serves both the comparison and the rewrite (no window
         # where they diverge); unknown keys (the file's self-documenting
@@ -421,6 +429,46 @@ def _label_certificate(result: dict, cert_res: float,
             result["certificate_iters_max"] = int(it.max())
 
 
+def _telemetry_sink(mode: str, cfg=None):
+    """(sink, watchdog, run_dir) for the BENCH_TELEMETRY knob, or
+    (None, None, None). The manifest carries every BENCH_* knob — the
+    bench record's provenance contract extended to the stream."""
+    root = os.environ.get("BENCH_TELEMETRY")
+    if not root:
+        return None, None, None
+    from cbf_tpu import obs
+
+    run_dir = os.path.join(root, time.strftime("%Y%m%d-%H%M%S") + "-" + mode)
+    knobs = {k: v for k, v in sorted(os.environ.items())
+             if k.startswith("BENCH_")}
+    sink = obs.TelemetrySink(run_dir, manifest=obs.build_manifest(
+        cfg, extra={"bench_mode": mode, "bench_knobs": knobs}))
+    watchdog = obs.Watchdog(sink)   # event-driven alerts; stalls are the
+    # reader's job here (obs tail --stall-timeout / tpu_watch.sh) — the
+    # bench child's own clock already enforces the attempt timeout.
+    print(f"bench: telemetry -> {run_dir} "
+          f"(every {_env_int('BENCH_TELEMETRY_EVERY', 50)} steps)",
+          file=sys.stderr)
+    return sink, watchdog, run_dir
+
+
+def _finish_telemetry(sink, watchdog, result: dict, run_dir) -> None:
+    """Close out the stream and label the record (never the headline —
+    _maybe_update_last_verified skips telemetry runs like profiled ones)."""
+    if sink is None:
+        return
+    watchdog.stop()
+    summary = {"heartbeats": sink.heartbeat_count}
+    if "value" in result:
+        summary["rate"] = result["value"]
+    sink.summary(summary)
+    sink.close()
+    result["telemetry"] = run_dir
+    result["telemetry_heartbeats"] = sink.heartbeat_count
+    if watchdog.alerts:
+        result["telemetry_alerts"] = [a.kind for a in watchdog.alerts]
+
+
 def _profile_ctx():
     """(context manager, bool) for the BENCH_PROFILE knob: a jax.profiler
     trace of the measured window, or a null context. Shared by both bench
@@ -484,6 +532,8 @@ def _child_single(n: int, steps: int) -> dict:
                        certificate_check_every=cert_check,
                        certificate_fused=cert_fused)
     state0, step = swarm.make(cfg)
+    sink, watchdog, tele_dir = _telemetry_sink("single", cfg)
+    tele_every = _env_int("BENCH_TELEMETRY_EVERY", 50)
     # Certificate steps are ~2 orders of magnitude slower than filter-only
     # ones (the ADMM's dependent iteration chain — latency-, not
     # flops-bound), and the tunneled worker KILLS any single device
@@ -510,9 +560,15 @@ def _child_single(n: int, steps: int) -> dict:
     # chunk (a distinct static scan length that would otherwise compile
     # inside the timed window).
     t0 = time.time()
+    if sink is not None:
+        # Warm the INSTRUMENTED executable (the tap changes the compiled
+        # program) with the stream paused: the measured run reuses it,
+        # and warmup heartbeats never pollute the run's event record.
+        sink.pause()
     for w in dict.fromkeys((chunk, steps % chunk or chunk)):
         final, _, _ = rollout_chunked(step, state0, w, chunk=w,
-                                      unroll=unroll)
+                                      unroll=unroll, telemetry=sink,
+                                      telemetry_every=tele_every)
         jax.block_until_ready(final.x)
     if checkpointing:
         # Warm the PROCESS-WIDE checkpoint machinery (orbax/tensorstore
@@ -537,11 +593,15 @@ def _child_single(n: int, steps: int) -> dict:
 
     ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_") if checkpointing else None
     try:
+        if sink is not None:
+            sink.resume()
         with prof:
             t0 = time.time()
             final, outs, _ = rollout_chunked(step, state0, steps, chunk=chunk,
                                              checkpoint_dir=ckpt_dir,
-                                             resume=False, unroll=unroll)
+                                             resume=False, unroll=unroll,
+                                             telemetry=sink,
+                                             telemetry_every=tele_every)
             jax.block_until_ready(final.x)
             wall = time.time() - t0
     finally:
@@ -559,12 +619,16 @@ def _child_single(n: int, steps: int) -> dict:
 
     err = _check_safety(min_dist, infeasible, floor=_dynamics_floor(dynamics))
     if err:
-        return {"error": err, "retryable": False}
+        result = {"error": err, "retryable": False}
+        _finish_telemetry(sink, watchdog, result, tele_dir)
+        return result
     if certificate:
         cert_err, cert_res, cert_dropped = _gate_certificate(
             outs.certificate_residual, outs.certificate_dropped_count)
         if cert_err:
-            return {"error": cert_err, "retryable": False}
+            result = {"error": cert_err, "retryable": False}
+            _finish_telemetry(sink, watchdog, result, tele_dir)
+            return result
 
     result = {
         "metric": "agent-QP-steps/sec/chip (swarm N=%d)" % n,
@@ -630,6 +694,7 @@ def _child_single(n: int, steps: int) -> dict:
     if certificate:
         _label_certificate(result, cert_res, cert_dropped,
                            outs.certificate_iterations)
+    _finish_telemetry(sink, watchdog, result, tele_dir)
     return result
 
 
@@ -698,6 +763,8 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
                        certificate_check_every=cert_check,
                        certificate_fused=cert_fused)
     seeds = list(range(E))
+    sink, watchdog, tele_dir = _telemetry_sink("ensemble", cfg)
+    tele_every = _env_int("BENCH_TELEMETRY_EVERY", 50)
 
     print(f"bench: ensemble E={E} x swarm N={n}, steps={steps}, "
           f"chips={chips}", file=sys.stderr)
@@ -720,8 +787,14 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
     prof, profiled = _profile_ctx()
     with prof:
         t0 = time.time()
+        # Telemetry on the ensemble path is HOST-side (per-chunk metric
+        # offload, obs.tap.emit_ensemble_chunk) — the compiled program is
+        # identical with or without it, so only the measured call carries
+        # the sink. Unchunked, the heartbeats land when the segment
+        # completes (the stream/schema are the same).
         final, mets = sharded_swarm_rollout(cfg, mesh, seeds, steps=steps,
-                                            t0=1)
+                                            t0=1, telemetry=sink,
+                                            telemetry_every=tele_every)
         jax.block_until_ready(final[0])
         np.asarray(final[0])
         wall = time.time() - t0
@@ -739,12 +812,16 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
     if err:
         print(f"bench: wall={wall:.3f}s, min_dist={min_dist:.4f}, "
               f"infeasible={infeasible}", file=sys.stderr)
-        return {"error": err, "retryable": False}
+        result = {"error": err, "retryable": False}
+        _finish_telemetry(sink, watchdog, result, tele_dir)
+        return result
     if certificate:
         cert_err, cert_res, cert_dropped = _gate_certificate(
             mets.certificate_residual, mets.certificate_dropped)
         if cert_err:
-            return {"error": cert_err, "retryable": False}
+            result = {"error": cert_err, "retryable": False}
+            _finish_telemetry(sink, watchdog, result, tele_dir)
+            return result
 
     if chips == 1:
         efficiency = 1.0   # vs itself by construction — skip the extra runs
@@ -820,6 +897,7 @@ def _child_ensemble(n: int, steps: int, per_device: int) -> dict:
     if certificate:
         _label_certificate(result, cert_res, cert_dropped,
                            mets.certificate_iterations)
+    _finish_telemetry(sink, watchdog, result, tele_dir)
     return result
 
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
 
 
@@ -131,19 +132,45 @@ def cmd_run(args) -> int:
     state0, step = module.make(cfg)
     steps = getattr(cfg, steps_field)
 
+    sink = watchdog = None
+    if args.telemetry_dir:
+        from cbf_tpu import obs
+
+        sink = obs.TelemetrySink(
+            args.telemetry_dir,
+            manifest=obs.build_manifest(cfg, extra={
+                "scenario": args.scenario, "steps": steps}))
+        # Event-driven alert classes always on; the stall thread only when
+        # a timeout is given (compile time counts toward the first
+        # heartbeat — pick a timeout that covers it).
+        watchdog = obs.Watchdog(sink, stall_timeout=args.stall_timeout)
+
     prof = (profiling.trace(args.profile_dir) if args.profile_dir
             else contextlib.nullcontext())
-    with prof:
-        if args.checked:
-            final, outs = checked_rollout(step, state0, steps)
-            start = 0
-        elif args.checkpoint_dir:
-            final, outs, start = rollout_chunked(
-                step, state0, steps, chunk=args.chunk,
-                checkpoint_dir=args.checkpoint_dir, resume=not args.no_resume)
-        else:
-            final, outs = rollout(step, state0, steps)
-            start = 0
+    try:
+        with prof:
+            if args.checked:
+                checked_step = step
+                if sink is not None:
+                    from cbf_tpu.obs.tap import instrument_step
+
+                    checked_step = instrument_step(
+                        step, sink, every=args.telemetry_every)
+                final, outs = checked_rollout(checked_step, state0, steps)
+                start = 0
+            elif args.checkpoint_dir:
+                final, outs, start = rollout_chunked(
+                    step, state0, steps, chunk=args.chunk,
+                    checkpoint_dir=args.checkpoint_dir,
+                    resume=not args.no_resume, telemetry=sink,
+                    telemetry_every=args.telemetry_every)
+            else:
+                final, outs = rollout(step, state0, steps, telemetry=sink,
+                                      telemetry_every=args.telemetry_every)
+                start = 0
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
 
     record = {"scenario": args.scenario, "config": {
         f.name: repr(getattr(cfg, f.name)) for f in dataclasses.fields(cfg)}}
@@ -151,6 +178,12 @@ def cmd_run(args) -> int:
         record.update(summarize(outs))
     if start:
         record["resumed_from_step"] = start
+    if sink is not None:
+        sink.summary()
+        sink.close()
+        record["telemetry"] = sink.run_dir
+        record["telemetry_heartbeats"] = sink.heartbeat_count
+        record["telemetry_alerts"] = [a.kind for a in watchdog.alerts]
     if args.video and outs is not None:
         record["video"] = renderer(outs, cfg, args.video, start)
     if args.traj and outs is not None:
@@ -185,6 +218,74 @@ def _write_traj(path: str, outs, layout: str) -> str:
         return path
     np.save(path + ".npy", traj)         # graceful degradation
     return path + ".npy"
+
+
+def _resolve_run_dir(path: str, latest: bool, *, wait: bool = False) -> str:
+    """``--latest``: treat ``path`` as a ROOT holding run directories and
+    pick the one with the newest events.jsonl (optionally waiting for one
+    to appear — the watch-a-sweep-that-hasn't-started-yet case)."""
+    import time
+
+    from cbf_tpu.obs import schema as obs_schema
+
+    if not latest:
+        return path
+    deadline = time.time() + (3600.0 if wait else 0.0)
+    while True:
+        candidates = []
+        if os.path.isdir(path):
+            for name in os.listdir(path):
+                ev = os.path.join(path, name, obs_schema.EVENTS_FILENAME)
+                if os.path.isfile(ev):
+                    candidates.append((os.path.getmtime(ev),
+                                       os.path.join(path, name)))
+            ev = os.path.join(path, obs_schema.EVENTS_FILENAME)
+            if os.path.isfile(ev):
+                candidates.append((os.path.getmtime(ev), path))
+        if candidates:
+            return max(candidates)[1]
+        if time.time() >= deadline:
+            raise SystemExit(
+                f"no run directory with {obs_schema.EVENTS_FILENAME} "
+                f"under {path}")
+        time.sleep(1.0)
+
+
+def cmd_obs_tail(args) -> int:
+    """Stream a run's JSONL events to stdout (one JSON line each — the
+    file format IS the wire format). --follow keeps tailing until the
+    summary event; --stall-timeout adds reader-side stall detection: a
+    silent stream yields one synthetic stall alert and exits 3 (the
+    tpu_watch.sh contract)."""
+    from cbf_tpu.obs.sink import tail_events
+
+    run_dir = _resolve_run_dir(args.run_dir, args.latest, wait=args.follow)
+    stalled = False
+    for event in tail_events(run_dir, follow=args.follow,
+                             stall_timeout=args.stall_timeout):
+        print(json.dumps(event), flush=True)
+        if event.get("event") == "alert" and event.get("kind") == "stall":
+            stalled = True
+    return 3 if stalled else 0
+
+
+def cmd_obs_summary(args) -> int:
+    """One aggregate JSON object for a run directory: the summary event if
+    the run wrote one, else a recomputation from the heartbeat stream
+    (crashed runs), plus the manifest's run identity."""
+    from cbf_tpu.obs.sink import read_manifest, summarize_run
+
+    run_dir = _resolve_run_dir(args.run_dir, args.latest)
+    summary = summarize_run(run_dir)
+    manifest = read_manifest(run_dir)
+    if manifest is not None:
+        summary["manifest"] = {
+            k: manifest.get(k) for k in ("created", "git_sha", "jax_version",
+                                         "topology", "scenario", "steps")
+            if k in manifest}
+    summary["run_dir"] = os.path.abspath(run_dir)
+    print(json.dumps(summary, indent=2))
+    return 0 if summary.get("heartbeats") else 1
 
 
 def cmd_list(_args) -> int:
@@ -240,12 +341,46 @@ def main(argv=None) -> int:
                       help="write a jax.profiler trace here")
     runp.add_argument("--checked", action="store_true",
                       help="run under checkify NaN/inf validation")
+    runp.add_argument("--telemetry-dir", default=None,
+                      help="stream in-flight telemetry (manifest + JSONL "
+                           "heartbeats/alerts) into this run directory; "
+                           "tail it live with `obs tail <dir> --follow`")
+    runp.add_argument("--telemetry-every", type=int, default=50,
+                      help="heartbeat sampling interval in steps "
+                           "(default 50)")
+    runp.add_argument("--stall-timeout", type=float, default=None,
+                      help="watchdog missed-heartbeat alert after this "
+                           "many silent seconds (default: off; first "
+                           "heartbeat waits on compile — size accordingly)")
     runp.set_defaults(fn=cmd_run)
 
     sub.add_parser("list", help="list scenarios + config knobs") \
         .set_defaults(fn=cmd_list)
     sub.add_parser("bench", help="run the driver benchmark") \
         .set_defaults(fn=cmd_bench)
+
+    obsp = sub.add_parser("obs", help="telemetry run-dir tools (tail, "
+                                      "summary)")
+    obs_sub = obsp.add_subparsers(dest="obs_command", required=True)
+    tailp = obs_sub.add_parser(
+        "tail", help="print a run's JSONL events; -f follows live")
+    tailp.add_argument("run_dir")
+    tailp.add_argument("--follow", "-f", action="store_true",
+                       help="keep tailing until the summary event")
+    tailp.add_argument("--stall-timeout", type=float, default=None,
+                       help="with --follow: emit a synthetic stall alert "
+                            "and exit 3 after this many heartbeat-less "
+                            "seconds")
+    tailp.add_argument("--latest", action="store_true",
+                       help="run_dir is a root; tail its newest run "
+                            "(waits for one to appear with --follow)")
+    tailp.set_defaults(fn=cmd_obs_tail)
+    sump = obs_sub.add_parser(
+        "summary", help="aggregate a run directory into one JSON object")
+    sump.add_argument("run_dir")
+    sump.add_argument("--latest", action="store_true",
+                      help="run_dir is a root; summarize its newest run")
+    sump.set_defaults(fn=cmd_obs_summary)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,41 @@
+"""Streaming telemetry: in-flight visibility for compiled rollouts.
+
+The framework's post-hoc observability (StepOutputs/EnsembleMetrics riding
+``lax.scan``) gains a live twin: a jit-safe tap streams sampled heartbeats
+out of the running program, a structured sink writes a schema-versioned
+JSONL event stream + run manifest, and a watchdog raises structured
+alerts (NaN, certificate blow-up, sustained infeasibility, stalls) while
+the run is still in flight — watch, tail, and kill early instead of
+autopsy.
+
+    from cbf_tpu import obs
+
+    sink = obs.TelemetrySink("runs/demo", manifest=obs.build_manifest(cfg))
+    with obs.Watchdog(sink, stall_timeout=60):
+        final, outs = rollout(step, state0, steps,
+                              telemetry=sink, telemetry_every=50)
+    sink.summary()
+
+    $ python -m cbf_tpu obs tail runs/demo --follow
+    $ python -m cbf_tpu obs summary runs/demo
+
+Schema: ``obs.schema`` (versioned; drift against StepOutputs/
+EnsembleMetrics is a tier-1 failure via scripts/obs_schema_audit.py).
+"""
+
+from cbf_tpu.obs.schema import SCHEMA_VERSION, HEARTBEAT_FIELDS
+from cbf_tpu.obs.sink import (MetricsRegistry, TelemetrySink, build_manifest,
+                              read_events, read_manifest, summarize_run,
+                              tail_events)
+from cbf_tpu.obs.tap import emit_ensemble_chunk, instrument_step
+from cbf_tpu.obs.watchdog import (ALERT_CERT_BLOWUP, ALERT_INFEASIBLE,
+                                  ALERT_KINDS, ALERT_NAN, ALERT_STALL, Alert,
+                                  Watchdog)
+
+__all__ = [
+    "SCHEMA_VERSION", "HEARTBEAT_FIELDS", "MetricsRegistry", "TelemetrySink",
+    "build_manifest", "read_events", "read_manifest", "summarize_run",
+    "tail_events", "emit_ensemble_chunk", "instrument_step", "Alert",
+    "Watchdog", "ALERT_KINDS", "ALERT_NAN", "ALERT_CERT_BLOWUP",
+    "ALERT_INFEASIBLE", "ALERT_STALL",
+]

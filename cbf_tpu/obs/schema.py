@@ -1,0 +1,160 @@
+"""Telemetry event schema: the ONE mapping from in-program observability
+records (rollout.engine.StepOutputs, parallel.ensemble.EnsembleMetrics) to
+the streamed heartbeat fields.
+
+Everything the compiled hot loop can report post-hoc must be streamable
+in-flight under the same name, or carry an explicit exclusion reason —
+``scripts/obs_schema_audit.py`` (a tier-1 test) fails the build when a
+StepOutputs/EnsembleMetrics field is missing from both tables, so the
+telemetry stream cannot silently drift behind the metrics structs.
+
+Events are JSON objects, one per line (JSONL), every one carrying
+``schema`` = :data:`SCHEMA_VERSION`. Event types:
+
+- ``heartbeat`` — sampled in-flight snapshot: ``step`` (global step index),
+  ``t_wall`` (host receive time, s), ``step_rate`` (steps/s since the
+  previous heartbeat; null on the first), plus one key per tracked
+  :data:`HEARTBEAT_FIELDS` entry. Ensemble-path heartbeats additionally
+  carry ``ensemble_members`` (the member count the values were reduced
+  over).
+- ``alert`` — structured watchdog verdict: ``kind`` (one of
+  ``obs.watchdog.ALERT_KINDS``), ``step`` (int or null for host-side
+  alerts like stalls), ``detail`` (human-readable one-liner), ``t_wall``.
+- ``summary`` — run-end aggregate: the sink's counters/gauges/histograms
+  snapshot (``metrics``) plus ``heartbeats`` / ``alerts`` totals.
+
+The run manifest is a separate ``manifest.json`` in the run directory
+(written once at run start — see ``obs.sink.build_manifest``), also
+stamped with ``schema``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, NamedTuple
+
+SCHEMA_VERSION = 1
+
+EVENT_TYPES = ("heartbeat", "alert", "summary")
+
+#: Name of the per-run manifest file inside a run directory.
+MANIFEST_FILENAME = "manifest.json"
+#: Name of the event-stream file inside a run directory.
+EVENTS_FILENAME = "events.jsonl"
+
+
+class HeartbeatField(NamedTuple):
+    """One streamed heartbeat channel.
+
+    ``step_output`` / ``ensemble``: the corresponding StepOutputs /
+    EnsembleMetrics field name (None when the struct has no twin).
+    ``reduce``: how ensemble-member (and host) values fold into the one
+    streamed scalar — "min" | "max" | "sum".
+    ``kind``: "counter" (monotone accumulation across heartbeats — the
+    registry sums it) vs "gauge" (instantaneous level — the registry
+    tracks last/min/max and a histogram).
+    """
+    name: str
+    step_output: str | None
+    ensemble: str | None
+    reduce: str
+    kind: str
+
+
+HEARTBEAT_FIELDS: tuple[HeartbeatField, ...] = (
+    HeartbeatField("min_pairwise_distance", "min_pairwise_distance",
+                   "nearest_distance", "min", "gauge"),
+    HeartbeatField("filter_active_count", "filter_active_count",
+                   "engaged_count", "sum", "counter"),
+    HeartbeatField("infeasible_count", "infeasible_count",
+                   "infeasible_count", "sum", "counter"),
+    HeartbeatField("max_relax_rounds", "max_relax_rounds",
+                   None, "max", "gauge"),
+    HeartbeatField("gating_overflow_count", "gating_overflow_count",
+                   None, "sum", "counter"),
+    HeartbeatField("gating_dropped_count", "gating_dropped_count",
+                   "dropped_count", "sum", "counter"),
+    HeartbeatField("certificate_residual", "certificate_residual",
+                   "certificate_residual", "max", "gauge"),
+    HeartbeatField("certificate_dropped_count", "certificate_dropped_count",
+                   "certificate_dropped", "max", "counter"),
+    HeartbeatField("saturation_deficit", "saturation_deficit",
+                   "saturation_deficit", "max", "gauge"),
+    HeartbeatField("certificate_iterations", "certificate_iterations",
+                   "certificate_iterations", "max", "gauge"),
+    # Tap-computed (no struct twin): number of non-finite elements across
+    # the float leaves of the post-step STATE, evaluated only on sampled
+    # steps inside the tap's fire branch. Exists because XLA's min/max
+    # reductions swallow NaN (a NaN-corrupted swarm reports
+    # min_pairwise_distance 0.0, not NaN), so no StepOutputs channel
+    # reliably goes non-finite — this one counts the corruption directly
+    # and the watchdog's `nan` alert triggers on it (> 0).
+    HeartbeatField("nonfinite_state_count", None, None, "sum", "gauge"),
+)
+
+#: StepOutputs fields deliberately NOT streamed, with the reason — the
+#: schema audit requires every field to be here or in HEARTBEAT_FIELDS.
+EXCLUDED_STEP_OUTPUT_FIELDS: dict[str, str] = {
+    "trajectory": "bulk (N, 2) per-agent positions — recorded via "
+                  "record_trajectory/--traj and the native trajsink, not "
+                  "telemetry (a heartbeat is scalars)",
+}
+
+#: EnsembleMetrics fields deliberately NOT streamed (none today).
+EXCLUDED_ENSEMBLE_FIELDS: dict[str, str] = {}
+
+
+def step_output_channels() -> dict[str, HeartbeatField]:
+    """StepOutputs field name -> HeartbeatField for every streamed field."""
+    return {f.step_output: f for f in HEARTBEAT_FIELDS
+            if f.step_output is not None}
+
+
+def ensemble_channels() -> dict[str, HeartbeatField]:
+    """EnsembleMetrics field name -> HeartbeatField for every streamed
+    field."""
+    return {f.ensemble: f for f in HEARTBEAT_FIELDS
+            if f.ensemble is not None}
+
+
+def field_by_name(name: str) -> HeartbeatField:
+    for f in HEARTBEAT_FIELDS:
+        if f.name == name:
+            return f
+    raise KeyError(name)
+
+
+_REDUCERS = {"min": min, "max": max, "sum": sum}
+
+
+def reduce_members(field: HeartbeatField, values) -> float:
+    """Fold one heartbeat channel's per-member values (an iterable of
+    scalars) into the streamed scalar, per the field's declared reduction.
+    Used identically for ensemble members and for cross-host merges, so
+    the two reductions cannot diverge."""
+    vals = list(values)
+    if not vals:
+        raise ValueError(f"no values to reduce for {field.name}")
+    return _REDUCERS[field.reduce](vals)
+
+
+def json_scalar(v: Any):
+    """A JSON-encodable scalar for an event value: NaN/inf become strings
+    (JSON has no non-finite numbers; json.dumps would emit the non-standard
+    ``NaN`` literal that strict parsers — and the watchdog's reader — then
+    reject)."""
+    f = float(v)
+    if math.isnan(f):
+        return "nan"
+    if math.isinf(f):
+        return "inf" if f > 0 else "-inf"
+    if f == int(f) and abs(f) < 2**53:
+        return int(f)
+    return f
+
+
+def scalar_value(v: Any) -> float:
+    """Parse an event value back to float (inverse of :func:`json_scalar`)."""
+    if isinstance(v, str):
+        return float(v)
+    return float(v)

@@ -1,0 +1,149 @@
+"""Jit-safe telemetry tap: stream sampled StepOutputs out of the compiled
+hot loop without breaking ``lax.scan``/``jit`` or the chunked-rollout
+executable reuse.
+
+The tap is a pure step-fn wrapper (same composition contract as
+``utils.faults``): it runs the wrapped step, then — every ``every``-th
+global step, under ``lax.cond`` so skipped steps pay one integer compare —
+ships the step's scalar observables to the host through
+``jax.experimental.io_callback`` and hands the UNTOUCHED (state, outputs)
+back to the scan. The streamed values are the very same program values the
+scan stacks into StepOutputs, so a heartbeat at step t bit-matches the
+post-hoc ``StepOutputs[t]`` slice by construction (pinned by
+tests/test_telemetry.py).
+
+``ordered=False`` by default ("ordered only where required"): unordered
+callbacks let XLA overlap the host transfer with device compute, and the
+sink tolerates out-of-order delivery (step_rate only advances on forward
+progress). Pass ``ordered=True`` only when event ORDER itself is the
+signal (e.g. proving a stall happened after step k).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import io_callback
+
+from cbf_tpu.obs import schema
+from cbf_tpu.obs.sink import TelemetrySink
+
+
+def instrument_step(step_fn: Callable, sink: TelemetrySink, *,
+                    every: int = 50, ordered: bool = False) -> Callable:
+    """Wrap ``step_fn`` so every ``every``-th global step emits a heartbeat
+    into ``sink``. Static sampling interval: ``t % every == 0`` on the
+    global step index, so chunked/resumed rollouts sample the same steps a
+    single-scan rollout would.
+
+    Wrappers are cached on the sink per (step_fn, every, ordered): a
+    repeat rollout through the same sink reuses the identical function
+    object and therefore the jit cache (a fresh closure per call would
+    silently retrace every chunk).
+    """
+    if every < 1:
+        raise ValueError(f"telemetry every must be >= 1, got {every}")
+    key = (step_fn, every, ordered)
+    cached = sink._tap_cache.get(key)
+    if cached is not None:
+        return cached
+
+    def wrapped(state, t):
+        state, out = step_fn(state, t)
+        # Field selection happens at TRACE time: () leaves (untracked
+        # channels) and non-scalar leaves (trajectory) never enter the
+        # callback, so the payload is a handful of scalars.
+        names: list[str] = []
+        vals = []
+        for f in schema.HEARTBEAT_FIELDS:
+            if f.step_output is None:
+                continue
+            v = getattr(out, f.step_output)
+            if isinstance(v, tuple):
+                continue
+            if getattr(v, "ndim", 0) != 0:
+                continue
+            names.append(f.name)
+            vals.append(v)
+        n_metrics = len(vals)
+        # Post-step float state leaves ride as cond operands (already
+        # materialized — no per-step compute); the non-finite count over
+        # them is evaluated INSIDE the fire branch, so corruption
+        # detection costs only on sampled steps. Dedicated channel
+        # because XLA min/max reductions swallow NaN — see
+        # schema.HEARTBEAT_FIELDS["nonfinite_state_count"].
+        state_leaves = [l for l in jax.tree.leaves(state)
+                        if hasattr(l, "dtype")
+                        and jnp.issubdtype(l.dtype, jnp.floating)]
+        names.append("nonfinite_state_count")
+
+        def host_emit(step, *scalars):
+            sink.heartbeat(int(step),
+                           {n: s.item() for n, s in zip(names, scalars)})
+
+        def fire(step, *ops):
+            scalars = ops[:n_metrics]
+            leaves = ops[n_metrics:]
+            nonfinite = sum(
+                (jnp.sum(~jnp.isfinite(l), dtype=jnp.int32) for l in leaves),
+                jnp.zeros((), jnp.int32))
+            io_callback(host_emit, None, step, *scalars, nonfinite,
+                        ordered=ordered)
+            return jnp.zeros((), jnp.int32)
+
+        def skip(step, *ops):
+            return jnp.zeros((), jnp.int32)
+
+        lax.cond(t % every == 0, fire, skip, t, *vals, *state_leaves)
+        return state, out
+
+    sink._tap_cache[key] = wrapped
+    return wrapped
+
+
+def emit_ensemble_chunk(sink: TelemetrySink, metrics, t_start: int, *,
+                        every: int = 50) -> int:
+    """Host-side heartbeat emission for the ensemble path: fold one
+    offloaded metrics chunk (member-major (E, steps) EnsembleMetrics
+    leaves, already on host via the ``stack_host_chunks`` offload path)
+    into sampled heartbeats.
+
+    The sharded rollout's scan cannot host-callback from inside
+    ``shard_map`` portably, so in-flight visibility rides the existing
+    per-chunk host offload instead: each segment's metrics produce the
+    same ``t % every == 0`` heartbeats the tap would, values reduced
+    across ensemble members by each channel's declared reduction
+    (schema.HEARTBEAT_FIELDS). Multi-host: every process computes, only
+    process 0 writes (the metrics leaves are already global).
+
+    Returns the number of heartbeats emitted.
+    """
+    import numpy as np
+
+    if every < 1:
+        raise ValueError(f"telemetry every must be >= 1, got {every}")
+    if jax.process_index() != 0:
+        return 0
+    fields = []
+    for f in schema.HEARTBEAT_FIELDS:
+        if f.ensemble is None:
+            continue
+        leaf = getattr(metrics, f.ensemble, ())
+        if isinstance(leaf, tuple):
+            continue
+        fields.append((f, np.asarray(leaf)))
+    if not fields:
+        return 0
+    n_steps = fields[0][1].shape[1]
+    members = fields[0][1].shape[0]
+    first = (-t_start) % every
+    emitted = 0
+    for j in range(first, n_steps, every):
+        values = {f.name: schema.reduce_members(f, arr[:, j].tolist())
+                  for f, arr in fields}
+        sink.heartbeat(t_start + j, values, ensemble_members=members)
+        emitted += 1
+    return emitted

@@ -1,0 +1,191 @@
+"""Host-side watchdog: turn the heartbeat stream into structured alerts.
+
+Consumes a :class:`~cbf_tpu.obs.sink.TelemetrySink`'s events synchronously
+(subscriber callback — O(fields) per heartbeat) plus one optional thread
+for the only check that needs wall-clock initiative: stall detection
+(missed heartbeats — a wedged device/tunnel emits nothing, so no event can
+trigger the check).
+
+Alert classes (every one provably trippable via ``utils.faults`` —
+tests/test_telemetry.py injects each fault and asserts the alert):
+
+- ``nan`` — any heartbeat channel non-finite (``faults.nan_at_step`` /
+  ``inf_at_step`` corrupt the state; the NaN reaches the streamed
+  min-distance within a step).
+- ``certificate_blowup`` — certificate_residual above ``residual_threshold``
+  (``faults.corrupt_output_at_step`` injects a residual spike into the
+  emitted record inside compiled code).
+- ``sustained_infeasibility`` — infeasible_count > 0 for
+  ``infeasible_patience`` consecutive heartbeats (same injector, a step
+  range).
+- ``stall`` — no heartbeat for ``stall_timeout`` seconds while the run is
+  live (``faults.stall_at_step`` blocks the compiled program on the host
+  clock).
+
+Alerts are appended to the run's JSONL stream (event "alert"), collected
+in ``Watchdog.alerts``, and forwarded to ``on_alert`` when given. Edge-
+triggered: each class re-arms only after a healthy heartbeat, so a
+100-step blow-up is one alert, not 100.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, NamedTuple
+
+from cbf_tpu.obs import schema
+from cbf_tpu.obs.sink import TelemetrySink
+
+ALERT_NAN = "nan"
+ALERT_CERT_BLOWUP = "certificate_blowup"
+ALERT_INFEASIBLE = "sustained_infeasibility"
+ALERT_STALL = "stall"
+
+ALERT_KINDS = (ALERT_NAN, ALERT_CERT_BLOWUP, ALERT_INFEASIBLE, ALERT_STALL)
+
+
+class Alert(NamedTuple):
+    kind: str
+    step: int | None
+    detail: str
+    t_wall: float
+
+
+class Watchdog:
+    """Subscribe to ``sink`` and raise structured alerts on its stream.
+
+    ``stall_timeout=None`` (default) disables the stall thread — the three
+    event-driven checks still run. Use as a context manager or call
+    ``stop()``; the stall thread is a daemon either way.
+    """
+
+    def __init__(self, sink: TelemetrySink, *,
+                 residual_threshold: float = 1e-2,
+                 infeasible_patience: int = 3,
+                 stall_timeout: float | None = None,
+                 on_alert: Callable[[Alert], None] | None = None):
+        if infeasible_patience < 1:
+            raise ValueError(
+                f"infeasible_patience must be >= 1, got {infeasible_patience}")
+        self.sink = sink
+        self.residual_threshold = float(residual_threshold)
+        self.infeasible_patience = int(infeasible_patience)
+        self.stall_timeout = stall_timeout
+        self.on_alert = on_alert
+        self.alerts: list[Alert] = []
+        self._lock = threading.Lock()
+        self._infeasible_streak = 0
+        self._armed = {ALERT_NAN: True, ALERT_CERT_BLOWUP: True,
+                       ALERT_INFEASIBLE: True}
+        self._stop = threading.Event()
+        self._started = time.time()
+        self._thread = None
+        sink.subscribe(self._on_event)
+        if stall_timeout is not None:
+            if stall_timeout <= 0:
+                raise ValueError(
+                    f"stall_timeout must be > 0, got {stall_timeout}")
+            self._thread = threading.Thread(target=self._stall_loop,
+                                            daemon=True)
+            self._thread.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.sink.unsubscribe(self._on_event)
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- checks ------------------------------------------------------------
+
+    def _raise_alert(self, kind: str, step: int | None, detail: str) -> None:
+        alert = Alert(kind, step, detail, time.time())
+        with self._lock:
+            self.alerts.append(alert)
+        self.sink.alert(kind, step=step, detail=detail)
+        if self.on_alert is not None:
+            try:
+                self.on_alert(alert)
+            except Exception:
+                pass
+
+    def _on_event(self, event: dict) -> None:
+        if event.get("event") != "heartbeat":
+            return
+        step = event.get("step")
+        values = {f.name: schema.scalar_value(event[f.name])
+                  for f in schema.HEARTBEAT_FIELDS if f.name in event}
+
+        bad = sorted(n for n, v in values.items()
+                     if v != v or abs(v) == float("inf"))
+        # The tap's dedicated corruption counter: XLA min/max reductions
+        # swallow NaN, so a NaN-corrupted state shows up as a POSITIVE
+        # count here rather than a non-finite metric value.
+        nsc = values.get("nonfinite_state_count")
+        if nsc is not None and nsc == nsc and nsc > 0:
+            bad.append(f"nonfinite_state_count={int(nsc)}")
+        if bad:
+            if self._armed[ALERT_NAN]:
+                self._armed[ALERT_NAN] = False
+                self._raise_alert(
+                    ALERT_NAN, step,
+                    f"non-finite heartbeat channel(s): {', '.join(bad)}")
+        else:
+            self._armed[ALERT_NAN] = True
+
+        res = values.get("certificate_residual")
+        if res is not None:
+            if res == res and res > self.residual_threshold:
+                if self._armed[ALERT_CERT_BLOWUP]:
+                    self._armed[ALERT_CERT_BLOWUP] = False
+                    self._raise_alert(
+                        ALERT_CERT_BLOWUP, step,
+                        f"certificate residual {res:.3e} > threshold "
+                        f"{self.residual_threshold:.1e}")
+            else:
+                self._armed[ALERT_CERT_BLOWUP] = True
+
+        inf = values.get("infeasible_count")
+        if inf is not None:
+            if inf == inf and inf > 0:
+                self._infeasible_streak += 1
+                if (self._infeasible_streak >= self.infeasible_patience
+                        and self._armed[ALERT_INFEASIBLE]):
+                    self._armed[ALERT_INFEASIBLE] = False
+                    self._raise_alert(
+                        ALERT_INFEASIBLE, step,
+                        f"infeasible QPs on {self._infeasible_streak} "
+                        "consecutive heartbeats "
+                        f"(last count {int(inf)})")
+            else:
+                self._infeasible_streak = 0
+                self._armed[ALERT_INFEASIBLE] = True
+
+    def _stall_loop(self) -> None:
+        # Re-arming: one alert per stall episode; a fresh heartbeat after
+        # the alert re-arms the detector.
+        alerted_at: float | None = None
+        while not self._stop.wait(min(self.stall_timeout / 4, 1.0)):
+            last = self.sink.last_heartbeat_wall
+            ref = last if last is not None else self._started
+            age = time.time() - ref
+            if age <= self.stall_timeout:
+                alerted_at = None
+                continue
+            if alerted_at is not None and (last or 0.0) <= alerted_at:
+                continue
+            alerted_at = ref
+            what = ("no heartbeat yet" if last is None
+                    else "heartbeats stopped")
+            self._raise_alert(
+                ALERT_STALL, None,
+                f"{what}: {age:.1f}s silent > stall_timeout="
+                f"{self.stall_timeout:.1f}s")

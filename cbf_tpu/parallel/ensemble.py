@@ -396,7 +396,8 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
                           cbf: CBFParams | None = None,
                           initial_state=None, t0: int = 0,
                           chunk: int | None = None,
-                          with_solver_state: bool = False):
+                          with_solver_state: bool = False,
+                          telemetry=None, telemetry_every: int = 50):
     """Run len(seeds) independent swarms over the (dp, sp) mesh.
 
     ``initial_state``: optional (x0, v0) pair — (x0, v0, theta0) in
@@ -424,6 +425,16 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
     (including the Verlet cache and the solver carry) threads through
     segments EXACTLY — a chunked run computes the same trajectory as an
     unchunked one. Metrics come back as host (numpy) arrays.
+
+    ``telemetry``: an optional :class:`cbf_tpu.obs.TelemetrySink`. The
+    sharded scan cannot host-callback portably from inside ``shard_map``,
+    so ensemble heartbeats ride the existing per-chunk host offload
+    instead (``obs.tap.emit_ensemble_chunk``): with ``chunk`` set, each
+    segment's offloaded metrics emit the ``t % telemetry_every == 0``
+    heartbeats IN FLIGHT (latency = one chunk), values reduced across
+    members per the schema's declared reductions; without ``chunk`` the
+    same events are emitted when the single segment completes. Multi-host:
+    only process 0 writes.
 
     Returns ((x_final, v_final) — plus theta_final in unicycle mode, plus
     the final solver carry when ``with_solver_state=True`` — with
@@ -551,8 +562,20 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
             jnp.asarray(t_start, jnp.int32), cbf, *carry)
         return tuple(out[:parts + n_extra]), EnsembleMetrics(*out[-1])
 
+    emit_chunk = None
+    if telemetry is not None:
+        from cbf_tpu.obs.tap import emit_ensemble_chunk
+
+        def emit_chunk(mets_host, t_start):
+            emit_ensemble_chunk(telemetry, mets_host, t_start,
+                                every=telemetry_every)
+
     if chunk is None:
         carry, mets = run(t0, steps, state_full)
+        if emit_chunk is not None:
+            # Single compiled segment: the heartbeats are post-hoc but the
+            # stream/schema are identical to the chunked in-flight path.
+            emit_chunk(jax.device_get(mets), t0)
     else:
         from cbf_tpu.rollout.engine import stack_host_chunks
 
@@ -565,6 +588,8 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
             # bounds device memory for the metrics history and keeps the
             # stacking off the hot loop.
             host_parts.append(jax.device_get(mets_c))
+            if emit_chunk is not None:
+                emit_chunk(host_parts[-1], t)
             t += n
         mets = stack_host_chunks(host_parts, axis=1)   # (E, steps) leaves
 

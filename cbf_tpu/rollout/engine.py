@@ -65,12 +65,24 @@ class StepOutputs(NamedTuple):
     certificate_iterations: Any = ()
 
 
-@functools.partial(jax.jit, static_argnames=("step_fn", "steps", "unroll"))
-def rollout(step_fn: Callable, state0, steps: int, *, unroll: int = 1):
+def rollout(step_fn: Callable, state0, steps: int, *, unroll: int = 1,
+            telemetry=None, telemetry_every: int = 50):
     """Run ``steps`` iterations of ``step_fn`` under ``lax.scan``.
+
+    ``telemetry``: an optional :class:`cbf_tpu.obs.TelemetrySink` — the
+    step is wrapped with the jit-safe tap (``obs.tap.instrument_step``)
+    so every ``telemetry_every``-th step streams a heartbeat of the
+    step's scalar observables to the host WHILE the compiled program
+    runs. The wrapper is cached on the sink, so repeat calls reuse the
+    compiled executable; streamed values bit-match the returned
+    StepOutputs slices by construction.
 
     Returns (final_state, StepOutputs stacked over time).
     """
+    if telemetry is not None:
+        from cbf_tpu.obs.tap import instrument_step
+
+        step_fn = instrument_step(step_fn, telemetry, every=telemetry_every)
     return _rollout_from(step_fn, state0, jnp.zeros((), jnp.int32), steps,
                          unroll=unroll)
 
@@ -91,7 +103,8 @@ def _rollout_from(step_fn: Callable, state, t0, steps: int, unroll: int = 1):
 
 def rollout_chunked(step_fn: Callable, state0, steps: int, *,
                     chunk: int = 1000, checkpoint_dir: str | None = None,
-                    resume: bool = True, unroll: int = 1):
+                    resume: bool = True, unroll: int = 1,
+                    telemetry=None, telemetry_every: int = 50):
     """Run a long rollout in ``chunk``-step compiled segments, checkpointing
     the state pytree at every boundary (SURVEY.md §5 checkpoint/resume —
     absent in the reference).
@@ -101,6 +114,12 @@ def rollout_chunked(step_fn: Callable, state0, steps: int, *,
     outputs are returned only for the steps executed *this* call (completed
     chunks' outputs are not replayed).
 
+    ``telemetry``/``telemetry_every``: same contract as :func:`rollout` —
+    the step is wrapped ONCE before the chunk loop (every full-size chunk
+    keeps reusing one executable), and sampling is on the GLOBAL step
+    index, so a resumed run's heartbeats land on the same steps an
+    uninterrupted one's would.
+
     Returns (final_state, StepOutputs stacked over executed steps,
     start_step).
     """
@@ -108,6 +127,10 @@ def rollout_chunked(step_fn: Callable, state0, steps: int, *,
 
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
+    if telemetry is not None:
+        from cbf_tpu.obs.tap import instrument_step
+
+        step_fn = instrument_step(step_fn, telemetry, every=telemetry_every)
     state, start = state0, 0
     if checkpoint_dir and resume and ckpt.latest_step(checkpoint_dir) is not None:
         state, start = ckpt.restore(checkpoint_dir, state0)

@@ -19,6 +19,14 @@ import jax
 import numpy as np
 
 
+def _saveable(state: Any) -> Any:
+    """Normalize leaves orbax's StandardSave rejects: numpy scalar types
+    (np.int64 step counters and friends) become 0-d ndarrays — same bytes,
+    supported type. jax/numpy arrays pass through untouched."""
+    return jax.tree.map(
+        lambda x: np.asarray(x) if isinstance(x, np.generic) else x, state)
+
+
 def _manager(directory: str, max_to_keep: int | None = 2):
     import orbax.checkpoint as ocp
 
@@ -38,7 +46,7 @@ def save(directory: str, step: int, state: Any, *, max_to_keep: int | None = 2
     import orbax.checkpoint as ocp
 
     with _manager(directory, max_to_keep) as mgr:
-        mgr.save(step, args=ocp.args.StandardSave(state))
+        mgr.save(step, args=ocp.args.StandardSave(_saveable(state)))
         mgr.wait_until_finished()
 
 
@@ -65,7 +73,8 @@ class CheckpointWriter:
         )
 
     def save(self, step: int, state: Any) -> None:
-        self._mgr.save(step, args=self._ocp.args.StandardSave(state))
+        self._mgr.save(step,
+                       args=self._ocp.args.StandardSave(_saveable(state)))
 
     def close(self) -> None:
         self._mgr.wait_until_finished()
@@ -78,6 +87,47 @@ def latest_step(directory: str) -> int | None:
         return None
     with _manager(directory) as mgr:
         return mgr.latest_step()
+
+
+def _leaf_shapes(tree) -> dict[tuple, tuple]:
+    """Name-path -> shape for every shaped leaf, with dict keys and
+    namedtuple fields normalized to plain strings (a saved State comes
+    back from orbax metadata as a dict — same names, different container)."""
+    out = {}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        key = tuple(
+            str(getattr(p, "name", None) or getattr(p, "key", None)
+                or getattr(p, "idx", None) or p) for p in path)
+        shape = getattr(leaf, "shape", None)
+        if shape is not None:
+            out[key] = tuple(shape)
+    return out
+
+
+def _validate_against_stored(directory: str, step: int, abstract) -> None:
+    """Raise ValueError when the restore template's leaf shapes disagree
+    with the checkpoint's stored array metadata. Best-effort by design:
+    metadata that cannot be read (older orbax layouts) skips validation —
+    the check exists to turn SILENT pad/truncate corruption into a loud
+    error, not to add a new failure mode to healthy restores."""
+    import orbax.checkpoint as ocp
+
+    try:
+        meta = ocp.StandardCheckpointer().metadata(
+            os.path.join(os.path.abspath(directory), str(step), "default"))
+        stored = _leaf_shapes(meta)
+    except Exception:
+        return
+    if not stored:
+        return
+    tmpl = _leaf_shapes(abstract)
+    bad = [f"{'/'.join(k)}: stored {stored[k]} != template {tmpl[k]}"
+           for k in sorted(set(stored) & set(tmpl), key=str)
+           if stored[k] != tmpl[k]]
+    if bad:
+        raise ValueError(
+            f"checkpoint under {directory} (step {step}) does not match "
+            "the restore template: " + "; ".join(bad))
 
 
 def restore(directory: str, like: Any, step: int | None = None):
@@ -103,6 +153,14 @@ def restore(directory: str, like: Any, step: int | None = None):
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {directory}")
         abstract = jax.tree.map(_abstract, like)
+        # This orbax build does NOT raise on a template-shape mismatch — it
+        # silently ZERO-PADS (or truncates) the stored array into the
+        # template, so a wrong-`like` restore (N=9 template over an N=4
+        # checkpoint) would hand the resumed rollout fabricated state and
+        # explode far from the cause. Validate template shapes against the
+        # STORED array metadata up front (best-effort: unavailable
+        # metadata skips the check rather than failing a good restore).
+        _validate_against_stored(directory, step, abstract)
         try:
             return (mgr.restore(step, args=ocp.args.StandardRestore(abstract)),
                     step)

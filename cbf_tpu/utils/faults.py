@@ -20,6 +20,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 
 def _maybe_corrupt(leaf, hit, value):
@@ -53,6 +54,64 @@ def _value_at_step(step_fn: Callable, step_index: int, value) -> Callable:
         corrupted = jax.tree.map(
             lambda leaf: _maybe_corrupt(leaf, hit, value), state)
         return step_fn(corrupted, t)
+
+    return wrapped
+
+
+def corrupt_output_at_step(step_fn: Callable, step_index: int, field: str,
+                           value, *, until: int | None = None) -> Callable:
+    """Overwrite one StepOutputs FIELD with ``value`` for steps in
+    ``[step_index, until)`` (``until=None`` = just the one step) — the
+    observability-chain injector: the state stays healthy, only the
+    emitted record is corrupted inside compiled code, so a telemetry
+    pipeline (tap -> sink -> watchdog, ``cbf_tpu.obs``) can be proven to
+    carry and alert on e.g. a certificate-residual blow-up or an
+    infeasibility streak end-to-end without needing a scenario that
+    organically produces one. The field must already be tracked (a ()
+    leaf has no trace-time shape to forge).
+    """
+    def wrapped(state, t):
+        state, out = step_fn(state, t)
+        leaf = getattr(out, field)
+        if isinstance(leaf, tuple):
+            raise ValueError(
+                f"StepOutputs.{field} is untracked (()) in this scenario — "
+                "corrupt_output_at_step needs a tracked field")
+        if until is None:
+            hit = t == step_index
+        else:
+            hit = (t >= step_index) & (t < until)
+        forged = jnp.where(hit, jnp.asarray(value, leaf.dtype), leaf)
+        return state, out._replace(**{field: forged})
+
+    return wrapped
+
+
+def stall_at_step(step_fn: Callable, step_index: int,
+                  seconds: float) -> Callable:
+    """Block the compiled program on the host clock for ``seconds`` at
+    ``t == step_index`` — a wedge/stall fault (hung collective, stuck
+    tunnel) for exercising missed-heartbeat detection. Implemented as a
+    host callback (``io_callback``) under ``lax.cond``, so the stall
+    happens INSIDE the running scan: heartbeats genuinely stop flowing,
+    they are not merely delayed in a queue."""
+    import time
+
+    from jax.experimental import io_callback
+
+    def _sleep():
+        time.sleep(seconds)
+
+    def wrapped(state, t):
+        def fire(u):
+            io_callback(_sleep, None, ordered=True)
+            return u
+
+        # ordered=True sequences the sleep against the surrounding steps'
+        # own (ordered or effectful) ops — the stall happens AT this step.
+        lax.cond(t == step_index, fire, lambda u: u,
+                 jnp.zeros((), jnp.int32))
+        return step_fn(state, t)
 
     return wrapped
 

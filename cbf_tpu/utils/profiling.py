@@ -73,11 +73,28 @@ def _ensure_listeners() -> None:
 _ensure_listeners()
 
 
-def compile_stats() -> dict[str, int]:
-    """Jit compile/cache event counters (e.g. backend_compile_duration
-    fires per fresh compile; absence of growth between two calls around a
-    jitted call means the executable was reused from cache)."""
+def compile_event_counts() -> dict[str, int]:
+    """Public accessor for the jit compile/cache event counters (e.g.
+    backend_compile_duration fires per fresh compile; absence of growth
+    between two calls around a jitted call means the executable was reused
+    from cache). The telemetry run manifest snapshots this at run start
+    and the summary event records the delta — recompile count is a
+    first-class run-health signal (an unstable jit cache key recompiling
+    every chunk shows up here, not in any per-step metric)."""
     return dict(_event_counts)
+
+
+def reset_compile_event_counts() -> None:
+    """Zero the compile/cache counters (scoping a measurement to one run
+    without arithmetic against a prior snapshot). Listener registration is
+    unaffected — counting resumes immediately."""
+    _event_counts.clear()
+
+
+def compile_stats() -> dict[str, int]:
+    """Deprecated alias of :func:`compile_event_counts` (pre-round-7 name,
+    kept for callers)."""
+    return compile_event_counts()
 
 
 class StepTimer:
@@ -98,3 +115,51 @@ class StepTimer:
 
     def summary(self) -> str:
         return " ".join(f"{k}={v:.3f}s" for k, v in sorted(self.totals.items()))
+
+
+def tensorboard_available() -> bool:
+    """True when a TensorBoard scalar writer backend is importable."""
+    try:
+        import tensorboardX  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def export_scalars_to_tensorboard(run_dir: str,
+                                  log_dir: str | None = None) -> str | None:
+    """Export a telemetry run's heartbeat stream (``cbf_tpu.obs``) as
+    TensorBoard scalars — one tag per heartbeat channel plus ``step_rate``,
+    stepped by the global rollout step — next to the device traces
+    :func:`trace` already writes in the same format family.
+
+    Optional dependency: returns None (no-op) when no writer backend is
+    importable — telemetry itself never depends on TensorBoard. Returns
+    the log directory written otherwise (default: ``<run_dir>/tensorboard``).
+    """
+    if not tensorboard_available():
+        return None
+    from tensorboardX import SummaryWriter
+
+    from cbf_tpu.obs import schema as obs_schema
+    from cbf_tpu.obs.sink import read_events
+
+    log_dir = log_dir or f"{run_dir.rstrip('/')}/tensorboard"
+    writer = SummaryWriter(log_dir)
+    try:
+        for ev in read_events(run_dir):
+            if ev.get("event") != "heartbeat":
+                continue
+            step = int(ev.get("step", 0))
+            for f in obs_schema.HEARTBEAT_FIELDS:
+                if f.name in ev:
+                    writer.add_scalar(f"telemetry/{f.name}",
+                                      obs_schema.scalar_value(ev[f.name]),
+                                      global_step=step)
+            if ev.get("step_rate") is not None:
+                writer.add_scalar("telemetry/step_rate", ev["step_rate"],
+                                  global_step=step)
+    finally:
+        writer.close()
+    return log_dir

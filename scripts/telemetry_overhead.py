@@ -1,0 +1,82 @@
+"""Measure the telemetry tap's wall-time overhead: telemetry-on vs
+telemetry-off swarm rollout, interleaved min-of-R timing.
+
+The acceptance budget (ISSUE 2 / docs/BENCH_LOG.md Round 7) is <= 3%
+overhead at N=1024 with the documented sampling interval K=50. This
+script is the one measurement path for that number — used standalone for
+the bench log and by tests/test_telemetry.py::
+test_telemetry_overhead_within_budget (which runs it as a SUBPROCESS:
+the tier-1 harness forces --xla_force_host_platform_device_count=8, and
+under 8 virtual CPU devices the callback machinery costs ~5x its real
+single-device cost — a harness artifact the budget does not govern, so
+the measurement controls its own backend).
+
+Prints one JSON line: {n, steps, every, reps, off_s, on_s, overhead,
+heartbeats, platform}.
+
+Usage: python scripts/telemetry_overhead.py [--n 1024] [--steps 300]
+       [--every 50] [--reps 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def measure(n: int, steps: int, every: int, reps: int) -> dict:
+    import jax
+
+    from cbf_tpu import obs
+    from cbf_tpu.rollout.engine import rollout
+    from cbf_tpu.scenarios import swarm
+
+    cfg = swarm.Config(n=n, steps=steps, record_trajectory=False)
+    state0, step = swarm.make(cfg)
+    sink = obs.TelemetrySink(tempfile.mkdtemp(prefix="obs_overhead_"))
+    instrumented = obs.instrument_step(step, sink, every=every)
+
+    def one(step_fn):
+        t0 = time.perf_counter()
+        final, _ = rollout(step_fn, state0, cfg.steps)
+        jax.block_until_ready(final.x)
+        return time.perf_counter() - t0
+
+    one(step), one(instrumented)          # compile both executables
+    # Interleaved, alternating leg order, per-leg minimum: scheduler noise
+    # on a seconds-scale window swamps a 3% signal in any single pair.
+    offs, ons = [], []
+    for i in range(reps):
+        legs = ((offs, step), (ons, instrumented))
+        for acc, fn in (legs if i % 2 == 0 else legs[::-1]):
+            acc.append(one(fn))
+    heartbeats = sink.heartbeat_count
+    sink.close()
+    off_s, on_s = min(offs), min(ons)
+    return {"n": n, "steps": steps, "every": every, "reps": reps,
+            "off_s": round(off_s, 4), "on_s": round(on_s, 4),
+            "overhead": round((on_s - off_s) / off_s, 4),
+            "heartbeats": heartbeats,
+            "platform": jax.devices()[0].platform}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=1024)
+    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--every", type=int, default=50)
+    p.add_argument("--reps", type=int, default=5)
+    args = p.parse_args()
+    print(json.dumps(measure(args.n, args.steps, args.every, args.reps)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

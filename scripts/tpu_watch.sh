@@ -1,21 +1,33 @@
 #!/usr/bin/env bash
-# Probe the tunneled TPU on a loop; at the FIRST healthy probe run the
-# whole measurement sweep (scripts/tpu_sweep.sh) and exit. Launch once in
-# the background at session start — it catches a recovery window whenever
-# it happens, instead of relying on a human/agent to probe at the right
-# moment (the round-4 lesson: the tunnel was wedged for the entire
-# session, and any healthy minutes between manual probes went unused).
+# Probe the tunneled TPU on a loop; at the FIRST healthy probe launch the
+# whole measurement sweep (scripts/tpu_sweep.sh) with telemetry streaming
+# on, and watch the sweep through its JSONL HEARTBEAT STREAM instead of
+# scraping process liveness: `python -m cbf_tpu obs tail --follow
+# --stall-timeout` follows the newest run directory and exits 3 the moment
+# heartbeats stop flowing — a wedged tunnel mid-run is detected in
+# STALL_S seconds with the exact last-known step on record, not hours
+# later from a dead process table. Launch once in the background at
+# session start (the round-4 lesson: healthy minutes between manual
+# probes went unused):
 #
 #   nohup bash scripts/tpu_watch.sh > docs/sweeps/watch.log 2>&1 &
 #
-# Interval 15 min (a probe against a wedged tunnel burns a 120 s child
-# timeout; 15 min keeps the duty cycle ~13% while bounding the worst-case
-# missed-window latency). Stops after MAX_HOURS regardless.
+# Probe interval 15 min (a probe against a wedged tunnel burns a 120 s
+# child timeout; 15 min keeps the duty cycle ~13%). Stops after MAX_HOURS
+# regardless. Exit codes: 0 sweep finished, 2 no healthy probe before the
+# deadline, 3 sweep stalled (heartbeats stopped; see the stall alert at
+# the end of the tail output and the run dir's events.jsonl for the last
+# heartbeat's step/rate).
 set -u
 cd "$(dirname "$0")/.."
 INTERVAL="${TPU_WATCH_INTERVAL_S:-900}"
 MAX_HOURS="${TPU_WATCH_MAX_HOURS:-12}"
 SWEEP="${TPU_WATCH_SWEEP:-scripts/tpu_sweep.sh}"
+# Telemetry root the sweep's bench children stream into; the watcher
+# follows the newest run under it. Stall timeout must cover warmup/compile
+# (the first heartbeat waits on it) AND the certificate chunk cadence.
+TELEMETRY_ROOT="${TPU_WATCH_TELEMETRY:-docs/sweeps/telemetry}"
+STALL_S="${TPU_WATCH_STALL_S:-600}"
 deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
 n=0
 while [ "$(date +%s)" -lt "$deadline" ]; do
@@ -28,9 +40,38 @@ ok, reason = bench.probe_device_subprocess(timeout_s=120)
 print('[tpu_watch]', (ok, reason))
 sys.exit(0 if ok else 1)
 "; then
-    echo "[tpu_watch] HEALTHY — running $SWEEP"
-    bash "$SWEEP"
-    echo "[tpu_watch] sweep finished rc=$? — exiting"
+    echo "[tpu_watch] HEALTHY — running $SWEEP (telemetry -> $TELEMETRY_ROOT)"
+    mkdir -p "$TELEMETRY_ROOT"
+    BENCH_TELEMETRY="$TELEMETRY_ROOT" bash "$SWEEP" &
+    sweep_pid=$!
+    # Consume the heartbeat stream: --latest waits for the first bench
+    # child to open its run dir, then follows it; a silent stream for
+    # STALL_S emits one synthetic stall alert and exits 3. Loop: each
+    # bench child is its own run dir, so re-tail the newest one until
+    # the sweep process finishes.
+    watch_rc=0
+    while kill -0 "$sweep_pid" 2>/dev/null; do
+      python -m cbf_tpu obs tail "$TELEMETRY_ROOT" --latest --follow \
+        --stall-timeout "$STALL_S"
+      rc=$?
+      if [ "$rc" -eq 3 ]; then
+        if kill -0 "$sweep_pid" 2>/dev/null; then
+          echo "[tpu_watch] STALL — no heartbeat for ${STALL_S}s with the" \
+               "sweep still alive (pid $sweep_pid); leaving it to its own" \
+               "timeouts, reporting stall"
+          watch_rc=3
+          break
+        fi
+        # Sweep already exited between heartbeats — not a stall.
+        break
+      fi
+      sleep 5
+    done
+    wait "$sweep_pid"
+    sweep_rc=$?
+    echo "[tpu_watch] sweep finished rc=$sweep_rc (watch rc=$watch_rc) —" \
+         "summaries: python -m cbf_tpu obs summary $TELEMETRY_ROOT --latest"
+    [ "$watch_rc" -ne 0 ] && exit "$watch_rc"
     exit 0
   fi
   sleep "$INTERVAL"

@@ -20,17 +20,18 @@ def _random_problems(rng, n, m):
     return A, b
 
 
-def test_parity_vs_jax_enumeration(rng):
-    import jax
+def test_parity_vs_jax_enumeration(rng, x64):
+    # The x64 fixture enables float64 (jax.enable_x64 is newer-JAX public
+    # API; the conftest fixture resolves the experimental context manager
+    # on this container's 0.4.x).
     from cbf_tpu.solvers.exact2d import solve_qp_2d_batch
 
-    with jax.enable_x64(True):
-        A, b = _random_problems(rng, 200, 10)
-        x_n, feas_n, rounds_n, _ = native.solve_qp_2d_batch(A, b)
-        x_j, info = solve_qp_2d_batch(A, b)
-        np.testing.assert_array_equal(feas_n, np.asarray(info.feasible))
-        ok = feas_n
-        np.testing.assert_allclose(x_n[ok], np.asarray(x_j)[ok], atol=1e-8)
+    A, b = _random_problems(rng, 200, 10)
+    x_n, feas_n, rounds_n, _ = native.solve_qp_2d_batch(A, b)
+    x_j, info = solve_qp_2d_batch(A, b)
+    np.testing.assert_array_equal(feas_n, np.asarray(info.feasible))
+    ok = feas_n
+    np.testing.assert_allclose(x_n[ok], np.asarray(x_j)[ok], atol=1e-8)
 
 
 def test_parity_vs_slsqp_oracle(rng):

@@ -1,0 +1,384 @@
+"""Streaming telemetry (cbf_tpu.obs): tap correctness (streamed heartbeats
+bit-match post-hoc StepOutputs/EnsembleMetrics on the scenario, chunked,
+and ensemble paths), sink/manifest/registry behavior, every watchdog alert
+class tripped via a utils.faults injection, schema-drift enforcement, and
+the tap's overhead budget (slow-marked)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from cbf_tpu import obs
+from cbf_tpu.obs import schema
+from cbf_tpu.rollout.engine import rollout, rollout_chunked
+from cbf_tpu.scenarios import swarm
+from cbf_tpu.utils import faults
+
+
+def _heartbeats(run_dir):
+    return [e for e in obs.read_events(run_dir)
+            if e.get("event") == "heartbeat"]
+
+
+def _drain(sink, expected, timeout_s=5.0):
+    """Unordered callbacks may still be landing right after
+    block_until_ready — wait for the expected count (bounded)."""
+    deadline = time.time() + timeout_s
+    while sink.heartbeat_count < expected and time.time() < deadline:
+        time.sleep(0.01)
+    return sink.heartbeat_count
+
+
+def _assert_bitmatch(run_dir, outs, every, steps, start=0):
+    """Every streamed heartbeat value equals the corresponding post-hoc
+    StepOutputs slice exactly (same program value — NaNs compare as
+    NaN==NaN here)."""
+    hbs = {e["step"]: e for e in _heartbeats(run_dir)}
+    expected_steps = [t for t in range(start, start + steps)
+                      if t % every == 0]
+    assert sorted(hbs) == expected_steps
+    for f in schema.HEARTBEAT_FIELDS:
+        if f.step_output is None:
+            # Tap-computed channel (no StepOutputs twin): present on every
+            # tap heartbeat, finite on a healthy run.
+            assert all(f.name in e for e in hbs.values())
+            continue
+        leaf = getattr(outs, f.step_output)
+        if isinstance(leaf, tuple):
+            assert all(f.name not in e for e in hbs.values())
+            continue
+        series = np.asarray(leaf)
+        for t, e in hbs.items():
+            got = schema.scalar_value(e[f.name])
+            want = float(series[t - start])
+            assert got == want or (got != got and want != want), (
+                f"{f.name} at step {t}: streamed {got} != post-hoc {want}")
+
+
+def test_heartbeats_bitmatch_scenario_path(tmp_path):
+    cfg = swarm.Config(n=24, steps=30, certificate=True)
+    state0, step = swarm.make(cfg)
+    sink = obs.TelemetrySink(str(tmp_path))
+    final, outs = rollout(step, state0, cfg.steps, telemetry=sink,
+                          telemetry_every=5)
+    np.asarray(final.x)
+    _drain(sink, 6)
+    sink.close()
+    _assert_bitmatch(str(tmp_path), outs, every=5, steps=30)
+
+
+def test_heartbeats_bitmatch_chunked_path(tmp_path):
+    """Chunked rollouts sample on the GLOBAL step index across chunk
+    boundaries (incl. a trailing partial chunk), values bit-matching the
+    stacked host outputs."""
+    cfg = swarm.Config(n=16, steps=23)
+    state0, step = swarm.make(cfg)
+    sink = obs.TelemetrySink(str(tmp_path))
+    final, outs, start = rollout_chunked(step, state0, cfg.steps, chunk=7,
+                                         telemetry=sink, telemetry_every=3)
+    assert start == 0
+    _drain(sink, 8)
+    sink.close()
+    _assert_bitmatch(str(tmp_path), outs, every=3, steps=23)
+
+
+def test_heartbeats_bitmatch_ensemble_path(tmp_path):
+    """Ensemble heartbeats (per-chunk host offload) reduce member values
+    exactly as the schema declares — bit-equal to applying the same
+    reduction to the returned EnsembleMetrics columns."""
+    import jax
+
+    from cbf_tpu.parallel import make_mesh
+    from cbf_tpu.parallel.ensemble import sharded_swarm_rollout
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    mesh = make_mesh(n_dp=2, n_sp=1)
+    cfg = swarm.Config(n=16, steps=12)
+    sink = obs.TelemetrySink(str(tmp_path))
+    _, mets = sharded_swarm_rollout(cfg, mesh, seeds=[0, 1], chunk=5,
+                                    telemetry=sink, telemetry_every=3)
+    sink.close()
+    hbs = {e["step"]: e for e in _heartbeats(str(tmp_path))}
+    assert sorted(hbs) == [0, 3, 6, 9]
+    assert all(e["ensemble_members"] == 2 for e in hbs.values())
+    for f in schema.HEARTBEAT_FIELDS:
+        if f.ensemble is None:
+            continue
+        leaf = getattr(mets, f.ensemble, ())
+        if isinstance(leaf, tuple):
+            continue
+        arr = np.asarray(leaf)
+        for t, e in hbs.items():
+            want = schema.reduce_members(f, arr[:, t].tolist())
+            got = schema.scalar_value(e[f.name])
+            assert got == float(want), (f.name, t, got, want)
+
+
+def test_manifest_and_summary(tmp_path):
+    cfg = swarm.Config(n=9, steps=10)
+    state0, step = swarm.make(cfg)
+    sink = obs.TelemetrySink(
+        str(tmp_path), manifest=obs.build_manifest(cfg, extra={"knob": 1}))
+    rollout(step, state0, cfg.steps, telemetry=sink, telemetry_every=2)
+    _drain(sink, 5)
+    summary = sink.summary()
+    sink.close()
+
+    manifest = obs.read_manifest(str(tmp_path))
+    assert manifest["schema"] == schema.SCHEMA_VERSION
+    assert manifest["jax_version"]
+    assert "git_sha" in manifest
+    assert manifest["topology"]["backend"] == "cpu"
+    assert manifest["knob"] == 1
+    assert manifest["config"]["n"] == "9"
+    # Recompile visibility: a fresh scenario compile happened during the
+    # run, so the summary's delta over the manifest snapshot is non-empty.
+    assert isinstance(manifest["compile_event_counts"], dict)
+    assert summary["heartbeats"] == 5
+    assert any("compile" in k for k in summary["compile_events_during_run"])
+    # Counter channels accumulated in the registry.
+    assert summary["metrics"]["infeasible_count"]["samples"] == 5
+    # summarize_run prefers the written summary event.
+    assert obs.summarize_run(str(tmp_path))["from"] == "summary_event"
+
+
+def test_compile_event_counts_public_accessors():
+    import jax
+    import jax.numpy as jnp
+
+    from cbf_tpu.utils import profiling
+
+    def fresh(x):
+        return x * 3.0 - 1.0
+
+    before = profiling.compile_event_counts()
+    jax.jit(fresh)(jnp.ones(7)).block_until_ready()
+    after = profiling.compile_event_counts()
+    key = "/jax/core/compile/backend_compile_duration"
+    assert after.get(key, 0) > before.get(key, 0)
+    assert profiling.compile_stats() == after   # deprecated alias
+    profiling.reset_compile_event_counts()
+    assert profiling.compile_event_counts() == {}
+    # Counting resumes after reset (listeners stay registered).
+    jax.jit(lambda x: x + 2.0)(jnp.ones(3)).block_until_ready()
+    assert profiling.compile_event_counts().get(key, 0) >= 1
+
+
+def test_registry_merge_and_histogram():
+    a, b = obs.MetricsRegistry(), obs.MetricsRegistry()
+    a.counter("c").add(2)
+    b.counter("c").add(3)
+    a.gauge("g").set(1.0)
+    b.gauge("g").set(5.0)
+    a.histogram("h").observe(1e-3)
+    b.histogram("h").observe(float("nan"))
+    a.merge(b.snapshot())
+    snap = a.snapshot()
+    assert snap["c"]["total"] == 5 and snap["c"]["samples"] == 2
+    assert snap["g"]["min"] == 1.0 and snap["g"]["max"] == 5.0
+    assert snap["h.hist"]["samples"] == 2 and snap["h.hist"]["nonfinite"] == 1
+
+
+# --- watchdog alert classes: each tripped by a utils.faults injection ----
+
+def test_watchdog_nan_alert_from_injected_state_fault(tmp_path):
+    cfg = swarm.Config(n=12, steps=20)
+    state0, step = swarm.make(cfg)
+    bad = faults.nan_at_step(step, step_index=7)
+    sink = obs.TelemetrySink(str(tmp_path))
+    with obs.Watchdog(sink) as wd:
+        rollout(bad, state0, cfg.steps, telemetry=sink, telemetry_every=1)
+        _drain(sink, 20)
+    sink.close()
+    kinds = [a.kind for a in wd.alerts]
+    assert obs.ALERT_NAN in kinds
+    first = next(a for a in wd.alerts if a.kind == obs.ALERT_NAN)
+    assert first.step is not None and first.step >= 7
+    # The alert rode the stream too (structured, machine-readable).
+    assert any(e.get("kind") == obs.ALERT_NAN
+               for e in obs.read_events(str(tmp_path))
+               if e.get("event") == "alert")
+
+
+def test_watchdog_certificate_blowup_from_forged_output(tmp_path):
+    cfg = swarm.Config(n=24, steps=12, certificate=True)
+    state0, step = swarm.make(cfg)
+    bad = faults.corrupt_output_at_step(step, 5, "certificate_residual", 1.0)
+    sink = obs.TelemetrySink(str(tmp_path))
+    with obs.Watchdog(sink, residual_threshold=1e-2) as wd:
+        rollout(bad, state0, cfg.steps, telemetry=sink, telemetry_every=1)
+        _drain(sink, 12)
+    sink.close()
+    blowups = [a for a in wd.alerts if a.kind == obs.ALERT_CERT_BLOWUP]
+    assert len(blowups) == 1 and blowups[0].step == 5   # edge-triggered
+
+
+def test_watchdog_sustained_infeasibility_from_forged_output(tmp_path):
+    cfg = swarm.Config(n=12, steps=20)
+    state0, step = swarm.make(cfg)
+    bad = faults.corrupt_output_at_step(step, 6, "infeasible_count", 2,
+                                        until=16)
+    sink = obs.TelemetrySink(str(tmp_path))
+    with obs.Watchdog(sink, infeasible_patience=3) as wd:
+        rollout(bad, state0, cfg.steps, telemetry=sink, telemetry_every=1)
+        _drain(sink, 20)
+    sink.close()
+    hits = [a for a in wd.alerts if a.kind == obs.ALERT_INFEASIBLE]
+    assert len(hits) == 1 and hits[0].step == 8   # 3rd bad heartbeat
+
+
+def test_watchdog_stall_from_injected_stall(tmp_path):
+    """faults.stall_at_step blocks the compiled scan on the host clock —
+    heartbeats genuinely stop — and the watchdog's stall thread alerts
+    WHILE the program is still running."""
+    cfg = swarm.Config(n=9, steps=30)
+    state0, step = swarm.make(cfg)
+    bad = faults.stall_at_step(step, step_index=15, seconds=1.5)
+    sink = obs.TelemetrySink(str(tmp_path))
+    # Compile first (stream paused) so the tight-stall-timeout watchdog
+    # below never sees compile latency — only the injected wedge.
+    sink.pause()
+    final, _ = rollout(bad, state0, cfg.steps, telemetry=sink,
+                       telemetry_every=1)
+    np.asarray(final.x)
+    sink.resume()
+    with obs.Watchdog(sink, stall_timeout=0.4) as wd:
+        final, _ = rollout(bad, state0, cfg.steps, telemetry=sink,
+                           telemetry_every=1)
+        np.asarray(final.x)
+        end_wall = time.time()
+        stalls = [a for a in wd.alerts if a.kind == obs.ALERT_STALL]
+        assert stalls, "stall alert must fire during the injected wedge"
+        assert stalls[0].t_wall <= end_wall
+    sink.close()
+
+
+def test_corrupt_output_rejects_untracked_field():
+    cfg = swarm.Config(n=9, steps=4)   # no certificate => residual is ()
+    state0, step = swarm.make(cfg)
+    bad = faults.corrupt_output_at_step(step, 1, "certificate_residual", 1.0)
+    with pytest.raises(ValueError, match="untracked"):
+        rollout(bad, state0, cfg.steps)
+
+
+def test_tap_wrapper_cached_per_sink(tmp_path):
+    cfg = swarm.Config(n=9, steps=4)
+    _, step = swarm.make(cfg)
+    sink = obs.TelemetrySink(str(tmp_path))
+    w1 = obs.instrument_step(step, sink, every=2)
+    w2 = obs.instrument_step(step, sink, every=2)
+    w3 = obs.instrument_step(step, sink, every=3)
+    assert w1 is w2 and w1 is not w3   # same key reuses the jit cache
+    sink.close()
+
+
+def test_reader_side_stall_detection(tmp_path):
+    """tail_events emits ONE synthetic stall alert when a followed stream
+    goes silent — the obs tail --stall-timeout / tpu_watch.sh contract."""
+    sink = obs.TelemetrySink(str(tmp_path))
+    sink.heartbeat(0, {"min_pairwise_distance": 1.0})
+    events = list(obs.tail_events(str(tmp_path), follow=True,
+                                  poll_s=0.05, stall_timeout=0.3))
+    sink.close()
+    assert events[-1]["event"] == "alert"
+    assert events[-1]["kind"] == "stall" and events[-1]["synthetic"]
+
+
+def test_nonfinite_values_stay_strict_json(tmp_path):
+    """NaN/inf heartbeat values are encoded as strings: every line of the
+    stream must parse under strict JSON (the watchdog/tail readers)."""
+    sink = obs.TelemetrySink(str(tmp_path))
+    sink.heartbeat(0, {"min_pairwise_distance": float("nan"),
+                       "certificate_residual": float("inf")})
+    sink.close()
+    with open(sink.events_path) as fh:
+        for line in fh:
+            ev = json.loads(line, parse_constant=lambda c: pytest.fail(
+                f"non-strict JSON constant {c} in stream"))
+    assert ev["min_pairwise_distance"] == "nan"
+    assert schema.scalar_value(ev["min_pairwise_distance"]) != \
+        schema.scalar_value(ev["min_pairwise_distance"])   # NaN round-trip
+
+
+def test_obs_schema_audit():
+    """Tier-1 enforcement of the schema-drift lint (the satellite contract:
+    a StepOutputs/EnsembleMetrics field missing from the telemetry schema
+    or docs fails the suite, like tier1_marker_audit)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    try:
+        import obs_schema_audit
+    finally:
+        sys.path.pop(0)
+    assert obs_schema_audit.audit() == []
+
+
+def test_tensorboard_export(tmp_path):
+    from cbf_tpu.utils import profiling
+
+    if not profiling.tensorboard_available():
+        pytest.skip("no TensorBoard writer backend in this environment")
+    sink = obs.TelemetrySink(str(tmp_path))
+    sink.heartbeat(0, {"min_pairwise_distance": 0.5})
+    sink.heartbeat(10, {"min_pairwise_distance": 0.4})
+    sink.close()
+    log_dir = profiling.export_scalars_to_tensorboard(str(tmp_path))
+    assert log_dir and os.path.isdir(log_dir)
+    assert any("tfevents" in f for f in os.listdir(log_dir))
+
+
+def test_cli_run_telemetry_and_obs_summary(tmp_path):
+    """End-to-end CLI: run with --telemetry-dir, then obs summary reads it
+    back (exit 0, heartbeats counted, manifest attached)."""
+    run_dir = str(tmp_path / "r")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-m", "cbf_tpu", "run", "swarm", "--steps", "12",
+         "--set", "n=9", "--telemetry-dir", run_dir,
+         "--telemetry-every", "4"],
+        capture_output=True, text=True, cwd=repo, env=env, timeout=300)
+    assert out.returncode == 0, out.stderr[-800:]
+    record = json.loads(out.stdout.strip().splitlines()[-1])
+    assert record["telemetry_heartbeats"] == 3
+    summ = subprocess.run(
+        [sys.executable, "-m", "cbf_tpu", "obs", "summary", run_dir],
+        capture_output=True, text=True, cwd=repo, env=env, timeout=120)
+    assert summ.returncode == 0, summ.stderr[-800:]
+    parsed = json.loads(summ.stdout)
+    assert parsed["heartbeats"] == 3
+    assert parsed["from"] == "summary_event"
+    assert parsed["manifest"]["topology"]["backend"] == "cpu"
+
+
+@pytest.mark.slow
+def test_telemetry_overhead_within_budget():
+    """The acceptance budget: telemetry-on rollout wall time within 3% of
+    telemetry-off at N=1024, sampling every K=50 steps (the documented
+    operating point — docs/BENCH_LOG.md Round 7).
+
+    Measured in a SUBPROCESS via scripts/telemetry_overhead.py (the one
+    measurement path, shared with the bench log): this harness forces 8
+    virtual CPU devices for the mesh tests, and under that flag the
+    callback machinery costs ~5x its real single-device price — a harness
+    artifact, not the production overhead the budget governs."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts",
+                                      "telemetry_overhead.py"),
+         "--n", "1024", "--steps", "300", "--every", "50", "--reps", "5"],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=560)
+    assert out.returncode == 0, out.stderr[-800:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["heartbeats"] > 0
+    assert rec["overhead"] <= 0.03, (
+        f"telemetry overhead {rec['overhead']:.1%} > 3% budget "
+        f"(off {rec['off_s']}s, on {rec['on_s']}s at N=1024, K=50)")
