@@ -288,6 +288,37 @@ def cmd_obs_summary(args) -> int:
     return 0 if summary.get("heartbeats") else 1
 
 
+def cmd_lint(args) -> int:
+    """Static analysis gate: AST trace-safety rules over the given paths,
+    plus (``--all``) the jaxpr entry-point invariants and the
+    consolidated repo audits. Exit 0 = clean (modulo the baseline),
+    1 = unsuppressed findings or stale baseline entries, 2 = analyzer
+    failure (malformed baseline, unreadable path)."""
+    from cbf_tpu.analysis import report
+    from cbf_tpu.analysis.baseline import BaselineError
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = args.paths or [os.path.join(repo_root, "cbf_tpu")]
+    try:
+        result = report.run_lint(
+            paths, repo_root=repo_root, baseline_path=args.baseline,
+            jaxpr=args.all or args.jaxpr, audits=args.all,
+            entrypoints=args.entrypoint or None)
+    except BaselineError as e:
+        print(f"lint: {e}", file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"lint: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(report.render_json(result,
+                                 show_suppressed=args.show_suppressed))
+    else:
+        print(report.render_text(result,
+                                 show_suppressed=args.show_suppressed))
+    return result.exit_code
+
+
 def cmd_list(_args) -> int:
     for name, (module, steps_field, *_rest) in sorted(_scenarios().items()):
         cfg = module.Config()
@@ -353,6 +384,35 @@ def main(argv=None) -> int:
                            "many silent seconds (default: off; first "
                            "heartbeat waits on compile — size accordingly)")
     runp.set_defaults(fn=cmd_run)
+
+    lintp = sub.add_parser(
+        "lint", help="static analysis: trace-safety + recompile-hazard "
+                     "rules (docs/API.md 'Static analysis')")
+    lintp.add_argument("paths", nargs="*",
+                       help="files/directories to lint (default: the "
+                            "cbf_tpu package)")
+    lintp.add_argument("--all", action="store_true",
+                       help="also run the jaxpr entry-point invariants "
+                            "(JX0xx) and the consolidated repo audits "
+                            "(AUD0xx: obs schema, tier-1 markers, chain "
+                            "depth)")
+    lintp.add_argument("--jaxpr", action="store_true",
+                       help="also run just the jaxpr entry-point "
+                            "invariants (JX0xx)")
+    lintp.add_argument("--entrypoint", action="append", default=[],
+                       metavar="NAME",
+                       help="restrict the jaxpr checks to these entry "
+                            "points (repeatable; see analysis.jaxpr_rules"
+                            ".entrypoint_specs)")
+    lintp.add_argument("--json", action="store_true",
+                       help="machine-readable output (one JSON object)")
+    lintp.add_argument("--baseline", default=None,
+                       help="suppression file (default: "
+                            "cbf_tpu/analysis/baseline.toml)")
+    lintp.add_argument("--show-suppressed", action="store_true",
+                       help="also print baseline-suppressed findings "
+                            "with their reasons")
+    lintp.set_defaults(fn=cmd_lint)
 
     sub.add_parser("list", help="list scenarios + config knobs") \
         .set_defaults(fn=cmd_list)

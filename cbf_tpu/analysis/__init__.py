@@ -1,0 +1,38 @@
+"""Static analysis: machine-checked trace-safety and recompile-hazard
+invariants for the compiled hot paths.
+
+The paper's value proposition — a compiled, hardware-rate safety filter
+— survives only while the hot paths stay jit-clean: one stray host
+sync, one Python branch on a tracer, one unhashable static argument
+silently reintroduces the serial latency chain and recompile storms the
+perf PRs removed. This package turns that from reviewer vigilance into
+a standing gate:
+
+* :mod:`cbf_tpu.analysis.ast_rules` — AST trace-safety linter (host
+  syncs, tracer branching, recompile hazards) over source, no
+  execution;
+* :mod:`cbf_tpu.analysis.jaxpr_rules` — invariants asserted on the
+  ABSTRACT traces of the public entry points (callback allowlist, f32
+  dtype discipline, carry aval stability);
+* :mod:`cbf_tpu.analysis.audits` — the former standalone audit scripts
+  (obs schema, tier-1 markers, chain depth) as rules;
+* :mod:`cbf_tpu.analysis.baseline` — suppression file with mandatory
+  reasons (``baseline.toml``): pre-existing findings visible, new ones
+  fatal;
+* :mod:`cbf_tpu.analysis.registry` / :mod:`~cbf_tpu.analysis.report` —
+  the rule table and the text/JSON reporters.
+
+CLI: ``python -m cbf_tpu lint [paths] [--all] [--json]
+[--show-suppressed]`` — docs/API.md "Static analysis" documents the
+rule IDs and the suppression format; tests/test_analysis.py enforces
+repo-cleanliness as tier-1.
+"""
+
+from cbf_tpu.analysis.registry import RULES, Finding, Rule, rule_ids
+from cbf_tpu.analysis.report import (LintResult, render_json, render_text,
+                                     run_lint)
+
+__all__ = [
+    "Finding", "LintResult", "RULES", "Rule", "render_json",
+    "render_text", "rule_ids", "run_lint",
+]

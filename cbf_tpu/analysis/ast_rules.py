@@ -1,0 +1,639 @@
+"""AST trace-safety linter: find host syncs and recompile hazards in
+code that runs under a JAX trace, without importing or executing it.
+
+The analyzer works per module in two passes:
+
+1. **Scope inference** — decide which functions are *traced scope*:
+   their bodies execute under ``jit``/``scan``/``cond``/``vmap``/
+   ``shard_map`` tracing, so host-syncing constructs there are bugs.
+   A function is traced if any of:
+
+   * it is decorated with a tracing transform (``@jax.jit``,
+     ``@functools.partial(jax.jit, ...)``, ``@jax.vmap``, ...);
+   * it is passed (by name, or as an inline ``lambda``) to a tracing
+     call — ``lax.scan``/``cond``/``while_loop``/``fori_loop``/
+     ``switch``, ``jax.jit``/``vmap``/``grad``, ``shard_map``,
+     ``checkify`` — anywhere in the module;
+   * it directly calls ``lax`` control flow itself (a step-fn wrapper
+     composing ``lax.cond`` manipulates tracers inline even when the
+     module never hands it to ``scan`` — the faults/tap wrapper
+     pattern);
+   * it is defined inside a traced function (nested defs run at trace
+     time).
+
+   Functions passed as the *callback* to ``io_callback``/
+   ``pure_callback``/``jax.debug.callback`` are **host scope** — they
+   run on the host by construction, and host-ness overrides traced-ness
+   (the telemetry tap's ``host_emit`` calls ``.item()`` legitimately).
+   Traced-ness then propagates through same-module direct calls: a
+   helper invoked from a traced body is itself traced.
+
+2. **Rule checks** — inside traced scopes, flag host-sync constructs
+   (TS001-TS008); module-wide, flag recompile hazards (RC001-RC003).
+   "Array-valued" is decided by a conservative intra-function dataflow:
+   an expression is *arrayish* if it is built from ``jnp.``/``lax.``
+   calls or from names assigned from such expressions. Branching on
+   plain Python config (``if cfg.dynamics == "unicycle"``) is therefore
+   never flagged — exactly the static/traced distinction the rules
+   exist to police. The inference is deliberately under-approximate:
+   a miss is a finding the next reviewer can still catch, a false
+   positive is a baseline entry forever.
+
+Everything here is pure ``ast`` — no jax import, no code execution —
+so the linter runs in milliseconds and can't be broken by import-time
+side effects of the code under analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable
+
+from cbf_tpu.analysis.registry import Finding
+
+# Transforms whose decorated function body executes under a trace.
+TRACE_DECORATORS = frozenset({
+    "jax.jit", "jax.pmap", "jax.vmap", "jax.grad", "jax.value_and_grad",
+    "jax.checkpoint", "jax.remat", "jax.custom_jvp", "jax.custom_vjp",
+    "jax.experimental.shard_map.shard_map",
+})
+
+# Calls whose function-valued arguments become traced scope.
+TRACE_CALLS = frozenset({
+    "jax.lax.scan", "jax.lax.cond", "jax.lax.while_loop",
+    "jax.lax.fori_loop", "jax.lax.switch", "jax.lax.map",
+    "jax.lax.associative_scan", "jax.lax.custom_root",
+    "jax.jit", "jax.pmap", "jax.vmap", "jax.grad", "jax.value_and_grad",
+    "jax.checkpoint", "jax.remat", "jax.linearize", "jax.jvp", "jax.vjp",
+    "jax.experimental.shard_map.shard_map",
+    "jax.experimental.checkify.checkify",
+    "jax.make_jaxpr", "jax.eval_shape",
+})
+
+
+def _is_trace_call(name: str | None) -> bool:
+    if name is None:
+        return False
+    if name in TRACE_CALLS:
+        return True
+    # shard_map travels under several paths across jax versions
+    # (jax.shard_map, jax.experimental.shard_map.shard_map) and repos
+    # wrap it in local compat shims that keep the name — a call NAMED
+    # shard_map taking a function is a tracing boundary wherever the
+    # symbol actually lives (parallel/ensemble.py's check_rep shim).
+    return name == "shard_map" or name.endswith(".shard_map")
+
+# Direct lax control-flow: a function calling these composes tracer
+# control flow inline — traced scope even if never handed to scan in
+# this module (the step-fn wrapper pattern: faults/tap compose lax.cond
+# and are scanned elsewhere). lax.scan itself is deliberately NOT in
+# this set: a function that calls scan at its top level is the DRIVER —
+# its own body runs host-side (eagerly or once at jit trace) and
+# host-side reporting after the scan is fine; only the scanned body is
+# traced, and it is marked through TRACE_CALLS.
+LAX_CONTROL_FLOW = frozenset({
+    "jax.lax.cond", "jax.lax.while_loop",
+    "jax.lax.fori_loop", "jax.lax.switch",
+})
+
+# Calls whose first function argument runs on the HOST (overrides traced).
+HOST_CALLBACK_CALLS = frozenset({
+    "jax.experimental.io_callback", "jax.pure_callback",
+    "jax.experimental.pure_callback", "jax.debug.callback",
+    "jax.experimental.host_callback.call",
+})
+
+# numpy constructors that materialize host arrays (TS003 / RC003).
+NP_MATERIALIZERS = frozenset({"numpy.asarray", "numpy.array"})
+ARRAY_CONSTRUCTOR_SUFFIXES = frozenset({
+    "array", "asarray", "zeros", "ones", "full", "arange", "linspace",
+    "eye", "broadcast_to", "stack", "concatenate",
+})
+
+HOST_CLOCK_CALLS = frozenset({
+    "time.time", "time.perf_counter", "time.monotonic", "time.sleep",
+    "time.process_time",
+})
+
+class _Func:
+    """One function-like scope (def or lambda) with lint bookkeeping."""
+
+    __slots__ = ("node", "qualname", "parent", "params", "traced", "host",
+                 "jit_rooted")
+
+    def __init__(self, node, qualname: str, parent: "_Func | None"):
+        self.node = node
+        self.qualname = qualname
+        self.parent = parent
+        args = node.args
+        names = [a.arg for a in (args.posonlyargs + args.args
+                                 + args.kwonlyargs)]
+        if args.vararg:
+            names.append(args.vararg.arg)
+        if args.kwarg:
+            names.append(args.kwarg.arg)
+        self.params = set(names)
+        self.traced = False
+        self.host = False
+        self.jit_rooted = False   # RC003: traced via a *jit* boundary
+
+
+class ModuleLinter:
+    """Lint one module's source: ``ModuleLinter(src, path).findings()``."""
+
+    def __init__(self, source: str, path: str):
+        self.path = path
+        self.tree = ast.parse(source, filename=path)
+        self.aliases = _import_aliases(self.tree)
+        self.funcs: list[_Func] = []
+        self._by_node: dict[ast.AST, _Func] = {}
+        self._by_name: dict[str, list[_Func]] = {}
+        self._collect(self.tree, parent=None, prefix="")
+        self._infer_scopes()
+
+    # -- name normalization ----------------------------------------------
+
+    def _dotted(self, node) -> str | None:
+        """Normalized dotted path of an expression ("jnp.sum" ->
+        "jax.numpy.sum"), or None for non-name expressions."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        parts.reverse()
+        head = self.aliases.get(parts[0], parts[0])
+        return ".".join([head] + parts[1:])
+
+    def _call_target(self, call: ast.Call) -> str | None:
+        name = self._dotted(call.func)
+        if name == "functools.partial" and call.args:
+            # functools.partial(jax.jit, ...) IS jax.jit for our purposes.
+            inner = self._dotted(call.args[0])
+            return inner
+        return name
+
+    # -- pass 1: collect + scope inference -------------------------------
+
+    def _collect(self, node, parent, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{child.name}"
+                fn = _Func(child, qn, parent)
+                self.funcs.append(fn)
+                self._by_node[child] = fn
+                self._by_name.setdefault(child.name, []).append(fn)
+                self._collect(child, fn, qn + ".")
+            elif isinstance(child, ast.Lambda):
+                qn = f"{prefix}<lambda L{child.lineno}>"
+                fn = _Func(child, qn, parent)
+                self.funcs.append(fn)
+                self._by_node[child] = fn
+                self._collect(child, fn, qn + ".")
+            else:
+                self._collect(child, parent, prefix)
+
+    def _resolve_func_arg(self, node) -> "_Func | None":
+        if isinstance(node, ast.Lambda):
+            return self._by_node.get(node)
+        if isinstance(node, ast.Name):
+            cands = self._by_name.get(node.id)
+            return cands[-1] if cands else None
+        return None
+
+    def _infer_scopes(self):
+        # Decorator roots.
+        for fn in self.funcs:
+            for dec in getattr(fn.node, "decorator_list", ()):
+                name = (self._call_target(dec) if isinstance(dec, ast.Call)
+                        else self._dotted(dec))
+                if name in TRACE_DECORATORS or _is_trace_call(name):
+                    fn.traced = True
+                    if name and name.endswith("jit"):
+                        fn.jit_rooted = True
+        # Call-site roots + host callbacks, anywhere in the module.
+        for call in ast.walk(self.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            name = self._call_target(call)
+            if name in HOST_CALLBACK_CALLS:
+                if call.args:
+                    tgt = self._resolve_func_arg(call.args[0])
+                    if tgt is not None:
+                        tgt.host = True
+                continue
+            if _is_trace_call(name):
+                for arg in list(call.args) + [k.value for k in
+                                              call.keywords]:
+                    tgt = self._resolve_func_arg(arg)
+                    if tgt is not None:
+                        tgt.traced = True
+                        if name and name.endswith("jit"):
+                            tgt.jit_rooted = True
+        # Inline lax control flow marks the calling function itself.
+        for fn in self.funcs:
+            for call in self._own_nodes(fn, ast.Call):
+                if self._call_target(call) in LAX_CONTROL_FLOW:
+                    fn.traced = True
+        # Nested defs of traced functions run at trace time; nested defs
+        # of host callbacks run on host. Then propagate traced-ness
+        # through same-module direct calls to a fixpoint.
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.funcs:
+                if fn.parent is not None:
+                    if fn.parent.host and not fn.host:
+                        fn.host = True
+                        changed = True
+                    if fn.parent.traced and not fn.traced and not fn.host:
+                        fn.traced = True
+                        changed = True
+                if not fn.traced or fn.host:
+                    continue
+                for call in self._own_nodes(fn, ast.Call):
+                    if isinstance(call.func, ast.Name):
+                        for cand in self._by_name.get(call.func.id, ()):
+                            if not cand.traced and not cand.host:
+                                cand.traced = True
+                                changed = True
+
+    def _own_nodes(self, fn: _Func, kind) -> Iterable:
+        """Nodes lexically in ``fn``'s body, excluding nested function
+        scopes (they are analyzed as their own scopes)."""
+        body = (fn.node.body if isinstance(fn.node.body, list)
+                else [fn.node.body])
+
+        def walk(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                if isinstance(child, kind):
+                    yield child
+                yield from walk(child)
+
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(stmt, kind):
+                yield stmt
+            yield from walk(stmt)
+
+    # -- arrayish dataflow -----------------------------------------------
+
+    def _arrayish_call(self, call: ast.Call, arrayish: set[str]) -> bool:
+        name = self._dotted(call.func)
+        if name is not None:
+            head = name.split(".")[0]
+            full = name
+            if full.startswith(("jax.numpy.", "jax.lax.", "jax.nn.",
+                                "jax.random.", "jax.scipy.")):
+                return True
+            if full.startswith("jax.") and full.count(".") == 1 and \
+                    full.split(".")[1] in ("vmap", "grad", "jit"):
+                return False
+            if head in arrayish:
+                # method call on an arrayish value: x.astype(...), .sum()
+                return True
+        elif isinstance(call.func, ast.Attribute):
+            return self._arrayish(call.func.value, arrayish)
+        return False
+
+    def _arrayish(self, node, arrayish: set[str]) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in arrayish
+        if isinstance(node, ast.Call):
+            return self._arrayish_call(node, arrayish)
+        if isinstance(node, ast.BinOp):
+            return (self._arrayish(node.left, arrayish)
+                    or self._arrayish(node.right, arrayish))
+        if isinstance(node, ast.UnaryOp):
+            return self._arrayish(node.operand, arrayish)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` are host-level identity checks
+            # on the BINDING — a tracer is never None; branching on them
+            # is the standard optional-argument pattern, not a host sync.
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return (self._arrayish(node.left, arrayish)
+                    or any(self._arrayish(c, arrayish)
+                           for c in node.comparators))
+        if isinstance(node, ast.BoolOp):
+            return any(self._arrayish(v, arrayish) for v in node.values)
+        if isinstance(node, ast.Attribute):
+            # Static array metadata is Python-valued under trace: .shape
+            # tuples, .ndim/.size ints, .dtype — branching on them is the
+            # fixed-shape idiom this codebase is built on, not a sync.
+            if node.attr in ("shape", "ndim", "dtype", "size", "_fields"):
+                return False
+            return self._arrayish(node.value, arrayish)
+        if isinstance(node, ast.Subscript):
+            return self._arrayish(node.value, arrayish)
+        if isinstance(node, ast.IfExp):
+            return (self._arrayish(node.body, arrayish)
+                    or self._arrayish(node.orelse, arrayish))
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._arrayish(e, arrayish) for e in node.elts)
+        return False
+
+    def _arrayish_names(self, fn: _Func) -> set[str]:
+        """Names bound (directly or transitively) to jnp/lax results in
+        ``fn``'s own body. Two passes so loop-carried rebinds settle."""
+        arrayish: set[str] = set()
+        for _ in range(2):
+            for stmt in self._own_nodes(fn, (ast.Assign, ast.AugAssign,
+                                             ast.AnnAssign)):
+                value = stmt.value
+                if value is None:
+                    continue
+                if not self._arrayish(value, arrayish):
+                    continue
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                for t in targets:
+                    for leaf in ast.walk(t):
+                        if isinstance(leaf, ast.Name):
+                            arrayish.add(leaf.id)
+        return arrayish
+
+    # -- pass 2: rules ---------------------------------------------------
+
+    def findings(self) -> list[Finding]:
+        out: list[Finding] = []
+        for fn in self.funcs:
+            if fn.traced and not fn.host:
+                out.extend(self._check_traced(fn))
+        out.extend(self._check_recompile_hazards())
+        out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return out
+
+    def _find(self, rule, node, fn, message) -> Finding:
+        sym = fn.qualname if fn is not None else "<module>"
+        return Finding(rule, self.path, node.lineno, node.col_offset,
+                       sym, message)
+
+    def _check_traced(self, fn: _Func) -> list[Finding]:
+        out = []
+        arrayish = self._arrayish_names(fn)
+        for call in self._own_nodes(fn, ast.Call):
+            name = self._dotted(call.func)
+            if isinstance(call.func, ast.Attribute) and \
+                    call.func.attr in ("item", "tolist"):
+                out.append(self._find(
+                    "TS001", call, fn,
+                    f".{call.func.attr}() in traced scope forces a host "
+                    "sync on the traced value"))
+            if isinstance(call.func, ast.Name) and \
+                    call.func.id in ("float", "int", "bool") and \
+                    call.func.id not in self.aliases and call.args and \
+                    self._arrayish(call.args[0], arrayish):
+                out.append(self._find(
+                    "TS002", call, fn,
+                    f"{call.func.id}() concretizes an array value in "
+                    "traced scope"))
+            if name in NP_MATERIALIZERS and call.args and \
+                    self._arrayish(call.args[0], arrayish):
+                out.append(self._find(
+                    "TS003", call, fn,
+                    f"{name.split('.')[-1]}() pulls a traced value to "
+                    "host numpy inside traced scope"))
+            if isinstance(call.func, ast.Name) and call.func.id == "print" \
+                    and "print" not in self.aliases:
+                out.append(self._find(
+                    "TS006", call, fn,
+                    "print() in traced scope runs once at trace time; "
+                    "use jax.debug.print for per-step output"))
+            if name in HOST_CLOCK_CALLS:
+                out.append(self._find(
+                    "TS007", call, fn,
+                    f"{name}() in traced scope is a trace-time constant"))
+            if name is not None and name.startswith("jax.debug."):
+                out.append(self._find(
+                    "TS008", call, fn,
+                    f"{name} left in traced scope (host callback on the "
+                    "hot path)"))
+        for node in self._own_nodes(fn, ast.If):
+            if self._arrayish(node.test, arrayish):
+                out.append(self._find(
+                    "TS004", node, fn,
+                    "`if` branches on an array-valued expression in "
+                    "traced scope; use lax.cond/jnp.where"))
+        for node in self._own_nodes(fn, ast.While):
+            if self._arrayish(node.test, arrayish):
+                out.append(self._find(
+                    "TS005", node, fn,
+                    "`while` loops on an array-valued expression in "
+                    "traced scope; use lax.while_loop"))
+        return out
+
+    # -- recompile hazards -----------------------------------------------
+
+    def _check_recompile_hazards(self) -> list[Finding]:
+        out = []
+        # RC001: static_argnums/argnames vs the decorated signature.
+        for fn in self.funcs:
+            for dec in getattr(fn.node, "decorator_list", ()):
+                if isinstance(dec, ast.Call) and \
+                        self._call_target(dec) in ("jax.jit", "jit"):
+                    out.extend(self._check_static_args(dec, fn, fn))
+        for call in ast.walk(self.tree):
+            if not (isinstance(call, ast.Call)
+                    and self._call_target(call) == "jax.jit"):
+                continue
+            if self._dotted(call.func) == "jax.jit":
+                fn_arg = call.args[0] if call.args else None
+            else:                      # functools.partial(jax.jit, fn, ...)
+                fn_arg = call.args[1] if len(call.args) > 1 else None
+            tgt = self._resolve_func_arg(fn_arg)
+            if tgt is not None:
+                out.extend(self._check_static_args(
+                    call, tgt, self._enclosing(call)))
+        # RC002: jit constructed inside a loop body.
+        out.extend(self._check_jit_in_loop(self.tree, None, 0))
+        # RC003: jit roots closing over enclosing-function arrays.
+        for fn in self.funcs:
+            if fn.jit_rooted and fn.parent is not None:
+                out.extend(self._check_closure_arrays(fn))
+        return out
+
+    def _enclosing(self, node) -> "_Func | None":
+        # cheap parent lookup: walk functions and test lexical containment
+        for fn in reversed(self.funcs):
+            for n in ast.walk(fn.node):
+                if n is node:
+                    return fn
+        return None
+
+    def _check_static_args(self, call: ast.Call, target: _Func,
+                           where: "_Func | None") -> list[Finding]:
+        out = []
+        args_node = target.node.args
+        params = [a.arg for a in (args_node.posonlyargs + args_node.args)]
+        defaults = {p: d for p, d in zip(reversed(params),
+                                         reversed(args_node.defaults))}
+        kw_defaults = {a.arg: d for a, d in zip(args_node.kwonlyargs,
+                                                args_node.kw_defaults)
+                       if d is not None}
+        defaults.update(kw_defaults)
+        all_params = set(params) | {a.arg for a in args_node.kwonlyargs}
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for c in ast.walk(kw.value):
+                    if isinstance(c, ast.Constant) and \
+                            isinstance(c.value, str) and \
+                            c.value not in all_params:
+                        out.append(self._find(
+                            "RC001", call, where,
+                            f"static_argnames names {c.value!r}, which "
+                            f"{target.qualname}() has no parameter for "
+                            "(rename drift — jit will reject or retrace)"))
+            if kw.arg in ("static_argnums", "static_argnames"):
+                names = []
+                for c in ast.walk(kw.value):
+                    if isinstance(c, ast.Constant):
+                        if isinstance(c.value, int) and \
+                                0 <= c.value < len(params):
+                            names.append(params[c.value])
+                        elif isinstance(c.value, str):
+                            names.append(c.value)
+                for pname in names:
+                    d = defaults.get(pname)
+                    if d is None:
+                        continue
+                    if isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                            isinstance(d, ast.Call) and
+                            (self._dotted(d.func) or "").split(".")[-1]
+                            in ARRAY_CONSTRUCTOR_SUFFIXES):
+                        out.append(self._find(
+                            "RC001", call, where,
+                            f"static argument {pname!r} of "
+                            f"{target.qualname}() defaults to an "
+                            "unhashable value — every call re-keys the "
+                            "jit cache (TypeError or retrace)"))
+        return out
+
+    def _check_jit_in_loop(self, node, fn, loop_depth) -> list[Finding]:
+        out = []
+        for child in ast.iter_child_nodes(node):
+            depth = loop_depth
+            if isinstance(child, (ast.For, ast.While)):
+                depth += 1
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a fresh function scope resets loop context
+                out.extend(self._check_jit_in_loop(
+                    child, self._by_node.get(child), 0))
+                continue
+            if isinstance(child, ast.Call) and depth > 0 and \
+                    self._call_target(child) in ("jax.jit",):
+                out.append(self._find(
+                    "RC002", child, fn,
+                    "jax.jit(...) constructed inside a loop body — the "
+                    "fresh wrapper compiles anew every iteration; hoist "
+                    "it (or cache per static key)"))
+            out.extend(self._check_jit_in_loop(child, fn, depth))
+        return out
+
+    def _check_closure_arrays(self, fn: _Func) -> list[Finding]:
+        out = []
+        bound = set(fn.params)
+        for stmt in self._own_nodes(fn, (ast.Assign, ast.AugAssign,
+                                         ast.AnnAssign)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for t in targets:
+                for leaf in ast.walk(t):
+                    if isinstance(leaf, ast.Name):
+                        bound.add(leaf.id)
+        for inner in self.funcs:
+            if inner.parent is fn:
+                bound.add(getattr(inner.node, "name", ""))
+        free = set()
+        for name in self._own_nodes(fn, ast.Name):
+            if isinstance(name.ctx, ast.Load) and name.id not in bound:
+                free.add(name.id)
+        scope = fn.parent
+        while scope is not None:
+            for stmt in self._own_nodes(scope, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name) and t.id in free and \
+                            isinstance(stmt.value, ast.Call):
+                        cname = self._dotted(stmt.value.func) or ""
+                        if cname.startswith(("jax.numpy.", "numpy.")) and \
+                                cname.split(".")[-1] in \
+                                ARRAY_CONSTRUCTOR_SUFFIXES:
+                            out.append(self._find(
+                                "RC003", fn.node, fn,
+                                f"jit-compiled {fn.qualname}() closes "
+                                f"over array {t.id!r} built in "
+                                f"{scope.qualname}() — baked in as a "
+                                "constant; pass it as an argument"))
+            scope = scope.parent
+        return out
+
+
+def lint_source(source: str, path: str) -> list[Finding]:
+    """Lint one module's source text. Raises SyntaxError on broken input."""
+    return ModuleLinter(source, path).findings()
+
+
+def lint_paths(paths: Iterable[str], repo_root: str | None = None
+               ) -> list[Finding]:
+    """Lint every ``.py`` file under ``paths`` (files or directories).
+
+    Paths in findings are repo-root-relative when ``repo_root`` is given
+    (the form the baseline stores), absolute/as-given otherwise.
+    """
+    files: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                # analysis_fixtures holds the DELIBERATELY-bad rule
+                # snippets (tests/analysis_fixtures) — linting the
+                # linter's own true-positive corpus would make every
+                # whole-repo run fail by design.
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git",
+                                            "analysis_fixtures")]
+                files.extend(os.path.join(dirpath, f)
+                             for f in filenames if f.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    findings: list[Finding] = []
+    for f in sorted(set(files)):
+        rel = os.path.relpath(f, repo_root) if repo_root else f
+        with open(f, encoding="utf-8") as fh:
+            try:
+                findings.extend(lint_source(fh.read(), rel))
+            except SyntaxError as e:
+                findings.append(Finding(
+                    "TS001", rel, e.lineno or 0, 0, "<module>",
+                    f"unparseable module: {e.msg}"))
+    return findings
+
+
+def _import_aliases(tree: ast.Module) -> dict[str, str]:
+    """Map local names to canonical dotted module paths.
+
+    ``import jax.numpy as jnp`` -> {"jnp": "jax.numpy"};
+    ``from jax import lax`` -> {"lax": "jax.lax"};
+    ``from jax.experimental import io_callback`` ->
+    {"io_callback": "jax.experimental.io_callback"}.
+    """
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module and \
+                node.level == 0:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    # normalize common shorthand: `import numpy as np` handled above;
+    # nothing else to do — _dotted() resolves through this map.
+    return aliases

@@ -1,0 +1,382 @@
+"""Consolidated repo audits: the three former standalone scripts as
+analyzer rules.
+
+``scripts/obs_schema_audit.py``, ``scripts/tier1_marker_audit.py`` and
+``scripts/chain_depth.py`` each grew ad hoc as one PR's regression
+gate; this module is their one home so ``cbf_tpu lint --all`` runs the
+whole correctness surface in one invocation. The scripts remain as thin
+shims (same CLI, same ``audit()``/``chain_profile()`` entry points) so
+existing tier-1 tests and operator muscle memory keep working.
+
+* AUD001 — telemetry schema drift (StepOutputs/EnsembleMetrics vs the
+  heartbeat schema and docs/API.md);
+* AUD002 — budget-shaped tests missing ``@pytest.mark.slow`` (the
+  870 s tier-1 budget);
+* AUD003 — certificate chain-depth regression (the fused ADMM
+  iteration's serialized pair-op chain vs its pinned bound).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from cbf_tpu.analysis.registry import Finding
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# -- AUD001: obs schema drift (former scripts/obs_schema_audit.py) --------
+
+
+def obs_schema_audit(repo_root: str | None = None) -> list[str]:
+    """One "what drifted — where" string per violation (see the shim's
+    docstring for the four invariants)."""
+    from cbf_tpu.obs import schema
+    from cbf_tpu.parallel.ensemble import EnsembleMetrics
+    from cbf_tpu.rollout.engine import StepOutputs
+
+    repo = repo_root or _REPO
+    problems = []
+
+    mapped_step = schema.step_output_channels()
+    for field in StepOutputs._fields:
+        if field in mapped_step or \
+                field in schema.EXCLUDED_STEP_OUTPUT_FIELDS:
+            continue
+        problems.append(
+            f"StepOutputs.{field} is neither a heartbeat channel "
+            "(schema.HEARTBEAT_FIELDS.step_output) nor excluded with a "
+            "reason (schema.EXCLUDED_STEP_OUTPUT_FIELDS)")
+
+    mapped_ens = schema.ensemble_channels()
+    for field in EnsembleMetrics._fields:
+        if field in mapped_ens or \
+                field in schema.EXCLUDED_ENSEMBLE_FIELDS:
+            continue
+        problems.append(
+            f"EnsembleMetrics.{field} is neither a heartbeat channel "
+            "(schema.HEARTBEAT_FIELDS.ensemble) nor excluded with a "
+            "reason (schema.EXCLUDED_ENSEMBLE_FIELDS)")
+
+    # Dangling mappings: schema entries naming fields the structs no
+    # longer have (a struct rename must update the schema in the same PR).
+    for f in schema.HEARTBEAT_FIELDS:
+        if f.step_output is not None and \
+                f.step_output not in StepOutputs._fields:
+            problems.append(
+                f"schema field {f.name!r} maps step_output="
+                f"{f.step_output!r}, which StepOutputs does not have")
+        if f.ensemble is not None and \
+                f.ensemble not in EnsembleMetrics._fields:
+            problems.append(
+                f"schema field {f.name!r} maps ensemble={f.ensemble!r}, "
+                "which EnsembleMetrics does not have")
+        if f.reduce not in ("min", "max", "sum"):
+            problems.append(
+                f"schema field {f.name!r} has unknown reduction "
+                f"{f.reduce!r}")
+        if f.kind not in ("gauge", "counter"):
+            problems.append(
+                f"schema field {f.name!r} has unknown kind {f.kind!r}")
+    for field, reason in schema.EXCLUDED_STEP_OUTPUT_FIELDS.items():
+        if field not in StepOutputs._fields:
+            problems.append(
+                f"EXCLUDED_STEP_OUTPUT_FIELDS names {field!r}, which "
+                "StepOutputs does not have")
+        if not reason.strip():
+            problems.append(f"exclusion of StepOutputs.{field} has no "
+                            "reason")
+    for field, reason in schema.EXCLUDED_ENSEMBLE_FIELDS.items():
+        if field not in EnsembleMetrics._fields:
+            problems.append(
+                f"EXCLUDED_ENSEMBLE_FIELDS names {field!r}, which "
+                "EnsembleMetrics does not have")
+        if not reason.strip():
+            problems.append(f"exclusion of EnsembleMetrics.{field} has no "
+                            "reason")
+
+    # Docs: every heartbeat field + alert kind must be documented.
+    api_path = os.path.join(repo, "docs", "API.md")
+    try:
+        with open(api_path, encoding="utf-8") as fh:
+            api_text = fh.read()
+    except OSError:
+        problems.append(f"docs/API.md unreadable at {api_path}")
+        api_text = ""
+    if api_text:
+        for f in schema.HEARTBEAT_FIELDS:
+            if f"`{f.name}`" not in api_text:
+                problems.append(
+                    f"heartbeat field `{f.name}` is undocumented in "
+                    "docs/API.md")
+        from cbf_tpu.obs import watchdog
+        for kind in watchdog.ALERT_KINDS:
+            if f"`{kind}`" not in api_text:
+                problems.append(
+                    f"watchdog alert kind `{kind}` is undocumented in "
+                    "docs/API.md")
+    return problems
+
+
+# -- AUD002: tier-1 slow markers (former scripts/tier1_marker_audit.py) ---
+
+N_LIMIT = 8192
+STEPS_LIMIT = 2000
+CERT_N_LIMIT = 512
+
+
+def _int_value(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+def _is_slow_marked(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        # pytest.mark.slow (bare) or pytest.mark.slow(...) (called).
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if isinstance(target, ast.Attribute) and target.attr == "slow":
+            return True
+    return False
+
+
+def _budget_violations(fn: ast.FunctionDef) -> list[str]:
+    """Budget-shaped constructs inside one test function."""
+    hits = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        kw = {k.arg: _int_value(k.value) for k in node.keywords if k.arg}
+        certificate = any(
+            k.arg == "certificate" and isinstance(k.value, ast.Constant)
+            and k.value.value is True for k in node.keywords)
+        n = kw.get("n") or kw.get("N")
+        steps = kw.get("steps")
+        if n is not None and n >= N_LIMIT:
+            hits.append(f"n={n} >= {N_LIMIT}")
+        if (certificate and n is not None and n >= CERT_N_LIMIT
+                and steps is not None and steps >= STEPS_LIMIT):
+            hits.append(f"certificate n={n}, steps={steps} "
+                        f">= {STEPS_LIMIT}")
+    # Parametrize lists can also carry the sizes (test_large_n pattern).
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        target = dec.func
+        if not (isinstance(target, ast.Attribute)
+                and target.attr == "parametrize"):
+            continue
+        for arg in ast.walk(dec):
+            v = _int_value(arg)
+            if v is not None and v >= N_LIMIT:
+                hits.append(f"parametrized size {v} >= {N_LIMIT}")
+    return hits
+
+
+def tier1_marker_audit(tests_dir: str | None = None) -> list[str]:
+    """Return "file::test — reason" strings for every unmarked
+    budget-shaped test."""
+    tests_dir = tests_dir or os.path.join(_REPO, "tests")
+    problems = []
+    for name in sorted(os.listdir(tests_dir)):
+        if not (name.startswith("test_") and name.endswith(".py")):
+            continue
+        path = os.path.join(tests_dir, name)
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.FunctionDef) \
+                    or not node.name.startswith("test_"):
+                continue
+            if _is_slow_marked(node):
+                continue
+            for reason in _budget_violations(node):
+                problems.append(f"{name}::{node.name} — {reason} "
+                                "(mark @pytest.mark.slow or shrink)")
+    return problems
+
+
+# -- AUD003: chain-depth regression (former scripts/chain_depth.py) -------
+
+# Serialized memory-bound accesses over the pair-row axis. Elementwise
+# ops between them fuse and add no dependent kernel.
+HEAVY_PRIMITIVES = frozenset({
+    "gather", "scatter", "scatter-add", "scatter_add",
+    "dynamic_slice", "dynamic_update_slice",
+})
+
+# Call-like primitives whose sub-jaxpr executes once, inline.
+_SUBJAXPR_PARAMS = ("jaxpr", "call_jaxpr", "fun_jaxpr")
+
+# The pinned bound tests/test_fused_batched.py enforces: the fused
+# iteration's whole point is a <= 4 serialized pair-op chain.
+FUSED_CHAIN_DEPTH_BOUND = 4
+
+
+def _literal_type():
+    try:  # newer JAX moved jaxpr types under jax.extend
+        from jax.extend.core import Literal
+    except ImportError:  # pragma: no cover - older layout
+        from jax.core import Literal
+    return Literal
+
+
+def _sub_jaxpr(params, key):
+    j = params.get(key)
+    if j is None:
+        return None
+    return j.jaxpr if hasattr(j, "jaxpr") else j
+
+
+def _analyze(jaxpr, in_depths, counts):
+    """Longest heavy-op path through ``jaxpr``.
+
+    ``in_depths``: chain depth already accumulated on each invar.
+    Returns per-output depths; ``counts`` (dict) accumulates total heavy
+    ops by primitive name. Scan bodies contribute ``length`` sequential
+    passes (the carry serializes them); cond takes the max over branches.
+    """
+    Literal = _literal_type()
+    env = {}
+
+    def read(atom):
+        if isinstance(atom, Literal):
+            return 0
+        return env.get(atom, 0)
+
+    def write(var, depth):
+        env[var] = depth
+
+    for var in jaxpr.constvars:
+        write(var, 0)
+    for var, depth in zip(jaxpr.invars, in_depths):
+        write(var, depth)
+
+    for eqn in jaxpr.eqns:
+        din = max((read(a) for a in eqn.invars), default=0)
+        name = eqn.primitive.name
+        if name == "scan":
+            body = _sub_jaxpr(eqn.params, "jaxpr")
+            length = int(eqn.params.get("length", 1))
+            sub_counts: dict = {}
+            # One pass from zero depth gives the per-pass carry increment;
+            # the carry dependency serializes passes, so the scan's chain
+            # contribution is length * that increment.
+            outs = _analyze(body, [0] * len(body.invars), sub_counts)
+            n_carry = int(eqn.params.get("num_carry", 0))
+            inc = max(outs[:n_carry], default=0) if n_carry else \
+                max(outs, default=0)
+            for k, v in sub_counts.items():
+                counts[k] = counts.get(k, 0) + v * length
+            for var in eqn.outvars:
+                write(var, din + inc * length)
+        elif name == "while":
+            # Not expected in a single-iteration trace; treat as one pass
+            # of cond+body so a future refactor degrades loudly (depth
+            # grows) instead of silently hiding ops.
+            total = din
+            for key in ("cond_jaxpr", "body_jaxpr"):
+                body = _sub_jaxpr(eqn.params, key)
+                if body is not None:
+                    outs = _analyze(body, [total] * len(body.invars), counts)
+                    total = max(outs, default=total)
+            for var in eqn.outvars:
+                write(var, total)
+        elif name == "cond":
+            branch_outs = []
+            for br in eqn.params.get("branches", ()):
+                body = br.jaxpr if hasattr(br, "jaxpr") else br
+                branch_outs.append(
+                    _analyze(body, [din] * len(body.invars), counts))
+            for i, var in enumerate(eqn.outvars):
+                write(var, max((o[i] for o in branch_outs), default=din))
+        else:
+            body = None
+            for key in _SUBJAXPR_PARAMS:
+                body = _sub_jaxpr(eqn.params, key)
+                if body is not None:
+                    break
+            if body is not None:
+                outs = _analyze(
+                    body, [read(a) for a in eqn.invars][:len(body.invars)],
+                    counts)
+                for var, d in zip(eqn.outvars, outs):
+                    write(var, d)
+            else:
+                dout = din + 1 if name in HEAVY_PRIMITIVES else din
+                if name in HEAVY_PRIMITIVES:
+                    counts[name] = counts.get(name, 0) + 1
+                for var in eqn.outvars:
+                    write(var, dout)
+
+    return [read(a) for a in jaxpr.outvars]
+
+
+def chain_profile(settings=None, N: int = 64, k: int = 8,
+                  agent_k: int | None = None) -> dict:
+    """Profile one ADMM iteration of the sparse certificate solver.
+
+    Returns {"chain_depth", "heavy_ops", "op_counts"} for one iteration
+    of :func:`cbf_tpu.solvers.sparse_admm.admm_iteration_spec`'s step
+    function under ``settings`` with the inner budget normalized to one
+    step (``cg_iters=1``: the knob scales the chain linearly everywhere,
+    fusion changes the chain's STRUCTURE — the constant this isolates).
+    """
+    import jax
+
+    from cbf_tpu.solvers.sparse_admm import (SparseADMMSettings,
+                                             admm_iteration_spec)
+
+    settings = settings if settings is not None else SparseADMMSettings()
+    settings = settings._replace(cg_iters=1)
+    step, carry0 = admm_iteration_spec(N=N, k=k, settings=settings,
+                                       agent_k=agent_k)
+    closed = jax.make_jaxpr(step)(carry0)
+    counts: dict = {}
+    out_depths = _analyze(closed.jaxpr, [0] * len(closed.jaxpr.invars),
+                          counts)
+    return {
+        "chain_depth": max(out_depths, default=0),
+        "heavy_ops": sum(counts.values()),
+        "op_counts": dict(sorted(counts.items())),
+    }
+
+
+def chain_depth_audit() -> list[str]:
+    """The regression gate as audit problems: fused <= pinned bound,
+    and fused strictly shallower than the default path."""
+    from cbf_tpu.solvers.sparse_admm import SparseADMMSettings
+
+    fused = chain_profile(SparseADMMSettings(fused=True, ksolve="chebyshev"))
+    default = chain_profile(SparseADMMSettings())
+    problems = []
+    if fused["chain_depth"] > FUSED_CHAIN_DEPTH_BOUND:
+        problems.append(
+            f"fused ADMM iteration chain_depth={fused['chain_depth']} "
+            f"exceeds the pinned bound {FUSED_CHAIN_DEPTH_BOUND} "
+            f"(op_counts={fused['op_counts']})")
+    if fused["chain_depth"] >= default["chain_depth"]:
+        problems.append(
+            f"fused chain_depth={fused['chain_depth']} is not shallower "
+            f"than the default path's {default['chain_depth']} — the "
+            "fusion no longer buys anything")
+    return problems
+
+
+# -- runner ----------------------------------------------------------------
+
+def run_audits(repo_root: str | None = None) -> list[Finding]:
+    """All three audits as Findings (the ``lint --all`` surface)."""
+    findings = []
+    for msg in obs_schema_audit(repo_root):
+        findings.append(Finding("AUD001", "cbf_tpu/obs/schema.py", 0, 0,
+                                "<schema>", msg))
+    for msg in tier1_marker_audit(
+            os.path.join(repo_root or _REPO, "tests")):
+        findings.append(Finding("AUD002", "tests/", 0, 0, "<tests>", msg))
+    for msg in chain_depth_audit():
+        findings.append(Finding("AUD003", "cbf_tpu/solvers/sparse_admm.py",
+                                0, 0, "<chain>", msg))
+    return findings

@@ -1,0 +1,348 @@
+"""Jaxpr-level invariant checker: assert properties of the compiled
+entry points on their ABSTRACT traces — no device execution.
+
+Where the AST rules (ast_rules.py) police source patterns, these rules
+police the program JAX actually builds: ``jax.make_jaxpr`` traces each
+public entry point with abstract inputs (ShapedArray only — zeros are
+never materialized on a device beyond trace-time constants), and the
+resulting jaxpr is walked recursively through every sub-jaxpr
+(``pjit``/``scan``/``cond``/``while``/``shard_map`` bodies). Three
+invariants:
+
+* **JX001 — callback allowlist.** The only host callback permitted on a
+  hot path is the telemetry tap's ``host_emit``
+  (``cbf_tpu.obs.tap.instrument_step``). Anything else —
+  ``jax.debug.print`` left behind, an ``io_callback`` smuggled in by a
+  wrapper, a ``pure_callback`` shim — serializes the dispatch pipeline
+  exactly the way PR 1 removed.
+* **JX002 — f32 dtype discipline.** Traced under x64 (so float64 is
+  *representable*, not silently squashed to f32 the way the default
+  config hides it), the f32 path must stay f32: any
+  ``convert_element_type`` from a narrower float to float64 is drift —
+  a stray ``np.float64`` scalar or dtype-less ``np.linspace`` constant
+  promoting the whole chain.
+* **JX003 — carry aval stability.** Entry points that thread state
+  (rollout state, the certificate solver's warm carry) must return it
+  with bit-identical avals (shape+dtype) to what they took: aval drift
+  means every chunked segment recompiles and the carry can never be
+  donated/aliased.
+
+``check_jaxpr`` is the reusable core (the tests aim it at
+fault-injected step functions); ``run_entrypoint_checks`` traces the
+repo's production surface: ``rollout`` (shared compiled unit of
+``rollout_chunked``), ``sharded_swarm_rollout``, and the fused/batched
+certificate solves.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable
+
+from cbf_tpu.analysis.registry import Finding
+
+# Host-callback primitives (jax 0.4.x names; matched by substring so a
+# rename to e.g. `ordered_io_callback` still trips).
+CALLBACK_PRIMITIVES = ("io_callback", "pure_callback", "debug_callback",
+                      "outside_call", "host_callback")
+
+# The one approved callback target: the telemetry tap's host emitter.
+APPROVED_CALLBACK_MODULES = ("cbf_tpu.obs.",)
+
+
+def _sub_jaxprs(params: dict):
+    """Yield every sub-jaxpr referenced by an eqn's params (closed or
+    open, single or in a branches tuple)."""
+    for v in params.values():
+        vs = v if isinstance(v, (list, tuple)) else [v]
+        for b in vs:
+            if hasattr(b, "jaxpr"):
+                yield b.jaxpr
+            elif hasattr(b, "eqns"):
+                yield b
+
+
+def iter_eqns(jaxpr):
+    """Every eqn in ``jaxpr`` and all nested sub-jaxprs, depth-first."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn.params):
+            yield from iter_eqns(sub)
+
+
+def _callback_target(eqn):
+    """Best-effort extraction of the Python function a callback eqn will
+    invoke (jax wraps it in _FlatCallback / closures across versions)."""
+    for key in ("callback", "callback_func", "fun", "f"):
+        cb = eqn.params.get(key)
+        if cb is None:
+            continue
+        for attr in ("callback_func", "__wrapped__", "func", "fun"):
+            inner = getattr(cb, attr, None)
+            if inner is not None:
+                cb = inner
+        return cb
+    return None
+
+
+def _callback_identity(eqn) -> tuple[str, str]:
+    fn = _callback_target(eqn)
+    if fn is None:
+        return "<unknown>", "<unknown>"
+    mod = getattr(fn, "__module__", None) or "<unknown>"
+    qual = getattr(fn, "__qualname__", None) or repr(fn)
+    # debug_callback wraps the user fn in a local _flat_callback whose
+    # module is jax._src.debugging; chase the closure for the real one.
+    closure = getattr(fn, "__closure__", None) or ()
+    for cell in closure:
+        c = cell.cell_contents
+        if callable(c) and getattr(c, "__module__", "").startswith(
+                "cbf_tpu"):
+            return c.__module__, getattr(c, "__qualname__", repr(c))
+    return mod, qual
+
+
+def _is_approved_callback(eqn) -> bool:
+    mod, _ = _callback_identity(eqn)
+    return mod.startswith(APPROVED_CALLBACK_MODULES)
+
+
+def _is_f64(aval) -> bool:
+    dt = getattr(aval, "dtype", None)
+    return dt is not None and str(dt) == "float64"
+
+
+def _is_narrow_float(aval) -> bool:
+    dt = getattr(aval, "dtype", None)
+    return dt is not None and str(dt) in ("float32", "float16", "bfloat16")
+
+
+def check_jaxpr(jaxpr, *, entry: str = "<entry>",
+                allow_approved_callbacks: bool = True) -> list[Finding]:
+    """JX001 + JX002 over one (possibly nested) jaxpr."""
+    findings = []
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if any(tok in name for tok in CALLBACK_PRIMITIVES):
+            if allow_approved_callbacks and _is_approved_callback(eqn):
+                continue
+            mod, qual = _callback_identity(eqn)
+            findings.append(Finding(
+                "JX001", entry, 0, 0, entry,
+                f"unapproved host callback `{name}` -> {mod}.{qual} on "
+                "the compiled path (only the obs.instrument_step tap is "
+                "allowed)"))
+        if name == "convert_element_type":
+            new_dtype = eqn.params.get("new_dtype")
+            if new_dtype is not None and str(new_dtype) == "float64" and \
+                    any(_is_narrow_float(getattr(v, "aval", None))
+                        for v in eqn.invars):
+                findings.append(Finding(
+                    "JX002", entry, 0, 0, entry,
+                    "float64 promotion from a narrower float on the f32 "
+                    "path (convert_element_type -> f64): dtype drift"))
+    return findings
+
+
+def check_carry_stability(in_tree_avals, out_tree_avals, *,
+                          entry: str = "<entry>") -> list[Finding]:
+    """JX003: carried state must come back with the avals it went in
+    with. Both arguments are flat lists of (name, aval) pairs."""
+    def sig(aval):
+        return (tuple(getattr(aval, "shape", ())),
+                str(getattr(aval, "dtype", "")))
+
+    findings = []
+    ins = dict(in_tree_avals)
+    for name, out_aval in out_tree_avals:
+        in_aval = ins.get(name)
+        if in_aval is None:
+            continue
+        if sig(in_aval) != sig(out_aval):
+            si, so = sig(in_aval), sig(out_aval)
+            findings.append(Finding(
+                "JX003", entry, 0, 0, entry,
+                f"carried leaf {name!r} drifts "
+                f"{si[1]}{list(si[0])} -> {so[1]}{list(so[0])}: chunked "
+                "executable reuse and carry donation break"))
+    return findings
+
+
+def _flat_avals(prefix: str, tree) -> list[tuple[str, object]]:
+    import jax
+    import numpy as np
+
+    out = []
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
+        if not (hasattr(leaf, "shape") and hasattr(leaf, "dtype")):
+            leaf = np.asarray(leaf)
+        out.append((f"{prefix}[{i}]", leaf))
+    return out
+
+
+def trace_and_check(fn: Callable, args: tuple, *, entry: str,
+                    carry_argnum: int | None = None,
+                    carry_out: Callable | None = None,
+                    x64: bool = True,
+                    allow_approved_callbacks: bool = True) -> list[Finding]:
+    """Abstractly trace ``fn(*args)`` and run JX001/JX002 (+ JX003 when
+    ``carry_argnum``/``carry_out`` identify the carried state).
+
+    ``carry_out(outputs)`` extracts the returned carry pytree from the
+    traced outputs; JX003 compares its avals against
+    ``args[carry_argnum]``'s. Tracing runs under x64 by default so
+    float64 is representable and JX002 can see drift at all (with x64
+    off, jax silently squashes every f64 request to f32 — the exact
+    masking this checker exists to remove).
+    """
+    import jax
+
+    enable = getattr(jax, "enable_x64", None)
+    if enable is None:                     # 0.4.x keeps it in experimental
+        from jax.experimental import enable_x64 as enable
+    import contextlib
+    ctx = enable(True) if x64 else contextlib.nullcontext()
+    with ctx:
+        closed, out_shapes = jax.make_jaxpr(fn, return_shape=True)(*args)
+    findings = check_jaxpr(
+        closed.jaxpr, entry=entry,
+        allow_approved_callbacks=allow_approved_callbacks)
+    if carry_argnum is not None and carry_out is not None:
+        findings.extend(check_carry_stability(
+            _flat_avals("carry", args[carry_argnum]),
+            _flat_avals("carry", carry_out(out_shapes)),
+            entry=entry))
+    return findings
+
+
+# -- production entry points ----------------------------------------------
+
+def entrypoint_specs() -> dict[str, Callable[[], list[Finding]]]:
+    """The checked production surface, one thunk per entry point.
+
+    Small problem sizes: make_jaxpr cost scales with trace length, not
+    data, and every invariant here is size-independent (the same
+    primitives appear at n=8 as at n=4096).
+    """
+    def _rollout() -> list[Finding]:
+        import jax.numpy as jnp  # noqa: F401  (jax import gate)
+
+        from cbf_tpu.rollout.engine import rollout
+        from cbf_tpu.scenarios import swarm
+
+        cfg = swarm.Config(n=8, steps=4, k_neighbors=4)
+        state0, step = swarm.make(cfg)
+        return trace_and_check(
+            lambda s: rollout(step, s, 4), (state0,),
+            entry="rollout[swarm]",
+            carry_argnum=0, carry_out=lambda out: out[0])
+
+    def _rollout_certificate_fused() -> list[Finding]:
+        from cbf_tpu.rollout.engine import rollout
+        from cbf_tpu.scenarios import swarm
+
+        cfg = swarm.Config(n=8, steps=4, k_neighbors=4, certificate=True,
+                           certificate_backend="sparse",
+                           certificate_fused=True,
+                           certificate_warm_start=True,
+                           certificate_iters=4, certificate_cg_iters=2)
+        state0, step = swarm.make(cfg)
+        return trace_and_check(
+            lambda s: rollout(step, s, 4), (state0,),
+            entry="rollout[swarm+certificate_fused]",
+            carry_argnum=0, carry_out=lambda out: out[0])
+
+    def _rollout_telemetry() -> list[Finding]:
+        """The instrumented path: the tap's ONE approved callback must
+        pass, proving the allowlist is an allowlist, not a blanket
+        callback ban that would force telemetry off the hot path."""
+        import tempfile
+
+        from cbf_tpu import obs
+        from cbf_tpu.rollout.engine import rollout
+        from cbf_tpu.scenarios import swarm
+
+        cfg = swarm.Config(n=8, steps=4, k_neighbors=4)
+        state0, step = swarm.make(cfg)
+        with tempfile.TemporaryDirectory() as d:
+            sink = obs.TelemetrySink(d)
+            try:
+                return trace_and_check(
+                    lambda s: rollout(step, s, 4, telemetry=sink,
+                                      telemetry_every=2), (state0,),
+                    entry="rollout[swarm+telemetry]",
+                    carry_argnum=0, carry_out=lambda out: out[0])
+            finally:
+                sink.close()
+
+    def _certificate_batched() -> list[Finding]:
+        import jax.numpy as jnp
+
+        from cbf_tpu.scenarios import swarm
+        from cbf_tpu.scenarios.swarm import apply_certificate_batched
+
+        cfg = swarm.Config(n=8, certificate=True,
+                           certificate_backend="sparse",
+                           certificate_warm_start=True,
+                           certificate_iters=4, certificate_cg_iters=2)
+        from cbf_tpu.sim.certificates import certificate_solver_seed
+        seed = certificate_solver_seed(cfg.n, cfg.certificate_k, cfg.dtype)
+        E = 2
+        carry0 = tuple(jnp.broadcast_to(a[None], (E,) + a.shape)
+                       for a in seed)
+        u = jnp.zeros((E, cfg.n, 2), jnp.float32)
+        x = jnp.zeros((E, cfg.n, 2), jnp.float32)
+        return trace_and_check(
+            lambda uu, xx, ss: apply_certificate_batched(
+                cfg, uu, xx, solver_state=ss),
+            (u, x, carry0),
+            entry="apply_certificate_batched",
+            carry_argnum=2, carry_out=lambda out: out[4])
+
+    def _sharded_rollout() -> list[Finding]:
+        import jax
+        import jax.numpy as jnp
+
+        from cbf_tpu.parallel.ensemble import sharded_swarm_rollout
+        from cbf_tpu.parallel.mesh import make_mesh
+        from cbf_tpu.scenarios import swarm
+
+        cfg = swarm.Config(n=8, steps=3, k_neighbors=4)
+        mesh = make_mesh(n_dp=1, n_sp=1, devices=jax.devices()[:1])
+        x0 = jnp.zeros((1, 8, 2), jnp.float32)
+        v0 = jnp.zeros((1, 8, 2), jnp.float32)
+        return trace_and_check(
+            lambda x, v: sharded_swarm_rollout(
+                cfg, mesh, seeds=(0,), steps=3, initial_state=(x, v)),
+            (x0, v0),
+            entry="sharded_swarm_rollout",
+            carry_argnum=0, carry_out=lambda out: out[0][0])
+
+    return {
+        "rollout": _rollout,
+        "rollout_certificate_fused": _rollout_certificate_fused,
+        "rollout_telemetry": _rollout_telemetry,
+        "certificate_batched": _certificate_batched,
+        "sharded_rollout": _sharded_rollout,
+    }
+
+
+def run_entrypoint_checks(only: Iterable[str] | None = None
+                          ) -> list[Finding]:
+    """Trace every production entry point and collect JX findings.
+
+    A trace that CRASHES is reported as a JX001 finding rather than an
+    analyzer exception: an untraceable entry point can't be certified
+    callback-clean either.
+    """
+    specs = entrypoint_specs()
+    names = list(only) if only is not None else list(specs)
+    findings: list[Finding] = []
+    for name in names:
+        try:
+            findings.extend(specs[name]())
+        except Exception as e:                 # noqa: BLE001
+            findings.append(Finding(
+                "JX001", f"entrypoint:{name}", 0, 0, name,
+                f"entry point failed to trace abstractly: "
+                f"{type(e).__name__}: {e}"))
+    return findings

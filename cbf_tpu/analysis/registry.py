@@ -1,0 +1,136 @@
+"""Rule registry: the one table of what the analyzer checks.
+
+Every check the subsystem can make — AST trace-safety rules, recompile
+hazards, jaxpr-level invariants, and the consolidated repo audits — is a
+:class:`Rule` registered here under a stable ID. The registry is the
+contract surface: docs/API.md documents exactly this table (enforced by
+tests/test_analysis.py::test_rules_documented), baseline entries name
+rules by these IDs, and reporting severities come from here, so a rule
+cannot exist half-wired (implemented but undocumented, or suppressible
+but unexplained).
+
+ID scheme:
+
+* ``TS0xx`` — trace-safety: code that would host-sync, retrace, or
+  silently constant-fold inside a traced scope (jit/scan/cond/vmap/...).
+* ``RC0xx`` — recompile hazards: patterns that make XLA rebuild an
+  executable it should reuse.
+* ``JX0xx`` — jaxpr invariants: properties asserted on the abstract
+  trace of the public entry points (no device execution).
+* ``AUD0xx`` — repo audits folded in from the former standalone scripts
+  (obs schema drift, tier-1 slow markers, certificate chain depth).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+ERROR = "error"
+WARNING = "warning"
+
+
+class Rule(NamedTuple):
+    id: str
+    severity: str      # ERROR | WARNING
+    summary: str       # one line, shown in reports and docs
+
+
+class Finding(NamedTuple):
+    """One concrete violation: rule + location + human-readable detail.
+
+    ``symbol`` is the enclosing function qualname (or ``<module>``) —
+    baseline suppressions match on (rule, path, symbol), never on line
+    numbers, so unrelated edits above a finding don't invalidate the
+    baseline.
+    """
+    rule: str
+    path: str          # repo-relative where possible
+    line: int
+    col: int
+    symbol: str
+    message: str
+
+    @property
+    def severity(self) -> str:
+        return RULES[self.rule].severity
+
+    def key(self) -> tuple:
+        return (self.rule, self.path, self.symbol)
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "severity": self.severity,
+                "path": self.path, "line": self.line, "col": self.col,
+                "symbol": self.symbol, "message": self.message}
+
+
+_RULES = [
+    # -- AST trace-safety ------------------------------------------------
+    Rule("TS001", ERROR,
+         "host sync in traced scope: .item()/.tolist() forces a device "
+         "round-trip and blocks the dispatch pipeline"),
+    Rule("TS002", ERROR,
+         "Python cast float()/int()/bool() of an array value in traced "
+         "scope: concretizes the tracer (host sync, or trace-time error)"),
+    Rule("TS003", ERROR,
+         "np.asarray/np.array of a traced value in traced scope: silently "
+         "materializes on host and constant-folds into the executable"),
+    Rule("TS004", ERROR,
+         "Python `if` on an array-valued expression in traced scope: "
+         "branches on a tracer (trace-time error, or a silently baked-in "
+         "branch when the value is concrete)"),
+    Rule("TS005", ERROR,
+         "Python `while` on an array-valued expression in traced scope: "
+         "unrolls on a tracer or host-syncs per iteration; use "
+         "lax.while_loop"),
+    Rule("TS006", WARNING,
+         "bare print() in traced scope: executes once at trace time, not "
+         "per step — use jax.debug.print (and remove before shipping)"),
+    Rule("TS007", WARNING,
+         "host clock (time.time/perf_counter/sleep) in traced scope: a "
+         "trace-time constant, not a per-step measurement"),
+    Rule("TS008", WARNING,
+         "jax.debug.* left in traced scope: each call is a host callback "
+         "on the hot path (debug aid, not production telemetry)"),
+    # -- recompile hazards ----------------------------------------------
+    Rule("RC001", ERROR,
+         "static jit argument is unhashable or names a missing parameter: "
+         "every call re-keys (TypeError) or silently retraces"),
+    Rule("RC002", ERROR,
+         "jax.jit constructed inside a loop body: a fresh wrapper per "
+         "iteration defeats the jit cache (recompile storm)"),
+    Rule("RC003", WARNING,
+         "jit-decorated function closes over an array built in the "
+         "enclosing function: baked in as a constant; rebuild of the "
+         "closure retraces — pass it as an argument"),
+    # -- jaxpr invariants -------------------------------------------------
+    Rule("JX001", ERROR,
+         "unapproved host callback primitive on a compiled entry point "
+         "(only the obs.instrument_step telemetry tap is allowed)"),
+    Rule("JX002", ERROR,
+         "float64 promotion on the f32 path: convert_element_type to f64 "
+         "from a narrower float (dtype drift doubles bandwidth and "
+         "detunes TPU kernels)"),
+    Rule("JX003", ERROR,
+         "carried state aval drift: an entry point returns state with "
+         "different shape/dtype than it took — chunked reuse of one "
+         "executable is impossible (recompile every segment) and "
+         "donation/aliasing of the carry breaks"),
+    # -- consolidated audits ---------------------------------------------
+    Rule("AUD001", ERROR,
+         "telemetry schema drift: StepOutputs/EnsembleMetrics field "
+         "missing from the heartbeat schema or docs (former "
+         "scripts/obs_schema_audit.py)"),
+    Rule("AUD002", ERROR,
+         "budget-shaped test without @pytest.mark.slow: erodes the "
+         "tier-1 870 s budget (former scripts/tier1_marker_audit.py)"),
+    Rule("AUD003", ERROR,
+         "certificate chain-depth regression: fused ADMM iteration's "
+         "serialized pair-op chain exceeded its pinned bound (former "
+         "scripts/chain_depth.py gate)"),
+]
+
+RULES: dict[str, Rule] = {r.id: r for r in _RULES}
+
+
+def rule_ids() -> list[str]:
+    return [r.id for r in _RULES]

@@ -1,0 +1,106 @@
+"""Reporting + the lint runner: one function the CLI and the tier-1
+test both call, so "what the gate enforces" and "what the terminal
+shows" cannot drift apart.
+
+Text output is one finding per line in the compiler-style
+``path:line:col [RULE/severity] symbol: message`` form (clickable in
+editors); JSON output is a single object with the findings, the
+suppressed set, stale baseline entries, and the exit code, so CI and
+dashboards consume the same stream the humans read.
+
+Exit-code contract (the CLI's and the tier-1 gate's):
+
+* 0 — no unsuppressed findings (suppressed ones may exist);
+* 1 — at least one unsuppressed finding, or a stale baseline entry
+  (a fixed finding must retire its suppression in the same change);
+* 2 — the analyzer itself failed (malformed baseline, unreadable path).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable
+
+from cbf_tpu.analysis import ast_rules, baseline as baseline_mod
+from cbf_tpu.analysis.registry import RULES, Finding
+
+
+class LintResult:
+    def __init__(self, active, suppressed, stale):
+        self.active: list[Finding] = active
+        self.suppressed: list[tuple[Finding,
+                                    baseline_mod.Suppression]] = suppressed
+        self.stale: list[baseline_mod.Suppression] = stale
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if (self.active or self.stale) else 0
+
+    def as_dict(self) -> dict:
+        return {
+            "findings": [f.as_dict() for f in self.active],
+            "suppressed": [
+                dict(f.as_dict(), reason=s.reason)
+                for f, s in self.suppressed],
+            "stale_suppressions": [s._asdict() for s in self.stale],
+            "rules": {rid: {"severity": r.severity, "summary": r.summary}
+                      for rid, r in RULES.items()
+                      if any(f.rule == rid for f in self.active)},
+            "exit_code": self.exit_code,
+        }
+
+
+def run_lint(paths: Iterable[str], *, repo_root: str | None = None,
+             baseline_path: str | None = None,
+             jaxpr: bool = False, audits: bool = False,
+             entrypoints: Iterable[str] | None = None) -> LintResult:
+    """Lint ``paths`` (AST rules), optionally adding the jaxpr
+    entry-point checks and the consolidated repo audits, and fold the
+    result through the baseline."""
+    findings = ast_rules.lint_paths(paths, repo_root=repo_root)
+    if jaxpr:
+        from cbf_tpu.analysis import jaxpr_rules
+
+        findings.extend(jaxpr_rules.run_entrypoint_checks(entrypoints))
+    if audits:
+        from cbf_tpu.analysis import audits as audits_mod
+
+        findings.extend(audits_mod.run_audits(repo_root=repo_root))
+    sups = baseline_mod.load(baseline_path)
+    active, suppressed, stale = baseline_mod.split(findings, sups)
+    return LintResult(active, suppressed, stale)
+
+
+def _fmt(f: Finding, suffix: str = "") -> str:
+    loc = f"{f.path}:{f.line}:{f.col}" if f.line else f.path
+    return (f"{loc} [{f.rule}/{RULES[f.rule].severity}] "
+            f"{f.symbol}: {f.message}{suffix}")
+
+
+def render_text(result: LintResult, *, show_suppressed: bool = False
+                ) -> str:
+    lines = []
+    for f in result.active:
+        lines.append(_fmt(f))
+    if show_suppressed:
+        for f, s in result.suppressed:
+            lines.append(_fmt(f, f"  [suppressed: {s.reason}]"))
+    for s in result.stale:
+        lines.append(
+            f"{s.path} [baseline/stale] {s.symbol}: suppression for "
+            f"{s.rule} matches no finding — fixed? delete its entry "
+            f"(reason was: {s.reason})")
+    n_act, n_sup = len(result.active), len(result.suppressed)
+    lines.append(
+        f"lint: {n_act} finding{'s' if n_act != 1 else ''}, "
+        f"{n_sup} suppressed, {len(result.stale)} stale baseline "
+        f"entr{'ies' if len(result.stale) != 1 else 'y'}")
+    return "\n".join(lines)
+
+
+def render_json(result: LintResult, *, show_suppressed: bool = False
+                ) -> str:
+    d = result.as_dict()
+    if not show_suppressed:
+        d.pop("suppressed")
+    return json.dumps(d, indent=2)

@@ -116,6 +116,54 @@ def stall_at_step(step_fn: Callable, step_index: int,
     return wrapped
 
 
+def leak_host_callback(step_fn: Callable, every: int = 1) -> Callable:
+    """Inject an UNAPPROVED host callback into the step — the
+    trace-safety fault: a wrapper (profiler shim, stray debug tap)
+    smuggling an ``io_callback`` onto the compiled hot path, where it
+    serializes dispatch. Exists so the static-analysis jaxpr checker
+    (cbf_tpu.analysis.jaxpr_rules, rule JX001) can be proven to DETECT
+    such a callback: its target lives in this module, which is not on
+    the checker's allowlist (only the obs telemetry tap is)."""
+    from jax.experimental import io_callback
+
+    def _leak(t):
+        pass
+
+    def wrapped(state, t):
+        state, out = step_fn(state, t)
+
+        def fire(u):
+            io_callback(_leak, None, u, ordered=False)
+            return u
+
+        lax.cond(t % every == 0, fire, lambda u: u, t)
+        return state, out
+
+    return wrapped
+
+
+def promote_f64(step_fn: Callable, field: str = "min_pairwise_distance"
+                ) -> Callable:
+    """Route one StepOutputs FIELD through float64 and back — the
+    dtype-drift fault: a stray np.float64 scalar or dtype-less
+    constant promoting part of the f32 path to f64 (invisible in the
+    output dtype, doubled bandwidth inside). Under the default x64-off
+    config jax silently squashes the promotion, so this only *exists*
+    when traced under x64 — exactly how the jaxpr checker (rule JX002)
+    runs, and why it runs that way."""
+    def wrapped(state, t):
+        state, out = step_fn(state, t)
+        leaf = getattr(out, field)
+        if isinstance(leaf, tuple):
+            raise ValueError(
+                f"StepOutputs.{field} is untracked (()) in this scenario — "
+                "promote_f64 needs a tracked field")
+        drifted = leaf.astype(jnp.float64).astype(leaf.dtype)
+        return state, out._replace(**{field: drifted})
+
+    return wrapped
+
+
 def teleport_at_step(step_fn: Callable, step_index: int,
                      agent: int = 0, offset=(0.0, 0.0)) -> Callable:
     """Teleport one agent by ``offset`` at ``t == step_index`` — a finite

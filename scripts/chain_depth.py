@@ -1,29 +1,17 @@
-"""Serialized-op profile of one sparse-ADMM certificate iteration.
+"""Serialized-op profile of one sparse-ADMM certificate iteration —
+thin shim over the analysis subsystem.
 
-The joint certificate solve is LATENCY-bound: its wall is the length of
-the dependent chain of tiny O(R) pair ops (gathers/scatters over the
-pair-row axis) inside the ADMM iteration, times the iteration count —
-not the flops any one op carries (VERDICT r5, docs/BENCH_LOG.md). The
-fused iteration (solvers.sparse_admm, ``SparseADMMSettings.fused``)
-attacks exactly that chain, so the chain DEPTH is the quantity to pin:
-this script traces one production iteration to a jaxpr and reports the
-longest dependency chain of pair-memory ops, and
-tests/test_fused_batched.py turns the report into a regression gate
-(fused <= 4, and fused strictly shallower than the default path).
+The profiler lives in :mod:`cbf_tpu.analysis.audits` (``chain_profile``
++ the AUD003 regression gate run by ``python -m cbf_tpu lint --all``);
+this script keeps the original CLI and the ``chain_profile()`` entry
+point that tests/test_fused_batched.py loads.
 
-Metric definition (the one the regression test pins):
-
-* Counted ops: ``gather``, ``scatter``, ``scatter-add``,
-  ``dynamic_slice``, ``dynamic_update_slice`` — the serialized
-  memory-bound accesses over the R-sized pair axis. Elementwise math
-  between them fuses into the surrounding kernels and adds no chain.
-* Chain depth = the longest path through the iteration jaxpr counting
-  only those ops, with scan bodies (the inner K-solve) multiplied by
-  their trip count.
-* The inner solve budget is normalized to ONE step (``cg_iters=1``)
-  before tracing: ``cg_iters`` scales the chain linearly on every path
-  and is a tuning knob, while fusion changes the chain's STRUCTURE —
-  the per-inner-step and per-iteration constants this profile isolates.
+Metric (the one the regression test pins): the longest dependency chain
+of pair-memory ops (gather/scatter/dynamic_slice/...) through one ADMM
+iteration's jaxpr, scan bodies multiplied by trip count, inner solve
+budget normalized to one step. The joint certificate solve is
+LATENCY-bound on exactly this chain (VERDICT r5, docs/BENCH_LOG.md),
+so the fused iteration's <= 4 bound is the quantity to watch.
 
 Usage::
 
@@ -38,141 +26,10 @@ from __future__ import annotations
 import os
 import sys
 
-import jax
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-try:  # newer JAX moved jaxpr types under jax.extend
-    from jax.extend.core import Literal
-except ImportError:  # pragma: no cover - older layout
-    from jax.core import Literal
-
-# Serialized memory-bound accesses over the pair-row axis. Elementwise
-# ops between them fuse and add no dependent kernel.
-HEAVY_PRIMITIVES = frozenset({
-    "gather", "scatter", "scatter-add", "scatter_add",
-    "dynamic_slice", "dynamic_update_slice",
-})
-
-# Call-like primitives whose sub-jaxpr executes once, inline.
-_SUBJAXPR_PARAMS = ("jaxpr", "call_jaxpr", "fun_jaxpr")
-
-
-def _sub_jaxpr(params, key):
-    j = params.get(key)
-    if j is None:
-        return None
-    return j.jaxpr if hasattr(j, "jaxpr") else j
-
-
-def _analyze(jaxpr, in_depths, counts):
-    """Longest heavy-op path through ``jaxpr``.
-
-    ``in_depths``: chain depth already accumulated on each invar.
-    Returns per-output depths; ``counts`` (dict) accumulates total heavy
-    ops by primitive name. Scan bodies contribute ``length`` sequential
-    passes (the carry serializes them); cond takes the max over branches.
-    """
-    env = {}
-
-    def read(atom):
-        if isinstance(atom, Literal):
-            return 0
-        return env.get(atom, 0)
-
-    def write(var, depth):
-        env[var] = depth
-
-    for var in jaxpr.constvars:
-        write(var, 0)
-    for var, depth in zip(jaxpr.invars, in_depths):
-        write(var, depth)
-
-    for eqn in jaxpr.eqns:
-        din = max((read(a) for a in eqn.invars), default=0)
-        name = eqn.primitive.name
-        if name == "scan":
-            body = _sub_jaxpr(eqn.params, "jaxpr")
-            length = int(eqn.params.get("length", 1))
-            sub_counts: dict = {}
-            # One pass from zero depth gives the per-pass carry increment;
-            # the carry dependency serializes passes, so the scan's chain
-            # contribution is length * that increment.
-            outs = _analyze(body, [0] * len(body.invars), sub_counts)
-            n_carry = int(eqn.params.get("num_carry", 0))
-            inc = max(outs[:n_carry], default=0) if n_carry else \
-                max(outs, default=0)
-            for k, v in sub_counts.items():
-                counts[k] = counts.get(k, 0) + v * length
-            for var in eqn.outvars:
-                write(var, din + inc * length)
-        elif name == "while":
-            # Not expected in a single-iteration trace; treat as one pass
-            # of cond+body so a future refactor degrades loudly (depth
-            # grows) instead of silently hiding ops.
-            total = din
-            for key in ("cond_jaxpr", "body_jaxpr"):
-                body = _sub_jaxpr(eqn.params, key)
-                if body is not None:
-                    outs = _analyze(body, [total] * len(body.invars), counts)
-                    total = max(outs, default=total)
-            for var in eqn.outvars:
-                write(var, total)
-        elif name == "cond":
-            branch_outs = []
-            for br in eqn.params.get("branches", ()):
-                body = br.jaxpr if hasattr(br, "jaxpr") else br
-                branch_outs.append(
-                    _analyze(body, [din] * len(body.invars), counts))
-            for i, var in enumerate(eqn.outvars):
-                write(var, max((o[i] for o in branch_outs), default=din))
-        else:
-            body = None
-            for key in _SUBJAXPR_PARAMS:
-                body = _sub_jaxpr(eqn.params, key)
-                if body is not None:
-                    break
-            if body is not None:
-                outs = _analyze(
-                    body, [read(a) for a in eqn.invars][:len(body.invars)],
-                    counts)
-                for var, d in zip(eqn.outvars, outs):
-                    write(var, d)
-            else:
-                dout = din + 1 if name in HEAVY_PRIMITIVES else din
-                if name in HEAVY_PRIMITIVES:
-                    counts[name] = counts.get(name, 0) + 1
-                for var in eqn.outvars:
-                    write(var, dout)
-
-    return [read(a) for a in jaxpr.outvars]
-
-
-def chain_profile(settings=None, N: int = 64, k: int = 8,
-                  agent_k: int | None = None) -> dict:
-    """Profile one ADMM iteration of the sparse certificate solver.
-
-    Returns {"chain_depth", "heavy_ops", "op_counts"} for one iteration
-    of :func:`cbf_tpu.solvers.sparse_admm.admm_iteration_spec`'s step
-    function under ``settings`` with the inner budget normalized to one
-    step (see module docstring).
-    """
-    from cbf_tpu.solvers.sparse_admm import (SparseADMMSettings,
-                                             admm_iteration_spec)
-
-    settings = settings if settings is not None else SparseADMMSettings()
-    settings = settings._replace(cg_iters=1)
-    step, carry0 = admm_iteration_spec(N=N, k=k, settings=settings,
-                                       agent_k=agent_k)
-    closed = jax.make_jaxpr(step)(carry0)
-    counts: dict = {}
-    out_depths = _analyze(closed.jaxpr, [0] * len(closed.jaxpr.invars),
-                          counts)
-    return {
-        "chain_depth": max(out_depths, default=0),
-        "heavy_ops": sum(counts.values()),
-        "op_counts": dict(sorted(counts.items())),
-    }
+from cbf_tpu.analysis.audits import (HEAVY_PRIMITIVES,  # noqa: F401
+                                     chain_profile)
 
 
 def main() -> None:

@@ -1,25 +1,15 @@
-"""Telemetry schema-drift lint: the stream may never fall behind the
-metrics structs.
+"""Telemetry schema-drift lint — thin shim over the analysis subsystem.
 
-StepOutputs (rollout/engine.py) and EnsembleMetrics (parallel/ensemble.py)
-are the two in-program observability records; ``cbf_tpu.obs.schema`` maps
-them onto the streamed heartbeat fields. A field added to either struct
-without a schema entry would be visible post-hoc but INVISIBLE in flight —
-exactly the silent drift a telemetry layer exists to prevent — and a
-heartbeat field missing from docs/API.md is unusable by operators. This
-audit fails on either gap:
+The audit logic lives in :func:`cbf_tpu.analysis.audits.obs_schema_audit`
+(rule AUD001, run by ``python -m cbf_tpu lint --all``); this script keeps
+the original CLI and the ``audit()`` entry point that
+tests/test_telemetry.py::test_obs_schema_audit imports, so the tier-1
+contract and operator muscle memory survive the consolidation.
 
-1. every StepOutputs field is a heartbeat channel (``step_output``) or
-   carries an explicit exclusion reason (EXCLUDED_STEP_OUTPUT_FIELDS);
-2. every EnsembleMetrics field likewise (``ensemble`` /
-   EXCLUDED_ENSEMBLE_FIELDS);
-3. every schema mapping points at a REAL struct field (a renamed struct
-   field can't leave a dangling schema entry behind);
-4. every heartbeat field name and alert kind appears in docs/API.md's
-   Observability section.
-
-Enforced as a tier-1 test (tests/test_telemetry.py::test_obs_schema_audit)
-— same contract as scripts/tier1_marker_audit.py.
+Checks (see the analysis module for details): every StepOutputs /
+EnsembleMetrics field is a heartbeat channel or carries an explicit
+exclusion reason; no schema mapping dangles on a renamed struct field;
+every heartbeat field and alert kind is documented in docs/API.md.
 
 Usage: python scripts/obs_schema_audit.py  (exit 1 on violations)
 """
@@ -35,92 +25,9 @@ sys.path.insert(0, _REPO)
 
 def audit() -> list[str]:
     """Return one "what drifted — where" string per violation."""
-    from cbf_tpu.obs import schema
-    from cbf_tpu.parallel.ensemble import EnsembleMetrics
-    from cbf_tpu.rollout.engine import StepOutputs
+    from cbf_tpu.analysis.audits import obs_schema_audit
 
-    problems = []
-
-    mapped_step = schema.step_output_channels()
-    for field in StepOutputs._fields:
-        if field in mapped_step:
-            continue
-        if field in schema.EXCLUDED_STEP_OUTPUT_FIELDS:
-            continue
-        problems.append(
-            f"StepOutputs.{field} is neither a heartbeat channel "
-            "(schema.HEARTBEAT_FIELDS.step_output) nor excluded with a "
-            "reason (schema.EXCLUDED_STEP_OUTPUT_FIELDS)")
-
-    mapped_ens = schema.ensemble_channels()
-    for field in EnsembleMetrics._fields:
-        if field in mapped_ens:
-            continue
-        if field in schema.EXCLUDED_ENSEMBLE_FIELDS:
-            continue
-        problems.append(
-            f"EnsembleMetrics.{field} is neither a heartbeat channel "
-            "(schema.HEARTBEAT_FIELDS.ensemble) nor excluded with a "
-            "reason (schema.EXCLUDED_ENSEMBLE_FIELDS)")
-
-    # Dangling mappings: schema entries naming fields the structs no
-    # longer have (a struct rename must update the schema in the same PR).
-    for f in schema.HEARTBEAT_FIELDS:
-        if f.step_output is not None and \
-                f.step_output not in StepOutputs._fields:
-            problems.append(
-                f"schema field {f.name!r} maps step_output="
-                f"{f.step_output!r}, which StepOutputs does not have")
-        if f.ensemble is not None and \
-                f.ensemble not in EnsembleMetrics._fields:
-            problems.append(
-                f"schema field {f.name!r} maps ensemble={f.ensemble!r}, "
-                "which EnsembleMetrics does not have")
-        if f.reduce not in ("min", "max", "sum"):
-            problems.append(
-                f"schema field {f.name!r} has unknown reduction "
-                f"{f.reduce!r}")
-        if f.kind not in ("gauge", "counter"):
-            problems.append(
-                f"schema field {f.name!r} has unknown kind {f.kind!r}")
-    for field, reason in schema.EXCLUDED_STEP_OUTPUT_FIELDS.items():
-        if field not in StepOutputs._fields:
-            problems.append(
-                f"EXCLUDED_STEP_OUTPUT_FIELDS names {field!r}, which "
-                "StepOutputs does not have")
-        if not reason.strip():
-            problems.append(f"exclusion of StepOutputs.{field} has no "
-                            "reason")
-    for field, reason in schema.EXCLUDED_ENSEMBLE_FIELDS.items():
-        if field not in EnsembleMetrics._fields:
-            problems.append(
-                f"EXCLUDED_ENSEMBLE_FIELDS names {field!r}, which "
-                "EnsembleMetrics does not have")
-        if not reason.strip():
-            problems.append(f"exclusion of EnsembleMetrics.{field} has no "
-                            "reason")
-
-    # Docs: every heartbeat field + alert kind must be documented.
-    api_path = os.path.join(_REPO, "docs", "API.md")
-    try:
-        with open(api_path) as fh:
-            api_text = fh.read()
-    except OSError:
-        problems.append(f"docs/API.md unreadable at {api_path}")
-        api_text = ""
-    if api_text:
-        for f in schema.HEARTBEAT_FIELDS:
-            if f"`{f.name}`" not in api_text:
-                problems.append(
-                    f"heartbeat field `{f.name}` is undocumented in "
-                    "docs/API.md")
-        from cbf_tpu.obs import watchdog
-        for kind in watchdog.ALERT_KINDS:
-            if f"`{kind}`" not in api_text:
-                problems.append(
-                    f"watchdog alert kind `{kind}` is undocumented in "
-                    "docs/API.md")
-    return problems
+    return obs_schema_audit(_REPO)
 
 
 def main() -> int:

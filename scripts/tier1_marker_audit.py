@@ -1,115 +1,36 @@
-"""Tier-1 speed audit: keep the `not slow` set inside the 870 s budget.
+"""Tier-1 speed audit — thin shim over the analysis subsystem.
 
-Tier-1 verification (ROADMAP.md) runs ``pytest -m 'not slow'`` under a
-hard 870 s timeout. Nothing in this repo registered the ``slow`` marker
-until round 6, which made the filter a no-op: any new heavy test lands
-straight in the gating set and the budget erodes silently — the failure
-mode only shows up as a timeout three rounds later, far from the commit
-that caused it.
+The audit logic lives in :func:`cbf_tpu.analysis.audits.tier1_marker_audit`
+(rule AUD002, run by ``python -m cbf_tpu lint --all``); this script keeps
+the original CLI and the ``audit()`` entry point that
+tests/test_fused_batched.py::test_tier1_marker_audit loads, so the
+tier-1 contract travels unchanged.
 
-This audit makes the contract enforceable at authoring time. It walks
-every test module's AST and flags *budget-shaped* tests — problem sizes
-or horizons whose CPU cost is known to be minutes, calibrated against
-the current suite (docs/BENCH_LOG.md per-step costs):
-
-* ``n``/``N`` >= ``N_LIMIT`` (default 8192): a single certificate-free
-  step at N=4096 is fine (tests/test_large_n.py measures ~60 steps in
-  budget), the next doubling is not;
-* ``steps`` >= ``STEPS_LIMIT`` (default 2000) in the same call as
-  ``certificate=True`` sizes >= 512: the certificate step is ~2 orders
-  slower than the filter step.
-
-A flagged test must carry ``@pytest.mark.slow`` (registered in
-pyproject.toml) — or shrink. The audit itself runs as a tier-1 test
-(tests/test_fused_batched.py::test_tier1_marker_audit) so the gate
-travels with the suite.
+The check: budget-shaped tests (problem sizes/horizons whose CPU cost
+is known to be minutes — n >= 8192, or certificate=True with n >= 512
+and steps >= 2000) must carry ``@pytest.mark.slow`` or shrink, keeping
+the ``pytest -m 'not slow'`` tier-1 set inside its 870 s budget.
 
 Usage: python scripts/tier1_marker_audit.py  (exit 1 on violations)
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-N_LIMIT = 8192
-STEPS_LIMIT = 2000
-CERT_N_LIMIT = 512
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
 
-_TESTS_DIR = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests")
-
-
-def _int_value(node):
-    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
-            and not isinstance(node.value, bool):
-        return node.value
-    return None
-
-
-def _is_slow_marked(fn: ast.FunctionDef) -> bool:
-    for dec in fn.decorator_list:
-        # pytest.mark.slow (bare) or pytest.mark.slow(...) (called).
-        target = dec.func if isinstance(dec, ast.Call) else dec
-        if isinstance(target, ast.Attribute) and target.attr == "slow":
-            return True
-    return False
-
-
-def _budget_violations(fn: ast.FunctionDef) -> list[str]:
-    """Budget-shaped constructs inside one test function."""
-    hits = []
-    for node in ast.walk(fn):
-        if not isinstance(node, ast.Call):
-            continue
-        kw = {k.arg: _int_value(k.value) for k in node.keywords if k.arg}
-        certificate = any(
-            k.arg == "certificate" and isinstance(k.value, ast.Constant)
-            and k.value.value is True for k in node.keywords)
-        n = kw.get("n") or kw.get("N")
-        steps = kw.get("steps")
-        if n is not None and n >= N_LIMIT:
-            hits.append(f"n={n} >= {N_LIMIT}")
-        if (certificate and n is not None and n >= CERT_N_LIMIT
-                and steps is not None and steps >= STEPS_LIMIT):
-            hits.append(f"certificate n={n}, steps={steps} "
-                        f">= {STEPS_LIMIT}")
-    # Parametrize lists can also carry the sizes (test_large_n pattern).
-    for dec in fn.decorator_list:
-        if not isinstance(dec, ast.Call):
-            continue
-        target = dec.func
-        if not (isinstance(target, ast.Attribute)
-                and target.attr == "parametrize"):
-            continue
-        for arg in ast.walk(dec):
-            v = _int_value(arg)
-            if v is not None and v >= N_LIMIT:
-                hits.append(f"parametrized size {v} >= {N_LIMIT}")
-    return hits
+_TESTS_DIR = os.path.join(_REPO, "tests")
 
 
 def audit(tests_dir: str = _TESTS_DIR) -> list[str]:
     """Return "file::test — reason" strings for every unmarked
     budget-shaped test."""
-    problems = []
-    for name in sorted(os.listdir(tests_dir)):
-        if not (name.startswith("test_") and name.endswith(".py")):
-            continue
-        path = os.path.join(tests_dir, name)
-        with open(path) as fh:
-            tree = ast.parse(fh.read(), filename=path)
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.FunctionDef) \
-                    or not node.name.startswith("test_"):
-                continue
-            if _is_slow_marked(node):
-                continue
-            for reason in _budget_violations(node):
-                problems.append(f"{name}::{node.name} — {reason} "
-                                "(mark @pytest.mark.slow or shrink)")
-    return problems
+    from cbf_tpu.analysis.audits import tier1_marker_audit
+
+    return tier1_marker_audit(tests_dir)
 
 
 def main() -> int:

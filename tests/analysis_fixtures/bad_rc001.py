@@ -1,0 +1,14 @@
+"""RC001 bad: static jit arguments that can't key the cache."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def scaled(x, gains=[1.0, 2.0]):     # RC001: unhashable static default
+    return x * gains[0]
+
+
+@functools.partial(jax.jit, static_argnames=("n_agents",))
+def stepper(x, n):                   # RC001: renamed param left behind
+    return x * n

@@ -1,0 +1,10 @@
+"""RC002 bad: jit constructed fresh every loop iteration."""
+import jax
+
+
+def sweep(configs, x):
+    results = []
+    for cfg in configs:
+        step = jax.jit(lambda v: v * cfg["gain"])   # RC002: recompiles
+        results.append(step(x))
+    return results

@@ -1,0 +1,14 @@
+"""RC003 bad: jit closure captures an array built in the enclosing
+function — baked into the executable; a rebuilt closure retraces."""
+import jax
+import jax.numpy as jnp
+
+
+def make_step(n):
+    weights = jnp.arange(n)          # array in the enclosing scope
+
+    @jax.jit
+    def step(x):                     # RC003: captures `weights`
+        return x * weights
+
+    return step

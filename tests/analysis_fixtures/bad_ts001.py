@@ -1,0 +1,14 @@
+"""TS001 bad: .item()/.tolist() host sync inside a scan body."""
+import jax.numpy as jnp
+from jax import lax
+
+
+def rollout(state):
+    def step(carry, t):
+        carry = carry + 1.0
+        peek = carry.sum().item()        # TS001: host sync in traced scope
+        rows = carry.tolist()            # TS001 again
+        del peek, rows
+        return carry, carry
+
+    return lax.scan(step, state, jnp.arange(10))

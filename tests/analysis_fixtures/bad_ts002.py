@@ -1,0 +1,11 @@
+"""TS002 bad: Python float()/int() cast of a traced value."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def energy(x):
+    e = jnp.sum(x * x)
+    scale = float(e)                 # TS002: concretizes the tracer
+    count = int(jnp.sum(x > 0))      # TS002 again
+    return scale * count

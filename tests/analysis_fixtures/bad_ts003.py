@@ -1,0 +1,11 @@
+"""TS003 bad: numpy materialization of a traced value."""
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+
+@jax.jit
+def normalize(x):
+    y = jnp.abs(x)
+    host = np.asarray(y)             # TS003: device->host inside jit
+    return x / np.array(y).max()     # TS003 again

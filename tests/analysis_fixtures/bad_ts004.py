@@ -1,0 +1,15 @@
+"""TS004 bad: Python `if` on an array-valued expression in a scan body."""
+import jax.numpy as jnp
+from jax import lax
+
+
+def rollout(state):
+    def step(carry, t):
+        d = jnp.min(carry)
+        if d < 0.1:                  # TS004: branches on a tracer
+            carry = carry * 0.0
+        if jnp.any(carry > 1e6):     # TS004 again
+            carry = jnp.clip(carry, 0, 1e6)
+        return carry, d
+
+    return lax.scan(step, state, jnp.arange(10))

@@ -1,0 +1,12 @@
+"""TS005 bad: Python `while` on an array-valued residual."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def solve(x):
+    r = jnp.sum(x * x)
+    while r > 1e-6:                  # TS005: unrolls/syncs on a tracer
+        x = x * 0.5
+        r = jnp.sum(x * x)
+    return x

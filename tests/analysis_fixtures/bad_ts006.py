@@ -1,0 +1,11 @@
+"""TS006 bad: bare print in a traced step (runs once at trace time)."""
+import jax.numpy as jnp
+from jax import lax
+
+
+def rollout(state):
+    def step(carry, t):
+        print("step", t)             # TS006: trace-time only
+        return carry + 1.0, carry
+
+    return lax.scan(step, state, jnp.arange(10))

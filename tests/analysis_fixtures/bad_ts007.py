@@ -1,0 +1,12 @@
+"""TS007 bad: host clock inside traced scope is a trace-time constant."""
+import time
+
+import jax
+
+
+@jax.jit
+def timed_step(x):
+    t0 = time.time()                 # TS007: constant-folded at trace
+    y = x * 2.0
+    elapsed = time.perf_counter() - t0   # TS007 again
+    return y, elapsed

@@ -1,0 +1,12 @@
+"""TS008 bad: jax.debug.* left on the hot path."""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def rollout(state):
+    def step(carry, t):
+        jax.debug.print("carry min {m}", m=jnp.min(carry))   # TS008
+        return carry + 1.0, carry
+
+    return lax.scan(step, state, jnp.arange(10))

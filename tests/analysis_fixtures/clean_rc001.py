@@ -1,0 +1,14 @@
+"""RC001 clean: hashable static args that exist on the signature."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def scaled(x, mode="fast"):
+    return x * (2.0 if mode == "fast" else 1.0)
+
+
+@functools.partial(jax.jit, static_argnames=("steps",))
+def stepper(x, steps=10):
+    return x * steps

@@ -1,0 +1,11 @@
+"""RC002 clean: the jit wrapper is hoisted; the loop only dispatches."""
+import jax
+
+
+def step(v, gain):
+    return v * gain
+
+
+def sweep(configs, x):
+    jitted = jax.jit(step)
+    return [jitted(x, cfg["gain"]) for cfg in configs]

@@ -1,0 +1,14 @@
+"""RC003 clean: the array rides as an argument; the closure only
+captures static Python scalars."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def make_step(n):
+    @functools.partial(jax.jit, static_argnames=("gain",))
+    def step(x, weights, gain=2.0):
+        return x * weights * gain
+
+    return lambda x: step(x, jnp.arange(n))

@@ -1,0 +1,23 @@
+"""TS001 clean: .item() on the HOST side of an io_callback is the
+approved pattern (the telemetry tap), and host helpers outside traced
+scope sync freely."""
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import io_callback
+
+
+def summarize(outs):
+    # plain host function: .item() is fine here
+    return float(outs.sum().item())
+
+
+def rollout(state):
+    def host_emit(step, value):
+        print("step", int(step), value.item())   # host callback body
+
+    def step(carry, t):
+        carry = carry + 1.0
+        io_callback(host_emit, None, t, carry.sum(), ordered=False)
+        return carry, carry
+
+    return lax.scan(step, state, jnp.arange(10))

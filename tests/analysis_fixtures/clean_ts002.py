@@ -1,0 +1,10 @@
+"""TS002 clean: casts of static Python config are trace-time constants,
+not host syncs."""
+import jax
+
+
+@jax.jit
+def scaled(x, cfg_gain="2.5"):
+    gain = float(cfg_gain)           # Python string -> float: static
+    n = int(x.shape[0])              # shapes are static metadata
+    return x * gain / n

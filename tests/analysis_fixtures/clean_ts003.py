@@ -1,0 +1,10 @@
+"""TS003 clean: numpy on static config (spawn grids, constants) at
+trace time is the standard constant-building idiom."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def shifted(x, offsets=(0.5, -0.5)):
+    base = np.asarray(offsets)       # static tuple -> trace-time constant
+    return x + base.sum()

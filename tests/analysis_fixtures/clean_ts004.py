@@ -1,0 +1,18 @@
+"""TS004 clean: branching on static config and None-ness is the
+fixed-shape idiom; tracer selects go through jnp.where/lax.cond."""
+import jax.numpy as jnp
+from jax import lax
+
+
+def rollout(state, cfg_mode="fast", cap=None):
+    def step(carry, t):
+        if cfg_mode == "fast":               # static Python config
+            carry = carry + 1.0
+        if cap is not None:                  # optional-argument pattern
+            carry = jnp.minimum(carry, cap)
+        if carry.shape[0] > 4:               # static shape metadata
+            carry = carry * 2.0
+        carry = jnp.where(jnp.min(carry) < 0.1, carry * 0.0, carry)
+        return carry, carry
+
+    return lax.scan(step, state, jnp.arange(10))

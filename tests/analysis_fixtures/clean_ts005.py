@@ -1,0 +1,18 @@
+"""TS005 clean: a fixed Python trip count unrolls statically (fine);
+data-dependent exits go through lax.while_loop."""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.jit
+def solve(x, iters=8):
+    k = 0
+    while k < iters:                 # static Python counter
+        x = x * 0.5
+        k += 1
+
+    def cond(c):
+        return jnp.sum(c * c) > 1e-6
+
+    return lax.while_loop(cond, lambda c: c * 0.5, x)

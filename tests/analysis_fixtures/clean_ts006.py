@@ -1,0 +1,20 @@
+"""TS006 clean: printing on the host side (outside traced scope, or in
+a host callback body) is fine."""
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import io_callback
+
+
+def report(result):
+    print("final:", result)          # host function
+
+
+def rollout(state):
+    def host_log(t):
+        print("heartbeat at", t)     # host callback body
+
+    def step(carry, t):
+        io_callback(host_log, None, t, ordered=False)
+        return carry + 1.0, carry
+
+    return lax.scan(step, state, jnp.arange(10))

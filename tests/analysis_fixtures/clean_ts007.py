@@ -1,0 +1,16 @@
+"""TS007 clean: timing around the compiled call, on the host."""
+import time
+
+import jax
+
+
+@jax.jit
+def step(x):
+    return x * 2.0
+
+
+def bench(x):
+    t0 = time.perf_counter()         # host scope: fine
+    y = step(x)
+    y.block_until_ready()
+    return y, time.perf_counter() - t0

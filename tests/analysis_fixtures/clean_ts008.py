@@ -1,0 +1,12 @@
+"""TS008 clean: no debug taps in traced scope; host-side logging only."""
+import jax.numpy as jnp
+from jax import lax
+
+
+def rollout(state):
+    def step(carry, t):
+        return carry + 1.0, jnp.min(carry)
+
+    final, mins = lax.scan(step, state, jnp.arange(10))
+    print("host-side summary:", mins)
+    return final

@@ -1,0 +1,336 @@
+"""Static-analysis subsystem (cbf_tpu.analysis): the analyzer itself.
+
+Three layers, mirroring the subsystem:
+
+* fixture snippets per AST rule (tests/analysis_fixtures/: one
+  known-bad, one known-clean each) pin every rule's true-positive AND
+  false-positive behavior;
+* the jaxpr checker is proven to DETECT injected faults
+  (utils/faults.py: an unapproved io_callback, a forced float64
+  promotion, a carry-dtype drift) and to PASS the approved telemetry
+  tap;
+* ``test_repo_is_lint_clean`` is the standing tier-1 gate: the full
+  ``cbf_tpu lint --all`` surface over the repo must exit 0 — every
+  future PR runs under it.
+"""
+
+import json
+import os
+
+import pytest
+
+from cbf_tpu.analysis import RULES, rule_ids
+from cbf_tpu.analysis import ast_rules, baseline
+from cbf_tpu.analysis.report import render_json, render_text, run_lint
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "analysis_fixtures")
+
+_AST_RULES = [r for r in rule_ids() if r.startswith(("TS", "RC"))]
+
+
+def _lint_fixture(name: str):
+    path = os.path.join(_FIXTURES, name)
+    with open(path) as fh:
+        return ast_rules.lint_source(fh.read(), name)
+
+
+# -- AST rules: one bad + one clean fixture each --------------------------
+
+@pytest.mark.parametrize("rule", _AST_RULES)
+def test_rule_fires_on_bad_fixture(rule):
+    findings = _lint_fixture(f"bad_{rule.lower()}.py")
+    assert rule in {f.rule for f in findings}, (
+        f"{rule} did not fire on its known-bad fixture: {findings}")
+
+
+@pytest.mark.parametrize("rule", _AST_RULES)
+def test_rule_silent_on_clean_fixture(rule):
+    findings = _lint_fixture(f"clean_{rule.lower()}.py")
+    assert findings == [], (
+        f"clean fixture for {rule} produced findings: {findings}")
+
+
+def test_fixture_corpus_covers_enough_rules():
+    """The acceptance bar: the fixture corpus trips >= 8 distinct rule
+    IDs (it currently trips all 11 AST rules)."""
+    fired = set()
+    for name in sorted(os.listdir(_FIXTURES)):
+        if name.startswith("bad_") and name.endswith(".py"):
+            fired |= {f.rule for f in _lint_fixture(name)}
+    assert len(fired) >= 8, sorted(fired)
+
+
+def test_host_callback_scope_overrides_traced():
+    """A nested def passed to io_callback is HOST scope even inside a
+    traced wrapper — the telemetry tap's host_emit pattern must never
+    self-flag (this was the analyzer's first real bug)."""
+    src = """
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import io_callback
+
+def instrument(step_fn, sink):
+    def wrapped(state, t):
+        state, out = step_fn(state, t)
+        def host_emit(v):
+            sink(v.item())
+        def fire(u):
+            io_callback(host_emit, None, u)
+            return u
+        lax.cond(t % 5 == 0, fire, lambda u: u, out)
+        return state, out
+    return wrapped
+"""
+    assert ast_rules.lint_source(src, "tap.py") == []
+
+
+# -- baseline round-trip ---------------------------------------------------
+
+def test_baseline_roundtrip_suppresses_and_shows(tmp_path):
+    target = os.path.join(_FIXTURES, "bad_ts001.py")
+    findings = run_lint([target], repo_root=_ROOT).active
+    assert findings
+    # suppress exactly what was found, using the paths run_lint reports
+    sups = [baseline.Suppression(f.rule, f.path, f.symbol,
+                                 "fixture: known-bad by construction")
+            for f in findings]
+    bpath = str(tmp_path / "baseline.toml")
+    baseline.write(bpath, sups)
+    res = run_lint([target], repo_root=_ROOT, baseline_path=bpath)
+    assert res.exit_code == 0
+    assert res.active == []
+    assert len(res.suppressed) == len(findings)
+    # suppressed findings stay VISIBLE under --show-suppressed
+    text = render_text(res, show_suppressed=True)
+    assert "suppressed: fixture: known-bad by construction" in text
+    assert "TS001" in text
+    # ... and absent without it
+    text = render_text(res, show_suppressed=False)
+    assert "known-bad by construction" not in text
+
+
+def test_stale_baseline_entry_fails(tmp_path):
+    bpath = str(tmp_path / "baseline.toml")
+    baseline.write(bpath, [baseline.Suppression(
+        "TS006", "cbf_tpu/nonexistent.py", "gone", "fixed long ago")])
+    res = run_lint([os.path.join(_FIXTURES, "clean_ts001.py")],
+                   baseline_path=bpath)
+    assert res.exit_code == 1
+    assert len(res.stale) == 1
+    assert "stale" in render_text(res)
+
+
+def test_baseline_requires_reason(tmp_path):
+    bpath = str(tmp_path / "baseline.toml")
+    bpath_file = tmp_path / "baseline.toml"
+    bpath_file.write_text(
+        '[[suppress]]\nrule = "TS001"\npath = "x.py"\nsymbol = "f"\n'
+        'reason = ""\n')
+    with pytest.raises(baseline.BaselineError):
+        baseline.load(bpath)
+
+
+def test_baseline_rejects_unknown_rule(tmp_path):
+    (tmp_path / "baseline.toml").write_text(
+        '[[suppress]]\nrule = "ZZ999"\npath = "x.py"\nsymbol = "f"\n'
+        'reason = "typo"\n')
+    with pytest.raises(baseline.BaselineError):
+        baseline.load(str(tmp_path / "baseline.toml"))
+
+
+def test_baseline_fallback_parser_matches_tomli():
+    """The no-tomli fallback reader must parse what render() writes."""
+    sups = [baseline.Suppression("TS001", "a/b.py", "f.g", "why not"),
+            baseline.Suppression("RC002", "c.py", "<module>", "legacy")]
+    text = baseline.render(sups)
+    parsed = baseline._parse_toml(text)
+    assert [baseline.Suppression(e["rule"], e["path"], e["symbol"],
+                                 e["reason"]) for e in parsed] == sups
+
+
+# -- jaxpr checker: injected faults must be detected ----------------------
+
+def _swarm_step():
+    from cbf_tpu.scenarios import swarm
+
+    cfg = swarm.Config(n=8, steps=4, k_neighbors=4)
+    return swarm.make(cfg)
+
+
+def test_jaxpr_detects_injected_io_callback():
+    """utils.faults.leak_host_callback smuggles an io_callback into the
+    compiled rollout; the checker must flag it as JX001 (its target is
+    not the approved obs tap)."""
+    from cbf_tpu.analysis import jaxpr_rules
+    from cbf_tpu.rollout.engine import rollout
+    from cbf_tpu.utils import faults
+
+    state0, step = _swarm_step()
+    leaky = faults.leak_host_callback(step, every=2)
+    findings = jaxpr_rules.trace_and_check(
+        lambda s: rollout(leaky, s, 4), (state0,), entry="leaky")
+    assert [f.rule for f in findings] == ["JX001"]
+    assert "cbf_tpu.utils.faults" in findings[0].message
+
+
+def test_jaxpr_detects_forced_f64_promotion():
+    """utils.faults.promote_f64 routes a StepOutputs field through
+    float64 on the f32 rollout path; under the checker's x64 trace the
+    promotion is visible and must be flagged as JX002."""
+    from cbf_tpu.analysis import jaxpr_rules
+    from cbf_tpu.rollout.engine import rollout
+    from cbf_tpu.utils import faults
+
+    state0, step = _swarm_step()
+    drifty = faults.promote_f64(step)
+    findings = jaxpr_rules.trace_and_check(
+        lambda s: rollout(drifty, s, 4), (state0,), entry="drifty")
+    assert "JX002" in {f.rule for f in findings}
+
+
+def test_jaxpr_detects_carry_aval_drift():
+    """An entry returning its carry at a different dtype is JX003."""
+    import jax
+    import jax.numpy as jnp
+
+    from cbf_tpu.analysis import jaxpr_rules
+    from cbf_tpu.rollout.engine import rollout
+
+    state0, step = _swarm_step()
+
+    def drifting(s):
+        final, _ = rollout(step, s, 4)
+        return jax.tree.map(
+            lambda l: (l.astype(jnp.float64)
+                       if hasattr(l, "dtype") and l.dtype == jnp.float32
+                       else l), final)
+
+    findings = jaxpr_rules.trace_and_check(
+        drifting, (state0,), entry="drift",
+        carry_argnum=0, carry_out=lambda out: out)
+    assert "JX003" in {f.rule for f in findings}
+
+
+def test_jaxpr_approves_telemetry_tap(tmp_path):
+    """The allowlist is an allowlist: the obs.instrument_step tap's
+    io_callback passes, and with allow_approved_callbacks=False the
+    same trace is flagged — proving the discrimination is real, not a
+    blanket pass."""
+    from cbf_tpu import obs
+    from cbf_tpu.analysis import jaxpr_rules
+    from cbf_tpu.rollout.engine import rollout
+
+    state0, step = _swarm_step()
+    sink = obs.TelemetrySink(str(tmp_path))
+    try:
+        fn = lambda s: rollout(step, s, 4, telemetry=sink,  # noqa: E731
+                               telemetry_every=2)
+        assert jaxpr_rules.trace_and_check(
+            fn, (state0,), entry="tap") == []
+        flagged = jaxpr_rules.trace_and_check(
+            fn, (state0,), entry="tap", allow_approved_callbacks=False)
+        assert {f.rule for f in flagged} == {"JX001"}
+    finally:
+        sink.close()
+
+
+def test_entrypoint_specs_all_trace():
+    """Every production entry point traces abstractly and comes back
+    clean — the substance of the tier-1 gate, entry by entry."""
+    from cbf_tpu.analysis import jaxpr_rules
+
+    for name, thunk in jaxpr_rules.entrypoint_specs().items():
+        assert thunk() == [], f"entry point {name} is not clean"
+
+
+# -- consolidated audits ---------------------------------------------------
+
+def test_audits_clean_on_repo():
+    from cbf_tpu.analysis.audits import run_audits
+
+    assert run_audits(_ROOT) == []
+
+
+def test_chain_depth_audit_still_pins_fused_bound():
+    """The consolidated AUD003 gate reports the same fused <= 4 bound
+    the pre-consolidation script pinned."""
+    from cbf_tpu.analysis.audits import (FUSED_CHAIN_DEPTH_BOUND,
+                                         chain_profile)
+    from cbf_tpu.solvers.sparse_admm import SparseADMMSettings
+
+    fused = chain_profile(SparseADMMSettings(fused=True,
+                                             ksolve="chebyshev"))
+    assert fused["chain_depth"] <= FUSED_CHAIN_DEPTH_BOUND
+
+
+# -- CLI -------------------------------------------------------------------
+
+def test_cli_lint_clean_exit_zero(capsys):
+    from cbf_tpu.__main__ import main
+
+    assert main(["lint", os.path.join(_ROOT, "cbf_tpu")]) == 0
+    assert "0 findings" in capsys.readouterr().out
+
+
+def test_cli_lint_bad_fixture_exit_one(capsys):
+    from cbf_tpu.__main__ import main
+
+    rc = main(["lint", os.path.join(_FIXTURES, "bad_ts004.py")])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "TS004" in out
+
+
+def test_cli_lint_json(capsys):
+    from cbf_tpu.__main__ import main
+
+    rc = main(["lint", "--json", os.path.join(_FIXTURES, "bad_rc002.py")])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["exit_code"] == 1
+    assert any(f["rule"] == "RC002" for f in payload["findings"])
+    # the rules table rides along so dashboards need no second source
+    assert payload["rules"]["RC002"]["severity"] == "error"
+
+
+def test_cli_lint_malformed_baseline_exit_two(tmp_path, capsys):
+    from cbf_tpu.__main__ import main
+
+    bad = tmp_path / "b.toml"
+    bad.write_text('[[suppress]]\nrule = "TS001"\n')
+    rc = main(["lint", "--baseline", str(bad),
+               os.path.join(_FIXTURES, "clean_ts001.py")])
+    assert rc == 2
+
+
+# -- the standing gate -----------------------------------------------------
+
+def test_repo_is_lint_clean():
+    """Tier-1 gate: the full lint surface — AST rules over every source
+    tree, the jaxpr entry-point invariants, and the consolidated audits
+    — exits 0 against the checked-in baseline. A new finding means: fix
+    it, or add a baseline entry WITH a reason in the same PR."""
+    res = run_lint(
+        [os.path.join(_ROOT, p)
+         for p in ("cbf_tpu", "scripts", "examples", "bench.py")],
+        repo_root=_ROOT, jaxpr=True, audits=True)
+    assert res.exit_code == 0, "\n" + render_text(res)
+
+
+def test_rules_documented():
+    """Every registered rule ID appears in docs/API.md's Static
+    analysis section — same docs-can't-drift contract as the obs
+    schema audit."""
+    with open(os.path.join(_ROOT, "docs", "API.md")) as fh:
+        api = fh.read()
+    missing = [rid for rid in RULES if f"`{rid}`" not in api]
+    assert not missing, f"undocumented rules: {missing}"
+
+
+def test_render_json_contract():
+    res = run_lint([os.path.join(_FIXTURES, "bad_ts007.py")])
+    payload = json.loads(render_json(res, show_suppressed=True))
+    assert set(payload) == {"findings", "suppressed",
+                            "stale_suppressions", "rules", "exit_code"}
