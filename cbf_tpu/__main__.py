@@ -288,6 +288,122 @@ def cmd_obs_summary(args) -> int:
     return 0 if summary.get("heartbeats") else 1
 
 
+def _load_requests(path: str):
+    """Parse a serve request file into swarm Configs.
+
+    Format: a JSON list (or ``{"requests": [...]}``) of objects, each
+    with optional ``steps``/``seed`` shorthands and an ``overrides``
+    object of typed swarm.Config field values (JSON carries the types —
+    no string re-parsing like --set). An integer ``repeat`` clones the
+    entry (mixed-workload files stay short)."""
+    import dataclasses as _dc
+
+    from cbf_tpu.scenarios import swarm
+
+    with open(path) as fh:
+        spec = json.load(fh)
+    if isinstance(spec, dict):
+        spec = spec["requests"]
+    fields = {f.name for f in _dc.fields(swarm.Config)}
+    cfgs = []
+    for i, entry in enumerate(spec):
+        overrides = dict(entry.get("overrides", {}))
+        for shorthand in ("steps", "seed"):
+            if shorthand in entry:
+                overrides[shorthand] = entry[shorthand]
+        unknown = set(overrides) - fields
+        if unknown:
+            raise SystemExit(f"request {i}: unknown config fields "
+                             f"{sorted(unknown)}")
+        cfg = _dc.replace(swarm.Config(), **overrides)
+        cfgs.extend([cfg] * int(entry.get("repeat", 1)))
+    if not cfgs:
+        raise SystemExit(f"{path}: no requests")
+    return cfgs
+
+
+def cmd_serve(args) -> int:
+    """Batch-serve a request file through the serving engine (offline
+    drain mode): bucket by static signature, pack same-bucket requests
+    into one lockstep executable, optionally AOT-prewarm every bucket
+    first. Prints one JSON record (per-request summaries + aggregate
+    throughput/latency + compile counters)."""
+    import statistics
+    import time as _time
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    import numpy as np
+
+    from cbf_tpu.serve import ServeEngine
+    from cbf_tpu.utils import profiling
+
+    cfgs = _load_requests(args.requests)
+
+    sink = None
+    if args.telemetry_dir:
+        from cbf_tpu import obs
+
+        sink = obs.TelemetrySink(args.telemetry_dir)
+    engine = ServeEngine(max_batch=args.max_batch,
+                         flush_deadline_s=args.flush_deadline,
+                         cache_dir=args.cache_dir, telemetry=sink)
+    prewarm_s = None
+    if args.prewarm or args.prewarm_only:
+        prewarm_s = engine.prewarm(cfgs)
+    if sink is not None:
+        from cbf_tpu import obs
+
+        # Manifest AFTER prewarm: its compile_event_counts snapshot then
+        # carries the per-bucket executable hit/miss + prewarm counters.
+        sink.write_manifest(obs.build_manifest(
+            None, extra=engine.manifest_extra()))
+    record = {"requests": len(cfgs), "cache_dir": engine.cache_dir,
+              "max_batch": args.max_batch}
+    if prewarm_s is not None:
+        record["prewarm_s"] = prewarm_s
+        record["buckets"] = engine.manifest_extra()["serve"]["buckets"]
+    if args.prewarm_only:
+        record["stats"] = engine.stats
+        print(json.dumps(record))
+        if sink is not None:
+            sink.close()
+        return 0
+
+    t0 = _time.perf_counter()
+    results = engine.run(cfgs)
+    wall = _time.perf_counter() - t0
+    lat = sorted(r.latency_s for r in results)
+    qp_steps = sum(r.n * r.steps for r in results)
+    record.update({
+        "wall_s": round(wall, 3),
+        "agent_qp_steps_per_sec": round(qp_steps / wall, 1),
+        "latency_p50_s": round(statistics.median(lat), 4),
+        "latency_p99_s": round(lat[min(len(lat) - 1,
+                                       int(0.99 * len(lat)))], 4),
+        "stats": engine.stats,
+        "compile_counters": {k: v for k, v in
+                             profiling.compile_event_counts().items()
+                             if k.startswith("serve.")},
+        "results": [{
+            "request_id": r.request_id, "bucket": r.bucket, "n": r.n,
+            "steps": r.steps, "latency_s": r.latency_s,
+            "min_pairwise_distance": round(float(
+                np.min(r.outputs.min_pairwise_distance)), 4),
+            "infeasible_count": int(np.sum(r.outputs.infeasible_count)),
+        } for r in results],
+    })
+    if sink is not None:
+        sink.summary({"requests_served": len(results)})
+        sink.close()
+        record["telemetry"] = sink.run_dir
+    print(json.dumps(record))
+    return 0
+
+
 def cmd_lint(args) -> int:
     """Static analysis gate: AST trace-safety rules over the given paths,
     plus (``--all``) the jaxpr entry-point invariants and the
@@ -413,6 +529,38 @@ def main(argv=None) -> int:
                        help="also print baseline-suppressed findings "
                             "with their reasons")
     lintp.set_defaults(fn=cmd_lint)
+
+    servep = sub.add_parser(
+        "serve", help="batch-serve a rollout request file through the "
+                      "shape-bucketed serving engine (docs/API.md "
+                      "'Serving')")
+    servep.add_argument("requests",
+                        help="JSON request file: a list (or {'requests': "
+                             "[...]}) of {steps, seed, overrides{...}, "
+                             "repeat} objects over swarm.Config fields")
+    servep.add_argument("--platform", default=None, choices=("cpu", "tpu"),
+                        help="force a JAX backend before first use")
+    servep.add_argument("--max-batch", type=int, default=8,
+                        help="lockstep micro-batch size per bucket "
+                             "(default 8; the batch axis is padded to it)")
+    servep.add_argument("--flush-deadline", type=float, default=0.05,
+                        help="queue-mode flush deadline in seconds "
+                             "(recorded; offline drain batches eagerly)")
+    servep.add_argument("--prewarm", action="store_true",
+                        help="AOT-compile every bucket before serving "
+                             "(jit().lower().compile() per bucket)")
+    servep.add_argument("--prewarm-only", action="store_true",
+                        help="compile the request file's buckets and "
+                             "exit (cache-priming mode: pair with "
+                             "CBF_TPU_CACHE_DIR)")
+    servep.add_argument("--cache-dir", default=None,
+                        help="persistent compilation cache directory "
+                             "(overrides CBF_TPU_CACHE_DIR)")
+    servep.add_argument("--telemetry-dir", default=None,
+                        help="write a serve run directory: manifest with "
+                             "bucket/compile attribution + one 'request' "
+                             "event per served request")
+    servep.set_defaults(fn=cmd_serve)
 
     sub.add_parser("list", help="list scenarios + config knobs") \
         .set_defaults(fn=cmd_list)

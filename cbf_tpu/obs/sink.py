@@ -379,6 +379,26 @@ class TelemetrySink:
         self._emit(event)
         return event
 
+    def event(self, event_type: str, payload: dict | None = None) -> dict:
+        """Append a generic schema-stamped event to the stream (e.g. the
+        serving layer's per-request attribution records: one ``request``
+        event per completed request with its bucket, latency and safety
+        metrics). Readers ignore event types they don't know —
+        ``summarize_run`` folds only heartbeats/alerts — so new types
+        extend the stream without a schema bump. Reserved types
+        (heartbeat/alert/summary) must go through their dedicated
+        methods, which maintain counters and subscriber contracts."""
+        if event_type in ("heartbeat", "alert", "summary"):
+            raise ValueError(
+                f"{event_type!r} events have dedicated methods — use "
+                "heartbeat()/alert()/summary()")
+        event = {"event": event_type, "schema": schema.SCHEMA_VERSION,
+                 "t_wall": round(time.time(), 6)}
+        if payload:
+            event.update(payload)
+        self._emit(event)
+        return event
+
     def summary(self, extra: dict | None = None) -> dict:
         """Write the run-end summary event (registry snapshot + compile
         counter delta vs the manifest) and return it."""

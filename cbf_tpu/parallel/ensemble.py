@@ -750,3 +750,54 @@ def _rollout_executable(cfg: swarm_scenario.Config, mesh, E: int, steps: int):
         check_rep=False,   # rollout bodies carry while/fori loops
     )
     return jax.jit(fn)
+
+
+# ------------------------------------------------------- serving batch ----
+
+def lockstep_traced_rollout(static_cfg: swarm_scenario.Config,
+                            horizon: int, *,
+                            cbf: CBFParams | None = None,
+                            donate_states: bool = True):
+    """Build the serving layer's per-member traced-config lockstep
+    executable: a micro-batch of HETEROGENEOUS requests of one bucket
+    run as a single vmapped ``lax.scan`` program (the batch size is the
+    inputs' leading axis; one executable per (bucket, horizon, B)).
+
+    The Monte-Carlo ensemble above batches many seeds of ONE config; this
+    is the generalization the request-serving engine needs — each member
+    carries its own traced scalars (``swarm.split_static_traced``: radius,
+    gains, dt, ...), its own padded-agent count (``n_active``) and its own
+    horizon (``steps``), all riding as vmapped arrays through one shared
+    compiled program, so the scan's serial step chain — the latency wall
+    at small N — is paid once for the whole micro-batch.
+
+    Per-member horizons ride as a horizon MASK: the scan always runs
+    ``horizon`` (the bucket horizon) steps, and a member whose ``steps``
+    is exhausted FREEZES — its carry is re-selected unchanged — so
+    shorter requests in the batch are correct (their post-horizon
+    StepOutputs rows are repeats the caller trims) at the cost of the
+    bucket's worst-case step count.
+
+    Returns ``run(states, traced, steps) -> (final_states, outs)``:
+    ``states`` a member-stacked State pytree ((B, ...) leaves), ``traced``
+    a dict of (B,) scalars (split_static_traced's keys), ``steps`` (B,)
+    int32. Jitted, with ``states`` donated by default (the serving engine
+    owns the padded states it packs; pass ``donate_states=False`` to keep
+    caller buffers alive).
+    """
+    step = swarm_scenario.make_step_traced(static_cfg, cbf)
+
+    def run(states, traced, steps):
+        def one(state, traced_i, steps_i):
+            def body(st, t):
+                new_st, out = step(st, t, traced_i)
+                live = t < steps_i
+                new_st = jax.tree.map(
+                    lambda a, b: jnp.where(live, a, b), new_st, st)
+                return new_st, out
+
+            return lax.scan(body, state, jnp.arange(horizon))
+
+        return jax.vmap(one)(states, traced, steps)
+
+    return jax.jit(run, donate_argnums=(0,) if donate_states else ())

@@ -87,8 +87,7 @@ def rollout(step_fn: Callable, state0, steps: int, *, unroll: int = 1,
                          unroll=unroll)
 
 
-@functools.partial(jax.jit, static_argnames=("step_fn", "steps", "unroll"))
-def _rollout_from(step_fn: Callable, state, t0, steps: int, unroll: int = 1):
+def _rollout_body(step_fn: Callable, state, t0, steps: int, unroll: int = 1):
     """One compiled chunk: ``steps`` iterations starting at global step t0.
 
     t0 is a traced scalar so every full-size chunk reuses one executable
@@ -101,10 +100,26 @@ def _rollout_from(step_fn: Callable, state, t0, steps: int, unroll: int = 1):
     return lax.scan(body, state, t0 + jnp.arange(steps), unroll=unroll)
 
 
+_rollout_from = functools.partial(
+    jax.jit, static_argnames=("step_fn", "steps", "unroll"))(_rollout_body)
+
+# Donating twin: the carry state's buffers are handed to XLA for in-place
+# reuse across chunk boundaries (at large N the state is the dominant
+# live allocation between chunks). Safe ONLY when the caller owns the
+# state exclusively — rollout_chunked uses it from the second chunk on
+# (the first chunk's input is the CALLER's state0, which must survive;
+# later inputs are the previous chunk's output, dead after the call) and
+# only while no async checkpoint writer may still be reading the buffers.
+_rollout_from_donated = functools.partial(
+    jax.jit, static_argnames=("step_fn", "steps", "unroll"),
+    donate_argnums=(1,))(_rollout_body)
+
+
 def rollout_chunked(step_fn: Callable, state0, steps: int, *,
                     chunk: int = 1000, checkpoint_dir: str | None = None,
                     resume: bool = True, unroll: int = 1,
-                    telemetry=None, telemetry_every: int = 50):
+                    telemetry=None, telemetry_every: int = 50,
+                    donate_carry: bool | None = None):
     """Run a long rollout in ``chunk``-step compiled segments, checkpointing
     the state pytree at every boundary (SURVEY.md §5 checkpoint/resume —
     absent in the reference).
@@ -119,6 +134,17 @@ def rollout_chunked(step_fn: Callable, state0, steps: int, *,
     keeps reusing one executable), and sampling is on the GLOBAL step
     index, so a resumed run's heartbeats land on the same steps an
     uninterrupted one's would.
+
+    ``donate_carry``: donate the state pytree's buffers to each chunk so
+    XLA reuses them in place across chunk boundaries (at large N the
+    carry is the dominant live allocation between chunks). The caller's
+    ``state0`` survives — a defensive on-device copy is made once at
+    entry. Default (None) = auto: donate exactly when no checkpoint
+    writer runs — the async boundary save may still be READING the state
+    in a background thread while the next chunk would donate it away, so
+    checkpointed runs keep the non-donating executable. Pass an explicit
+    bool to pin the choice (bench warmup must compile the same executable
+    the measured configuration reuses).
 
     Returns (final_state, StepOutputs stacked over executed steps,
     start_step).
@@ -138,13 +164,26 @@ def rollout_chunked(step_fn: Callable, state0, steps: int, *,
     # One async writer for the whole run: boundary saves overlap the next
     # chunk's device compute instead of stalling it.
     writer = ckpt.CheckpointWriter(checkpoint_dir) if checkpoint_dir else None
+    if donate_carry is None:
+        donate_carry = writer is None
+    if donate_carry and writer is not None:
+        raise ValueError(
+            "donate_carry=True with a checkpoint_dir is unsafe: the async "
+            "boundary save may still be reading the state buffers the next "
+            "chunk donates away")
+    run = _rollout_from_donated if donate_carry else _rollout_from
+    if donate_carry:
+        # The first chunk's input is the CALLER's state0 (reused by tests
+        # and benches) — copy once so every chunk, including the first,
+        # goes through the one donating executable.
+        state = jax.tree.map(jnp.copy, state)
     parts = []
     t0 = start
     try:
         while t0 < steps:
             n = min(chunk, steps - t0)
-            state, outs = _rollout_from(step_fn, state, jnp.asarray(t0), n,
-                                        unroll=unroll)
+            state, outs = run(step_fn, state, jnp.asarray(t0), n,
+                              unroll=unroll)
             # Eager host offload each chunk: bounds HBM for recorded
             # trajectories, and (measured on the TPU bench) beats deferring
             # the transfer, which contends with the async checkpoint

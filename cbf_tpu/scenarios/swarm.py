@@ -288,6 +288,15 @@ class Config:
     # Training configs set this low so the filter engages within short
     # differentiable horizons (cf. examples/train_safety_params.py).
     spawn_half_width_override: float | None = None
+    # Override the certificate's arena half-width (None = the derived
+    # 1.5 * spawn_half_width). The serving layer's padded buckets park
+    # inactive pad agents on a far-away grid; the joint certificate's
+    # boundary rows must CONTAIN that parking lot or every pad would sit
+    # outside the arena with a permanently violated boundary row
+    # (polluting the residual gate). Enlarging the box only slackens
+    # rows the packed swarm never binds (agents converge to the central
+    # disk), so real-agent solutions are unchanged. Static per bucket.
+    arena_half_override: float | None = None
 
     @property
     def spawn_half_width(self) -> float:
@@ -301,6 +310,11 @@ class Config:
     @property
     def pack_radius(self) -> float:
         return self.pack_spacing * float(np.sqrt(self.n))
+
+    def split_static_traced(self):
+        """(static_cfg, traced) — the serving layer's bucket split; see
+        the module-level :func:`split_static_traced`."""
+        return split_static_traced(self)
 
 
 class State(NamedTuple):
@@ -433,12 +447,48 @@ def attach_obstacle_rows(obs_slab, mask, obstacles4, d_o, safety_distance):
     return obs_slab, mask, priority
 
 
-def barrier_dynamics(cfg: Config, dtype):
+def barrier_dynamics(cfg: Config, dtype, validate: bool = True):
     """(f, g, discrete) for the configured barrier discretization (see
     Config.barrier). Validates Config.dynamics — every execution path
     (scenario step, sharded ensemble, trainer) comes through here, so a
     typo'd mode raises instead of silently running single-integrator
-    physics."""
+    physics.
+
+    ``validate=False`` skips :func:`validate_config` — the serving
+    layer's traced-config path (:func:`make_step_traced`) substitutes
+    per-request TRACED scalars into the config, on which the validation
+    comparisons (e.g. the unicycle wheel-speed bound) would raise a
+    tracer-boolean error; it validates the concrete request config once
+    on the host instead."""
+    if validate:
+        validate_config(cfg)
+    if cfg.dynamics == "double":
+        dt = cfg.dt
+        f = dt * jnp.array([[0, 0, 1, 0], [0, 0, 0, 1],
+                            [0, 0, 0, 0], [0, 0, 0, 0]], dtype)
+        # Row-scale form (not a nested literal list): dt may be a TRACED
+        # per-request scalar on the serving path.
+        g = (jnp.array([[1, 0], [0, 1], [1, 0], [0, 1]], dtype)
+             * jnp.stack([dt * dt, dt * dt, dt, dt]).astype(dtype)[:, None])
+        return f, g, True
+    discrete = (cfg.n_obstacles > 0 if cfg.barrier == "auto"
+                else cfg.barrier == "discrete")
+    # Discrete rows are exact discrete-time CBF conditions (see
+    # Config.barrier): the drift term carries dt * (relative velocity) and
+    # the control term dt * u, so the row IS h_{k+1} >= (1-gamma) h_k for
+    # the integration x_{k+1} = x_k + dt*u.
+    scale = cfg.dt if discrete else cfg.dyn_scale
+    g = scale * jnp.array([[1, 0], [0, 1], [0, 0], [0, 0]], dtype)
+    f = (cfg.dt * jnp.array([[0, 0, 1, 0], [0, 0, 0, 1],
+                             [0, 0, 0, 0], [0, 0, 0, 0]], dtype)
+         if discrete else cfg.dyn_scale * jnp.zeros((4, 4), dtype))
+    return f, g, discrete
+
+
+def validate_config(cfg: Config) -> None:
+    """Raise on invalid/unsupported knob combinations. Requires CONCRETE
+    config values (comparisons on floats) — call it on the original
+    request config before substituting traced scalars."""
     if cfg.dynamics not in ("single", "double", "unicycle"):
         raise ValueError(
             f"dynamics must be single|double|unicycle, got {cfg.dynamics!r}")
@@ -542,7 +592,9 @@ def barrier_dynamics(cfg: Config, dtype):
         # and only the post-hoc residual would reveal it. 0.12 is the
         # CertificateParams safety_radius the step uses; 2x is packing
         # slack.
-        side = 2 * 1.5 * cfg.spawn_half_width
+        side = 2 * (cfg.arena_half_override
+                    if cfg.arena_half_override is not None
+                    else 1.5 * cfg.spawn_half_width)
         if side * side < 2.0 * cfg.n * 0.12 * 0.12:
             raise ValueError(
                 f"certificate boundary box ({side:.2f} m square, from "
@@ -584,24 +636,6 @@ def barrier_dynamics(cfg: Config, dtype):
                 "double dynamics needs accel_limit > 0 and "
                 f"vel_tracking_tau > 0, got {cfg.accel_limit}, "
                 f"{cfg.vel_tracking_tau}")
-        dt = cfg.dt
-        f = dt * jnp.array([[0, 0, 1, 0], [0, 0, 0, 1],
-                            [0, 0, 0, 0], [0, 0, 0, 0]], dtype)
-        g = jnp.array([[dt * dt, 0], [0, dt * dt],
-                       [dt, 0], [0, dt]], dtype)
-        return f, g, True
-    discrete = (cfg.n_obstacles > 0 if cfg.barrier == "auto"
-                else cfg.barrier == "discrete")
-    # Discrete rows are exact discrete-time CBF conditions (see
-    # Config.barrier): the drift term carries dt * (relative velocity) and
-    # the control term dt * u, so the row IS h_{k+1} >= (1-gamma) h_k for
-    # the integration x_{k+1} = x_k + dt*u.
-    scale = cfg.dt if discrete else cfg.dyn_scale
-    g = scale * jnp.array([[1, 0], [0, 1], [0, 0], [0, 0]], dtype)
-    f = (cfg.dt * jnp.array([[0, 0, 1, 0], [0, 0, 0, 1],
-                             [0, 0, 0, 0], [0, 0, 0, 0]], dtype)
-         if discrete else cfg.dyn_scale * jnp.zeros((4, 4), dtype))
-    return f, g, discrete
 
 
 def obstacle_positions_at(cfg: Config, t: float) -> np.ndarray:
@@ -822,7 +856,8 @@ def _certificate_problem(cfg: Config):
     drifted duplicate would certify against different constraint sets per
     execution path)."""
     from cbf_tpu.sim.certificates import CertificateParams
-    half = cfg.spawn_half_width * 1.5
+    half = (cfg.arena_half_override if cfg.arena_half_override is not None
+            else cfg.spawn_half_width * 1.5)
     return (CertificateParams(magnitude_limit=cfg.speed_limit),
             (-half, half, -half, half))
 
@@ -1091,8 +1126,25 @@ def verlet_gating(cfg: Config, x, states4, cache, K: int,
 
 
 def make(cfg: Config = Config(), cbf: CBFParams | None = None):
+    step = _build_step(cfg, cbf)          # validates cfg first
+    return initial_state(cfg), step
+
+
+def _build_step(cfg: Config, cbf: CBFParams | None = None, *,
+                active=None, validate: bool = True):
+    """The scenario step factory — the body of :func:`make` without the
+    initial state (the serving layer builds padded initial states itself).
+
+    ``active``: optional (N,) bool — the serving layer's padded-bucket
+    mask. Pad agents (False rows) are excluded from the consensus
+    centroid and get a zero nominal, so they stay parked on the far-away
+    grid the packer put them on; every other exclusion (gating, QP
+    engagement, certificate rows, metrics) then follows from distance —
+    a parked pad is never inside any radius. ``validate=False``: see
+    :func:`barrier_dynamics` (traced-config path).
+    """
     dt_ = cfg.dtype
-    f, g, discrete = barrier_dynamics(cfg, dt_)   # validates cfg.dynamics
+    f, g, discrete = barrier_dynamics(cfg, dt_, validate=validate)
     double = cfg.dynamics == "double"
     unicycle = cfg.dynamics == "unicycle"
     if cbf is None:
@@ -1135,8 +1187,6 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
             window_blocks = int(np.ceil(
                 (band + 2 * pallas_knn.RTILE) / pallas_knn.CTILE)) + 1
 
-    state0 = initial_state(cfg)
-
     def step(state: State, t):
         if unicycle:
             # Work in si space: the projection point l ahead of the wheel
@@ -1145,7 +1195,16 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
             x = projection_points(cfg, state.x, state.theta)
         else:
             x = state.x                                        # (N, 2)
-        to_c = jnp.mean(x, axis=0)[None] - x                   # (N, 2)
+        if active is None:
+            centroid = jnp.mean(x, axis=0)
+        else:
+            # Padded bucket: the consensus target is the REAL agents'
+            # centroid — parked pads a megameter away would otherwise
+            # drag it off the swarm.
+            n_act = jnp.maximum(jnp.sum(active.astype(dt_)), 1.0)
+            centroid = jnp.sum(jnp.where(active[:, None], x, 0.0),
+                               axis=0) / n_act
+        to_c = centroid[None] - x                              # (N, 2)
         d_c = jnp.linalg.norm(to_c, axis=1, keepdims=True)
         # Pull toward the centroid only while outside the packing disk.
         pull = jnp.maximum(d_c - cfg.pack_radius, 0.0)
@@ -1154,6 +1213,11 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
             obstacles4 = obstacle_states_at(cfg, t, dt_)
             dodge, d_o = lane_dodge(x, obstacles4, cfg.safety_distance)
             u0 = u0 + 2.0 * dodge
+        if active is not None:
+            # Pads hold station: zero nominal (and nothing engages their
+            # filter — no neighbor is within any radius of the parking
+            # grid), so u == 0 and the integrator keeps them parked.
+            u0 = jnp.where(active[:, None], u0, 0.0)
         # Discrete barrier (single mode): agent velocity slots are zero by
         # construction (u is the unknown the row solves for; a fellow
         # agent's motion is covered by the pairwise (1-2*gamma) bound) —
@@ -1272,7 +1336,91 @@ def make(cfg: Config = Config(), cbf: CBFParams | None = None):
         )
         return new_state, out
 
-    return state0, step
+    return step
+
+
+# Float Config fields the serving layer may vary PER REQUEST inside one
+# compiled bucket executable: each is consumed only by jnp arithmetic on
+# the step path (never by shapes, Python control flow, or kernel/window
+# sizing), so substituting a traced scalar re-dispatches instead of
+# re-tracing. Structural knobs (n, dynamics, gating, certificate backend
+# and budgets, skins, relax_cap's None-ness, dtype) stay static — they
+# ARE the bucket signature. speed_limit/max_speed stay static too: the
+# certificate's binding-pair radius is a HOST bisection over the
+# magnitude limit (sim.certificates.binding_pair_radius) and the
+# unicycle wheel-realizability check compares speed_limit concretely.
+TRACED_CONFIG_FIELDS: tuple[str, ...] = (
+    "safety_distance", "consensus_gain", "pack_spacing", "dt",
+    "dyn_scale", "sep_gain", "sep_target",
+    "accel_limit", "vel_tracking_tau", "projection_distance",
+    "obstacle_orbit_frac", "obstacle_omega",
+)
+
+
+def split_static_traced(cfg: Config):
+    """Split a request config into its bucket-static part and its traced
+    per-request scalars (``Config.split_static_traced()``).
+
+    Returns ``(static_cfg, traced)``: ``static_cfg`` is ``cfg`` with every
+    :data:`TRACED_CONFIG_FIELDS` value (plus ``seed`` and ``steps`` —
+    spawn data and the horizon mask respectively, neither part of the
+    compiled program's identity) replaced by the dataclass default, so two
+    requests differing only in traced scalars produce EQUAL static
+    configs — the serving layer's bucket-equality test. ``traced`` maps
+    field name -> float value, plus ``"n_active"`` (= ``cfg.n``: the
+    padded-bucket mask cardinality — the packer overrides it after
+    padding ``n`` up to the bucket size).
+
+    The request config is validated here (concretely, on the host) —
+    :func:`make_step_traced` then skips validation on the traced
+    substitute. Rejected: ``gating="banded"`` (its window heuristic does
+    host float math on ``safety_distance``) and the Verlet skins'
+    interaction is kept but their *skin values* stay static.
+    """
+    validate_config(cfg)
+    if cfg.gating == "banded":
+        raise ValueError(
+            'gating="banded" cannot ride the traced-config path: its '
+            "window sizing is host-side float math over safety_distance "
+            "(a traced scalar here) — use auto/pallas/jnp/streaming")
+    traced = {k: float(getattr(cfg, k)) for k in TRACED_CONFIG_FIELDS}
+    traced["n_active"] = cfg.n
+    defaults = {f.name: f.default for f in dataclasses.fields(Config)}
+    static_cfg = dataclasses.replace(
+        cfg, seed=defaults["seed"], steps=defaults["steps"],
+        **{k: defaults[k] for k in TRACED_CONFIG_FIELDS})
+    return static_cfg, traced
+
+
+def make_step_traced(static_cfg: Config, cbf: CBFParams | None = None):
+    """Step factory for the serving layer's traced-config buckets.
+
+    Returns ``step(state, t, traced) -> (state, StepOutputs)`` where
+    ``traced`` is the dict :func:`split_static_traced` produced (scalars
+    may be traced arrays — the serving engine vmaps this step over a
+    stacked request axis). ``traced["n_active"]`` masks the trailing
+    ``n - n_active`` pad agents out of the consensus/nominal (see
+    :func:`_build_step`); the packer parks them far away so every other
+    exclusion follows from distance.
+
+    Validation ran concretely in :func:`split_static_traced` (per
+    request); the traced substitute skips it (tracer comparisons would
+    throw). The static config's own combination is re-validated once
+    here.
+    """
+    validate_config(static_cfg)
+    if static_cfg.gating == "banded":
+        raise ValueError("banded gating is rejected on the traced path "
+                         "(see split_static_traced)")
+
+    def step(state: State, t, traced):
+        cfg_t = dataclasses.replace(
+            static_cfg, **{k: traced[k] for k in TRACED_CONFIG_FIELDS})
+        active = jnp.arange(static_cfg.n) < traced["n_active"]
+        inner = _build_step(cfg_t, cbf, active=active, validate=False)
+        return inner(state, t)
+
+    return step
 
 
 def run(cfg: Config = Config(), **kw):

@@ -1,0 +1,20 @@
+"""Throughput serving layer: shape-bucketed request batching + AOT prewarm.
+
+Heavy traffic is many heterogeneous small rollout requests, not one big
+rollout — this package routes them onto the compiled machinery the rest
+of the framework already owns. See `serve.buckets` (static signatures),
+`serve.pack` (padded-agent packing), `serve.engine` (queue, micro-batch
+formation, prewarm, persistent-cache knob), and docs/API.md "Serving".
+"""
+
+from cbf_tpu.serve.buckets import (BucketKey, DEFAULT_BUCKET_SIZES,
+                                   DEFAULT_HORIZON_QUANTUM, bucket_horizon,
+                                   bucket_key, bucket_n)
+from cbf_tpu.serve.engine import (PendingRequest, RequestResult, ServeEngine,
+                                  configure_compilation_cache)
+
+__all__ = [
+    "BucketKey", "DEFAULT_BUCKET_SIZES", "DEFAULT_HORIZON_QUANTUM",
+    "PendingRequest", "RequestResult", "ServeEngine", "bucket_horizon",
+    "bucket_key", "bucket_n", "configure_compilation_cache",
+]

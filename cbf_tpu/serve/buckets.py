@@ -1,0 +1,114 @@
+"""Shape-bucketed request signatures for the serving layer.
+
+A rollout request's compiled program is determined by its STATIC
+signature: agent count (padded up to a bucket size), scan horizon
+(padded up to a quantum), dynamics family, certificate backend + budget
+knobs, gating kernel, dtype — everything `swarm.split_static_traced`
+leaves in the static config. Two requests with equal signatures differ
+only in data (seed), traced scalars (radius, gains, dt, ...) and their
+horizon mask, so they can share one lockstep-batched executable
+(`parallel.ensemble.lockstep_traced_rollout`). This module computes the
+signature; the packer (`serve.pack`) produces the padded member arrays
+that ride it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import NamedTuple
+
+from cbf_tpu.scenarios import swarm
+
+# Power-of-two agent-count ladder: few buckets (few executables to
+# compile/prewarm) at a bounded <= 2x padding-flops overhead per request.
+DEFAULT_BUCKET_SIZES: tuple[int, ...] = (
+    16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+
+# Scan horizons round up to this quantum: per-request step counts ride as
+# a horizon MASK inside the bucket executable, so the quantum bounds both
+# the number of distinct compiled horizons and the frozen-tail overhead.
+DEFAULT_HORIZON_QUANTUM = 64
+
+# Certificate buckets: arena half-width enlarged to contain the packer's
+# far-away parking lot (serve.pack) — a pad OUTSIDE the arena box would
+# carry a permanently violated boundary row into the joint QP. Real
+# agents never bind the boundary rows either way (the swarm converges to
+# the central packing disk), so enlarging only slackens already-slack
+# rows. 2^24 m: exactly representable, beyond the largest bucket's
+# parking extent.
+PARKING_ARENA_HALF = float(2 ** 24)
+
+
+class BucketKey(NamedTuple):
+    """Hashable bucket identity: the bucket-static config (n = bucket
+    size, traced fields at their defaults) + the padded scan horizon."""
+    static_cfg: swarm.Config
+    horizon: int
+
+    @property
+    def n(self) -> int:
+        return self.static_cfg.n
+
+    def label(self) -> str:
+        """Short stable tag for counters/telemetry/docs."""
+        c = self.static_cfg
+        cert = swarm.certificate_backend(c) if c.certificate else "off"
+        return (f"n{c.n}-t{self.horizon}-{c.dynamics}"
+                f"-cert_{cert}-g{c.gating}")
+
+
+def bucket_n(n: int, sizes: tuple[int, ...] = DEFAULT_BUCKET_SIZES) -> int:
+    """Smallest registered bucket size >= n."""
+    for s in sorted(sizes):
+        if s >= n:
+            return s
+    raise ValueError(
+        f"n={n} exceeds the largest bucket size {sizes[-1]} — extend "
+        "bucket_sizes (every size costs one executable per horizon)")
+
+
+def bucket_horizon(steps: int,
+                   quantum: int = DEFAULT_HORIZON_QUANTUM) -> int:
+    """steps rounded up to the horizon quantum (>= one quantum)."""
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    return max(quantum, quantum * math.ceil(steps / quantum))
+
+
+def bucket_key(cfg: swarm.Config, *,
+               sizes: tuple[int, ...] = DEFAULT_BUCKET_SIZES,
+               horizon_quantum: int = DEFAULT_HORIZON_QUANTUM):
+    """(BucketKey, traced) for one request config.
+
+    Validates the request (concretely — `swarm.split_static_traced`),
+    splits off the traced scalars, pads n up to the bucket and steps up
+    to the horizon quantum. Two per-request compensations keep the padded
+    program equivalent to the unpadded physics:
+
+    - ``pack_spacing`` is rescaled by sqrt(n_true / n_bucket): the step
+      derives the packing radius as ``pack_spacing * sqrt(cfg.n)`` with
+      the BUCKET n, so the traced spacing absorbs the ratio and the
+      request's true packing radius is preserved.
+    - certificate buckets force ``arena_half_override`` to
+      :data:`PARKING_ARENA_HALF` (see its comment); a request carrying
+      its own override is rejected — it could not contain the parking
+      lot.
+    """
+    static_cfg, traced = swarm.split_static_traced(cfg)
+    nb = bucket_n(cfg.n, sizes)
+    traced = dict(traced)
+    traced["pack_spacing"] = (
+        traced["pack_spacing"] * math.sqrt(cfg.n / nb))
+    updates: dict = {"n": nb}
+    if cfg.certificate:
+        if cfg.arena_half_override is not None:
+            raise ValueError(
+                "serve: certificate requests cannot carry their own "
+                "arena_half_override — the bucket forces the parking-"
+                "containing arena (buckets.PARKING_ARENA_HALF)")
+        updates["arena_half_override"] = PARKING_ARENA_HALF
+    static_cfg = dataclasses.replace(static_cfg, **updates)
+    return (BucketKey(static_cfg, bucket_horizon(cfg.steps,
+                                                 horizon_quantum)),
+            traced)

@@ -1,0 +1,341 @@
+"""Request-serving engine: queue, micro-batch formation, AOT prewarm.
+
+The throughput layer over the compiled rollout machinery: many
+independent rollout requests (each a `scenarios.swarm.Config`) are
+bucketed by static signature (`serve.buckets`), packed into
+lockstep-batched executables (`parallel.ensemble.lockstep_traced_rollout`
+— per-request traced scalars ride as vmapped arrays) and drained with
+micro-batch formation: a bucket flushes when it fills (``max_batch``
+requests) or when its oldest request's deadline (``flush_deadline_s``)
+expires. Cold start is attacked twice: `ServeEngine.prewarm` AOT-compiles
+registered buckets up front (``jax.jit(...).lower().compile()``), and
+`configure_compilation_cache` wires JAX's persistent compilation cache
+behind the ``CBF_TPU_CACHE_DIR`` knob so a SECOND process reuses the
+first's compilations. Executable hit/miss and prewarm wall time fold
+into the `utils.profiling` event counters, which the telemetry manifest
+snapshots.
+
+The scheduler (queue, deadlines, host clocks) is host-side by
+construction — nothing here runs inside traced scope except the packed
+rollout itself, which is exactly what the TS007/RC003 lint rules assert
+over this package.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import os
+import threading
+import time
+from typing import Any
+
+import numpy as np
+import jax
+
+from cbf_tpu.parallel.ensemble import lockstep_traced_rollout
+from cbf_tpu.scenarios import swarm
+from cbf_tpu.serve import buckets as _buckets
+from cbf_tpu.serve import pack as _pack
+from cbf_tpu.utils import profiling
+
+
+def configure_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Enable JAX's persistent compilation cache (the CBF_TPU_CACHE_DIR
+    knob): a second process serving the same bucket set deserializes the
+    first process's executables instead of recompiling them. Explicit
+    argument wins over the environment variable; returns the directory in
+    effect, or None (knob unset — no behavior change). The min-compile-
+    time floor is dropped to 0 so even small bucket executables persist
+    (the default 1 s floor would skip exactly the many-small-buckets
+    workload this layer serves)."""
+    cache_dir = cache_dir or os.environ.get("CBF_TPU_CACHE_DIR")
+    if not cache_dir:
+        return None
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except AttributeError:  # knob renamed across jax versions
+        pass
+    return cache_dir
+
+
+@dataclasses.dataclass
+class RequestResult:
+    """One served request's outcome (host arrays, trimmed to the
+    request's true n and steps — see `serve.pack.trim_result`)."""
+    request_id: str
+    bucket: str
+    n: int
+    steps: int
+    final_state: Any
+    outputs: Any            # StepOutputs, time axes = steps
+    latency_s: float        # submit -> result available
+    execute_s: float        # the batch's device wall (shared by members)
+    batch_fill: int         # real requests in the flushed batch
+
+
+class PendingRequest:
+    """Queue-mode handle: `result(timeout)` blocks until the scheduler
+    flushes the request's bucket."""
+
+    def __init__(self, request_id: str):
+        self.request_id = request_id
+        self._event = threading.Event()
+        self._result: RequestResult | None = None
+        self._error: BaseException | None = None
+
+    def _resolve(self, result=None, error=None):
+        self._result, self._error = result, error
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None) -> RequestResult:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not served in {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class ServeEngine:
+    """Shape-bucketed micro-batching server for swarm rollout requests.
+
+    Two drive modes share the bucket/executable machinery:
+
+    - `run(configs)` — synchronous offline drain (the CLI's request-file
+      mode, the bench): group, batch, execute, return every result.
+    - `start()` + `submit(cfg)` + `stop()` — queue mode: a scheduler
+      thread forms micro-batches, flushing a bucket on batch-full or on
+      the oldest member's ``flush_deadline_s``.
+
+    One executable exists per (bucket, horizon) — the batch axis is
+    always padded to ``max_batch`` (`serve.pack.stack_batch`), so a
+    deadline-forced partial flush reuses the full-batch program instead
+    of compiling a second one.
+    """
+
+    def __init__(self, *, max_batch: int = 8, flush_deadline_s: float = 0.05,
+                 bucket_sizes: tuple[int, ...] = _buckets.DEFAULT_BUCKET_SIZES,
+                 horizon_quantum: int = _buckets.DEFAULT_HORIZON_QUANTUM,
+                 cache_dir: str | None = None, telemetry=None):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.max_batch = max_batch
+        self.flush_deadline_s = flush_deadline_s
+        self.bucket_sizes = tuple(bucket_sizes)
+        self.horizon_quantum = horizon_quantum
+        self.cache_dir = configure_compilation_cache(cache_dir)
+        self.telemetry = telemetry
+        self.prewarm_s: float | None = None
+        self.stats = {"requests": 0, "batches": 0, "pad_slots": 0,
+                      "compile_hit": 0, "compile_miss": 0}
+        self._execs: dict[_buckets.BucketKey, Any] = {}
+        self._ids = itertools.count()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        # bucket key -> list of (PendingRequest, cfg, traced, enqueue_t)
+        self._queue: dict[_buckets.BucketKey, list] = {}
+        self._thread: threading.Thread | None = None
+        self._running = False
+
+    # -- buckets / executables --------------------------------------------
+
+    def bucket_of(self, cfg: swarm.Config):
+        """(BucketKey, traced) under this engine's ladder/quantum."""
+        return _buckets.bucket_key(cfg, sizes=self.bucket_sizes,
+                                   horizon_quantum=self.horizon_quantum)
+
+    def _executable(self, key: _buckets.BucketKey):
+        """Get-or-AOT-compile the bucket's batch executable, counting
+        hits/misses into the shared profiling event registry."""
+        compiled = self._execs.get(key)
+        if compiled is not None:
+            self.stats["compile_hit"] += 1
+            profiling.add_event_count(f"serve.executable_hit[{key.label()}]")
+            return compiled
+        self.stats["compile_miss"] += 1
+        profiling.add_event_count(f"serve.executable_miss[{key.label()}]")
+        t0 = time.perf_counter()
+        fn = lockstep_traced_rollout(key.static_cfg, key.horizon)
+        compiled = fn.lower(*_pack.dummy_batch(key, self.max_batch)).compile()
+        wall = time.perf_counter() - t0
+        profiling.add_event_count(f"serve.compile_ms[{key.label()}]",
+                                  int(wall * 1000))
+        self._execs[key] = compiled
+        return compiled
+
+    def prewarm(self, configs) -> float:
+        """AOT-compile every bucket the given request configs map to
+        (startup cost paid before traffic; with the persistent cache
+        configured, a later process's prewarm deserializes instead of
+        compiling). Returns — and records — the total prewarm wall."""
+        t0 = time.perf_counter()
+        for cfg in configs:
+            key, _ = self.bucket_of(cfg)
+            self._executable(key)
+        self.prewarm_s = round(time.perf_counter() - t0, 3)
+        profiling.add_event_count("serve.prewarm_ms",
+                                  int(self.prewarm_s * 1000))
+        return self.prewarm_s
+
+    def manifest_extra(self) -> dict:
+        """Telemetry-manifest attribution block (cache dir, ladder,
+        prewarmed buckets + their compile counters live in the manifest's
+        compile_event_counts snapshot via utils.profiling)."""
+        return {"serve": {
+            "cache_dir": self.cache_dir,
+            "max_batch": self.max_batch,
+            "flush_deadline_s": self.flush_deadline_s,
+            "bucket_sizes": list(self.bucket_sizes),
+            "horizon_quantum": self.horizon_quantum,
+            "prewarm_s": self.prewarm_s,
+            "buckets": sorted(k.label() for k in self._execs),
+        }}
+
+    # -- execution ---------------------------------------------------------
+
+    def _execute(self, key: _buckets.BucketKey, entries) -> None:
+        """Run one micro-batch (1..max_batch queue entries) and resolve
+        every member's PendingRequest."""
+        try:
+            compiled = self._executable(key)
+            cfgs = [cfg for (_p, cfg, _tr, _t) in entries]
+            traced = [tr for (_p, _cfg, tr, _t) in entries]
+            states, traced_b, steps_b = _pack.stack_batch(
+                key, cfgs, traced, self.max_batch)
+            t0 = time.perf_counter()
+            final_states, outs = compiled(states, traced_b, steps_b)
+            jax.block_until_ready(final_states.x)
+            execute_s = time.perf_counter() - t0
+        except BaseException as e:
+            for pending, *_ in entries:
+                pending._resolve(error=e)
+            return
+        final_states = jax.device_get(final_states)
+        outs = jax.device_get(outs)
+        now = time.time()
+        self.stats["batches"] += 1
+        self.stats["pad_slots"] += self.max_batch - len(entries)
+        for slot, (pending, cfg, _tr, t_enq) in enumerate(entries):
+            final, outs_i = _pack.trim_result(final_states, outs, slot,
+                                              cfg.n, cfg.steps)
+            result = RequestResult(
+                request_id=pending.request_id, bucket=key.label(),
+                n=cfg.n, steps=cfg.steps, final_state=final,
+                outputs=outs_i, latency_s=round(now - t_enq, 6),
+                execute_s=round(execute_s, 6), batch_fill=len(entries))
+            self.stats["requests"] += 1
+            if self.telemetry is not None:
+                self.telemetry.event("request", {
+                    "request_id": result.request_id,
+                    "bucket": result.bucket, "n": cfg.n,
+                    "steps": cfg.steps,
+                    "latency_s": result.latency_s,
+                    "execute_s": result.execute_s,
+                    "batch_fill": result.batch_fill,
+                    "min_pairwise_distance": float(
+                        np.min(outs_i.min_pairwise_distance)),
+                    "infeasible_count": int(
+                        np.sum(outs_i.infeasible_count)),
+                })
+            pending._resolve(result=result)
+
+    # -- synchronous drain -------------------------------------------------
+
+    def run(self, configs) -> list[RequestResult]:
+        """Serve a request list synchronously: bucket, batch (order-
+        preserving within a bucket), execute, return results in request
+        order."""
+        entries_by_key: dict[_buckets.BucketKey, list] = {}
+        pendings = []
+        now = time.time()
+        for cfg in configs:
+            key, traced = self.bucket_of(cfg)
+            pending = PendingRequest(f"r{next(self._ids)}")
+            pendings.append(pending)
+            entries_by_key.setdefault(key, []).append(
+                (pending, cfg, traced, now))
+        for key, entries in entries_by_key.items():
+            for i in range(0, len(entries), self.max_batch):
+                self._execute(key, entries[i:i + self.max_batch])
+        return [p.result(timeout=0) for p in pendings]
+
+    # -- queue mode --------------------------------------------------------
+
+    def start(self) -> None:
+        with self._lock:
+            if self._running:
+                return
+            self._running = True
+        self._thread = threading.Thread(target=self._scheduler_loop,
+                                        name="serve-scheduler", daemon=True)
+        self._thread.start()
+
+    def submit(self, cfg: swarm.Config,
+               request_id: str | None = None) -> PendingRequest:
+        """Enqueue one request (queue mode; call `start()` first). The
+        bucket flushes when max_batch requests accumulate or after
+        flush_deadline_s, whichever comes first."""
+        key, traced = self.bucket_of(cfg)   # validates before enqueueing
+        pending = PendingRequest(request_id or f"r{next(self._ids)}")
+        with self._cond:
+            if not self._running:
+                raise RuntimeError("engine not started — call start() "
+                                   "(or use run() for a one-shot drain)")
+            self._queue.setdefault(key, []).append(
+                (pending, cfg, traced, time.time()))
+            self._cond.notify()
+        return pending
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop the scheduler; by default flush whatever is queued
+        first."""
+        with self._cond:
+            self._running = False
+            self._cond.notify()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if drain:
+            leftovers = []
+            with self._lock:
+                for key in sorted(self._queue, key=lambda k: k.label()):
+                    entries = self._queue[key]
+                    while entries:
+                        leftovers.append((key, entries[:self.max_batch]))
+                        del entries[:self.max_batch]
+                self._queue.clear()
+            for key, batch in leftovers:
+                self._execute(key, batch)
+
+    def _scheduler_loop(self) -> None:
+        while True:
+            to_run = []
+            with self._cond:
+                if not self._running:
+                    return
+                now = time.time()
+                next_deadline = None
+                for key, entries in self._queue.items():
+                    while len(entries) >= self.max_batch:
+                        to_run.append((key, entries[:self.max_batch]))
+                        del entries[:self.max_batch]
+                    if entries:
+                        deadline = entries[0][3] + self.flush_deadline_s
+                        if deadline <= now:
+                            to_run.append((key, entries[:]))
+                            entries.clear()
+                        elif (next_deadline is None
+                                or deadline < next_deadline):
+                            next_deadline = deadline
+                if not to_run:
+                    self._cond.wait(
+                        timeout=None if next_deadline is None
+                        else max(next_deadline - now, 1e-3))
+                    continue
+            for key, batch in to_run:
+                self._execute(key, batch)

@@ -1,0 +1,131 @@
+"""Request packing: padded initial states, batch stacking, result trims.
+
+Padding contract (the parity test in tests/test_serve.py pins it): a
+request of n agents entering an n_bucket-sized bucket gets its REAL
+agents spawned by the scenario's canonical spawn (same seed law as the
+unpadded run) and its ``n_bucket - n`` PAD agents parked on a far-away
+grid. Pads are excluded from the consensus/nominal by the traced step's
+``n_active`` mask (`swarm._build_step`); every other exclusion follows
+from distance — a pad a megameter away is never inside the gating
+radius, never inside the certificate's binding radius, never the swarm's
+minimum pairwise distance (the parking grid spacing is ~1 km), and its
+zero command keeps it parked, so no StepOutputs metric ever sees it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from cbf_tpu.scenarios import swarm
+from cbf_tpu.serve.buckets import BucketKey
+
+# Parking grid: exactly-representable f32 values, spacing far above any
+# real inter-agent scale, offset far outside any real arena. A single row
+# of pads along +x at y = PARK_OFFSET.
+PARK_OFFSET = float(2 ** 20)     # ~1.05e6 m
+PARK_SPACING = float(2 ** 10)    # 1024 m between pads
+
+
+def parking_rows(count: int, dtype) -> np.ndarray:
+    """(count, 2) pad positions on the parking grid."""
+    i = np.arange(count, dtype=np.float64)
+    return np.stack([PARK_OFFSET + PARK_SPACING * i,
+                     np.full(count, PARK_OFFSET)], axis=1).astype(dtype)
+
+
+def padded_initial_state(cfg: swarm.Config, key: BucketKey) -> swarm.State:
+    """One request's initial State at BUCKET shapes: real agents from the
+    scenario's canonical spawn (`swarm.spawn_positions` +
+    `clear_obstacle_spawn` + `heading_spawn` — the same laws the unpadded
+    run uses), pads parked, structural carries (Verlet caches, ADMM warm
+    carry) seeded at bucket size from the same single-source seeds
+    `swarm.initial_state` uses."""
+    bcfg = key.static_cfg
+    if cfg.n > bcfg.n:
+        raise ValueError(f"request n={cfg.n} exceeds bucket n={bcfg.n}")
+    n_pad = bcfg.n - cfg.n
+    x_real = swarm.clear_obstacle_spawn(
+        cfg, swarm.spawn_positions(cfg, cfg.seed))
+    x0 = jnp.concatenate(
+        [x_real, jnp.asarray(parking_rows(n_pad, cfg.dtype))], axis=0)
+    theta0: tuple | jnp.ndarray = ()
+    if cfg.dynamics == "unicycle":
+        theta0 = jnp.concatenate(
+            [swarm.heading_spawn(cfg, cfg.seed),
+             jnp.zeros((n_pad,), cfg.dtype)])
+    cache = swarm.verlet_cache_seed(bcfg) if cfg.gating_rebuild_skin else ()
+    ccache: tuple = ()
+    if cfg.certificate_rebuild_skin:
+        from cbf_tpu.sim.certificates import certificate_cache_seed
+        ccache = certificate_cache_seed(bcfg.n, cfg.certificate_k,
+                                        cfg.dtype)
+    sstate: tuple = ()
+    if cfg.certificate_warm_start:
+        from cbf_tpu.sim.certificates import certificate_solver_seed
+        sstate = certificate_solver_seed(bcfg.n, cfg.certificate_k,
+                                         cfg.dtype)
+    return swarm.State(x=x0, v=jnp.zeros_like(x0), theta=theta0,
+                       gating_cache=cache, certificate_cache=ccache,
+                       certificate_solver_state=sstate)
+
+
+def stack_batch(key: BucketKey, requests, traced_list, max_batch: int):
+    """(states, traced, steps) device inputs for one micro-batch.
+
+    ``requests``: the real request configs (1..max_batch of them);
+    ``traced_list``: their traced dicts from `buckets.bucket_key`. The
+    batch axis is PADDED to ``max_batch`` so every flush of a bucket —
+    full or deadline-forced — reuses ONE executable: pad slots clone the
+    first request's state with ``steps = 0``, so the horizon mask freezes
+    them at t=0 and their outputs are discarded.
+    """
+    if not 1 <= len(requests) <= max_batch:
+        raise ValueError(f"batch of {len(requests)} requests does not fit "
+                         f"max_batch={max_batch}")
+    states = [padded_initial_state(cfg, key) for cfg in requests]
+    traced = list(traced_list)
+    steps = [cfg.steps for cfg in requests]
+    while len(states) < max_batch:
+        states.append(states[0])
+        traced.append(traced[0])
+        steps.append(0)
+    dtype = key.static_cfg.dtype
+    stacked_states = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+    stacked_traced = {
+        k: (jnp.asarray([t[k] for t in traced], jnp.int32)
+            if k == "n_active"
+            else jnp.asarray([t[k] for t in traced], dtype))
+        for k in traced[0]}
+    return stacked_states, stacked_traced, jnp.asarray(steps, jnp.int32)
+
+
+def dummy_batch(key: BucketKey, max_batch: int):
+    """Prewarm inputs: a full batch of the bucket's own static config
+    (whose defaults are a valid request) — same avals as any real
+    batch."""
+    cfg = dataclasses.replace(key.static_cfg, steps=key.horizon)
+    _, traced = swarm.split_static_traced(cfg)
+    return stack_batch(key, [cfg] * max_batch,
+                       [traced] * max_batch, max_batch)
+
+
+def trim_result(final_states, outs, slot: int, n_active: int, steps: int):
+    """Extract one request's (final_state, outputs) from the batch, on
+    host, trimmed to its true agent count and horizon: StepOutputs time
+    axes cut to ``steps`` (post-horizon rows are frozen repeats), the
+    trajectory's agent axis cut to ``n_active``, the final state's agent
+    rows likewise (structural carries are internal and dropped)."""
+    final_b = jax.tree.map(lambda a: np.asarray(a[slot]), final_states)
+    outs_b = jax.tree.map(lambda a: np.asarray(a[slot][:steps]), outs)
+    if not isinstance(outs_b.trajectory, tuple):
+        outs_b = outs_b._replace(
+            trajectory=outs_b.trajectory[:, :n_active])
+    theta = (final_b.theta[:n_active]
+             if not isinstance(final_b.theta, tuple) else ())
+    final = swarm.State(x=final_b.x[:n_active], v=final_b.v[:n_active],
+                        theta=theta)
+    return final, outs_b

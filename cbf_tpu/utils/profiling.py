@@ -91,6 +91,17 @@ def reset_compile_event_counts() -> None:
     _event_counts.clear()
 
 
+def add_event_count(name: str, value: int = 1) -> None:
+    """Fold a framework-level event into the SAME counter registry the
+    jax.monitoring listener feeds — one accessor path
+    (:func:`compile_event_counts`) for both, so everything that snapshots
+    the counters (the telemetry manifest at run start, the summary
+    event's delta) picks up framework counters (e.g. the serving layer's
+    per-bucket executable hit/miss and prewarm wall time) with no
+    parallel plumbing."""
+    _event_counts[name] = _event_counts.get(name, 0) + int(value)
+
+
 def compile_stats() -> dict[str, int]:
     """Deprecated alias of :func:`compile_event_counts` (pre-round-7 name,
     kept for callers)."""

@@ -1,12 +1,44 @@
 #!/usr/bin/env bash
-# Round-4 TPU measurement sweep (docs/BENCH_LOG.md list) — run top-down at
-# the first healthy probe; each line is independent so a mid-sweep wedge
-# still leaves the earlier results on disk. Output: one timestamped raw
-# log under docs/sweeps/ (transcribe highlights into docs/BENCH_LOG.md).
+# TPU measurement sweep driver — run top-down at the first healthy probe;
+# each line is independent so a mid-sweep wedge still leaves the earlier
+# results on disk. Output: one timestamped raw log under docs/sweeps/
+# (transcribe highlights into docs/BENCH_LOG.md).
+#
+# Usage: scripts/tpu_sweep.sh [--profile <name>]
+#
+# Profiles (the former tpu_sweep_r05{b,c,d}.sh variants consolidated —
+# they shared the whole harness and differed only in the item list):
+#   r04  (default) round-4 matrix: wedge-fix validation, ensemble,
+#        dynamics families, chunked-gap attribution, certificate + round-5
+#        levers, Verlet gating cache, k-sweep, profile trace.
+#   r05b round-5 continuation (post worker-crash chunk sizing): ensemble
+#        honest-timing re-measure + certificate at safe chunk sizes.
+#   r05c round-5 part 3: certificate short-horizon items + the deep-budget
+#        rerun of the long-horizon convergence failure.
+#   r05d round-5 final: gating cache / k-sweep / streaming kernel, then
+#        certificate warm+tol, batched ensemble chains, lean-budget rerun.
+#   r08  round-8 serving layer: BENCH_SERVE mixed-traffic throughput
+#        (fresh-compile-vs-dispatch and warm batching axes), the
+#        certificate serve workload (lockstep ADMM-chain amortization on
+#        real hardware), and the CBF_TPU_CACHE_DIR two-process compile
+#        reuse measurement.
 set -u -o pipefail   # pipefail: probe()'s exit code must survive the tee
 cd "$(dirname "$0")/.."
+
+PROFILE="r04"
+if [ "${1:-}" = "--profile" ]; then
+  PROFILE="${2:?--profile needs a name}"
+elif [ -n "${1:-}" ]; then
+  echo "usage: $0 [--profile r04|r05b|r05c|r05d|r08]" >&2; exit 64
+fi
+case "$PROFILE" in
+r04|r05b|r05c|r05d|r08) ;;
+*) echo "unknown profile '$PROFILE' (have r04 r05b r05c r05d r08)" >&2
+   exit 64 ;;
+esac
+
 mkdir -p docs/sweeps
-LOG="docs/sweeps/tpu_sweep_$(date +%Y%m%d_%H%M%S).log"
+LOG="docs/sweeps/tpu_sweep_${PROFILE}_$(date +%Y%m%d_%H%M%S).log"
 run() {
   echo "=== ${*:-defaults} ===" | tee -a "$LOG"
   env "$@" python bench.py 2>&1 | tee -a "$LOG"
@@ -22,46 +54,112 @@ print((ok, reason))
 sys.exit(0 if ok else 1)
 " 2>&1 | tee -a "$LOG"
 }
-
 # Abort on a wedged tunnel: each bench invocation would otherwise retry
-# against the dead device for up to BENCH_TOTAL_TIMEOUT (1500 s) x 11
-# items — hours of guaranteed failures.
-probe || { echo "device wedged — aborting sweep (see $LOG)"; exit 2; }
-# 1. Wedge-fix validation: default run, then probe again immediately.
-run
-probe || { echo "DEVICE WEDGED AFTER DEFAULT RUN — the exit-wedge fix did
-NOT hold; aborting (see $LOG)"; exit 3; }
-# 2. Ensemble rate (post retrace-fix + E_local==1 fast path).
-run BENCH_ENSEMBLE=1
-# 3. Dynamics families.
-run BENCH_DYNAMICS=double
-run BENCH_DYNAMICS=unicycle
-# 4. Chunked-gap attribution matrix (writer / chunking+fetch / bare-equiv).
-run BENCH_CHECKPOINT=0
-run BENCH_CHECKPOINT=0 BENCH_CHUNK=10000
-# 5. Certificate-on (sparse backend at ladder N, then mid N), plus the
-# round-5 levers: lean ADMM budget (50/6 converges ~200x under the gate
-# on contract states) + the certificate's own Verlet search cache —
-# 1.55x combined at N=4096 on CPU; the TPU split between iteration-chain
-# latency and search flops is what this pair of runs attributes.
-run BENCH_CERTIFICATE=1 BENCH_N=1024 BENCH_STEPS=2000
-run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=1000
-run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=1000 BENCH_CERT_ITERS=50 BENCH_CERT_CG=6
-run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=1000 BENCH_CERT_ITERS=50 BENCH_CERT_CG=6 BENCH_CERT_SKIN=0.1
-# 6. Verlet neighbor cache (round 5): the O(N^2) search is 63% of step
-# flops (roofline) — the cached selection should recover most of it.
-# 3x+ measured on CPU at N=2048; the floor metric is truncation-sound,
-# so an over-aggressive skin FAILS the safety gate conservatively
-# instead of hiding a blind spot (measured: skin=0.1 certifies the
-# exact floor to N=1024 but dips to 0.1257 at the N=4096 ladder rung;
-# skin=0.05 certifies the ladder rung — CPU-validated end-to-end).
-# Ordered before the k-sweep: it is the round-5 headline lever.
-run BENCH_GATING_SKIN=0.05
-run BENCH_GATING_SKIN=0.1 BENCH_STEPS=2000 BENCH_N=1024
-# 6b. k-NN k-sweep rates (floors already calibrated on CPU; k=8 = default).
-run BENCH_K_NEIGHBORS=12 BENCH_STEPS=2000
-run BENCH_K_NEIGHBORS=16 BENCH_STEPS=2000
-# 7. Profile trace for kernel tuning (tuning run, not a record).
-run BENCH_PROFILE=/tmp/tpu_trace_r04
+# against the dead device for up to BENCH_TOTAL_TIMEOUT (1500 s) per
+# item — hours of guaranteed failures.
+die() { echo "$1 — aborting sweep (see $LOG)"; exit "$2"; }
+
+probe || die "device wedged" 2
+
+case "$PROFILE" in
+r04)
+  # 1. Wedge-fix validation: default run, then probe again immediately.
+  run
+  probe || die "DEVICE WEDGED AFTER DEFAULT RUN — the exit-wedge fix did NOT hold" 3
+  # 2. Ensemble rate (post retrace-fix + E_local==1 fast path).
+  run BENCH_ENSEMBLE=1
+  # 3. Dynamics families.
+  run BENCH_DYNAMICS=double
+  run BENCH_DYNAMICS=unicycle
+  # 4. Chunked-gap attribution matrix (writer / chunking+fetch / bare-equiv).
+  run BENCH_CHECKPOINT=0
+  run BENCH_CHECKPOINT=0 BENCH_CHUNK=10000
+  # 5. Certificate-on (sparse backend at ladder N, then mid N) + round-5
+  # levers: lean ADMM budget + the certificate's own Verlet search cache.
+  run BENCH_CERTIFICATE=1 BENCH_N=1024 BENCH_STEPS=2000
+  run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=1000
+  run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=1000 BENCH_CERT_ITERS=50 BENCH_CERT_CG=6
+  run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=1000 BENCH_CERT_ITERS=50 BENCH_CERT_CG=6 BENCH_CERT_SKIN=0.1
+  # 6. Verlet neighbor cache (round 5; skin certified per rung) + k-sweep.
+  run BENCH_GATING_SKIN=0.05
+  run BENCH_GATING_SKIN=0.1 BENCH_STEPS=2000 BENCH_N=1024
+  run BENCH_K_NEIGHBORS=12 BENCH_STEPS=2000
+  run BENCH_K_NEIGHBORS=16 BENCH_STEPS=2000
+  # 7. Profile trace for kernel tuning (tuning run, not a record).
+  run BENCH_PROFILE=/tmp/tpu_trace_r04
+  ;;
+r05b)
+  # Continuation sweep: the items the first r05 sweep didn't reach
+  # (worker crashes on >~1 min single XLA executions — bench.py now
+  # sizes certificate chunks to ~10 s) + the honest-timing ensemble fix.
+  run BENCH_ENSEMBLE=1
+  run BENCH_CERTIFICATE=1 BENCH_N=1024 BENCH_STEPS=2000
+  run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=200
+  run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=200 BENCH_CERT_ITERS=50 BENCH_CERT_CG=6
+  run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=200 BENCH_CERT_ITERS=50 BENCH_CERT_CG=6 BENCH_CERT_SKIN=0.1
+  probe || die "DEVICE WEDGED AFTER CERTIFICATE ITEMS" 3
+  run BENCH_GATING_SKIN=0.05
+  run BENCH_GATING_SKIN=0.1 BENCH_STEPS=2000 BENCH_N=1024
+  run BENCH_K_NEIGHBORS=12 BENCH_STEPS=2000
+  run BENCH_K_NEIGHBORS=16 BENCH_STEPS=2000
+  run BENCH_PROFILE=/tmp/tpu_trace_r05
+  ;;
+r05c)
+  # Part 3: certificate short-horizon items (pre-packing states), then
+  # the deep-budget rerun testing the residual-growth diagnosis.
+  run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=200
+  run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=200 BENCH_CERT_ITERS=50 BENCH_CERT_CG=6
+  run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=200 BENCH_CERT_ITERS=50 BENCH_CERT_CG=6 BENCH_CERT_SKIN=0.1
+  run BENCH_ATTEMPT_TIMEOUT=1400 BENCH_ATTEMPTS=1 BENCH_CERTIFICATE=1 BENCH_N=1024 BENCH_STEPS=2000 BENCH_CERT_ITERS=250 BENCH_CERT_CG=10
+  probe || die "DEVICE WEDGED AFTER CERTIFICATE ITEMS" 3
+  run BENCH_GATING_SKIN=0.05
+  run BENCH_GATING_SKIN=0.1 BENCH_STEPS=2000 BENCH_N=1024
+  run BENCH_K_NEIGHBORS=12 BENCH_STEPS=2000
+  run BENCH_K_NEIGHBORS=16 BENCH_STEPS=2000
+  run BENCH_PROFILE=/tmp/tpu_trace_r05
+  ;;
+r05d)
+  # Final round-5 part: safest/most-valuable first; the item that
+  # previously stalled runs LAST with a single attempt.
+  run BENCH_GATING_SKIN=0.05
+  run BENCH_GATING_SKIN=0.1 BENCH_STEPS=2000 BENCH_N=1024
+  run BENCH_K_NEIGHBORS=12 BENCH_STEPS=2000
+  run BENCH_K_NEIGHBORS=16 BENCH_STEPS=2000
+  run BENCH_GATING=streaming BENCH_CHECKPOINT=0 BENCH_CHUNK=10000
+  run BENCH_PROFILE=/tmp/tpu_trace_r05
+  probe || die "DEVICE WEDGED" 3
+  # Certificate warm-start + adaptive tol (the long-horizon fix).
+  run BENCH_CERTIFICATE=1 BENCH_N=1024 BENCH_STEPS=2000 BENCH_CERT_WARM=1 BENCH_CERT_TOL=5e-6 BENCH_CERT_ITERS=400
+  run BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=200 BENCH_CERT_WARM=1 BENCH_CERT_TOL=5e-6 BENCH_CERT_ITERS=400
+  probe || die "DEVICE WEDGED AFTER CERTIFICATE ITEMS" 3
+  # Batched certificate chains: E=4 priced against its paired E=1 run.
+  run BENCH_ENSEMBLE=1 BENCH_ENSEMBLE_E=4 BENCH_CERTIFICATE=1 BENCH_N=1024 BENCH_STEPS=25
+  run BENCH_ENSEMBLE=1 BENCH_ENSEMBLE_E=1 BENCH_CERTIFICATE=1 BENCH_N=1024 BENCH_STEPS=25
+  probe || die "DEVICE WEDGED AFTER ENSEMBLE-CERTIFICATE ITEMS" 3
+  # The lean-budget rerun that stalled in r05c (single attempt).
+  run BENCH_ATTEMPTS=1 BENCH_ATTEMPT_TIMEOUT=900 BENCH_CERTIFICATE=1 BENCH_N=4096 BENCH_STEPS=200 BENCH_CERT_ITERS=50 BENCH_CERT_CG=6
+  ;;
+r08)
+  # Serving layer (docs/BENCH_LOG.md Round 8): mixed-traffic throughput.
+  # 1. Filter-only mixed workload: fresh-traffic (compile-avoidance) and
+  # warm (pure batching — the TPU number the CPU round could not give:
+  # one core has no dispatch latency to amortize).
+  run BENCH_SERVE=1 BENCH_SERVE_STEPS=128
+  # 2. Certificate workload: the lockstep ADMM-chain amortization axis —
+  # the serve twin of r05d's E=4-vs-E=1 batched-chain measurement.
+  run BENCH_SERVE=1 BENCH_SERVE_CERT=1 BENCH_SERVE_N=64 BENCH_SERVE_STEPS=50
+  probe || die "DEVICE WEDGED AFTER SERVE ITEMS" 3
+  # 3. Two-process persistent-cache compile reuse (>= 30% gate's axis):
+  # same bucket set, cold dir then warm dir.
+  rm -rf /tmp/cbf_tpu_cache_r08
+  run BENCH_SERVE=1 BENCH_SERVE_STEPS=128 CBF_TPU_CACHE_DIR=/tmp/cbf_tpu_cache_r08
+  run BENCH_SERVE=1 BENCH_SERVE_STEPS=128 CBF_TPU_CACHE_DIR=/tmp/cbf_tpu_cache_r08
+  ;;
+*)
+  echo "unknown profile '$PROFILE' (have r04 r05b r05c r05d r08)" >&2
+  exit 64
+  ;;
+esac
+
 probe
 echo "sweep complete -> $LOG"

@@ -1,0 +1,381 @@
+"""Serving layer (cbf_tpu.serve): bucket signatures, padded-bucket
+parity, queue/micro-batch formation, prewarm + persistent-cache
+counters, and the standing throughput regression gate.
+
+The load-bearing pins:
+
+- PADDED-BUCKET PARITY (ISSUE 8 satellite): a request padded from its
+  true n up to the bucket size must reproduce the unpadded trajectory
+  for the real agents within tolerance, with pad agents masked out of
+  gating, the certificate, and every StepOutputs metric.
+- THROUGHPUT GATE: serving B=16 mixed-size requests through the batcher
+  beats sequential per-request execution (swarm.make + rollout — the
+  pre-serve execution model, which bakes every scalar into the jit
+  closure and so re-compiles on every novel request) by >= 1.5x wall,
+  interleaved min-of-R. This pins the traced-config split: if a traced
+  scalar regresses to a baked constant, the serve leg recompiles per
+  request too and the gate fails.
+- CACHE GATE: a second process with CBF_TPU_CACHE_DIR set prewarns the
+  same bucket set >= 30% faster than the cold first process.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from cbf_tpu.scenarios import swarm  # noqa: E402
+from cbf_tpu.serve import (ServeEngine, bucket_horizon, bucket_key,  # noqa: E402
+                           bucket_n)
+from cbf_tpu.serve import buckets as serve_buckets  # noqa: E402
+from cbf_tpu.serve import pack as serve_pack  # noqa: E402
+from cbf_tpu.utils import profiling  # noqa: E402
+
+
+# ------------------------------------------------------------ signatures --
+
+def test_bucket_equality_across_traced_scalars():
+    a, _ = bucket_key(swarm.Config(n=100, steps=90, seed=1,
+                                   safety_distance=0.42, dt=0.03,
+                                   consensus_gain=1.3, gating="jnp"))
+    b, _ = bucket_key(swarm.Config(n=120, steps=128, seed=9,
+                                   safety_distance=0.38, dt=0.04,
+                                   consensus_gain=0.9, gating="jnp"))
+    assert a == b                       # same bucket: n<=128, horizon 128
+    assert a.n == 128 and a.horizon == 128
+    assert "n128" in a.label() and "t128" in a.label()
+
+
+def test_bucket_splits_on_static_signature():
+    base = swarm.Config(n=100, steps=90, gating="jnp")
+    key0, _ = bucket_key(base)
+    for variant in (dataclasses.replace(base, n=200),        # next bucket
+                    dataclasses.replace(base, steps=200),    # next horizon
+                    dataclasses.replace(base, dynamics="double"),
+                    dataclasses.replace(base, k_neighbors=12),
+                    dataclasses.replace(base, speed_limit=0.15)):
+        key, _ = bucket_key(variant)
+        assert key != key0, variant
+
+
+def test_bucket_ladder_and_horizon_quantum():
+    assert bucket_n(1) == 16 and bucket_n(16) == 16 and bucket_n(17) == 32
+    with pytest.raises(ValueError):
+        bucket_n(10_000_000)
+    assert bucket_horizon(1) == 64
+    assert bucket_horizon(64) == 64
+    assert bucket_horizon(65) == 128
+
+
+def test_traced_split_rejects_banded_and_cert_arena_override():
+    with pytest.raises(ValueError, match="banded"):
+        swarm.Config(n=32, gating="banded").split_static_traced()
+    with pytest.raises(ValueError, match="arena_half_override"):
+        bucket_key(swarm.Config(n=32, gating="jnp", certificate=True,
+                                certificate_backend="sparse",
+                                arena_half_override=50.0))
+
+
+def test_pack_radius_preserved_through_bucket_padding():
+    cfg = swarm.Config(n=100, steps=64, gating="jnp")
+    key, traced = bucket_key(cfg)
+    padded = traced["pack_spacing"] * np.sqrt(key.n)
+    assert padded == pytest.approx(cfg.pack_radius, rel=1e-6)
+
+
+# ------------------------------------------------- padded-bucket parity --
+
+def test_padded_bucket_parity_mixed_batch():
+    """Three heterogeneous requests (different n, steps, dt, radius,
+    gains) served in ONE bucket executable each reproduce their own
+    unpadded single-request run: trajectory within tolerance, count
+    metrics exactly — pads contribute to nothing."""
+    cfgs = [
+        swarm.Config(n=50, steps=90, seed=3, gating="jnp",
+                     record_trajectory=True, safety_distance=0.42,
+                     consensus_gain=1.2),
+        swarm.Config(n=64, steps=70, seed=4, gating="jnp",
+                     record_trajectory=True, dt=0.028),
+        # steps 90/70/65 all round to the same 128-step horizon — one
+        # bucket key, one executable, one flush.
+        swarm.Config(n=40, steps=65, seed=5, gating="jnp",
+                     record_trajectory=True, consensus_gain=0.8),
+    ]
+    engine = ServeEngine(max_batch=4, bucket_sizes=(64,))
+    results = engine.run(cfgs)
+    assert engine.stats["batches"] == 1        # one bucket, one flush
+    for cfg, res in zip(cfgs, results):
+        final, outs = swarm.run(cfg)
+        assert res.n == cfg.n and res.steps == cfg.steps
+        assert res.outputs.trajectory.shape == (cfg.steps, cfg.n, 2)
+        np.testing.assert_allclose(res.outputs.trajectory,
+                                   np.asarray(outs.trajectory),
+                                   atol=2e-4)
+        np.testing.assert_allclose(res.final_state.x, np.asarray(final.x),
+                                   atol=2e-4)
+        np.testing.assert_allclose(res.outputs.min_pairwise_distance,
+                                   np.asarray(outs.min_pairwise_distance),
+                                   atol=2e-4)
+        # Count metrics: pads engage nothing, drop nothing, relax nothing.
+        for field in ("filter_active_count", "infeasible_count",
+                      "max_relax_rounds", "gating_dropped_count"):
+            np.testing.assert_array_equal(
+                getattr(res.outputs, field),
+                np.asarray(getattr(outs, field)), err_msg=field)
+
+
+def test_pads_stay_parked():
+    """The untrimmed bucket state: pad rows end exactly where the packer
+    parked them, with zero velocity — nothing ever engaged them."""
+    from cbf_tpu.parallel.ensemble import lockstep_traced_rollout
+
+    cfg = swarm.Config(n=20, steps=30, seed=2, gating="jnp")
+    key, traced = bucket_key(cfg, sizes=(32,))
+    states, traced_b, steps_b = serve_pack.stack_batch(key, [cfg], [traced],
+                                                       max_batch=1)
+    run = lockstep_traced_rollout(key.static_cfg, key.horizon,
+                                  donate_states=False)
+    final, _ = run(states, traced_b, steps_b)
+    pads = np.asarray(final.x)[0, cfg.n:]
+    np.testing.assert_array_equal(
+        pads, serve_pack.parking_rows(key.n - cfg.n, cfg.dtype))
+    assert not np.any(np.asarray(final.v)[0, cfg.n:])
+
+
+def test_padded_certificate_parity():
+    """Certificate bucket: the padded joint QP (decoupled pad variables,
+    parking-containing arena) reproduces the unpadded solve run under
+    the SAME arena, pads stay out of the residual/dropped metrics, and
+    the 1e-4 residual gate holds on the padded program."""
+    cfg = swarm.Config(n=24, steps=40, seed=5, gating="jnp",
+                      certificate=True, certificate_backend="sparse",
+                      record_trajectory=True)
+    baseline_cfg = dataclasses.replace(
+        cfg, arena_half_override=serve_buckets.PARKING_ARENA_HALF)
+    final, outs = swarm.run(baseline_cfg)
+    res = ServeEngine(max_batch=2, bucket_sizes=(32,)).run([cfg])[0]
+    np.testing.assert_allclose(res.outputs.trajectory,
+                               np.asarray(outs.trajectory), atol=5e-4)
+    assert float(np.max(res.outputs.certificate_residual)) < 1e-4
+    np.testing.assert_allclose(res.outputs.certificate_residual,
+                               np.asarray(outs.certificate_residual),
+                               atol=1e-5)
+    np.testing.assert_array_equal(res.outputs.certificate_dropped_count,
+                                  np.asarray(outs.certificate_dropped_count))
+
+
+# -------------------------------------------------- queue / micro-batch --
+
+def test_queue_flushes_on_batch_full_and_deadline():
+    engine = ServeEngine(max_batch=2, flush_deadline_s=0.15,
+                         bucket_sizes=(16,))
+    engine.start()
+    try:
+        cfg = swarm.Config(n=12, steps=10, gating="jnp")
+        t0 = time.time()
+        pending = [engine.submit(dataclasses.replace(cfg, seed=i))
+                   for i in range(3)]
+        results = [p.result(timeout=120) for p in pending]
+    finally:
+        engine.stop()
+    fills = sorted(r.batch_fill for r in results)
+    assert fills == [1, 2, 2]      # one full flush + one deadline flush
+    assert engine.stats["batches"] == 2
+    assert engine.stats["requests"] == 3
+    # The deadline flush cannot have resolved before the deadline.
+    assert results[2].latency_s >= 0.14 or time.time() - t0 > 10
+
+
+def test_submit_requires_started_engine():
+    engine = ServeEngine(max_batch=2)
+    with pytest.raises(RuntimeError, match="start"):
+        engine.submit(swarm.Config(n=12, steps=5, gating="jnp"))
+
+
+def test_stop_drains_queued_requests():
+    engine = ServeEngine(max_batch=8, flush_deadline_s=60.0,
+                         bucket_sizes=(16,))
+    engine.start()
+    pending = engine.submit(swarm.Config(n=12, steps=5, gating="jnp"))
+    engine.stop(drain=True)        # deadline far away: stop must flush
+    assert pending.done()
+    assert pending.result(timeout=0).steps == 5
+
+
+# ------------------------------------------- prewarm / compile counters --
+
+def test_executable_reuse_and_prewarm_counters():
+    cfg = swarm.Config(n=12, steps=10, gating="jnp")
+    engine = ServeEngine(max_batch=2, bucket_sizes=(16,))
+    engine.prewarm([cfg])
+    assert engine.prewarm_s is not None
+    base = dict(engine.stats)
+    engine.run([cfg, dataclasses.replace(cfg, seed=7)])
+    assert engine.stats["compile_miss"] == base["compile_miss"]  # no new
+    assert engine.stats["compile_hit"] > base["compile_hit"]
+    counts = profiling.compile_event_counts()
+    key, _ = engine.bucket_of(cfg)
+    assert counts.get(f"serve.executable_miss[{key.label()}]", 0) >= 1
+    assert counts.get(f"serve.executable_hit[{key.label()}]", 0) >= 1
+    assert any(k.startswith("serve.compile_ms[") for k in counts)
+    assert engine.manifest_extra()["serve"]["buckets"] == [key.label()]
+
+
+def test_serve_cli_request_file(tmp_path, capsys):
+    from cbf_tpu.__main__ import main as cli_main
+
+    path = tmp_path / "reqs.json"
+    path.write_text(json.dumps({"requests": [
+        {"steps": 8, "seed": 1, "overrides": {"n": 12, "gating": "jnp"}},
+        {"steps": 6, "seed": 2, "overrides": {"n": 10, "gating": "jnp"},
+         "repeat": 2},
+    ]}))
+    rc = cli_main(["serve", str(path), "--max-batch", "4"])
+    assert rc == 0
+    record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert record["requests"] == 3
+    assert len(record["results"]) == 3
+    assert record["agent_qp_steps_per_sec"] > 0
+    assert record["latency_p99_s"] >= record["latency_p50_s"]
+    assert all(r["min_pairwise_distance"] > 0.1 for r in record["results"])
+
+
+# ------------------------------------------------- donated chunk carries --
+
+def test_chunked_donation_matches_plain_and_preserves_state0():
+    cfg = swarm.Config(n=16, steps=30, gating="jnp")
+    state0, step = swarm.make(cfg)
+    from cbf_tpu.rollout.engine import rollout, rollout_chunked
+
+    final_p, outs_p = rollout(step, state0, cfg.steps)
+    # donate_carry defaults ON for non-checkpointed chunked runs.
+    final_c, outs_c, _ = rollout_chunked(step, state0, cfg.steps, chunk=10)
+    np.testing.assert_array_equal(np.asarray(final_p.x),
+                                  np.asarray(final_c.x))
+    np.testing.assert_array_equal(np.asarray(outs_p.min_pairwise_distance),
+                                  np.asarray(outs_c.min_pairwise_distance))
+    # The caller's state0 must survive the donation (defensive copy).
+    final_again, _, _ = rollout_chunked(step, state0, cfg.steps, chunk=10)
+    np.testing.assert_array_equal(np.asarray(final_c.x),
+                                  np.asarray(final_again.x))
+
+
+def test_donation_with_checkpoint_writer_rejected(tmp_path):
+    cfg = swarm.Config(n=16, steps=10, gating="jnp")
+    state0, step = swarm.make(cfg)
+    from cbf_tpu.rollout.engine import rollout_chunked
+
+    with pytest.raises(ValueError, match="donate_carry"):
+        rollout_chunked(step, state0, cfg.steps, chunk=5,
+                        checkpoint_dir=str(tmp_path), donate_carry=True)
+
+
+# ------------------------------------------------------ throughput gate --
+
+@pytest.mark.slow
+def test_batched_serving_beats_sequential_by_1_5x():
+    """The standing batching gate (ISSUE 8 acceptance): B=16 mixed-size
+    requests through the batcher vs sequential per-request execution,
+    interleaved min-of-R (scripts/telemetry_overhead.py methodology).
+    Every rep serves FRESH scalar knobs — real mixed traffic — so the
+    sequential legs pay what the pre-serve execution model actually pays
+    per novel request (a trace + compile), while the serve leg
+    re-dispatches its prewarmed bucket executables. Regressing a traced
+    field back to a baked constant makes the serve leg recompile per
+    request and fails this gate."""
+    import bench
+    from cbf_tpu.rollout.engine import rollout
+
+    B, base, steps, reps = 16, 32, 40, 2
+
+    def workload(rep):
+        return bench.serve_workload(rep, base=base, B=B, steps=steps,
+                                    gating="jnp")
+
+    engine = ServeEngine(max_batch=8)
+    engine.prewarm(workload(0))
+    engine.run(workload(0))                       # serve machinery warm
+
+    def sequential(cfgs):
+        finals = []
+        for cfg in cfgs:
+            state0, step = swarm.make(cfg)
+            final, _ = rollout(step, state0, cfg.steps)
+            finals.append(final)
+        jax.block_until_ready(finals[-1].x)
+
+    sequential(workload(1000))                    # sequential path warm
+
+    serve_walls, seq_walls = [], []
+    for i in range(reps):
+        fresh_a, fresh_b = workload(2 * i + 1), workload(2 * i + 2)
+        legs = ((serve_walls, lambda: engine.run(fresh_a)),
+                (seq_walls, lambda: sequential(fresh_b)))
+        for acc, fn in (legs if i % 2 == 0 else legs[::-1]):
+            t0 = time.perf_counter()
+            fn()
+            acc.append(time.perf_counter() - t0)
+    speedup = min(seq_walls) / min(serve_walls)
+    assert speedup >= 1.5, (
+        f"batched serving speedup {speedup:.2f}x < 1.5x "
+        f"(serve {min(serve_walls):.2f}s, sequential {min(seq_walls):.2f}s)")
+
+
+@pytest.mark.slow
+def test_persistent_cache_speeds_up_second_process(tmp_path):
+    """CBF_TPU_CACHE_DIR acceptance: a second process prewarns the same
+    bucket set >= 30% faster than the cold first process (JAX persistent
+    compilation cache, wired by serve.configure_compilation_cache)."""
+    reqs = tmp_path / "reqs.json"
+    reqs.write_text(json.dumps([
+        {"steps": 100, "seed": 1, "overrides": {"n": 100,
+                                                "gating": "jnp"}},
+        {"steps": 100, "seed": 2, "overrides": {"n": 64, "gating": "jnp"}},
+    ]))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["CBF_TPU_CACHE_DIR"] = str(tmp_path / "cache")
+    env.pop("XLA_FLAGS", None)     # single-device children, identical env
+
+    def prewarm_once():
+        out = subprocess.run(
+            [sys.executable, "-m", "cbf_tpu", "serve", str(reqs),
+             "--prewarm-only"],
+            capture_output=True, text=True, timeout=500, cwd=ROOT, env=env)
+        assert out.returncode == 0, out.stderr[-2000:]
+        return json.loads(out.stdout.strip().splitlines()[-1])["prewarm_s"]
+
+    cold = prewarm_once()
+    warm = prewarm_once()
+    assert warm <= 0.7 * cold, (
+        f"second-process prewarm {warm:.2f}s not >=30% faster than cold "
+        f"{cold:.2f}s")
+
+
+# ------------------------------------------------------------------ docs --
+
+def test_serving_documented():
+    """docs/API.md 'Serving' stays in lockstep with the code — the same
+    audit-enforcement style as the obs schema section."""
+    with open(os.path.join(ROOT, "docs", "API.md")) as fh:
+        text = fh.read()
+    assert "## Serving" in text
+    for needle in ("split_static_traced", "ServeEngine", "bucket",
+                   "CBF_TPU_CACHE_DIR", "python -m cbf_tpu serve",
+                   "n_active", "prewarm", "BENCH_SERVE",
+                   "lockstep_traced_rollout"):
+        assert needle in text, f"docs/API.md Serving: missing {needle!r}"
+    # The request-file schema keys the CLI consumes.
+    for needle in ("overrides", "repeat"):
+        assert needle in text, f"docs/API.md Serving: missing {needle!r}"
