@@ -88,6 +88,12 @@ Modes / env knobs:
     wall INCLUDES the tap (budgeted <= 3% — docs/BENCH_LOG.md Round 7);
     like profiled runs, telemetry runs are labeled in the record and
     excluded from the last-verified headline.
+  BENCH_VERIFY=1 — falsification throughput mode (cbf_tpu.verify):
+    candidate rollouts/sec through the vmapped margin evaluator, fresh
+    (trace + compile included — time-to-first-verdict) vs warm
+    (steady-state sweep rate) axes. Knobs: BENCH_VERIFY_N (256),
+    BENCH_VERIFY_STEPS (200), BENCH_VERIFY_BATCH (16),
+    BENCH_VERIFY_ROUNDS (3). See docs/BENCH_LOG.md Round 9.
   BENCH_ENSEMBLE=1 (or --ensemble) — dp-sharded ensemble of independent
     swarms over all available devices (the multi-chip measurement path for
     the v4-8 ladder rung); adds "chips" + "scaling_efficiency" fields.
@@ -936,6 +942,74 @@ def serve_workload(rep: int, *, base: int, B: int, steps: int,
         for i in range(B)]
 
 
+def _child_verify(steps: int) -> dict:
+    """BENCH_VERIFY mode: falsification throughput — candidate rollouts
+    per second through the vmapped margin evaluator (cbf_tpu.verify).
+
+    Two axes, same interleaving philosophy as the serve bench:
+    ``fresh_candidates_per_sec`` includes the one trace + compile a new
+    (config, batch-shape) pays — the time-to-first-verdict a CI gate
+    feels; ``warm_candidates_per_sec`` is the steady-state sweep rate
+    the budget knob buys once the executable exists (min-of-R rounds,
+    fresh seeded deltas per round so no round reuses device values).
+
+    Knobs: BENCH_VERIFY_N (256), BENCH_VERIFY_STEPS (BENCH_STEPS capped
+    at 200), BENCH_VERIFY_BATCH (16), BENCH_VERIFY_ROUNDS (3);
+    BENCH_GATING rides through to the swarm config."""
+    import jax
+    import numpy as np
+
+    from cbf_tpu.scenarios import swarm
+    from cbf_tpu.verify import search as vsearch
+
+    n = _env_int("BENCH_VERIFY_N", 256)
+    steps = min(_env_int("BENCH_VERIFY_STEPS", min(steps, 200)), 2000)
+    batch = _env_int("BENCH_VERIFY_BATCH", 16)
+    rounds = _env_int("BENCH_VERIFY_ROUNDS", 3)
+    gating = os.environ.get("BENCH_GATING", "auto")
+    cfg = swarm.Config(n=n, steps=steps, gating=gating)
+    settings = vsearch.SearchSettings(budget=batch * rounds, batch=batch,
+                                      seed=0)
+    print(f"bench: verify N={n} steps={steps} batch={batch} "
+          f"rounds={rounds}", file=sys.stderr)
+    adapter = vsearch.make_adapter("swarm", cfg)
+    eval_b = vsearch.make_eval_batch(adapter, settings)
+    key = jax.random.PRNGKey(settings.seed)
+
+    def deltas_for(r):
+        return settings.perturb_scale * jax.random.normal(
+            jax.random.fold_in(key, r), (batch, n, 2), cfg.dtype)
+
+    t0 = time.time()
+    margins0 = jax.block_until_ready(eval_b(deltas_for(0)))
+    fresh_s = time.time() - t0
+    best = float(np.min(np.asarray(margins0)))
+    round_walls = []
+    for r in range(1, rounds + 1):
+        d = jax.block_until_ready(deltas_for(r))    # proposal outside the
+        t0 = time.time()                            # measured window
+        m = jax.block_until_ready(eval_b(d))
+        round_walls.append(time.time() - t0)
+        best = min(best, float(np.min(np.asarray(m))))
+    warm_s = min(round_walls)
+    warm_cps = batch / warm_s
+    return {
+        "metric": (f"verify candidates/sec (swarm N={n}, steps={steps}, "
+                   f"batch={batch})"),
+        "value": round(warm_cps, 3),
+        "unit": "candidates_per_sec",
+        "vs_baseline": 0,
+        "fresh_candidates_per_sec": round(batch / fresh_s, 3),
+        "warm_candidates_per_sec": round(warm_cps, 3),
+        "fresh_batch_s": round(fresh_s, 3),
+        "warm_batch_s": round(warm_s, 3),
+        "agent_steps_per_sec": round(warm_cps * n * steps, 1),
+        "best_margin": round(best, 6),
+        "n": n, "steps": steps, "batch": batch, "rounds": rounds,
+        "platform": jax.default_backend(),
+    }
+
+
 def _child_serve(steps: int) -> dict:
     """BENCH_SERVE mode: sustained mixed traffic per chip through the
     serving engine (shape-bucketed lockstep batching, cbf_tpu.serve) vs
@@ -1123,7 +1197,9 @@ def child_main(result_path: str, ensemble: bool) -> None:
     # the r02 rate; the 420 s attempt timeout has ample slack).
     steps = _env_int("BENCH_STEPS", 10_000)
     try:
-        if os.environ.get("BENCH_SERVE", "0") == "1":
+        if os.environ.get("BENCH_VERIFY", "0") == "1":
+            result = _child_verify(steps)
+        elif os.environ.get("BENCH_SERVE", "0") == "1":
             result = _child_serve(steps)
         elif ensemble:
             result = _child_ensemble(n, steps,
@@ -1231,7 +1307,9 @@ def main() -> None:
             time.sleep(backoff)
             backoff *= 2
 
-    if os.environ.get("BENCH_SERVE", "0") == "1":
+    if os.environ.get("BENCH_VERIFY", "0") == "1":
+        label = "verify N=%d" % _env_int("BENCH_VERIFY_N", 256)
+    elif os.environ.get("BENCH_SERVE", "0") == "1":
         label = "serve B=%d" % _env_int("BENCH_SERVE_B", 16)
     else:
         label = ("ensemble x N=%d" if ensemble else "swarm N=%d") \

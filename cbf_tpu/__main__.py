@@ -404,6 +404,149 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def _verify_scenarios():
+    """scenario -> (module, steps_field) for the falsification CLI (no
+    render imports — verify runs headless)."""
+    from cbf_tpu.scenarios import cross_and_rescue, meet_at_center, swarm
+
+    return {"swarm": (swarm, "steps"),
+            "meet_at_center": (meet_at_center, "iterations"),
+            "cross_and_rescue": (cross_and_rescue, "iterations")}
+
+
+def _weakened_cbf(scenario: str, cfg, pairs: list[str]):
+    """Parse --weaken field=value pairs into a CBFParams override of the
+    scenario's DEFAULT filter parameters — the deliberate-weakening
+    lever the falsifier is tested against (e.g. --weaken dmin=0.16 or
+    --weaken gamma=0.9)."""
+    if not pairs:
+        return None
+    from cbf_tpu.core.filter import CBFParams
+    from cbf_tpu.scenarios import swarm
+
+    base = (swarm.default_cbf(cfg) if scenario == "swarm"
+            else CBFParams(max_speed=cfg.max_speed))
+    updates = {}
+    for pair in pairs:
+        key, _, raw = pair.partition("=")
+        if key not in CBFParams._fields:
+            raise SystemExit(f"--weaken: unknown CBFParams field {key!r}; "
+                             f"have {sorted(CBFParams._fields)}")
+        updates[key] = float(raw)
+    return base._replace(**updates)
+
+
+def cmd_verify(args) -> int:
+    """Falsification sweep: search for initial-condition perturbations
+    that violate a safety property, shrink what is found, optionally
+    archive it to a corpus. Exit 0 = survived the budget, 3 = violation
+    found (the tpu_watch.sh-style actionable exit)."""
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    import dataclasses as _dc
+
+    from cbf_tpu import verify as V
+
+    module, steps_field = _verify_scenarios()[args.scenario]
+    cfg = _apply_overrides(module.Config(), args.set, args.steps,
+                           steps_field, need_trajectory=False)
+    cbf = _weakened_cbf(args.scenario, cfg, args.weaken)
+    settings = V.SearchSettings(
+        budget=args.budget, batch=args.batch, seed=args.seed,
+        perturb_scale=args.perturb_scale, perturb_norm=args.perturb_norm)
+    thresholds = V.thresholds_for(args.scenario, cfg)
+    if args.properties:
+        selected = args.properties.split(",")
+        unknown = set(selected) - set(V.PROPERTY_NAMES)
+        if unknown:
+            raise SystemExit(f"unknown properties {sorted(unknown)}; have "
+                             f"{list(V.PROPERTY_NAMES)}")
+        # Unselected properties are made vacuous, not silently dropped:
+        # the margins still evaluate, they just cannot trigger "found".
+        vac = {"separation": ("separation_floor", -float("inf")),
+               "boundary": ("boundary_half", None),
+               "obstacle_clearance": ("obstacle_floor", -float("inf")),
+               "sustained_infeasibility": ("infeasible_streak_limit",
+                                           10 ** 9),
+               "goal_reach": ("goal_radius", None)}
+        thresholds = _dc.replace(thresholds, **{
+            field: value for name, (field, value) in vac.items()
+            if name not in selected})
+    mesh = None
+    if args.mesh_dp:
+        from cbf_tpu.parallel import make_mesh
+
+        mesh = make_mesh(n_dp=args.mesh_dp, n_sp=1)
+
+    sink = None
+    if args.telemetry_dir:
+        from cbf_tpu import obs
+
+        sink = obs.TelemetrySink(
+            args.telemetry_dir,
+            manifest=obs.build_manifest(cfg, extra={
+                "scenario": args.scenario, "verify": {
+                    "budget": settings.budget, "batch": settings.batch,
+                    "engines": args.engine, "seed": settings.seed}}))
+
+    engines = tuple(args.engine) if args.engine else ("random", "cem")
+    results = V.falsify(
+        args.scenario, cfg, settings=settings, engines=engines, cbf=cbf,
+        thresholds=thresholds, telemetry=sink, mesh=mesh)
+
+    from cbf_tpu.obs.schema import json_scalar
+
+    record = {"scenario": args.scenario, "budget": settings.budget,
+              "seed": settings.seed, "engines": list(engines),
+              "results": [{
+                  "engine": r.engine, "found": r.found,
+                  "margin": r.margin, "property": r.property,
+                  "evaluated": r.evaluated, "rounds": r.rounds,
+                  # strict-JSON: vacuous +inf margins encode as "inf"
+                  "margins": {k: json_scalar(v)
+                              for k, v in r.margins.items()},
+              } for r in results]}
+    found = next((r for r in results if r.found), None)
+    if found is not None and not args.no_shrink:
+        sr = V.shrink(args.scenario, cfg, found.delta, cbf=cbf,
+                      thresholds=thresholds, settings=settings,
+                      telemetry=sink)
+        record["shrunk"] = {
+            "property": sr.property, "steps": sr.steps,
+            "earliest_step": sr.earliest_step, "scale": sr.scale,
+            "margin": sr.margin, "margin_x64": sr.margin_x64,
+            "confirmed_x64": sr.confirmed_x64,
+            "evaluated": sr.evaluated,
+        }
+        if args.corpus_dir:
+            entry = V.entry_from(args.scenario, cfg, sr,
+                                 engine=found.engine, settings=settings,
+                                 cbf=cbf, thresholds=thresholds)
+            record["corpus"] = V.append_entry(args.corpus_dir, entry)
+    if sink is not None:
+        sink.summary({"violations_found": int(found is not None)})
+        sink.close()
+        record["telemetry"] = sink.run_dir
+    if args.json:
+        print(json.dumps(record))
+    else:
+        for r in record["results"]:
+            print(f"{r['engine']}: margin {r['margin']:.6f} "
+                  f"({r['property']}) after {r['evaluated']} candidates"
+                  f"{' — VIOLATION' if r['found'] else ''}")
+        if "shrunk" in record:
+            s = record["shrunk"]
+            print(f"shrunk: steps={s['steps']} scale={s['scale']:.4f} "
+                  f"margin_x64={s['margin_x64']:.6f} "
+                  f"confirmed_x64={s['confirmed_x64']}")
+        if "corpus" in record:
+            print(f"archived: {record['corpus']}")
+    return 3 if found is not None else 0
+
+
 def cmd_lint(args) -> int:
     """Static analysis gate: AST trace-safety rules over the given paths,
     plus (``--all``) the jaxpr entry-point invariants and the
@@ -561,6 +704,58 @@ def main(argv=None) -> int:
                              "bucket/compile attribution + one 'request' "
                              "event per served request")
     servep.set_defaults(fn=cmd_serve)
+
+    verp = sub.add_parser(
+        "verify", help="falsification sweep: search for initial-condition "
+                       "perturbations violating a safety property "
+                       "(docs/API.md 'Verification'); exit 3 = violation "
+                       "found")
+    verp.add_argument("scenario", nargs="?", default="swarm",
+                      choices=("swarm", "meet_at_center",
+                               "cross_and_rescue"))
+    verp.add_argument("--platform", default=None, choices=("cpu", "tpu"),
+                      help="force a JAX backend before first use")
+    verp.add_argument("--steps", type=int, default=None,
+                      help="rollout horizon (maps to steps/iterations)")
+    verp.add_argument("--set", action="append", default=[],
+                      metavar="FIELD=VALUE",
+                      help="override any config field")
+    verp.add_argument("--weaken", action="append", default=[],
+                      metavar="FIELD=VALUE",
+                      help="override CBFParams fields of the scenario's "
+                           "default filter (e.g. dmin=0.16, gamma=0.9) — "
+                           "the deliberate-weakening lever")
+    verp.add_argument("--budget", type=int, default=256,
+                      help="candidate rollouts per engine (default 256)")
+    verp.add_argument("--batch", type=int, default=32,
+                      help="vmapped candidates per jit dispatch")
+    verp.add_argument("--engine", action="append", default=[],
+                      choices=("random", "grad", "cem"),
+                      help="search engines, in order (repeatable; "
+                           "default: random, cem)")
+    verp.add_argument("--properties", default=None,
+                      help="comma-separated property subset that may "
+                           "trigger a violation (default: all)")
+    verp.add_argument("--seed", type=int, default=0)
+    verp.add_argument("--perturb-scale", type=float, default=0.04,
+                      help="proposal std in meters (default 0.04)")
+    verp.add_argument("--perturb-norm", type=float, default=0.1,
+                      help="per-agent L2 cap on perturbations "
+                           "(default 0.1 m)")
+    verp.add_argument("--no-shrink", action="store_true",
+                      help="skip minimizing a found counterexample")
+    verp.add_argument("--corpus-dir", default=None,
+                      help="append shrunk counterexamples to this "
+                           "corpus (violations.jsonl)")
+    verp.add_argument("--mesh-dp", type=int, default=None,
+                      help="shard the candidate batch over a dp mesh of "
+                           "this many devices")
+    verp.add_argument("--telemetry-dir", default=None,
+                      help="stream verify.round/verify.margin events "
+                           "into this run directory")
+    verp.add_argument("--json", action="store_true",
+                      help="machine-readable output (one JSON object)")
+    verp.set_defaults(fn=cmd_verify)
 
     sub.add_parser("list", help="list scenarios + config knobs") \
         .set_defaults(fn=cmd_list)

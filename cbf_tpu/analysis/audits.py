@@ -8,12 +8,15 @@ whole correctness surface in one invocation. The scripts remain as thin
 shims (same CLI, same ``audit()``/``chain_profile()`` entry points) so
 existing tier-1 tests and operator muscle memory keep working.
 
-* AUD001 — telemetry schema drift (StepOutputs/EnsembleMetrics vs the
-  heartbeat schema and docs/API.md);
+* AUD001 — telemetry schema drift (StepOutputs/EnsembleMetrics and the
+  verify event types vs the heartbeat schema and docs/API.md);
 * AUD002 — budget-shaped tests missing ``@pytest.mark.slow`` (the
   870 s tier-1 budget);
 * AUD003 — certificate chain-depth regression (the fused ADMM
-  iteration's serialized pair-op chain vs its pinned bound).
+  iteration's serialized pair-op chain vs its pinned bound);
+* AUD004 — reproducibility: no seedless np.random anywhere a verify
+  run's bit-replayability could route through (born in this module,
+  not a former script).
 """
 
 from __future__ import annotations
@@ -96,7 +99,31 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
             problems.append(f"exclusion of EnsembleMetrics.{field} has no "
                             "reason")
 
-    # Docs: every heartbeat field + alert kind must be documented.
+    # Verify-event drift: the falsification engines' emitted event types
+    # must match the schema's declaration — an event kind added to the
+    # emitter but not the schema (or vice versa) fails here, same
+    # contract as the StepOutputs channels above.
+    from cbf_tpu.verify import search as verify_search
+    if tuple(verify_search.EMITTED_EVENT_TYPES) != \
+            tuple(schema.VERIFY_EVENT_TYPES):
+        problems.append(
+            f"verify.search.EMITTED_EVENT_TYPES "
+            f"{verify_search.EMITTED_EVENT_TYPES!r} != "
+            f"obs.schema.VERIFY_EVENT_TYPES "
+            f"{schema.VERIFY_EVENT_TYPES!r} — emitter and schema drifted")
+    for etype in schema.VERIFY_EVENT_FIELDS:
+        if etype not in schema.VERIFY_EVENT_TYPES:
+            problems.append(
+                f"VERIFY_EVENT_FIELDS declares {etype!r}, which is not in "
+                "VERIFY_EVENT_TYPES")
+    for etype in schema.VERIFY_EVENT_TYPES:
+        if etype not in schema.VERIFY_EVENT_FIELDS:
+            problems.append(
+                f"verify event type {etype!r} has no VERIFY_EVENT_FIELDS "
+                "payload declaration")
+
+    # Docs: every heartbeat field + alert kind + verify event must be
+    # documented.
     api_path = os.path.join(repo, "docs", "API.md")
     try:
         with open(api_path, encoding="utf-8") as fh:
@@ -116,6 +143,16 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
                 problems.append(
                     f"watchdog alert kind `{kind}` is undocumented in "
                     "docs/API.md")
+        for etype, fields in schema.VERIFY_EVENT_FIELDS.items():
+            if f"`{etype}`" not in api_text:
+                problems.append(
+                    f"verify event type `{etype}` is undocumented in "
+                    "docs/API.md")
+            for field in fields:
+                if f"`{field}`" not in api_text:
+                    problems.append(
+                        f"verify event field `{field}` ({etype}) is "
+                        "undocumented in docs/API.md")
     return problems
 
 
@@ -365,10 +402,97 @@ def chain_depth_audit() -> list[str]:
     return problems
 
 
+# -- AUD004: reproducibility (seedless randomness) -------------------------
+
+#: np.random module-level draw functions — any call on the GLOBAL
+#: numpy generator is seedless by construction (its state is process
+#: entropy unless someone np.random.seed()s, which is itself banned:
+#: global-state seeding is action-at-a-distance, not threading a key).
+_NP_RANDOM_CONSTRUCTORS = frozenset({
+    "default_rng", "Generator", "SeedSequence", "PCG64", "Philox",
+    "MT19937", "SFC64", "BitGenerator", "RandomState", "get_state",
+})
+
+#: Source trees the reproducibility contract covers (tests may use
+#: whatever entropy they like — they assert, they don't archive).
+_AUD004_TREES = ("cbf_tpu", "scripts", "examples", "bench.py")
+
+
+def _np_random_attr(node: ast.Call) -> str | None:
+    """The attribute name X for a call shaped ``<name>.random.X(...)``
+    (np/numpy aliases), else None."""
+    fn = node.func
+    if not (isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, ast.Attribute)
+            and fn.value.attr == "random"
+            and isinstance(fn.value.value, ast.Name)
+            and fn.value.value.id in ("np", "numpy")):
+        return None
+    return fn.attr
+
+
+def _call_has_args(node: ast.Call) -> bool:
+    return bool(node.args or node.keywords)
+
+
+def reproducibility_audit(repo_root: str | None = None) -> list[str]:
+    """AUD004: every stochastic entry point must thread an EXPLICIT
+    seed — verify runs are archived with (config, seed, perturbation)
+    and must be bit-replayable from that record, which a process-entropy
+    RNG anywhere on the path silently breaks. Flags, in cbf_tpu/,
+    scripts/, examples/ and bench.py:
+
+    * ``np.random.default_rng()`` with no seed argument;
+    * any draw on the global generator (``np.random.uniform`` etc.) —
+      including ``np.random.seed`` (global-state seeding is not a
+      threaded key).
+
+    jax.random is exempt by construction: a PRNGKey cannot be built
+    without a seed."""
+    repo = repo_root or _REPO
+    problems = []
+    paths = []
+    for tree in _AUD004_TREES:
+        root = os.path.join(repo, tree)
+        if os.path.isfile(root):
+            paths.append(root)
+            continue
+        for dirpath, _dirs, files in os.walk(root):
+            paths.extend(os.path.join(dirpath, name)
+                         for name in sorted(files)
+                         if name.endswith(".py"))
+    for path in sorted(paths):
+        rel = os.path.relpath(path, repo)
+        with open(path, encoding="utf-8") as fh:
+            try:
+                tree = ast.parse(fh.read(), filename=path)
+            except SyntaxError as e:
+                problems.append(f"{rel}: unparseable ({e.msg})")
+                continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            attr = _np_random_attr(node)
+            if attr is None:
+                continue
+            if attr == "default_rng":
+                if not _call_has_args(node):
+                    problems.append(
+                        f"{rel}:{node.lineno}: np.random.default_rng() "
+                        "with no seed — thread an explicit seed (or a "
+                        "jax.random.PRNGKey) so the run is replayable")
+            elif attr not in _NP_RANDOM_CONSTRUCTORS:
+                problems.append(
+                    f"{rel}:{node.lineno}: np.random.{attr}(...) draws "
+                    "from the seedless GLOBAL generator — use "
+                    "np.random.default_rng(seed) or jax.random")
+    return problems
+
+
 # -- runner ----------------------------------------------------------------
 
 def run_audits(repo_root: str | None = None) -> list[Finding]:
-    """All three audits as Findings (the ``lint --all`` surface)."""
+    """All repo audits as Findings (the ``lint --all`` surface)."""
     findings = []
     for msg in obs_schema_audit(repo_root):
         findings.append(Finding("AUD001", "cbf_tpu/obs/schema.py", 0, 0,
@@ -379,4 +503,7 @@ def run_audits(repo_root: str | None = None) -> list[Finding]:
     for msg in chain_depth_audit():
         findings.append(Finding("AUD003", "cbf_tpu/solvers/sparse_admm.py",
                                 0, 0, "<chain>", msg))
+    for msg in reproducibility_audit(repo_root):
+        findings.append(Finding("AUD004", msg.split(":", 1)[0], 0, 0,
+                                "<reproducibility>", msg))
     return findings

@@ -127,6 +127,11 @@ _RULES = [
          "certificate chain-depth regression: fused ADMM iteration's "
          "serialized pair-op chain exceeded its pinned bound (former "
          "scripts/chain_depth.py gate)"),
+    Rule("AUD004", ERROR,
+         "reproducibility: seedless np.random (default_rng() without a "
+         "seed, or any global-generator draw) in cbf_tpu/scripts/"
+         "examples/bench — verify runs must be bit-replayable from "
+         "their corpus record"),
 ]
 
 RULES: dict[str, Rule] = {r.id: r for r in _RULES}

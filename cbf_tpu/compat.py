@@ -129,7 +129,8 @@ class Robotarium:
 
     def __init__(self, number_of_robots=-1, show_figure=False,
                  sim_in_real_time=False, initial_conditions=None,
-                 sim_params: SimParams = SimParams()):
+                 sim_params: SimParams = SimParams(), seed: int = 0):
+        self._seed = int(seed)
         ic = np.asarray(initial_conditions if initial_conditions is not None
                         else [], np.float32)
         if ic.size:
@@ -197,8 +198,11 @@ class Robotarium:
     def _random_poses(self, n, min_spacing=0.2):
         """Uniform poses with pairwise min-spacing rejection, so robots never
         spawn already violating the certificate radius (matching the rps
-        generator's spaced initial conditions [external — inferred])."""
-        rng = np.random.default_rng()
+        generator's spaced initial conditions [external — inferred]).
+        Seeded (the constructor's ``seed``; AUD004): a fallback spawn
+        that differed per process would break replayability for any
+        record built on it."""
+        rng = np.random.default_rng(self._seed)
         xmin, xmax, ymin, ymax = ARENA
         pts = np.empty((2, 0))
         for _ in range(1000):

@@ -103,6 +103,25 @@ EXCLUDED_STEP_OUTPUT_FIELDS: dict[str, str] = {
 #: EnsembleMetrics fields deliberately NOT streamed (none today).
 EXCLUDED_ENSEMBLE_FIELDS: dict[str, str] = {}
 
+#: Generic typed events the falsification subsystem (cbf_tpu.verify)
+#: appends via ``TelemetrySink.event()``. Declared here — not just
+#: emitted — so the schema audit (analysis.audits AUD001) can hold the
+#: emitter, this table and docs/API.md to one contract:
+#: ``verify.search.EMITTED_EVENT_TYPES`` must equal this tuple, and
+#: every type and field below must be documented.
+VERIFY_EVENT_TYPES: tuple[str, ...] = ("verify.round", "verify.margin")
+
+#: Per-event-type payload fields (all required on every event of that
+#: type). ``verify.round`` is the per-round search progress counter
+#: stream (one event per engine round — tail a long sweep live);
+#: ``verify.margin`` is an engine's final verdict record.
+VERIFY_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
+    "verify.round": ("engine", "round", "candidates", "best_margin",
+                     "violations", "evaluated"),
+    "verify.margin": ("engine", "scenario", "property", "margin",
+                      "found", "evaluated"),
+}
+
 
 def step_output_channels() -> dict[str, HeartbeatField]:
     """StepOutputs field name -> HeartbeatField for every streamed field."""

@@ -367,8 +367,14 @@ def spawn_positions(cfg: Config, seed) -> jnp.ndarray:
         or (seed.dtype == jnp.uint32 and jnp.ndim(seed) == 1)  # legacy key
     )
     key = seed if is_key else jax.random.PRNGKey(seed)
+    # Jitter is drawn in float32 REGARDLESS of cfg.dtype: under x64 the
+    # PRNG's default float64 stream produces different values for the
+    # same key, and the falsifier's x64 confirmation replay
+    # (verify.shrink) must re-run the SAME spawn at higher precision,
+    # not a different spawn. f32 configs are bit-identical to before.
     jitter = jax.random.uniform(
-        key, (cfg.n, 2), minval=-0.25 * spacing, maxval=0.25 * spacing
+        key, (cfg.n, 2), jnp.float32,
+        minval=-0.25 * spacing, maxval=0.25 * spacing
     )
     return jnp.asarray(grid, cfg.dtype) + jitter.astype(cfg.dtype)
 
@@ -711,7 +717,9 @@ def heading_spawn(cfg: Config, seed) -> jnp.ndarray:
     the latter would alias member i's headings with member i+1's spawn
     jitter in consecutive-seed Monte-Carlo ensembles."""
     key = jax.random.fold_in(jax.random.PRNGKey(int(seed)), 1)
-    return jax.random.uniform(key, (cfg.n,), minval=-np.pi,
+    # float32 draw for the same reason as spawn_positions: the x64
+    # confirmation replay must start from the same headings.
+    return jax.random.uniform(key, (cfg.n,), jnp.float32, minval=-np.pi,
                               maxval=np.pi).astype(cfg.dtype)
 
 
@@ -1125,13 +1133,14 @@ def verlet_gating(cfg: Config, x, states4, cache, K: int,
             (idx_c, xb_c, dropped_c, dkth_c))
 
 
-def make(cfg: Config = Config(), cbf: CBFParams | None = None):
-    step = _build_step(cfg, cbf)          # validates cfg first
+def make(cfg: Config = Config(), cbf: CBFParams | None = None, *,
+         unroll_relax: int = 0):
+    step = _build_step(cfg, cbf, unroll_relax=unroll_relax)  # validates cfg
     return initial_state(cfg), step
 
 
 def _build_step(cfg: Config, cbf: CBFParams | None = None, *,
-                active=None, validate: bool = True):
+                active=None, validate: bool = True, unroll_relax: int = 0):
     """The scenario step factory — the body of :func:`make` without the
     initial state (the serving layer builds padded initial states itself).
 
@@ -1142,6 +1151,15 @@ def _build_step(cfg: Config, cbf: CBFParams | None = None, *,
     engagement, certificate rows, metrics) then follows from distance —
     a parked pad is never inside any radius. ``validate=False``: see
     :func:`barrier_dynamics` (traced-config path).
+
+    ``unroll_relax > 0``: route the QP's relax-retry loop through the
+    branch-free unrolled path (core.filter safe_controls unroll_relax),
+    making the WHOLE scenario step reverse-differentiable — the
+    falsification subsystem's gradient engine (verify.search)
+    differentiates the rollout w.r.t. the initial state through it, the
+    same lever learn.tuning pulls for parameter training. Pair it with
+    ``gating="jnp"`` (the kernels' selection has no registered gradient)
+    and leave the Verlet caches off; 0 = the default scalar-guarded loop.
     """
     dt_ = cfg.dtype
     f, g, discrete = barrier_dynamics(cfg, dt_, validate=validate)
@@ -1277,6 +1295,7 @@ def _build_step(cfg: Config, cbf: CBFParams | None = None, *,
         u_safe, info = safe_controls(
             states4, obs_slab, mask, f, g, u0, cbf,
             priority_mask=priority, relax_cap=cap,
+            unroll_relax=unroll_relax,
             reference_layout=not plain_box,
             vel_box_rows=not plain_box)
         engaged = jnp.any(mask, axis=1)

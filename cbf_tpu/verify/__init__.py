@@ -1,0 +1,42 @@
+"""Falsification subsystem: adversarial counterexample search against
+the safety guarantee, shrinking, and a replayable violation corpus.
+
+The public surface:
+
+- :mod:`cbf_tpu.verify.properties` — differentiable robustness margins
+  (``margin < 0 <=> violation``) computed from rollout records, with a
+  NumPy parity twin.
+- :mod:`cbf_tpu.verify.search` — batched random / gradient-descent /
+  CEM counterexample search over perturbed initial states, one vmapped
+  jit program per batch, dp-mesh shardable.
+- :mod:`cbf_tpu.verify.shrink` — horizon + perturbation-norm
+  minimization and the x64 confirmation replay.
+- :mod:`cbf_tpu.verify.corpus` — schema-versioned JSONL archive of
+  minimized counterexamples and the replay gate over it.
+
+CLI: ``python -m cbf_tpu verify`` (exit 3 = violation found). Bench:
+``BENCH_VERIFY=1 python bench.py`` (candidates/sec, fresh vs warm).
+"""
+
+from cbf_tpu.verify.corpus import (append_entry, check_replay, entry_from,
+                                   load_entries, replay_corpus, replay_entry)
+from cbf_tpu.verify.properties import (DIFFERENTIABLE_PROPERTIES,
+                                       PROPERTY_NAMES, Margins,
+                                       PropertyThresholds, rollout_margins,
+                                       rollout_margins_np, thresholds_for)
+from cbf_tpu.verify.search import (ENGINES, Adapter, SearchResult,
+                                   SearchSettings, cem_search, falsify,
+                                   gradient_search, make_adapter,
+                                   make_eval_batch, make_eval_one,
+                                   random_search)
+from cbf_tpu.verify.shrink import ShrinkResult, enable_x64_ctx, shrink
+
+__all__ = [
+    "Adapter", "DIFFERENTIABLE_PROPERTIES", "ENGINES", "Margins",
+    "PROPERTY_NAMES", "PropertyThresholds", "SearchResult",
+    "SearchSettings", "ShrinkResult", "append_entry", "cem_search",
+    "check_replay", "enable_x64_ctx", "entry_from", "falsify",
+    "gradient_search", "load_entries", "make_adapter", "make_eval_batch",
+    "make_eval_one", "random_search", "replay_corpus", "replay_entry",
+    "rollout_margins", "rollout_margins_np", "shrink", "thresholds_for",
+]

@@ -1,0 +1,259 @@
+"""Replayable violation corpus: schema-versioned JSONL of minimized
+counterexamples + the loader/replayer that turns the archive into a
+standing regression gate.
+
+Every entry is self-contained: the scenario, the config overrides (only
+non-default fields — forward-compatible with new knobs), the optional
+CBF-parameter override that weakened the filter, the thresholds, the
+minimized perturbation, and the x64 margin the shrinker measured — plus
+provenance (git SHA, engine, seed, timestamp). ``replay_entry`` rebuilds
+the exact rollout under x64 and recomputes the margin; ``check_replay``
+turns (entry, replay) into problems:
+
+- ``expect="violates"`` entries must still violate AND reproduce the
+  recorded x64 margin BIT-EXACTLY (the determinism contract: same
+  config + seed + perturbation => same compiled program => same floats);
+- ``expect="safe"`` entries (the same perturbation against the FIXED
+  default config) must stay non-violating — the direction that catches a
+  future solver/gating change quietly reintroducing a known violation.
+
+tests/test_verify.py replays the checked-in corpus
+(``corpus/violations.jsonl``) as a tier-1 gate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any
+
+import numpy as np
+
+from cbf_tpu.verify.properties import PROPERTY_NAMES, PropertyThresholds
+from cbf_tpu.verify.search import SearchSettings, make_adapter
+from cbf_tpu.verify.shrink import ShrinkResult, enable_x64_ctx
+
+CORPUS_SCHEMA_VERSION = 1
+CORPUS_FILENAME = "violations.jsonl"
+
+_CONFIG_TYPES = {}  # scenario -> Config class (lazy; import cycle hygiene)
+
+
+def _config_cls(scenario: str):
+    if scenario not in _CONFIG_TYPES:
+        import importlib
+
+        mod = importlib.import_module(f"cbf_tpu.scenarios.{scenario}")
+        _CONFIG_TYPES[scenario] = mod.Config
+    return _CONFIG_TYPES[scenario]
+
+
+def config_overrides(cfg) -> dict:
+    """JSON-able dict of ``cfg``'s non-default fields. ``dtype`` is
+    deliberately dropped: replay always runs x64 (the precision is the
+    REPLAYER's choice, recorded per entry as margin_x64)."""
+    out = {}
+    for f in dataclasses.fields(cfg):
+        if f.name == "dtype":
+            continue
+        v = getattr(cfg, f.name)
+        d = f.default
+        if isinstance(v, tuple):
+            v = list(v)
+            d = list(d) if isinstance(d, tuple) else d
+        if v != d:
+            out[f.name] = v
+    return out
+
+
+def rebuild_config(scenario: str, overrides: dict):
+    cls = _config_cls(scenario)
+    fixed = {}
+    for f in dataclasses.fields(cls):
+        if f.name in overrides:
+            v = overrides[f.name]
+            if isinstance(f.default, tuple) and isinstance(v, list):
+                v = tuple(v)
+            fixed[f.name] = v
+    unknown = set(overrides) - set(fixed)
+    if unknown:
+        raise ValueError(
+            f"corpus entry overrides name unknown {scenario} Config "
+            f"fields {sorted(unknown)} — schema drift; bump the entry or "
+            "the config")
+    return cls(**fixed)
+
+
+def _thresholds_dict(th: PropertyThresholds) -> dict:
+    return {f.name: getattr(th, f.name)
+            for f in dataclasses.fields(th)
+            if getattr(th, f.name) != f.default}
+
+
+def _git_sha() -> str | None:
+    from cbf_tpu.obs.sink import _git_sha as sha
+
+    return sha()
+
+
+def entry_from(scenario: str, cfg, result: ShrinkResult, *, engine: str,
+               settings: SearchSettings, cbf=None,
+               thresholds: PropertyThresholds | None = None,
+               expect: str = "violates") -> dict:
+    """Build one archive entry from a shrunk counterexample."""
+    if expect not in ("violates", "safe"):
+        raise ValueError(f"expect must be violates|safe, got {expect!r}")
+    entry = {
+        "schema": CORPUS_SCHEMA_VERSION,
+        "scenario": scenario,
+        "overrides": config_overrides(cfg),
+        "cbf": None if cbf is None else {k: float(v) for k, v in
+                                         cbf._asdict().items()},
+        "thresholds": (_thresholds_dict(thresholds)
+                       if thresholds is not None else {}),
+        "seed": int(settings.seed),
+        "perturb_norm": float(settings.perturb_norm),
+        "engine": engine,
+        "property": result.property,
+        "delta": np.asarray(result.delta, np.float64).tolist(),
+        "scale": float(result.scale),
+        "steps": int(result.steps),
+        "earliest_step": result.earliest_step,
+        "margin": float(result.margin),
+        "margin_x64": float(result.margin_x64),
+        "confirmed_x64": bool(result.confirmed_x64),
+        "expect": expect,
+        "git_sha": _git_sha(),
+        "created": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+    }
+    return entry
+
+
+def corpus_path(dir_or_file: str) -> str:
+    if os.path.isdir(dir_or_file) or not dir_or_file.endswith(".jsonl"):
+        return os.path.join(dir_or_file, CORPUS_FILENAME)
+    return dir_or_file
+
+
+def append_entry(dir_or_file: str, entry: dict) -> str:
+    """Append one entry (one JSON line) to a corpus file; returns the
+    path written."""
+    path = corpus_path(dir_or_file)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "a") as fh:
+        fh.write(json.dumps(entry) + "\n")
+    return path
+
+
+def load_entries(dir_or_file: str) -> list[dict]:
+    """All corpus entries (strict: a malformed line or a
+    future/unknown schema version raises — an unreadable archive must
+    fail the gate, not silently shrink it)."""
+    path = corpus_path(dir_or_file)
+    entries = []
+    with open(path) as fh:
+        for i, line in enumerate(fh):
+            line = line.strip()
+            if not line:
+                continue
+            entry = json.loads(line)
+            if entry.get("schema") != CORPUS_SCHEMA_VERSION:
+                raise ValueError(
+                    f"{path}:{i + 1}: corpus schema "
+                    f"{entry.get('schema')!r} != supported "
+                    f"{CORPUS_SCHEMA_VERSION}")
+            entries.append(entry)
+    return entries
+
+
+def _rebuild_cbf(entry: dict):
+    if entry.get("cbf") is None:
+        return None
+    from cbf_tpu.core.filter import CBFParams
+
+    return CBFParams(**entry["cbf"])
+
+
+def _rebuild_thresholds(entry: dict) -> PropertyThresholds:
+    return dataclasses.replace(PropertyThresholds(),
+                               **entry.get("thresholds", {}))
+
+
+def replay_entry(entry: dict) -> dict:
+    """Rebuild the entry's exact rollout under x64 and recompute every
+    property margin. Returns ``{"margin", "margins", "violation",
+    "property"}`` — bit-comparable against the entry's recorded
+    ``margin_x64``."""
+    import jax
+    import jax.numpy as jnp
+
+    from cbf_tpu.verify.search import make_eval_one
+
+    scenario = entry["scenario"]
+    cfg = rebuild_config(scenario, entry["overrides"])
+    settings = SearchSettings(seed=int(entry.get("seed", 0)),
+                              perturb_norm=float(entry["perturb_norm"]))
+    with enable_x64_ctx():
+        cfg64 = dataclasses.replace(cfg, dtype=jnp.float64)
+        adapter = make_adapter(scenario, cfg64, cbf=_rebuild_cbf(entry),
+                               thresholds=_rebuild_thresholds(entry),
+                               steps=int(entry["steps"]))
+        delta = jnp.asarray(np.asarray(entry["delta"], np.float64))
+        margins = np.asarray(jax.jit(make_eval_one(adapter, settings))(delta),
+                             np.float64)
+    pi = PROPERTY_NAMES.index(entry["property"])
+    return {
+        "margin": float(margins[pi]),
+        "margins": {n: float(v) for n, v in zip(PROPERTY_NAMES, margins)},
+        "violation": bool(margins[pi] < 0),
+        "property": entry["property"],
+    }
+
+
+def check_replay(entry: dict, replay: dict) -> list[str]:
+    """Problems with one replayed entry (empty = the gate passes).
+
+    ``violates`` entries: the violation must still reproduce AND the
+    margin must match the record bit-exactly. ``safe`` entries: the
+    margin must stay non-negative — a negative here means a change
+    reintroduced a known violation into a config that was certified
+    clean when the entry was archived."""
+    problems = []
+    expect = entry.get("expect", "violates")
+    if expect == "violates":
+        if not replay["violation"]:
+            problems.append(
+                f"{entry['scenario']}/{entry['property']}: archived "
+                f"violation no longer reproduces (margin "
+                f"{replay['margin']:.9g} >= 0) — the detection machinery "
+                "or the dynamics changed out from under the corpus")
+        if replay["margin"] != entry["margin_x64"]:
+            problems.append(
+                f"{entry['scenario']}/{entry['property']}: x64 replay "
+                f"margin {replay['margin']!r} != recorded "
+                f"{entry['margin_x64']!r} — the run is no longer "
+                "bit-replayable from its corpus record")
+    elif replay["violation"]:
+        problems.append(
+            f"{entry['scenario']}/{entry['property']}: 'safe' entry now "
+            f"VIOLATES (margin {replay['margin']:.9g} < 0) — a change "
+            "reintroduced a known violation into the certified default "
+            "config")
+    return problems
+
+
+def replay_corpus(dir_or_file: str) -> list[tuple[dict, dict, list[str]]]:
+    """Replay every archived entry: the standing regression gate.
+    Returns ``(entry, replay, problems)`` triples; an empty corpus file
+    is an error (a gate that silently checks nothing)."""
+    entries = load_entries(dir_or_file)
+    if not entries:
+        raise ValueError(f"{corpus_path(dir_or_file)}: empty corpus — "
+                         "the replay gate would vacuously pass")
+    out = []
+    for entry in entries:
+        replay = replay_entry(entry)
+        out.append((entry, replay, check_replay(entry, replay)))
+    return out

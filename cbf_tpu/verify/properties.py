@@ -1,0 +1,277 @@
+"""Differentiable robustness margins: the falsification subsystem's
+property layer.
+
+The paper's value proposition is a GUARANTEE (min inter-robot distance
+stays above a safety radius under the CBF filter), but a guarantee only
+earns its keep if something attacks it. Each property here is a scalar
+*robustness margin* computed from the rollout's existing observability
+record (``rollout.engine.StepOutputs`` channels + the final state) where
+``margin < 0 <=> the property is violated`` — the signed-distance form
+STL robustness uses, so search engines (``verify.search``) can descend
+on it and shrinkers (``verify.shrink``) can bisect it.
+
+Every margin is pure jnp on already-computed channels: it runs INSIDE
+the compiled rollout program (one fused evaluation per candidate, no
+host round-trip per property) and is differentiable end-to-end through
+the rollout where the step itself is (the gradient-descent engine's
+requirement). A NumPy twin (:func:`rollout_margins_np`) recomputes the
+same margins post-hoc on host records — the parity oracle
+tests/test_verify.py pins the two against.
+
+Properties (vacuous ones report +inf, never silently 0):
+
+- ``separation`` — min over steps of ``min_pairwise_distance`` minus the
+  scenario's calibrated separation floor. THE paper claim.
+- ``boundary`` — arena containment: the half-width minus the worst
+  ``|coordinate|`` over the recorded trajectory (final positions when no
+  trajectory is recorded — a weaker but always-available check).
+- ``obstacle_clearance`` — min over recorded steps of the agent-obstacle
+  distance minus the obstacle floor (closed-form obstacle positions;
+  needs a trajectory and an ``obstacle_fn``).
+- ``sustained_infeasibility`` — the QP health claim: the longest
+  consecutive streak of steps with ``infeasible_count > 0`` must stay
+  under a limit (a transient squeeze is physics; a sustained streak is
+  a silently-neutered filter).
+- ``goal_reach`` — liveness: a filter that parks everyone at spawn
+  trivially "never collides"; the swarm must still pack into its disk.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+
+class Margins(NamedTuple):
+    """One scalar robustness margin per property; ``< 0`` <=> violation.
+    Vacuous properties (nothing to check in this scenario/config) are
+    ``+inf`` so min-reductions and argmins never select them."""
+    separation: Any
+    boundary: Any
+    obstacle_clearance: Any
+    sustained_infeasibility: Any
+    goal_reach: Any
+
+
+PROPERTY_NAMES: tuple[str, ...] = Margins._fields
+
+#: Properties with a usable gradient w.r.t. the initial state — the
+#: gradient-descent engine's objective set (``sustained_infeasibility``
+#: is a count of boolean flags: its cotangent is identically zero).
+DIFFERENTIABLE_PROPERTIES: tuple[str, ...] = (
+    "separation", "boundary", "obstacle_clearance", "goal_reach")
+
+
+@dataclasses.dataclass(frozen=True)
+class PropertyThresholds:
+    """The per-scenario constants the margins are signed against.
+
+    ``separation_floor`` defaults are the repo's own CALIBRATED gates
+    (bench.py SAFETY_FLOOR lineage), not the ideal barrier floor: the
+    discrete-time filter is allowed its measured discretization slack,
+    and a default config must come out margin-positive — the
+    falsifier's null hypothesis."""
+    separation_floor: float = 0.13
+    #: Arena half-width for the boundary property; None = vacuous.
+    boundary_half: float | None = None
+    obstacle_floor: float = 0.13
+    #: Longest tolerated consecutive infeasible streak (steps).
+    infeasible_streak_limit: int = 25
+    #: goal_reach: max stand-off beyond ``goal_radius`` tolerated at the
+    #: final step; ``goal_radius`` None = vacuous.
+    goal_slack: float = 0.5
+    goal_radius: float | None = None
+
+
+def thresholds_for(scenario: str, cfg) -> PropertyThresholds:
+    """Calibrated default thresholds per scenario (override any field via
+    ``dataclasses.replace``). Floors cite the repo's existing test/bench
+    gates so "default config survives" and "tier-1 floor holds" are the
+    same statement."""
+    if scenario == "swarm":
+        # 0.13 = bench.py SAFETY_FLOOR (L1 floor 0.2/sqrt(2) minus
+        # discretization slack). Boundary: the certificate's arena box —
+        # the one containment contract the repo already states.
+        half = (cfg.arena_half_override if cfg.arena_half_override
+                is not None else 1.5 * cfg.spawn_half_width)
+        # goal_reach is a CONVERGED-run liveness claim: it only applies
+        # when the horizon's travel budget (at half nominal speed — jam
+        # slack) covers the worst spawn-to-disk distance; short probe
+        # horizons get a vacuous goal property, not a fake violation.
+        d0max = float(np.sqrt(2.0) * cfg.spawn_half_width) + 0.3
+        travel = 0.5 * cfg.speed_limit * cfg.dt * cfg.steps
+        goal_radius = (float(cfg.pack_radius)
+                       if travel >= d0max - cfg.pack_radius else None)
+        return PropertyThresholds(
+            separation_floor=0.13, boundary_half=float(half),
+            obstacle_floor=0.13, goal_radius=goal_radius)
+    if scenario == "meet_at_center":
+        # 0.05: the reference scenario's own regression floor
+        # (tests/test_scenarios.py) — its ring obstacles orbit closer
+        # than the swarm floor by design.
+        return PropertyThresholds(separation_floor=0.05,
+                                  boundary_half=2.0)
+    if scenario == "cross_and_rescue":
+        return PropertyThresholds(separation_floor=0.13,
+                                  boundary_half=2.0)
+    raise ValueError(f"no calibrated thresholds for scenario {scenario!r}")
+
+
+def _longest_true_run(flags):
+    """Longest consecutive run of True in a (T,) bool array (jnp scan —
+    runs inside the compiled margin evaluation)."""
+    def body(run, f):
+        run = (run + 1) * f.astype(jnp.int32)
+        return run, run
+
+    _, runs = lax.scan(body, jnp.zeros((), jnp.int32), flags)
+    return jnp.max(runs)
+
+
+def rollout_margins(th: PropertyThresholds, outs, final_positions, *,
+                    trajectory=None, obstacle_fn: Callable | None = None
+                    ) -> Margins:
+    """All property margins for one rollout record.
+
+    Args:
+      th: scenario thresholds (:func:`thresholds_for`).
+      outs: the StepOutputs pytree stacked over time (scan outputs).
+      final_positions: (N, 2) final agent positions.
+      trajectory: optional (T, N, 2) recorded positions — upgrades the
+        boundary check from final-state to whole-run and enables
+        ``obstacle_clearance``.
+      obstacle_fn: optional ``t -> (M, 2)`` closed-form obstacle
+        positions (jnp; traced t), e.g. the swarm's orbit ring.
+
+    Pure jnp over already-computed channels: jit/vmap/grad-safe.
+    """
+    dt_ = final_positions.dtype
+    inf = jnp.asarray(jnp.inf, dt_)
+
+    separation = (jnp.min(outs.min_pairwise_distance)
+                  - th.separation_floor).astype(dt_)
+
+    if th.boundary_half is None:
+        boundary = inf
+    else:
+        pos = final_positions if trajectory is None else trajectory
+        boundary = (th.boundary_half - jnp.max(jnp.abs(pos))).astype(dt_)
+
+    if trajectory is not None and obstacle_fn is not None:
+        ts = jnp.arange(trajectory.shape[0])
+        obs_t = _obstacles_over_time(obstacle_fn, ts)        # (T, M, 2)
+        d = jnp.linalg.norm(
+            trajectory[:, :, None, :] - obs_t[:, None, :, :], axis=-1)
+        obstacle_clearance = (jnp.min(d) - th.obstacle_floor).astype(dt_)
+    else:
+        obstacle_clearance = inf
+
+    flags = outs.infeasible_count > 0
+    longest = _longest_true_run(flags)
+    lim = float(th.infeasible_streak_limit)
+    sustained = ((lim - longest.astype(dt_)) / lim).astype(dt_)
+
+    if th.goal_radius is None:
+        goal = inf
+    else:
+        c = jnp.mean(final_positions, axis=0)
+        d_c = jnp.linalg.norm(final_positions - c[None], axis=1)
+        goal = (th.goal_radius + th.goal_slack - jnp.max(d_c)).astype(dt_)
+
+    return Margins(separation=separation, boundary=boundary,
+                   obstacle_clearance=obstacle_clearance,
+                   sustained_infeasibility=sustained, goal_reach=goal)
+
+
+def _obstacles_over_time(obstacle_fn: Callable, ts):
+    """(T, M, 2) obstacle positions for a traced step vector — one vmap,
+    shared by the compiled and NumPy paths' shape contract."""
+    import jax
+
+    return jax.vmap(obstacle_fn)(ts)
+
+
+def stack_margins(m: Margins):
+    """(P,) array of margins in :data:`PROPERTY_NAMES` order — the form
+    the search engines reduce over."""
+    return jnp.stack([jnp.asarray(v) for v in m])
+
+
+def worst_property(margins_vec) -> tuple:
+    """(worst_margin, property_index) of a (P,) margin vector."""
+    i = jnp.argmin(margins_vec)
+    return margins_vec[i], i
+
+
+# ------------------------------------------------------------- NumPy twin
+
+def margin_series_np(th: PropertyThresholds, outs, *, trajectory=None,
+                     obstacle_fn_np: Callable | None = None,
+                     prop: str = "separation") -> np.ndarray | None:
+    """Per-step margin series for a property, NumPy, or None when the
+    property has no per-step decomposition (``goal_reach``; ``boundary``
+    and ``obstacle_clearance`` without a trajectory). The rollout-level
+    margin is ``series.min()``; the shrinker's earliest-violating-step
+    comes from ``argmax(series < 0)``."""
+    if prop == "separation":
+        return (np.asarray(outs.min_pairwise_distance, np.float64)
+                - th.separation_floor)
+    if prop == "boundary":
+        if trajectory is None or th.boundary_half is None:
+            return None
+        traj = np.asarray(trajectory, np.float64)
+        return th.boundary_half - np.abs(traj).max(axis=(1, 2))
+    if prop == "obstacle_clearance":
+        if trajectory is None or obstacle_fn_np is None:
+            return None
+        traj = np.asarray(trajectory, np.float64)
+        out = np.empty(traj.shape[0])
+        for t in range(traj.shape[0]):
+            opos = np.asarray(obstacle_fn_np(t), np.float64)
+            d = np.linalg.norm(traj[t][:, None] - opos[None], axis=-1)
+            out[t] = d.min() - th.obstacle_floor
+        return out
+    if prop == "sustained_infeasibility":
+        flags = np.asarray(outs.infeasible_count) > 0
+        run, runs = 0, np.empty(len(flags))
+        for t, f in enumerate(flags):
+            run = (run + 1) if f else 0
+            runs[t] = run
+        lim = float(th.infeasible_streak_limit)
+        return (lim - runs) / lim
+    if prop == "goal_reach":
+        return None
+    raise KeyError(prop)
+
+
+def rollout_margins_np(th: PropertyThresholds, outs, final_positions, *,
+                       trajectory=None,
+                       obstacle_fn_np: Callable | None = None) -> dict:
+    """Post-hoc NumPy recomputation of :func:`rollout_margins` — the
+    independent parity oracle (float64 host math, no jnp). Returns
+    property name -> float margin."""
+    out = {}
+    for prop in ("separation", "boundary", "obstacle_clearance",
+                 "sustained_infeasibility"):
+        series = margin_series_np(th, outs, trajectory=trajectory,
+                                  obstacle_fn_np=obstacle_fn_np, prop=prop)
+        if series is not None:
+            out[prop] = float(series.min())
+    fp = np.asarray(final_positions, np.float64)
+    if "boundary" not in out:
+        out["boundary"] = (float(th.boundary_half - np.abs(fp).max())
+                           if th.boundary_half is not None else np.inf)
+    if "obstacle_clearance" not in out:
+        out["obstacle_clearance"] = np.inf
+    if th.goal_radius is None:
+        out["goal_reach"] = np.inf
+    else:
+        c = fp.mean(axis=0)
+        d_c = np.linalg.norm(fp - c[None], axis=1)
+        out["goal_reach"] = float(th.goal_radius + th.goal_slack
+                                  - d_c.max())
+    return {name: out[name] for name in PROPERTY_NAMES}

@@ -1,0 +1,527 @@
+"""Massively-batched falsification: adversarial counterexample search.
+
+The attack surface is the initial condition: each engine searches for a
+bounded perturbation ``delta`` of the scenario's spawn state that drives
+a full rollout to a property violation (``verify.properties`` margin
+< 0). Every candidate is one complete compiled rollout; candidates are
+vmapped into ONE jit program per batch — the "thousands of independent
+problems, one device" shape (PAPERS.md: Many Problems One GPU) the
+framework's rollout engine already compiles to — and the batch axis can
+be sharded across the ``dp`` mesh axis (``parallel.make_mesh``) for
+large sweeps, exactly like the ensemble path shards members.
+
+Three engines, cheapest first:
+
+- :func:`random_search` — seeded Gaussian perturbations, pure breadth.
+- :func:`gradient_search` — descends the worst differentiable margin
+  w.r.t. the initial state THROUGH the compiled rollout (the swarm step
+  built with ``unroll_relax > 0`` — the same branch-free QP lever
+  learn.tuning trains through), normalized-gradient steps on a vmapped
+  candidate set.
+- :func:`cem_search` — cross-entropy refinement: resample around the
+  elite (lowest-margin) candidates, shrinking the proposal each round.
+
+All engines are bit-deterministic from ``SearchSettings.seed`` (every
+key is ``fold_in``-derived; no host entropy), stream per-round progress
+as ``verify.round`` telemetry events and their verdict as a
+``verify.margin`` event (``obs.schema.VERIFY_EVENT_TYPES``), and return
+:class:`SearchResult` records the shrinker and corpus consume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from cbf_tpu.rollout.engine import _rollout_body
+from cbf_tpu.utils.math import l2_cap
+from cbf_tpu.verify.properties import (DIFFERENTIABLE_PROPERTIES,
+                                       PROPERTY_NAMES, PropertyThresholds,
+                                       rollout_margins, stack_margins,
+                                       thresholds_for)
+
+#: Event types this module appends via TelemetrySink.event() — must stay
+#: equal to obs.schema.VERIFY_EVENT_TYPES (AUD001 cross-checks; a new
+#: event kind lands in the schema and docs in the same change).
+EMITTED_EVENT_TYPES: tuple[str, ...] = ("verify.round", "verify.margin")
+
+ENGINES: tuple[str, ...] = ("random", "grad", "cem")
+
+# fold_in tags: engine keys must never collide across engines or with
+# each other's round streams.
+_ENGINE_TAG = {"random": 1, "grad": 2, "cem": 3}
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchSettings:
+    """Falsification budget + proposal-distribution knobs (one dataclass
+    so CLI, bench and tests share defaults)."""
+    #: Max candidate rollouts PER ENGINE (rounded up to whole batches).
+    budget: int = 256
+    #: Vmapped candidates per jit dispatch (the device-fill knob).
+    batch: int = 32
+    #: Std (m) of the Gaussian initial-state perturbation proposal.
+    perturb_scale: float = 0.04
+    #: Hard per-agent L2 cap (m) on any candidate perturbation — the
+    #: declared attack neighborhood. Small enough that a perturbation
+    #: cannot fabricate a below-floor pair at t=0 (spawn spacing ~0.4 m):
+    #: a violation found is the FILTER's failure, not the spawner's.
+    perturb_norm: float = 0.1
+    seed: int = 0
+    # gradient engine
+    gd_iters: int = 12
+    gd_lr: float = 0.03
+    gd_candidates: int = 8
+    #: Unrolled QP relax rounds for the differentiable step (swarm.make
+    #: unroll_relax) — learn.tuning's default.
+    unroll_relax: int = 2
+    # CEM refinement
+    cem_rounds: int = 6
+    cem_elite_frac: float = 0.2
+    #: Proposal-std floor: CEM must keep exploring even after collapse.
+    cem_std_floor: float = 5e-3
+
+
+class Adapter(NamedTuple):
+    """One scenario bound for falsification: the compiled pieces every
+    engine shares (build once, evaluate thousands of candidates)."""
+    scenario: str
+    cfg: Any
+    state0: Any
+    step: Callable             # (state, t) -> (state, StepOutputs)
+    steps: int
+    thresholds: PropertyThresholds
+    delta_shape: tuple         # perturbation shape ((P, 2) positions)
+    perturb: Callable          # (state0, delta) -> state0'
+    positions: Callable        # final_state -> (N, 2)
+    traj_extract: Callable     # outs -> (T, N, 2) | None
+    obstacle_fn: Callable | None      # traced t -> (M, 2) | None
+    obstacle_fn_np: Callable | None   # host t -> (M, 2) | None
+    differentiable: bool
+
+
+def make_adapter(scenario: str, cfg=None, *, cbf=None, steps=None,
+                 thresholds: PropertyThresholds | None = None,
+                 differentiable: bool = False,
+                 unroll_relax: int = 2) -> Adapter:
+    """Bind a scenario config for falsification.
+
+    ``differentiable=True`` (swarm only): builds the step with the
+    unrolled-relax QP and jnp gating so engines can reverse-differentiate
+    the rollout w.r.t. the initial state; rejected for configs whose step
+    has non-differentiable structure (Verlet caches, the dense
+    certificate's fori_loop solver)."""
+    if scenario == "swarm":
+        return _swarm_adapter(cfg, cbf, steps, thresholds, differentiable,
+                              unroll_relax)
+    if differentiable:
+        raise ValueError(
+            f"the differentiable (gradient-engine) path exists for the "
+            f"swarm scenario only — {scenario!r} steps run the "
+            "scalar-guarded relax loop; use the random/cem engines")
+    if scenario == "meet_at_center":
+        return _meet_adapter(cfg, cbf, steps, thresholds)
+    if scenario == "cross_and_rescue":
+        return _cross_adapter(cfg, cbf, steps, thresholds)
+    raise ValueError(f"unknown scenario {scenario!r}; have swarm, "
+                     "meet_at_center, cross_and_rescue")
+
+
+def _swarm_adapter(cfg, cbf, steps, thresholds, differentiable,
+                   unroll_relax) -> Adapter:
+    from cbf_tpu.scenarios import swarm
+
+    cfg = cfg or swarm.Config()
+    if steps is not None:
+        cfg = dataclasses.replace(cfg, steps=int(steps))
+    if differentiable:
+        if cfg.gating_rebuild_skin or cfg.certificate_rebuild_skin:
+            raise ValueError(
+                "the gradient engine cannot differentiate the Verlet "
+                "caches (rebuild cond) — falsify with both skins at 0")
+        if cfg.certificate:
+            raise ValueError(
+                "the gradient engine does not differentiate the joint "
+                "certificate; falsify certificate configs with the "
+                "random/cem engines (the filter parameters under attack "
+                "are the same)")
+        cfg = dataclasses.replace(cfg, gating="jnp")
+    state0, step = swarm.make(
+        cfg, cbf, unroll_relax=unroll_relax if differentiable else 0)
+    th = thresholds or thresholds_for("swarm", cfg)
+    obstacle_fn = obstacle_fn_np = None
+    if cfg.n_obstacles:
+        obstacle_fn = (lambda t:
+                       swarm.obstacle_states_at(cfg, t, cfg.dtype)[:, :2])
+        obstacle_fn_np = lambda t: swarm.obstacle_positions_at(cfg, t)
+    traj_extract = ((lambda outs: outs.trajectory)
+                    if cfg.record_trajectory else (lambda outs: None))
+    return Adapter(
+        scenario="swarm", cfg=cfg, state0=state0, step=step,
+        steps=int(cfg.steps), thresholds=th,
+        delta_shape=(cfg.n, 2),
+        perturb=lambda s0, d: s0._replace(x=s0.x + d.astype(s0.x.dtype)),
+        positions=lambda final: final.x,
+        traj_extract=traj_extract,
+        obstacle_fn=obstacle_fn, obstacle_fn_np=obstacle_fn_np,
+        differentiable=differentiable)
+
+
+def _meet_adapter(cfg, cbf, steps, thresholds) -> Adapter:
+    from cbf_tpu.scenarios import meet_at_center as meet
+
+    cfg = cfg or meet.Config()
+    if steps is not None:
+        cfg = dataclasses.replace(cfg, iterations=int(steps))
+    state0, step = meet.make(cfg, cbf=cbf) if cbf is not None \
+        else meet.make(cfg)
+    th = thresholds or thresholds_for("meet_at_center", cfg)
+    n_obs = cfg.n_obstacles
+
+    def perturb(s0, d):
+        # Free agents only: perturbing the pursuit ring can fabricate a
+        # t=0 overlap no filter could have prevented.
+        return s0._replace(poses=s0.poses.at[:2, n_obs:].add(
+            d.T.astype(s0.poses.dtype)))
+
+    traj_extract = ((lambda outs: jnp.swapaxes(outs.trajectory, 1, 2))
+                    if cfg.record_trajectory else (lambda outs: None))
+    return Adapter(
+        scenario="meet_at_center", cfg=cfg, state0=state0, step=step,
+        steps=int(cfg.iterations), thresholds=th,
+        delta_shape=(cfg.n_free, 2), perturb=perturb,
+        positions=lambda final: final.poses[:2].T,
+        traj_extract=traj_extract,
+        obstacle_fn=None, obstacle_fn_np=None, differentiable=False)
+
+
+def _cross_adapter(cfg, cbf, steps, thresholds) -> Adapter:
+    from cbf_tpu.scenarios import cross_and_rescue as cross
+
+    cfg = cfg or cross.Config()
+    if steps is not None:
+        cfg = dataclasses.replace(cfg, iterations=int(steps))
+    state0, step = cross.make(cfg, cbf=cbf) if cbf is not None \
+        else cross.make(cfg)
+    th = thresholds or thresholds_for("cross_and_rescue", cfg)
+
+    def perturb(s0, d):
+        return s0._replace(poses=s0.poses.at[:2].add(
+            d.T.astype(s0.poses.dtype)))
+
+    def traj_extract(outs):
+        if not cfg.record_trajectory:
+            return None
+        return jnp.swapaxes(outs.trajectory[0], 1, 2)
+
+    return Adapter(
+        scenario="cross_and_rescue", cfg=cfg, state0=state0, step=step,
+        steps=int(cfg.iterations), thresholds=th,
+        delta_shape=(cfg.n_robots, 2), perturb=perturb,
+        positions=lambda final: final.poses[:2].T,
+        traj_extract=traj_extract,
+        obstacle_fn=None, obstacle_fn_np=None, differentiable=False)
+
+
+# ----------------------------------------------------------- evaluation --
+
+def project_delta(delta, norm_cap: float):
+    """Clamp each agent's perturbation row to the attack neighborhood
+    (per-row L2 cap) — applied INSIDE the compiled evaluation, so every
+    engine proposal obeys the same bound by construction."""
+    return l2_cap(delta, norm_cap)
+
+
+def make_eval_one(adapter: Adapter, settings: SearchSettings) -> Callable:
+    """``eval_one(delta) -> (P,) margin vector``: one full rollout + all
+    property margins as a single traced function (vmap/grad/jit compose
+    on top — the engines' shared core)."""
+    def eval_one(delta):
+        d = project_delta(delta, settings.perturb_norm)
+        s0 = adapter.perturb(adapter.state0, d)
+        final, outs = _rollout_body(adapter.step, s0,
+                                    jnp.zeros((), jnp.int32), adapter.steps)
+        m = rollout_margins(
+            adapter.thresholds, outs, adapter.positions(final),
+            trajectory=adapter.traj_extract(outs),
+            obstacle_fn=adapter.obstacle_fn)
+        return stack_margins(m)
+
+    return eval_one
+
+
+def make_eval_batch(adapter: Adapter, settings: SearchSettings,
+                    mesh=None) -> Callable:
+    """jit(vmap(eval_one)): ``(B, *delta_shape) -> (B, P)`` margins —
+    one compiled program per batch shape. With ``mesh``, the candidate
+    axis is sharded over the mesh's ``dp`` axis (B must be a multiple of
+    the dp extent — use :func:`round_batch`)."""
+    eval_b = jax.jit(jax.vmap(make_eval_one(adapter, settings)))
+    if mesh is None:
+        return eval_b
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ndim = 1 + len(adapter.delta_shape)
+    sharding = NamedSharding(mesh, P("dp", *([None] * (ndim - 1))))
+
+    def eval_sharded(deltas):
+        if deltas.shape[0] % mesh.shape["dp"]:
+            raise ValueError(
+                f"batch {deltas.shape[0]} must be a multiple of the dp "
+                f"extent {mesh.shape['dp']} (round_batch pads the "
+                "settings for you)")
+        return eval_b(jax.device_put(deltas, sharding))
+
+    return eval_sharded
+
+
+def round_batch(settings: SearchSettings, mesh) -> SearchSettings:
+    """Round ``settings.batch`` up to a whole multiple of the mesh's dp
+    extent (no-op without a mesh)."""
+    if mesh is None:
+        return settings
+    dp = mesh.shape["dp"]
+    batch = -(-settings.batch // dp) * dp
+    return dataclasses.replace(settings, batch=batch)
+
+
+# -------------------------------------------------------------- results --
+
+class SearchResult(NamedTuple):
+    """One engine's verdict: the lowest-margin candidate it saw."""
+    engine: str
+    scenario: str
+    found: bool                # any property margin < 0
+    margin: float              # the worst margin
+    property: str              # which property attained it
+    delta: np.ndarray          # the (projected) perturbation
+    margins: dict              # property name -> float margin
+    evaluated: int             # candidate rollouts consumed
+    rounds: int
+    seed: int
+
+
+def _result(engine, adapter, settings, delta_np, margins_vec, evaluated,
+            rounds) -> SearchResult:
+    m = np.asarray(margins_vec, np.float64)
+    i = int(np.argmin(m))
+    return SearchResult(
+        engine=engine, scenario=adapter.scenario,
+        found=bool(m[i] < 0.0), margin=float(m[i]),
+        property=PROPERTY_NAMES[i], delta=np.asarray(delta_np),
+        margins={name: float(v) for name, v in zip(PROPERTY_NAMES, m)},
+        evaluated=int(evaluated), rounds=int(rounds),
+        seed=settings.seed)
+
+
+def _emit_round(telemetry, engine, rnd, candidates, best_margin,
+                violations, evaluated) -> None:
+    if telemetry is None:
+        return
+    from cbf_tpu.obs import schema
+
+    telemetry.event("verify.round", {
+        "engine": engine, "round": int(rnd), "candidates": int(candidates),
+        "best_margin": schema.json_scalar(best_margin),
+        "violations": int(violations), "evaluated": int(evaluated)})
+
+
+def _emit_result(telemetry, result: SearchResult) -> None:
+    if telemetry is None:
+        return
+    from cbf_tpu.obs import schema
+
+    telemetry.event("verify.margin", {
+        "engine": result.engine, "scenario": result.scenario,
+        "property": result.property,
+        "margin": schema.json_scalar(result.margin),
+        "found": bool(result.found), "evaluated": result.evaluated})
+
+
+def _worst_per_candidate(margins) -> np.ndarray:
+    """(B,) worst margin per candidate, on host."""
+    return np.asarray(jnp.min(margins, axis=1), np.float64)
+
+
+# -------------------------------------------------------------- engines --
+
+def random_search(adapter: Adapter, settings: SearchSettings = SearchSettings(),
+                  *, telemetry=None, mesh=None) -> SearchResult:
+    """Batched seeded random search: breadth-first coverage of the attack
+    neighborhood. Stops after the first round that finds a violation (the
+    whole round still evaluates — determinism over latency)."""
+    settings = round_batch(settings, mesh)
+    eval_b = make_eval_batch(adapter, settings, mesh)
+    key = jax.random.fold_in(jax.random.PRNGKey(settings.seed),
+                             _ENGINE_TAG["random"])
+    B = settings.batch
+    rounds = max(1, -(-settings.budget // B))
+    best = (np.inf, None, None)          # (worst margin, delta, margins row)
+    evaluated = 0
+    for r in range(rounds):
+        deltas = settings.perturb_scale * jax.random.normal(
+            jax.random.fold_in(key, r), (B,) + adapter.delta_shape,
+            _state_dtype(adapter))
+        margins = eval_b(deltas)
+        worst = _worst_per_candidate(margins)
+        evaluated += B
+        i = int(np.argmin(worst))
+        if worst[i] < best[0]:
+            best = (worst[i], np.asarray(
+                project_delta(deltas[i], settings.perturb_norm)),
+                np.asarray(margins)[i])
+        _emit_round(telemetry, "random", r, B, best[0],
+                    int((worst < 0).sum()), evaluated)
+        if best[0] < 0:
+            break
+    result = _result("random", adapter, settings, best[1], best[2],
+                     evaluated, r + 1)
+    _emit_result(telemetry, result)
+    return result
+
+
+def _state_dtype(adapter: Adapter):
+    return adapter.positions(adapter.state0).dtype
+
+
+def gradient_search(adapter: Adapter,
+                    settings: SearchSettings = SearchSettings(), *,
+                    telemetry=None, mesh=None) -> SearchResult:
+    """Descend the worst DIFFERENTIABLE margin w.r.t. the initial state
+    through the compiled rollout: a vmapped candidate set of
+    normalized-gradient steps (step size ``gd_lr`` meters — scale-free in
+    the margin's magnitude). Requires a ``differentiable=True`` adapter
+    (swarm, unrolled-relax QP)."""
+    if not adapter.differentiable:
+        raise ValueError(
+            "gradient_search needs make_adapter(differentiable=True) "
+            "(swarm only — the unrolled-relax step); got a non-"
+            "differentiable adapter")
+    eval_one = make_eval_one(adapter, settings)
+    diff_idx = jnp.asarray([PROPERTY_NAMES.index(p)
+                            for p in DIFFERENTIABLE_PROPERTIES])
+
+    def objective(delta):
+        mvec = eval_one(delta)
+        return jnp.min(mvec[diff_idx]), mvec
+
+    grad_b = jax.jit(jax.vmap(jax.value_and_grad(objective, has_aux=True)))
+
+    @jax.jit
+    def descend(deltas, grads):
+        norm = jnp.sqrt(jnp.sum(grads ** 2, axis=(1, 2), keepdims=True))
+        step = grads / jnp.maximum(norm, 1e-12)
+        return deltas - settings.gd_lr * step
+
+    C = max(1, settings.gd_candidates)
+    key = jax.random.fold_in(jax.random.PRNGKey(settings.seed),
+                             _ENGINE_TAG["grad"])
+    deltas = settings.perturb_scale * jax.random.normal(
+        key, (C,) + adapter.delta_shape, _state_dtype(adapter))
+    best = (np.inf, None, None)
+    evaluated = 0
+    iters = max(1, min(settings.gd_iters,
+                       -(-settings.budget // C)))
+    for it in range(iters):
+        (obj, margins), grads = grad_b(deltas)
+        evaluated += C
+        worst = _worst_per_candidate(margins)
+        i = int(np.argmin(worst))
+        if worst[i] < best[0]:
+            best = (worst[i], np.asarray(
+                project_delta(deltas[i], settings.perturb_norm)),
+                np.asarray(margins)[i])
+        _emit_round(telemetry, "grad", it, C, best[0],
+                    int((worst < 0).sum()), evaluated)
+        if best[0] < 0:
+            break
+        deltas = descend(deltas, grads)
+    result = _result("grad", adapter, settings, best[1], best[2],
+                     evaluated, it + 1)
+    _emit_result(telemetry, result)
+    return result
+
+
+def cem_search(adapter: Adapter, settings: SearchSettings = SearchSettings(),
+               *, telemetry=None, mesh=None) -> SearchResult:
+    """Cross-entropy refinement: fit the proposal to the elite (lowest
+    worst-margin) candidates each round — the zoom-in stage after random
+    breadth, gradient-free (works on every scenario and property)."""
+    settings = round_batch(settings, mesh)
+    eval_b = make_eval_batch(adapter, settings, mesh)
+    B = settings.batch
+    rounds = max(1, min(settings.cem_rounds, -(-settings.budget // B)))
+    n_elite = max(1, int(settings.cem_elite_frac * B))
+    dt_ = _state_dtype(adapter)
+    mean = jnp.zeros(adapter.delta_shape, dt_)
+    std = jnp.full(adapter.delta_shape, settings.perturb_scale, dt_)
+    key = jax.random.fold_in(jax.random.PRNGKey(settings.seed),
+                             _ENGINE_TAG["cem"])
+    best = (np.inf, None, None)
+    evaluated = 0
+    for r in range(rounds):
+        noise = jax.random.normal(jax.random.fold_in(key, r),
+                                  (B,) + adapter.delta_shape, dt_)
+        deltas = mean[None] + std[None] * noise
+        margins = eval_b(deltas)
+        worst = _worst_per_candidate(margins)
+        evaluated += B
+        order = np.argsort(worst)
+        i = int(order[0])
+        if worst[i] < best[0]:
+            best = (worst[i], np.asarray(
+                project_delta(deltas[i], settings.perturb_norm)),
+                np.asarray(margins)[i])
+        _emit_round(telemetry, "cem", r, B, best[0],
+                    int((worst < 0).sum()), evaluated)
+        if best[0] < 0:
+            break
+        elite = jnp.asarray(np.asarray(deltas)[order[:n_elite]])
+        mean = jnp.mean(elite, axis=0)
+        std = jnp.maximum(jnp.std(elite, axis=0), settings.cem_std_floor)
+    result = _result("cem", adapter, settings, best[1], best[2],
+                     evaluated, r + 1)
+    _emit_result(telemetry, result)
+    return result
+
+
+_ENGINE_FNS = {"random": random_search, "grad": gradient_search,
+               "cem": cem_search}
+
+
+def falsify(scenario: str, cfg=None, *,
+            settings: SearchSettings = SearchSettings(),
+            engines=("random", "cem"), cbf=None,
+            thresholds: PropertyThresholds | None = None,
+            steps=None, telemetry=None, mesh=None,
+            stop_on_find: bool = True) -> list[SearchResult]:
+    """Run the requested engines in order against one scenario config.
+
+    Each engine gets ``settings.budget`` candidate rollouts. The
+    ``grad`` engine silently applies only where a differentiable adapter
+    exists (swarm without certificate/caches); requesting it elsewhere
+    raises. Returns every engine's :class:`SearchResult` (ordered as
+    run); with ``stop_on_find`` the sweep stops at the first engine that
+    violates."""
+    unknown = set(engines) - set(ENGINES)
+    if unknown:
+        raise ValueError(f"unknown engines {sorted(unknown)}; have "
+                         f"{ENGINES}")
+    adapter = make_adapter(scenario, cfg, cbf=cbf, steps=steps,
+                           thresholds=thresholds)
+    results = []
+    for engine in engines:
+        a = adapter
+        if engine == "grad":
+            a = make_adapter(scenario, cfg, cbf=cbf, steps=steps,
+                             thresholds=thresholds, differentiable=True,
+                             unroll_relax=settings.unroll_relax)
+        results.append(_ENGINE_FNS[engine](a, settings,
+                                           telemetry=telemetry, mesh=mesh))
+        if stop_on_find and results[-1].found:
+            break
+    return results

@@ -1,0 +1,200 @@
+"""Counterexample shrinking: from "the search found a violation" to a
+MINIMAL, trusted reproduction.
+
+A raw counterexample is a full-horizon rollout plus a batch-sized
+perturbation — too blunt to archive or debug. The shrinker reduces it on
+two axes and then re-litigates it at higher precision:
+
+1. **Horizon** — the earliest violating step: per-step-decomposable
+   properties expose a margin series (``properties.margin_series_np``),
+   so the first sub-zero index IS the earliest violation; the truncated
+   horizon is re-run to confirm (one compiled program at the new length).
+2. **Norm** — binary search on the perturbation's scale toward the
+   smallest multiple of the found delta that still violates: ~12
+   bisection rollouts bracket the violation boundary to < 0.1% of the
+   original scale.
+3. **Precision** — the minimized counterexample is replayed under x64
+   (fresh trace, float64 state and channels): a violation that vanishes
+   at double precision is a float32 artifact of the SIMULATION, not a
+   counterexample to the FILTER, and is marked unconfirmed rather than
+   archived as real.
+
+The result carries everything ``verify.corpus`` needs for a
+bit-replayable archive entry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from cbf_tpu.verify import properties as props
+from cbf_tpu.verify.properties import PROPERTY_NAMES
+from cbf_tpu.verify.search import (Adapter, SearchSettings, make_adapter,
+                                   make_eval_one, project_delta)
+
+
+class ShrinkResult(NamedTuple):
+    scenario: str
+    delta: np.ndarray          # minimized perturbation (scale applied)
+    scale: float               # final multiple of the input delta
+    steps: int                 # shrunk horizon
+    earliest_step: int | None  # first violating step (None: no series)
+    property: str
+    margin: float              # f32 margin at (delta, steps)
+    margin_x64: float          # x64 replay margin at (delta, steps)
+    confirmed_x64: bool        # violation survives double precision
+    evaluated: int             # rollouts spent shrinking
+
+
+def enable_x64_ctx():
+    """The x64 context manager on this stack (public jax.enable_x64 on
+    newer JAX, jax.experimental.enable_x64 on 0.4.x — the conftest
+    pattern, exported for the corpus replayer and tests)."""
+    enable = getattr(jax, "enable_x64", None)
+    if enable is None:
+        from jax.experimental import enable_x64 as enable
+    return enable(True)
+
+
+def _margins_at(adapter: Adapter, settings: SearchSettings, delta):
+    """(P,) margins of one candidate (fresh jit per adapter — shrink
+    evaluates a handful of candidates per horizon, not thousands)."""
+    return np.asarray(
+        jax.jit(make_eval_one(adapter, settings))(jnp.asarray(delta)),
+        np.float64)
+
+
+def _record(adapter: Adapter, settings: SearchSettings, delta):
+    """(final, outs) of one perturbed rollout — host records for the
+    margin-series decomposition."""
+    from cbf_tpu.rollout.engine import _rollout_body
+
+    def run(d):
+        d = project_delta(d, settings.perturb_norm)
+        s0 = adapter.perturb(adapter.state0, d)
+        return _rollout_body(adapter.step, s0, jnp.zeros((), jnp.int32),
+                             adapter.steps)
+
+    final, outs = jax.jit(run)(jnp.asarray(delta))
+    return jax.device_get(final), jax.device_get(outs)
+
+
+def _rebuild(scenario, cfg, cbf, thresholds, steps, dtype=None) -> Adapter:
+    if dtype is not None:
+        cfg = dataclasses.replace(cfg, dtype=dtype)
+    return make_adapter(scenario, cfg, cbf=cbf, thresholds=thresholds,
+                        steps=steps)
+
+
+def shrink(scenario: str, cfg, delta, *, cbf=None, thresholds=None,
+           settings: SearchSettings = SearchSettings(),
+           property: str | None = None, bisect_iters: int = 12,
+           telemetry=None) -> ShrinkResult:
+    """Minimize one found counterexample (see the module docstring).
+
+    ``delta`` is the search engine's perturbation (already inside the
+    attack neighborhood); ``property`` pins which margin to shrink
+    against (default: the most-violated one at full horizon).
+
+    Minimality deliberately stops short of the exact violation
+    boundary: the truncated horizon keeps a small grace window past the
+    earliest violating step, and the norm bisection returns the
+    smallest scale whose violation has real DEPTH (<= -tol), not the
+    boundary scale itself — a counterexample tuned to margin -1e-7
+    flips sign under any precision change and would fail its own x64
+    confirmation by construction."""
+    adapter = make_adapter(scenario, cfg, cbf=cbf, thresholds=thresholds)
+    cfg = adapter.cfg
+    th = adapter.thresholds
+    delta = np.asarray(delta)
+    evaluated = 0
+
+    margins = _margins_at(adapter, settings, delta)
+    evaluated += 1
+    pi = (int(np.argmin(margins)) if property is None
+          else PROPERTY_NAMES.index(property))
+    prop = PROPERTY_NAMES[pi]
+    if margins[pi] >= 0:
+        raise ValueError(
+            f"shrink needs a violating counterexample: property {prop!r} "
+            f"has margin {margins[pi]:.6f} >= 0 at the full horizon")
+
+    # 1. Horizon: earliest violating step from the margin series.
+    earliest = None
+    full_steps = steps = adapter.steps
+    final, outs = _record(adapter, settings, delta)
+    evaluated += 1
+    traj = adapter.traj_extract(outs)
+    traj = None if traj is None else np.asarray(traj)
+    series = props.margin_series_np(th, outs, trajectory=traj,
+                                    obstacle_fn_np=adapter.obstacle_fn_np,
+                                    prop=prop)
+    if series is not None and (series < 0).any():
+        earliest = int(np.argmax(series < 0))
+        # Grace window past the earliest violating step: the archived
+        # horizon must keep violating when the onset shifts by a couple
+        # of steps under x64 (see the docstring's minimality note).
+        steps = min(full_steps, earliest + 1 + max(2, earliest // 20))
+        adapter = _rebuild(scenario, cfg, cbf, th, steps)
+        m = _margins_at(adapter, settings, delta)
+        evaluated += 1
+        if m[pi] >= 0:
+            # Paranoia: a property whose series disagrees with its
+            # rollout margin would be a bug — fall back loudly to the
+            # full horizon rather than archive a non-reproduction.
+            steps, earliest = full_steps, None
+            adapter = _rebuild(scenario, cfg, cbf, th, full_steps)
+
+    # 2. Norm: bisect toward the violation boundary, then archive the
+    # smallest tested scale with real violation DEPTH (not the boundary).
+    margin_full = float(_margins_at(adapter, settings, delta)[pi])
+    evaluated += 1
+    tol = max(1e-5, 0.25 * abs(min(margin_full, 0.0)))
+    tested = [(1.0, margin_full)]
+    m0 = _margins_at(adapter, settings, np.zeros_like(delta))
+    evaluated += 1
+    if m0[pi] <= -tol:
+        tested.append((0.0, float(m0[pi])))  # violates unperturbed —
+        # the minimal counterexample is "no perturbation at all"
+    else:
+        lo, hi = 0.0, 1.0
+        for _ in range(bisect_iters):
+            mid = 0.5 * (lo + hi)
+            m = _margins_at(adapter, settings, mid * delta)
+            evaluated += 1
+            tested.append((mid, float(m[pi])))
+            if m[pi] < 0:
+                hi = mid
+            else:
+                lo = mid
+    deep = [s for s, m in tested if m <= -tol]
+    scale = min(deep) if deep else 1.0
+    delta_min = scale * delta
+    margin = float(_margins_at(adapter, settings, delta_min)[pi])
+    evaluated += 1
+
+    # 3. Precision: replay the minimized counterexample at x64.
+    with enable_x64_ctx():
+        a64 = _rebuild(scenario, cfg, cbf, th, steps, dtype=jnp.float64)
+        m64 = _margins_at(a64, settings, delta_min.astype(np.float64))
+        evaluated += 1
+    margin_x64 = float(m64[pi])
+
+    if telemetry is not None:
+        from cbf_tpu.obs import schema
+
+        telemetry.event("verify.round", {
+            "engine": "shrink", "round": 0, "candidates": evaluated,
+            "best_margin": schema.json_scalar(margin_x64),
+            "violations": int(margin_x64 < 0), "evaluated": evaluated})
+
+    return ShrinkResult(
+        scenario=scenario, delta=delta_min, scale=float(scale),
+        steps=int(steps), earliest_step=earliest, property=prop,
+        margin=margin, margin_x64=margin_x64,
+        confirmed_x64=bool(margin_x64 < 0), evaluated=evaluated)

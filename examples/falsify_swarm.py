@@ -1,0 +1,98 @@
+"""Falsify a deliberately weakened swarm filter, end to end.
+
+The walkthrough the verification subsystem (docs/API.md "Verification")
+is built around:
+
+1. weaken the filter — certify a 0.16 m radius instead of the 0.2 m the
+   separation floor assumes (the kind of quiet degradation a bad solver
+   or gating change could introduce);
+2. search for an initial-condition perturbation that drives a full
+   rollout below the floor (random breadth, then gradient descent
+   THROUGH the compiled rollout, then CEM refinement — whichever finds
+   first);
+3. shrink the counterexample to the earliest violating step and the
+   smallest perturbation scale that still violates, and confirm it at
+   float64 (a violation that vanishes at x64 is a float32 artifact,
+   not a filter bug);
+4. archive it to a corpus JSONL and replay it bit-exactly — the record
+   a CI gate can hold future solver changes against;
+5. run the SAME budget against the default filter and watch it survive.
+
+Run: ``python examples/falsify_swarm.py [--budget 64]`` (CPU-friendly,
+~a minute). Artifact: examples/media/falsify_corpus.jsonl.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+MEDIA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "media")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget", type=int, default=64,
+                    help="candidate rollouts per engine")
+    args = ap.parse_args()
+
+    from cbf_tpu.core.filter import CBFParams
+    from cbf_tpu.scenarios import swarm
+    from cbf_tpu import verify as V
+
+    # A 16-agent swarm that packs within the horizon, with the horizon
+    # cut just short of the weakened filter's unperturbed violation
+    # onset: delta = 0 is safe, so the engines must actually SEARCH.
+    cfg = swarm.Config(n=16, steps=140, k_neighbors=4, gating="jnp")
+    weak = CBFParams(max_speed=15.0, k=0.0, dmin=0.16)
+    settings = V.SearchSettings(budget=args.budget, batch=8, seed=0)
+
+    print("== 1. falsify the weakened filter (dmin 0.2 -> 0.16) ==")
+    results = V.falsify("swarm", cfg, settings=settings,
+                        engines=("random", "grad", "cem"), cbf=weak)
+    for r in results:
+        flag = " <- VIOLATION" if r.found else ""
+        print(f"  {r.engine:6s}: margin {r.margin:+.5f} ({r.property}) "
+              f"after {r.evaluated} candidates{flag}")
+    found = next((r for r in results if r.found), None)
+    if found is None:
+        print("  no violation found — raise --budget")
+        return 1
+
+    print("== 2. shrink the counterexample ==")
+    sr = V.shrink("swarm", cfg, found.delta, cbf=weak, settings=settings)
+    print(f"  earliest violating step {sr.earliest_step} "
+          f"(horizon {cfg.steps} -> {sr.steps}), scale {sr.scale:.3f}")
+    print(f"  margin f32 {sr.margin:+.6f}, x64 {sr.margin_x64:+.6f}, "
+          f"confirmed_x64={sr.confirmed_x64}")
+
+    print("== 3. archive + bit-exact replay ==")
+    os.makedirs(MEDIA, exist_ok=True)
+    path = os.path.join(MEDIA, "falsify_corpus.jsonl")
+    if os.path.exists(path):
+        os.remove(path)
+    entry = V.entry_from("swarm", cfg, sr, engine=found.engine,
+                         settings=settings, cbf=weak)
+    V.append_entry(path, entry)
+    (e, replay, problems), = V.replay_corpus(path)
+    print(f"  replayed margin {replay['margin']:+.6f} == recorded "
+          f"{e['margin_x64']:+.6f}: {replay['margin'] == e['margin_x64']}")
+    assert not problems, problems
+
+    print("== 4. the default filter survives the same budget ==")
+    r = V.random_search(V.make_adapter("swarm", cfg), settings)
+    print(f"  default: margin {r.margin:+.5f} ({r.property}) after "
+          f"{r.evaluated} candidates — found={r.found}")
+    assert not r.found
+    print(f"corpus written to {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,6 +22,10 @@
 #        certificate serve workload (lockstep ADMM-chain amortization on
 #        real hardware), and the CBF_TPU_CACHE_DIR two-process compile
 #        reuse measurement.
+#   r09  round-9 falsification engine: BENCH_VERIFY candidates/sec
+#        (fresh trace-and-compile vs warm sweep rate) across the ladder
+#        sizes, the Pallas-gating evaluator axis, and one standing
+#        weakened-config falsification probe through the CLI.
 set -u -o pipefail   # pipefail: probe()'s exit code must survive the tee
 cd "$(dirname "$0")/.."
 
@@ -29,11 +33,11 @@ PROFILE="r04"
 if [ "${1:-}" = "--profile" ]; then
   PROFILE="${2:?--profile needs a name}"
 elif [ -n "${1:-}" ]; then
-  echo "usage: $0 [--profile r04|r05b|r05c|r05d|r08]" >&2; exit 64
+  echo "usage: $0 [--profile r04|r05b|r05c|r05d|r08|r09]" >&2; exit 64
 fi
 case "$PROFILE" in
-r04|r05b|r05c|r05d|r08) ;;
-*) echo "unknown profile '$PROFILE' (have r04 r05b r05c r05d r08)" >&2
+r04|r05b|r05c|r05d|r08|r09) ;;
+*) echo "unknown profile '$PROFILE' (have r04 r05b r05c r05d r08 r09)" >&2
    exit 64 ;;
 esac
 
@@ -155,8 +159,26 @@ r08)
   run BENCH_SERVE=1 BENCH_SERVE_STEPS=128 CBF_TPU_CACHE_DIR=/tmp/cbf_tpu_cache_r08
   run BENCH_SERVE=1 BENCH_SERVE_STEPS=128 CBF_TPU_CACHE_DIR=/tmp/cbf_tpu_cache_r08
   ;;
+r09)
+  # Falsification engine (docs/BENCH_LOG.md Round 9): candidate
+  # rollouts/sec through the vmapped margin evaluator.
+  # 1. Ladder sizes, default gating (Pallas kernels on TPU).
+  run BENCH_VERIFY=1 BENCH_VERIFY_N=256 BENCH_VERIFY_STEPS=200
+  run BENCH_VERIFY=1 BENCH_VERIFY_N=1024 BENCH_VERIFY_STEPS=200
+  run BENCH_VERIFY=1 BENCH_VERIFY_N=4096 BENCH_VERIFY_STEPS=100 BENCH_VERIFY_BATCH=4
+  probe || die "DEVICE WEDGED AFTER VERIFY ITEMS" 3
+  # 2. Gating-backend axis: the jnp evaluator prices what the Pallas
+  # kernels buy a batched sweep.
+  run BENCH_VERIFY=1 BENCH_VERIFY_N=1024 BENCH_VERIFY_STEPS=200 BENCH_GATING=jnp
+  # 3. Wider batch: device-fill headroom of the candidate axis.
+  run BENCH_VERIFY=1 BENCH_VERIFY_N=1024 BENCH_VERIFY_STEPS=200 BENCH_VERIFY_BATCH=64
+  # 4. Standing weakened-config probe through the CLI (exit 3 = found,
+  # the expected outcome; || true keeps the sweep going either way).
+  python -m cbf_tpu verify swarm --set n=64 --set steps=300 --set gating=jnp \
+    --weaken dmin=0.16 --budget 64 --batch 16 --json 2>&1 | tee -a "$LOG" || true
+  ;;
 *)
-  echo "unknown profile '$PROFILE' (have r04 r05b r05c r05d r08)" >&2
+  echo "unknown profile '$PROFILE' (have r04 r05b r05c r05d r08 r09)" >&2
   exit 64
   ;;
 esac

@@ -1,0 +1,409 @@
+"""Falsification subsystem tests (cbf_tpu.verify).
+
+The detection claims are PROVEN, not assumed: a deliberately weakened
+filter (dmin relaxed 0.2 -> 0.16, i.e. the certified radius quietly
+shrunk — the kind of drift a bad solver change could introduce) is
+falsified by every engine within a small fixed budget, while the same
+budget leaves the default configurations un-falsified. The shrinker's
+minimality, the corpus's bit-exact x64 replay, and the schema/audit
+wiring are each pinned by their own test; ``test_corpus_replay_gate``
+replays the checked-in archive (corpus/violations.jsonl) as the
+standing tier-1 regression gate.
+"""
+
+import dataclasses
+import importlib
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cbf_tpu.core.filter import CBFParams
+from cbf_tpu.scenarios import swarm
+from cbf_tpu.verify import (PROPERTY_NAMES, PropertyThresholds,
+                            SearchSettings, corpus, properties, search)
+
+shrink_mod = importlib.import_module("cbf_tpu.verify.shrink")
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: The deliberately weakened filter: certified radius 0.2 -> 0.16 drops
+#: the packed-equilibrium floor (~dmin/sqrt(2) ~ 0.113) below the 0.13
+#: separation threshold — exactly the quiet degradation the falsifier
+#: exists to catch.
+WEAK_CBF = CBFParams(max_speed=15.0, k=0.0, dmin=0.16)
+
+#: Small swarm that packs within the horizon (calibrated: the weakened
+#: filter's unperturbed violation onset is step ~148).
+PACKED_CFG = swarm.Config(n=16, steps=250, k_neighbors=4, gating="jnp")
+#: Horizon just SHORT of the unperturbed onset: delta = 0 is safe
+#: (margin +0.016) and only a found perturbation violates — the
+#: search-has-to-actually-search case.
+MARGINAL_CFG = dataclasses.replace(PACKED_CFG, steps=140)
+
+SMALL = SearchSettings(budget=16, batch=8, seed=0)
+
+
+# ------------------------------------------------------------ properties
+
+def test_margin_parity_vs_numpy():
+    """The compiled jnp margins == the post-hoc NumPy recomputation on
+    the same records (trajectory + obstacles engaged so every
+    non-vacuous property exercises its real path)."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg = swarm.Config(n=12, steps=80, k_neighbors=4, gating="jnp",
+                       n_obstacles=3, record_trajectory=True)
+    a = search.make_adapter("swarm", cfg)
+    margins = np.asarray(
+        jax.jit(search.make_eval_one(a, SMALL))(jnp.zeros((12, 2))),
+        np.float64)
+    final, outs = shrink_mod._record(a, SMALL, np.zeros((12, 2)))
+    m_np = properties.rollout_margins_np(
+        a.thresholds, outs, np.asarray(final.x),
+        trajectory=np.asarray(outs.trajectory),
+        obstacle_fn_np=a.obstacle_fn_np)
+    for i, name in enumerate(PROPERTY_NAMES):
+        if np.isinf(margins[i]):
+            assert np.isinf(m_np[name]), name
+            continue
+        np.testing.assert_allclose(margins[i], m_np[name], atol=1e-5,
+                                   err_msg=name)
+
+
+def test_sustained_infeasibility_margin():
+    """The streak margin is computed from the longest RUN, not the
+    total: 30 scattered infeasible steps are fine, 30 consecutive ones
+    violate (limit 25)."""
+    class Outs:
+        pass
+
+    th = PropertyThresholds(infeasible_streak_limit=25)
+    o = Outs()
+    flags = np.zeros(100)
+    flags[::3] = 5.0                       # 34 scattered steps, runs of 1
+    o.infeasible_count = flags
+    s = properties.margin_series_np(th, o, prop="sustained_infeasibility")
+    assert s.min() > 0
+    flags = np.zeros(100)
+    flags[10:40] = 1.0                     # one 30-step run
+    o.infeasible_count = flags
+    s = properties.margin_series_np(th, o, prop="sustained_infeasibility")
+    assert s.min() < 0
+
+
+# --------------------------------------------------------------- engines
+
+def test_random_search_falsifies_weakened():
+    a = search.make_adapter("swarm", MARGINAL_CFG, cbf=WEAK_CBF)
+    r = search.random_search(a, SMALL)
+    assert r.found and r.property == "separation"
+    assert r.margin < 0
+    assert r.evaluated <= SMALL.budget
+
+
+def test_random_search_is_deterministic():
+    a = search.make_adapter("swarm", MARGINAL_CFG, cbf=WEAK_CBF)
+    r1 = search.random_search(a, SMALL)
+    r2 = search.random_search(a, SMALL)
+    assert r1.margin == r2.margin
+    np.testing.assert_array_equal(r1.delta, r2.delta)
+
+
+def test_gradient_search_descends_to_violation():
+    """The marginal horizon: delta = 0 is safe, so the gradient engine
+    must actually DESCEND the separation margin through the compiled
+    rollout (unrolled-relax QP) to cross zero."""
+    a = search.make_adapter("swarm", MARGINAL_CFG, cbf=WEAK_CBF,
+                            differentiable=True, unroll_relax=2)
+    r = search.gradient_search(
+        a, SearchSettings(budget=40, gd_candidates=4, gd_iters=10,
+                          gd_lr=0.03, seed=0))
+    assert r.found and r.margin < 0
+    assert r.rounds > 1          # iteration 0 (the random init) was safe
+
+
+def test_gradient_search_rejects_nondifferentiable_adapter():
+    a = search.make_adapter("swarm", MARGINAL_CFG, cbf=WEAK_CBF)
+    with pytest.raises(ValueError, match="differentiable"):
+        search.gradient_search(a, SMALL)
+    with pytest.raises(ValueError, match="gradient engine"):
+        search.make_adapter(
+            "swarm", dataclasses.replace(MARGINAL_CFG, n=256,
+                                         certificate=True,
+                                         certificate_backend="sparse"),
+            differentiable=True)
+
+
+def test_cem_search_refines_to_violation():
+    """CEM on the marginal horizon: round 1's unit proposal misses, the
+    elite refit walks the proposal into the violating region."""
+    a = search.make_adapter("swarm", MARGINAL_CFG, cbf=WEAK_CBF)
+    r = search.cem_search(a, SearchSettings(budget=48, batch=8, seed=0))
+    assert r.found and r.margin < 0
+    assert r.rounds > 1          # refinement, not first-round luck
+
+
+def test_default_configs_survive_the_same_budget():
+    """The falsifier's null hypothesis: the DEFAULT filter parameters
+    survive the exact budget that kills the weakened ones — on the
+    swarm packing case and both reference scenarios (budget-bounded
+    horizons; default knobs otherwise)."""
+    r = search.random_search(search.make_adapter("swarm", MARGINAL_CFG),
+                             SMALL)
+    assert not r.found, r
+    for scenario, steps in (("meet_at_center", 300),
+                            ("cross_and_rescue", 300)):
+        a = search.make_adapter(scenario, steps=steps)
+        r = search.random_search(a, SearchSettings(budget=8, batch=4,
+                                                   seed=0))
+        assert not r.found, (scenario, r.margins)
+
+
+def test_mesh_sharded_search_matches_unsharded():
+    """dp-mesh sharding of the candidate axis is a layout choice, not a
+    math change: same seed => same verdict and margins."""
+    from cbf_tpu.parallel import make_mesh
+
+    a = search.make_adapter("swarm", MARGINAL_CFG, cbf=WEAK_CBF)
+    r0 = search.random_search(a, SMALL)
+    r1 = search.random_search(a, SMALL, mesh=make_mesh(n_dp=4, n_sp=1))
+    assert r0.margin == pytest.approx(r1.margin, abs=1e-6)
+    assert r0.found == r1.found
+
+
+def test_unrolled_step_matches_default_path():
+    """swarm.make(unroll_relax=2) — the differentiable step the gradient
+    engine rides — produces the same rollout as the default
+    scalar-guarded relax loop (the safe_controls equivalence, now pinned
+    at scenario level)."""
+    from cbf_tpu.rollout.engine import rollout
+
+    cfg = swarm.Config(n=12, steps=60, k_neighbors=4, gating="jnp")
+    f0, o0 = rollout(swarm.make(cfg)[1], swarm.initial_state(cfg),
+                     cfg.steps)
+    f1, o1 = rollout(swarm.make(cfg, unroll_relax=2)[1],
+                     swarm.initial_state(cfg), cfg.steps)
+    np.testing.assert_allclose(np.asarray(f0.x), np.asarray(f1.x),
+                               atol=2e-5)
+    np.testing.assert_allclose(np.asarray(o0.min_pairwise_distance),
+                               np.asarray(o1.min_pairwise_distance),
+                               atol=2e-5)
+
+
+# -------------------------------------------------------------- shrinker
+
+@pytest.fixture(scope="module")
+def marginal_counterexample():
+    a = search.make_adapter("swarm", MARGINAL_CFG, cbf=WEAK_CBF)
+    r = search.random_search(a, SMALL)
+    assert r.found
+    return r
+
+
+@pytest.fixture(scope="module")
+def shrunk(marginal_counterexample):
+    return search, shrink_mod.shrink(
+        "swarm", MARGINAL_CFG, marginal_counterexample.delta,
+        cbf=WEAK_CBF, settings=SMALL)
+
+
+def test_shrinker_minimality(shrunk):
+    """Earliest-step minimality: the horizon one step short of the found
+    earliest violating step does NOT violate; norm minimality: the
+    unperturbed rollout at the shrunk horizon does not violate while the
+    shrunk delta does (with real depth — the x64 replay must survive)."""
+    import jax
+    import jax.numpy as jnp
+
+    _, sr = shrunk
+    assert sr.property == "separation"
+    assert sr.margin < 0 and sr.confirmed_x64
+    assert sr.earliest_step is not None
+    assert sr.steps <= MARGINAL_CFG.steps
+    assert 0.0 < sr.scale <= 1.0          # delta-dependent case: scale > 0
+
+    pi = PROPERTY_NAMES.index(sr.property)
+    # one step short of the earliest violation: must be safe
+    a_short = search.make_adapter("swarm", MARGINAL_CFG, cbf=WEAK_CBF,
+                                  steps=sr.earliest_step)
+    m_short = np.asarray(jax.jit(search.make_eval_one(a_short, SMALL))(
+        jnp.asarray(sr.delta)))
+    assert m_short[pi] >= 0, m_short
+    # unperturbed at the shrunk horizon: must be safe (norm minimality)
+    a_min = search.make_adapter("swarm", MARGINAL_CFG, cbf=WEAK_CBF,
+                                steps=sr.steps)
+    m0 = np.asarray(jax.jit(search.make_eval_one(a_min, SMALL))(
+        jnp.zeros_like(jnp.asarray(sr.delta))))
+    assert m0[pi] >= 0, m0
+
+
+# ---------------------------------------------------------------- corpus
+
+def test_corpus_roundtrip_bitexact(tmp_path, shrunk):
+    _, sr = shrunk
+    entry = corpus.entry_from("swarm", MARGINAL_CFG, sr, engine="random",
+                              settings=SMALL, cbf=WEAK_CBF)
+    path = corpus.append_entry(str(tmp_path), entry)
+    (loaded,) = corpus.load_entries(path)
+    assert loaded == json.loads(json.dumps(entry))
+    replay = corpus.replay_entry(loaded)
+    assert replay["violation"]
+    assert replay["margin"] == loaded["margin_x64"]   # bit-exact
+    assert not corpus.check_replay(loaded, replay)
+
+
+def test_corpus_gate_catches_reintroduction(shrunk):
+    """A 'safe' entry built from the DEFAULT filter must pass; the same
+    entry with the weakened filter smuggled in (simulating a change that
+    reintroduces the violation) must fail the gate."""
+    _, sr = shrunk
+    safe_entry = corpus.entry_from("swarm", MARGINAL_CFG, sr,
+                                   engine="random", settings=SMALL,
+                                   cbf=None, expect="safe")
+    replay = corpus.replay_entry(safe_entry)
+    assert not corpus.check_replay(safe_entry, replay)
+
+    bad = dict(safe_entry, cbf={k: float(v)
+                                for k, v in WEAK_CBF._asdict().items()})
+    # push the violation over the onset: the weakened filter violates
+    # this scenario unperturbed at the full horizon
+    bad["steps"] = PACKED_CFG.steps
+    problems = corpus.check_replay(bad, corpus.replay_entry(bad))
+    assert problems and "reintroduced" in problems[0]
+
+
+def test_corpus_rejects_schema_drift(tmp_path):
+    p = tmp_path / "violations.jsonl"
+    p.write_text(json.dumps({"schema": 999}) + "\n")
+    with pytest.raises(ValueError, match="schema"):
+        corpus.load_entries(str(p))
+    p.write_text("")
+    with pytest.raises((ValueError, FileNotFoundError)):
+        corpus.replay_corpus(str(p))
+
+
+def test_corpus_replay_gate():
+    """THE standing tier-1 gate: every entry in the checked-in corpus
+    replays clean — archived violations still reproduce bit-exactly at
+    x64, archived safe records stay safe."""
+    path = os.path.join(_ROOT, "corpus", corpus.CORPUS_FILENAME)
+    assert os.path.isfile(path), \
+        "checked-in corpus missing (corpus/violations.jsonl)"
+    results = corpus.replay_corpus(path)
+    problems = [p for _e, _r, ps in results for p in ps]
+    assert not problems, "\n".join(problems)
+    assert any(e.get("expect") == "violates" for e, _r, _p in results)
+    assert any(e.get("expect") == "safe" for e, _r, _p in results)
+
+
+# ----------------------------------------------------- telemetry + audits
+
+def test_search_emits_schema_events(tmp_path):
+    from cbf_tpu.obs import TelemetrySink, schema
+    from cbf_tpu.obs.sink import read_events
+
+    sink = TelemetrySink(str(tmp_path / "run"))
+    a = search.make_adapter("swarm", MARGINAL_CFG, cbf=WEAK_CBF)
+    search.random_search(a, SMALL, telemetry=sink)
+    sink.close()
+    events = read_events(sink.run_dir)
+    by_type = {}
+    for ev in events:
+        by_type.setdefault(ev.get("event"), []).append(ev)
+    for etype, fields in schema.VERIFY_EVENT_FIELDS.items():
+        assert by_type.get(etype), f"no {etype} events emitted"
+        for ev in by_type[etype]:
+            for field in fields:
+                assert field in ev, (etype, field, ev)
+
+
+def test_schema_audit_covers_verify_events(monkeypatch):
+    from cbf_tpu.analysis.audits import obs_schema_audit
+
+    assert obs_schema_audit() == []
+    monkeypatch.setattr(search, "EMITTED_EVENT_TYPES",
+                        ("verify.round", "verify.margin", "verify.extra"))
+    problems = obs_schema_audit()
+    assert any("drifted" in p for p in problems)
+
+
+def test_aud004_reproducibility_audit(tmp_path):
+    from cbf_tpu.analysis.audits import reproducibility_audit
+
+    assert reproducibility_audit() == []    # the repo itself is clean
+    bad_tree = tmp_path / "cbf_tpu"
+    bad_tree.mkdir()
+    (bad_tree / "bad.py").write_text(
+        "import numpy as np\n"
+        "rng = np.random.default_rng()\n"
+        "x = np.random.uniform(0, 1)\n"
+        "np.random.seed(4)\n"
+        "ok = np.random.default_rng(7)\n")
+    problems = reproducibility_audit(str(tmp_path))
+    assert len(problems) == 3, problems
+    assert any("no seed" in p for p in problems)
+    assert any("GLOBAL" in p for p in problems)
+
+
+# -------------------------------------------------------------------- CLI
+
+def _cli(*argv):
+    from cbf_tpu.__main__ import main
+
+    return main(list(argv))
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    base = ["verify", "swarm", "--set", "n=16", "--set", "steps=140",
+            "--set", "k_neighbors=4", "--set", "gating=jnp",
+            "--budget", "16", "--batch", "8", "--json"]
+    # weakened: violation found -> exit 3, corpus written
+    rc = _cli(*base, "--weaken", "dmin=0.16",
+              "--corpus-dir", str(tmp_path / "corpus"))
+    record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 3
+    assert record["results"][0]["found"]
+    assert record["shrunk"]["confirmed_x64"]
+    assert os.path.isfile(record["corpus"])
+    (entry,) = corpus.load_entries(record["corpus"])
+    assert entry["property"] == "separation"
+    # default: survives -> exit 0
+    rc = _cli(*base)
+    record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert "shrunk" not in record
+    # bad property selection -> SystemExit
+    with pytest.raises(SystemExit):
+        _cli(*base, "--properties", "nonsense")
+
+
+def test_cli_property_selection(capsys):
+    """--properties restricts what can trigger 'found': the weakened
+    config's separation violation is masked out when only
+    sustained_infeasibility is selected."""
+    rc = _cli("verify", "swarm", "--set", "n=16", "--set", "steps=140",
+              "--set", "k_neighbors=4", "--set", "gating=jnp",
+              "--weaken", "dmin=0.16", "--budget", "8", "--batch", "8",
+              "--properties", "sustained_infeasibility", "--json")
+    record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0, record
+
+
+# ------------------------------------------------------------------- docs
+
+def test_verify_documented():
+    """docs/API.md 'Verification' section exists and names the public
+    pieces (the audit-enforced docs contract, AUD001-style)."""
+    with open(os.path.join(_ROOT, "docs", "API.md")) as fh:
+        api = fh.read()
+    assert "## Verification" in api
+    for token in ("`falsify`", "`SearchSettings`", "`shrink`",
+                  "`replay_corpus`", "`verify.round`", "`verify.margin`",
+                  "`python -m cbf_tpu verify`", "`BENCH_VERIFY`",
+                  "violations.jsonl"):
+        assert token in api, f"docs/API.md Verification missing {token}"
+    for name in PROPERTY_NAMES:
+        assert f"`{name}`" in api, f"property {name} undocumented"
