@@ -94,6 +94,14 @@ Modes / env knobs:
     (steady-state sweep rate) axes. Knobs: BENCH_VERIFY_N (256),
     BENCH_VERIFY_STEPS (200), BENCH_VERIFY_BATCH (16),
     BENCH_VERIFY_ROUNDS (3). See docs/BENCH_LOG.md Round 9.
+  BENCH_SLO=1 — SLO latency mode (cbf_tpu.serve.loadgen): open-loop
+    seeded Poisson x bounded-Pareto traffic at a FIXED offered rate
+    through the serving engine; reports achieved sustained RPS,
+    end-to-end p50/p95/p99 latency, and the queue-wait vs execute
+    breakdown. Knobs: BENCH_SLO_RPS (8.0), BENCH_SLO_DURATION (10.0),
+    BENCH_SLO_SEED (0), BENCH_SLO_NMIN (8), BENCH_SLO_NMAX (96),
+    BENCH_SLO_ALPHA (1.3), BENCH_SLO_MAX_BATCH (8), BENCH_SLO_FLUSH
+    (0.05). See docs/BENCH_LOG.md Round 10.
   BENCH_ENSEMBLE=1 (or --ensemble) — dp-sharded ensemble of independent
     swarms over all available devices (the multi-chip measurement path for
     the v4-8 ladder rung); adds "chips" + "scaling_efficiency" fields.
@@ -1164,6 +1172,85 @@ def _child_serve(steps: int) -> dict:
     return result
 
 
+def _child_slo(steps: int) -> dict:
+    """BENCH_SLO mode: sustained-RPS / latency-percentile SLO harness
+    (cbf_tpu.serve.loadgen). Drives the serving engine with a seeded
+    OPEN-LOOP schedule — Poisson arrivals at BENCH_SLO_RPS, bounded-
+    Pareto request sizes — and reports what the SLO conversation needs:
+    achieved sustained RPS, end-to-end p50/p95/p99 latency, and the
+    queue-wait vs execute breakdown per request (where time went when
+    the engine fell behind). Unlike BENCH_SERVE (throughput vs
+    sequential at saturation), this measures latency under a FIXED
+    offered rate, which is the axis an operator actually provisions to.
+
+    Knobs: BENCH_SLO_RPS (8.0) — offered arrival rate; BENCH_SLO_DURATION
+    (10.0 s) — arrival window; BENCH_SLO_SEED (0); BENCH_SLO_NMIN (8) /
+    BENCH_SLO_NMAX (96) — bounded-Pareto size support; BENCH_SLO_ALPHA
+    (1.3) — tail index; BENCH_SLO_MAX_BATCH (8); BENCH_SLO_FLUSH (0.05 s)
+    — scheduler flush deadline. CBF_TPU_CACHE_DIR is honored and
+    recorded. Safety-gated like every serve record: the loadgen report
+    carries the min pairwise distance / infeasible count over every
+    served request."""
+    import jax
+    import numpy as np   # noqa: F401  (parity with sibling modes)
+
+    from cbf_tpu.serve import LoadSpec, ServeEngine, build_schedule, \
+        run_loadgen
+
+    rps = _env_float("BENCH_SLO_RPS", 8.0)
+    duration = _env_float("BENCH_SLO_DURATION", 10.0)
+    seed = _env_int("BENCH_SLO_SEED", 0)
+    n_min = _env_int("BENCH_SLO_NMIN", 8)
+    n_max = _env_int("BENCH_SLO_NMAX", 96)
+    alpha = _env_float("BENCH_SLO_ALPHA", 1.3)
+    max_batch = _env_int("BENCH_SLO_MAX_BATCH", 8)
+    flush = _env_float("BENCH_SLO_FLUSH", 0.05)
+
+    spec = LoadSpec(rps=rps, duration_s=duration, seed=seed, n_min=n_min,
+                    n_max=n_max, pareto_alpha=alpha)
+    engine = ServeEngine(max_batch=max_batch, flush_deadline_s=flush)
+    schedule = build_schedule(spec)
+    print(f"bench: slo rps={rps} duration={duration}s "
+          f"requests={len(schedule)} n=[{n_min},{n_max}] alpha={alpha} "
+          f"max_batch={max_batch} cache_dir={engine.cache_dir}",
+          file=sys.stderr)
+    # Prewarm every bucket the schedule will hit: the SLO axis is
+    # sustained-rate latency, not cold-start (fresh-compile latency is
+    # BENCH_SERVE's speedup_fresh_traffic axis).
+    prewarm_s = engine.prewarm([cfg for _, cfg in schedule])
+    report = run_loadgen(engine, spec)
+    print(f"bench: slo achieved={report['achieved_rps']} rps "
+          f"(offered {rps}), p50={report['latency_p50_s']}s "
+          f"p99={report['latency_p99_s']}s "
+          f"queue_wait_p99={report['queue_wait_p99_s']}s "
+          f"execute_p99={report['execute_p99_s']}s", file=sys.stderr)
+
+    if report["errors"]:
+        return {"error": f"{report['errors']}/{report['requests']} "
+                         f"requests failed", "retryable": False}
+    err = _check_safety(report["min_pairwise_distance"],
+                        report["infeasible_count"],
+                        floor=_dynamics_floor("single"))
+    if err:
+        return {"error": err, "retryable": False}
+    result = {
+        "metric": (f"serve sustained RPS (open-loop {rps} rps, "
+                   f"Pareto n in [{n_min},{n_max}])"),
+        "value": report["achieved_rps"],
+        "unit": "requests_per_sec",
+        "vs_baseline": 0,   # a latency/SLO axis, not the headline rate
+        "slo": True,
+        "max_batch": max_batch,
+        "flush_deadline_s": flush,
+        "prewarm_s": round(prewarm_s, 3),
+        "buckets": engine.manifest_extra()["serve"]["buckets"],
+        "cache_dir": engine.cache_dir,
+        "platform": jax.devices()[0].platform,
+        **report,
+    }
+    return result
+
+
 def _is_permanent_error(e: BaseException) -> bool:
     """Transient device/tunnel deaths raise (XlaRuntimeError: connection
     reset / DEADLINE_EXCEEDED / UNAVAILABLE) rather than hang — those must
@@ -1199,6 +1286,8 @@ def child_main(result_path: str, ensemble: bool) -> None:
     try:
         if os.environ.get("BENCH_VERIFY", "0") == "1":
             result = _child_verify(steps)
+        elif os.environ.get("BENCH_SLO", "0") == "1":
+            result = _child_slo(steps)
         elif os.environ.get("BENCH_SERVE", "0") == "1":
             result = _child_serve(steps)
         elif ensemble:
@@ -1309,6 +1398,8 @@ def main() -> None:
 
     if os.environ.get("BENCH_VERIFY", "0") == "1":
         label = "verify N=%d" % _env_int("BENCH_VERIFY_N", 256)
+    elif os.environ.get("BENCH_SLO", "0") == "1":
+        label = "slo rps=%g" % _env_float("BENCH_SLO_RPS", 8.0)
     elif os.environ.get("BENCH_SERVE", "0") == "1":
         label = "serve B=%d" % _env_int("BENCH_SERVE_B", 16)
     else:

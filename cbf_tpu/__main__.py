@@ -377,6 +377,7 @@ def cmd_serve(args) -> int:
     results = engine.run(cfgs)
     wall = _time.perf_counter() - t0
     lat = sorted(r.latency_s for r in results)
+    qwait = sorted(r.queue_wait_s for r in results)
     qp_steps = sum(r.n * r.steps for r in results)
     record.update({
         "wall_s": round(wall, 3),
@@ -384,6 +385,9 @@ def cmd_serve(args) -> int:
         "latency_p50_s": round(statistics.median(lat), 4),
         "latency_p99_s": round(lat[min(len(lat) - 1,
                                        int(0.99 * len(lat)))], 4),
+        "queue_wait_p50_s": round(statistics.median(qwait), 4),
+        "queue_wait_p99_s": round(qwait[min(len(qwait) - 1,
+                                            int(0.99 * len(qwait)))], 4),
         "stats": engine.stats,
         "compile_counters": {k: v for k, v in
                              profiling.compile_event_counts().items()
@@ -391,6 +395,7 @@ def cmd_serve(args) -> int:
         "results": [{
             "request_id": r.request_id, "bucket": r.bucket, "n": r.n,
             "steps": r.steps, "latency_s": r.latency_s,
+            "queue_wait_s": r.queue_wait_s, "execute_s": r.execute_s,
             "min_pairwise_distance": round(float(
                 np.min(r.outputs.min_pairwise_distance)), 4),
             "infeasible_count": int(np.sum(r.outputs.infeasible_count)),
@@ -398,6 +403,76 @@ def cmd_serve(args) -> int:
     })
     if sink is not None:
         sink.summary({"requests_served": len(results)})
+        sink.close()
+        record["telemetry"] = sink.run_dir
+    print(json.dumps(record))
+    return 0
+
+
+def cmd_loadgen(args) -> int:
+    """Open-loop SLO load generation against the serving engine: a
+    seeded Poisson-arrival, bounded-Pareto-size traffic run
+    (serve.loadgen), reported as sustained RPS + p50/p95/p99 end-to-end
+    latency with queue-wait vs execute breakdown. Optional exports: the
+    request-lifecycle Chrome trace (--chrome-trace, Perfetto-loadable),
+    a device profile with matching phase names (--xla-trace), and the
+    serve.span/loadgen.summary JSONL stream (--telemetry-dir)."""
+    import contextlib
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from cbf_tpu.serve import ServeEngine, LoadSpec, build_schedule, \
+        run_loadgen
+    from cbf_tpu.utils import profiling
+
+    try:
+        steps_choices = tuple(int(s) for s in args.steps.split(","))
+    except ValueError:
+        raise SystemExit(f"--steps must be comma-separated ints, "
+                         f"got {args.steps!r}")
+    spec = LoadSpec(rps=args.rps, duration_s=args.duration, seed=args.seed,
+                    n_min=args.n_min, n_max=args.n_max,
+                    pareto_alpha=args.pareto_alpha,
+                    steps_choices=steps_choices, gating=args.gating)
+    sink = None
+    if args.telemetry_dir:
+        from cbf_tpu import obs
+
+        sink = obs.TelemetrySink(args.telemetry_dir)
+    engine = ServeEngine(max_batch=args.max_batch,
+                         flush_deadline_s=args.flush_deadline,
+                         cache_dir=args.cache_dir, telemetry=sink)
+    schedule = build_schedule(spec)
+    prewarm_s = engine.prewarm([cfg for _, cfg in schedule])
+    if sink is not None:
+        from cbf_tpu import obs
+
+        sink.write_manifest(obs.build_manifest(
+            None, extra=engine.manifest_extra()))
+    trace_ctx = (profiling.trace(args.xla_trace) if args.xla_trace
+                 else contextlib.nullcontext())
+    with trace_ctx:
+        report = run_loadgen(engine, spec, telemetry=sink)
+    record = dict(report)
+    record.update({
+        "rps_target": args.rps, "max_batch": args.max_batch,
+        "flush_deadline_s": args.flush_deadline,
+        "n_min": args.n_min, "n_max": args.n_max,
+        "pareto_alpha": args.pareto_alpha,
+        "prewarm_s": prewarm_s,
+        "buckets": engine.manifest_extra()["serve"]["buckets"],
+        "stats": engine.stats,
+    })
+    if args.chrome_trace:
+        record["chrome_trace"] = engine.tracer.export_chrome_trace(
+            args.chrome_trace)
+    if args.xla_trace:
+        record["xla_trace"] = args.xla_trace
+    if sink is not None:
+        sink.summary({"requests_served": report["completed"]})
         sink.close()
         record["telemetry"] = sink.run_dir
     print(json.dumps(record))
@@ -704,6 +779,53 @@ def main(argv=None) -> int:
                              "bucket/compile attribution + one 'request' "
                              "event per served request")
     servep.set_defaults(fn=cmd_serve)
+
+    loadp = sub.add_parser(
+        "loadgen", help="open-loop SLO load generation against the "
+                        "serving engine: sustained RPS + latency "
+                        "percentiles (docs/API.md 'Tracing & SLOs')")
+    loadp.add_argument("--platform", default=None, choices=("cpu", "tpu"),
+                       help="force a JAX backend before first use")
+    loadp.add_argument("--rps", type=float, default=8.0,
+                       help="offered Poisson arrival rate, requests/s "
+                            "(default 8)")
+    loadp.add_argument("--duration", type=float, default=5.0,
+                       help="arrival window in seconds (default 5)")
+    loadp.add_argument("--seed", type=int, default=0,
+                       help="schedule seed (same seed = same traffic)")
+    loadp.add_argument("--n-min", type=int, default=8,
+                       help="bounded-Pareto request-size lower bound")
+    loadp.add_argument("--n-max", type=int, default=96,
+                       help="bounded-Pareto request-size upper bound")
+    loadp.add_argument("--pareto-alpha", type=float, default=1.3,
+                       help="size-distribution tail index (smaller = "
+                            "heavier tail; default 1.3)")
+    loadp.add_argument("--steps", default="20,40,60",
+                       help="comma-separated horizon mix (default "
+                            "20,40,60)")
+    loadp.add_argument("--gating", default="jnp",
+                       help="gating backend for generated requests "
+                            "(default jnp)")
+    loadp.add_argument("--max-batch", type=int, default=8,
+                       help="engine micro-batch size (default 8)")
+    loadp.add_argument("--flush-deadline", type=float, default=0.05,
+                       help="engine queue flush deadline in seconds "
+                            "(default 0.05)")
+    loadp.add_argument("--cache-dir", default=None,
+                       help="persistent compilation cache directory "
+                            "(overrides CBF_TPU_CACHE_DIR)")
+    loadp.add_argument("--telemetry-dir", default=None,
+                       help="write a run directory with serve.span + "
+                            "request + loadgen.summary JSONL events")
+    loadp.add_argument("--chrome-trace", default=None,
+                       help="export the request-lifecycle spans as "
+                            "Chrome trace-event JSON here (load in "
+                            "Perfetto / chrome://tracing)")
+    loadp.add_argument("--xla-trace", default=None,
+                       help="also write a jax.profiler device trace "
+                            "here — device time attributes to the same "
+                            "phase names as the host spans")
+    loadp.set_defaults(fn=cmd_loadgen)
 
     verp = sub.add_parser(
         "verify", help="falsification sweep: search for initial-condition "

@@ -9,7 +9,8 @@ shims (same CLI, same ``audit()``/``chain_profile()`` entry points) so
 existing tier-1 tests and operator muscle memory keep working.
 
 * AUD001 — telemetry schema drift (StepOutputs/EnsembleMetrics and the
-  verify event types vs the heartbeat schema and docs/API.md);
+  verify/serve/loadgen event types vs the heartbeat schema and
+  docs/API.md);
 * AUD002 — budget-shaped tests missing ``@pytest.mark.slow`` (the
   870 s tier-1 budget);
 * AUD003 — certificate chain-depth regression (the fused ADMM
@@ -122,6 +123,44 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
                 f"verify event type {etype!r} has no VERIFY_EVENT_FIELDS "
                 "payload declaration")
 
+    # Serve/loadgen event drift: the request-lifecycle emitters (the
+    # engine's `request` events + the tracer's `serve.span` events) and
+    # the load generator's summary event must match the schema's
+    # declarations — same four-way contract as the verify events.
+    from cbf_tpu.obs import trace as obs_trace
+    from cbf_tpu.serve import engine as serve_engine
+    from cbf_tpu.serve import loadgen as serve_loadgen
+    serve_emitted = tuple(serve_engine.EMITTED_EVENT_TYPES) + \
+        tuple(obs_trace.EMITTED_EVENT_TYPES)
+    if tuple(sorted(serve_emitted)) != \
+            tuple(sorted(schema.SERVE_EVENT_TYPES)):
+        problems.append(
+            f"serve emitters (engine+trace) {serve_emitted!r} != "
+            f"obs.schema.SERVE_EVENT_TYPES {schema.SERVE_EVENT_TYPES!r} "
+            "— emitter and schema drifted")
+    if tuple(serve_loadgen.EMITTED_EVENT_TYPES) != \
+            tuple(schema.LOADGEN_EVENT_TYPES):
+        problems.append(
+            f"serve.loadgen.EMITTED_EVENT_TYPES "
+            f"{serve_loadgen.EMITTED_EVENT_TYPES!r} != "
+            f"obs.schema.LOADGEN_EVENT_TYPES "
+            f"{schema.LOADGEN_EVENT_TYPES!r} — emitter and schema drifted")
+    for table_name, types_name, fields, types in (
+            ("SERVE_EVENT_FIELDS", "SERVE_EVENT_TYPES",
+             schema.SERVE_EVENT_FIELDS, schema.SERVE_EVENT_TYPES),
+            ("LOADGEN_EVENT_FIELDS", "LOADGEN_EVENT_TYPES",
+             schema.LOADGEN_EVENT_FIELDS, schema.LOADGEN_EVENT_TYPES)):
+        for etype in fields:
+            if etype not in types:
+                problems.append(
+                    f"{table_name} declares {etype!r}, which is not in "
+                    f"{types_name}")
+        for etype in types:
+            if etype not in fields:
+                problems.append(
+                    f"serve event type {etype!r} has no {table_name} "
+                    "payload declaration")
+
     # Docs: every heartbeat field + alert kind + verify event must be
     # documented.
     api_path = os.path.join(repo, "docs", "API.md")
@@ -143,16 +182,20 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
                 problems.append(
                     f"watchdog alert kind `{kind}` is undocumented in "
                     "docs/API.md")
-        for etype, fields in schema.VERIFY_EVENT_FIELDS.items():
-            if f"`{etype}`" not in api_text:
-                problems.append(
-                    f"verify event type `{etype}` is undocumented in "
-                    "docs/API.md")
-            for field in fields:
-                if f"`{field}`" not in api_text:
+        for family, table in (
+                ("verify", schema.VERIFY_EVENT_FIELDS),
+                ("serve", schema.SERVE_EVENT_FIELDS),
+                ("loadgen", schema.LOADGEN_EVENT_FIELDS)):
+            for etype, fields in table.items():
+                if f"`{etype}`" not in api_text:
                     problems.append(
-                        f"verify event field `{field}` ({etype}) is "
-                        "undocumented in docs/API.md")
+                        f"{family} event type `{etype}` is undocumented "
+                        "in docs/API.md")
+                for field in fields:
+                    if f"`{field}`" not in api_text:
+                        problems.append(
+                            f"{family} event field `{field}` ({etype}) "
+                            "is undocumented in docs/API.md")
     return problems
 
 

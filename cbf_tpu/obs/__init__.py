@@ -24,18 +24,20 @@ EnsembleMetrics is a tier-1 failure via scripts/obs_schema_audit.py).
 """
 
 from cbf_tpu.obs.schema import SCHEMA_VERSION, HEARTBEAT_FIELDS
-from cbf_tpu.obs.sink import (MetricsRegistry, TelemetrySink, build_manifest,
-                              read_events, read_manifest, summarize_run,
-                              tail_events)
+from cbf_tpu.obs.sink import (Histogram, MetricsRegistry, TelemetrySink,
+                              build_manifest, read_events, read_manifest,
+                              summarize_run, tail_events)
 from cbf_tpu.obs.tap import emit_ensemble_chunk, instrument_step
+from cbf_tpu.obs.trace import LIFECYCLE_PHASES, Span, Tracer
 from cbf_tpu.obs.watchdog import (ALERT_CERT_BLOWUP, ALERT_INFEASIBLE,
                                   ALERT_KINDS, ALERT_NAN, ALERT_STALL, Alert,
                                   Watchdog)
 
 __all__ = [
-    "SCHEMA_VERSION", "HEARTBEAT_FIELDS", "MetricsRegistry", "TelemetrySink",
-    "build_manifest", "read_events", "read_manifest", "summarize_run",
-    "tail_events", "emit_ensemble_chunk", "instrument_step", "Alert",
+    "SCHEMA_VERSION", "HEARTBEAT_FIELDS", "Histogram", "MetricsRegistry",
+    "TelemetrySink", "build_manifest", "read_events", "read_manifest",
+    "summarize_run", "tail_events", "emit_ensemble_chunk", "instrument_step",
+    "LIFECYCLE_PHASES", "Span", "Tracer", "Alert",
     "Watchdog", "ALERT_KINDS", "ALERT_NAN", "ALERT_CERT_BLOWUP",
     "ALERT_INFEASIBLE", "ALERT_STALL",
 ]

@@ -122,6 +122,36 @@ VERIFY_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
                       "found", "evaluated"),
 }
 
+#: Generic typed events the serving layer appends: ``request`` is the
+#: per-request attribution record ServeEngine writes on resolve (latency
+#: breakdown + safety metrics), ``serve.span`` is one request-lifecycle
+#: span from the ``obs.trace`` tracer (enqueue / queue_wait / pack /
+#: compile / executable_hit / execute / unpack / resolve). Same AUD001
+#: contract as the verify events: the emitters' ``EMITTED_EVENT_TYPES``
+#: (serve.engine + obs.trace) must union to this tuple, and every type
+#: and field must be documented in docs/API.md.
+SERVE_EVENT_TYPES: tuple[str, ...] = ("request", "serve.span")
+
+SERVE_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
+    "request": ("request_id", "bucket", "n", "steps", "latency_s",
+                "queue_wait_s", "execute_s", "batch_fill",
+                "min_pairwise_distance", "infeasible_count"),
+    "serve.span": ("trace_id", "span_id", "parent_id", "name", "bucket",
+                   "t0_s", "dur_s"),
+}
+
+#: The load generator's run-end record (``serve.loadgen``): offered vs
+#: achieved rates and the end-to-end latency percentiles of one open-loop
+#: traffic run. One event per loadgen run.
+LOADGEN_EVENT_TYPES: tuple[str, ...] = ("loadgen.summary",)
+
+LOADGEN_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
+    "loadgen.summary": ("seed", "offered_rps", "achieved_rps", "requests",
+                        "completed", "duration_s", "latency_p50_s",
+                        "latency_p95_s", "latency_p99_s",
+                        "queue_wait_p99_s", "execute_p99_s"),
+}
+
 
 def step_output_channels() -> dict[str, HeartbeatField]:
     """StepOutputs field name -> HeartbeatField for every streamed field."""

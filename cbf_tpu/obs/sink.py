@@ -73,15 +73,21 @@ class Gauge:
 class Histogram:
     """Fixed-boundary histogram (log-spaced default): bounded memory for
     unbounded streams. ``bounds`` are the upper edges of all but the last
-    (overflow) bucket."""
+    (overflow) bucket. Observed finite min/max are tracked alongside the
+    bucket counts so quantile ESTIMATES (:meth:`quantile`) stay bounded
+    by what was actually seen."""
 
     DEFAULT_BOUNDS = tuple(10.0 ** e for e in range(-9, 7))
+    #: The percentiles every snapshot reports (SLO convention).
+    SNAPSHOT_QUANTILES = (0.5, 0.95, 0.99)
 
     def __init__(self, bounds: tuple[float, ...] | None = None):
         self.bounds = tuple(bounds) if bounds else self.DEFAULT_BOUNDS
         self.counts = [0] * (len(self.bounds) + 1)
         self.samples = 0
         self.nonfinite = 0
+        self.vmin: float | None = None
+        self.vmax: float | None = None
 
     def observe(self, v: float) -> None:
         v = float(v)
@@ -89,16 +95,47 @@ class Histogram:
         if not (v == v and abs(v) != float("inf")):
             self.nonfinite += 1
             return
+        self.vmin = v if self.vmin is None else min(self.vmin, v)
+        self.vmax = v if self.vmax is None else max(self.vmax, v)
         for i, b in enumerate(self.bounds):
             if v <= b:
                 self.counts[i] += 1
                 return
         self.counts[-1] += 1
 
+    def quantile(self, q: float) -> float | None:
+        """Estimate the q-quantile (0 <= q <= 1) from the bucket counts:
+        find the bucket holding the target rank, then interpolate
+        linearly between its edges (observed min/max stand in for the
+        open-ended first and overflow edges). The estimate is clamped to
+        [observed min, observed max], so it is exact at the extremes,
+        monotone in q, and never invents values outside the data. None
+        when no finite sample has been observed."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile q must be in [0, 1], got {q}")
+        finite = sum(self.counts)
+        if finite == 0 or self.vmin is None:
+            return None
+        target = q * finite
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if c and cum + c >= target:
+                lo = self.vmin if i == 0 else self.bounds[i - 1]
+                hi = (self.vmax if i == len(self.bounds)
+                      else self.bounds[i])
+                est = lo + (hi - lo) * ((target - cum) / c)
+                return min(max(est, self.vmin), self.vmax)
+            cum += c
+        return self.vmax
+
     def snapshot(self) -> dict:
-        return {"type": "histogram", "bounds": list(self.bounds),
+        snap = {"type": "histogram", "bounds": list(self.bounds),
                 "counts": list(self.counts), "samples": self.samples,
-                "nonfinite": self.nonfinite}
+                "nonfinite": self.nonfinite, "min": self.vmin,
+                "max": self.vmax}
+        for q in self.SNAPSHOT_QUANTILES:
+            snap[f"p{round(q * 100)}"] = self.quantile(q)
+        return snap
 
 
 class MetricsRegistry:
@@ -164,6 +201,12 @@ class MetricsRegistry:
                 else:  # incompatible bins: keep totals honest, drop shape
                     h.samples += snap.get("samples", 0)
                     h.nonfinite += snap.get("nonfinite", 0)
+                if snap.get("min") is not None:
+                    h.vmin = (snap["min"] if h.vmin is None
+                              else min(h.vmin, snap["min"]))
+                if snap.get("max") is not None:
+                    h.vmax = (snap["max"] if h.vmax is None
+                              else max(h.vmax, snap["max"]))
 
 
 # ----------------------------------------------------------- manifest ----

@@ -1,0 +1,273 @@
+"""Request-lifecycle span tracing for the serving layer.
+
+The telemetry subsystem can see inside one compiled rollout (heartbeats)
+but could not answer "where did this request's 80 ms go?" — there was no
+tracing between request submission and result resolution. This module is
+that layer: a thread-safe :class:`Tracer` records nested, named spans on
+monotonic host clocks (``time.perf_counter`` — wall-clock steps from NTP
+never corrupt a duration), keyed by per-request trace ids, and exports
+them three ways:
+
+- **Chrome trace-event JSON** (:meth:`Tracer.chrome_trace` /
+  :meth:`Tracer.export_chrome_trace`) — load the file in Perfetto or
+  ``chrome://tracing`` and see the request lifecycle on a timeline,
+  per-thread. ``--xla-trace`` device profiles use the same phase names
+  (``utils.profiling.annotate``), so host spans and device time
+  attribute to one vocabulary.
+- **JSONL event stream** — one schema-stamped ``serve.span`` event per
+  finished span through ``TelemetrySink.event`` (AUD001 holds this
+  emitter, ``obs.schema.SERVE_EVENT_FIELDS`` and docs/API.md to one
+  contract).
+- **Latency histograms** — every span feeds
+  ``registry.histogram("serve.phase.<name>_s")`` (and its per-bucket
+  twin), so p50/p95/p99 come out of ``Histogram.quantile`` in run
+  summaries and ``cbf_tpu obs summary``.
+
+The serve engine's lifecycle phases (:data:`LIFECYCLE_PHASES`):
+``enqueue -> queue_wait -> pack -> (compile | executable_hit) ->
+execute -> unpack -> resolve``. Tracing is host-side only — it never
+enters traced scope, so rollout outputs are bit-identical with tracing
+on or off (pinned by tests/test_trace.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+
+#: The event types this module emits (AUD001: together with
+#: serve.engine's, must union to obs.schema.SERVE_EVENT_TYPES).
+EMITTED_EVENT_TYPES: tuple[str, ...] = ("serve.span",)
+
+#: The serve request lifecycle, in order. Host span names, registry
+#: histogram suffixes and the device-phase ``annotate`` scopes all draw
+#: from this vocabulary.
+LIFECYCLE_PHASES: tuple[str, ...] = (
+    "enqueue", "queue_wait", "pack", "compile", "executable_hit",
+    "execute", "unpack", "resolve")
+
+
+class Span:
+    """One finished (or in-flight) span: name + trace identity + start
+    offset/duration on the tracer's monotonic clock."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "bucket",
+                 "t0_s", "dur_s", "thread")
+
+    def __init__(self, name: str, trace_id: str | None, span_id: int,
+                 parent_id: int | None, bucket: str | None,
+                 t0_s: float, thread: int):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.bucket = bucket
+        self.t0_s = t0_s
+        self.dur_s: float | None = None
+        self.thread = thread
+
+
+class _SpanContext:
+    """Context manager wrapping one live span (nesting via the tracer's
+    thread-local stack)."""
+
+    __slots__ = ("_tracer", "span", "_t0_perf")
+
+    def __init__(self, tracer: "Tracer", span: Span, t0_perf: float):
+        self._tracer = tracer
+        self.span = span
+        self._t0_perf = t0_perf
+
+    def __enter__(self):
+        self._tracer._push(self.span)
+        return self.span
+
+    def __exit__(self, *exc):
+        self._tracer._pop(self.span)
+        self.span.dur_s = time.perf_counter() - self._t0_perf
+        self._tracer._finish(self.span)
+        return False
+
+
+class _NullContext:
+    """No-op stand-in when the tracer is disabled or the trace is
+    sampled out — same `with ... as span` shape, span is None."""
+
+    span = None
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullContext()
+
+
+class Tracer:
+    """Thread-safe span recorder on one process-local monotonic clock.
+
+    ``sink`` — optional TelemetrySink; every finished span becomes a
+    ``serve.span`` JSONL event. ``registry`` — optional MetricsRegistry
+    (defaults to the sink's); every span feeds the per-phase (and
+    per-bucket) latency histograms. ``enabled=False`` turns every call
+    into a no-op (the overhead-control kill switch).
+    ``sample_every=k`` records every k-th request trace (batch-level
+    spans, 1/B as numerous, are always recorded); the decision is
+    deterministic per trace id — no RNG, replay-stable.
+    ``max_spans`` bounds in-memory retention for the Chrome export;
+    beyond it spans still export to sink/registry but are dropped from
+    memory (counted in ``dropped``).
+    """
+
+    def __init__(self, *, sink=None, registry=None, enabled: bool = True,
+                 sample_every: int = 1, max_spans: int = 100_000):
+        self.sink = sink
+        self.registry = registry if registry is not None else (
+            sink.registry if sink is not None else None)
+        self.enabled = enabled
+        self.sample_every = max(1, int(sample_every))
+        self.max_spans = max_spans
+        self.spans: list[Span] = []
+        self.dropped = 0
+        self._epoch_perf = time.perf_counter()
+        self._epoch_wall = time.time()
+        self._lock = threading.Lock()
+        self._span_ids = itertools.count(1)
+        self._local = threading.local()
+        self._trace_seq = 0
+        self._trace_sampled: dict[str, bool] = {}
+
+    # -- clocks ------------------------------------------------------------
+
+    def now(self) -> float:
+        """Seconds since tracer epoch, monotonic — the timestamp domain
+        every span start/duration lives in (stamp enqueue times with
+        this, hand them back to :meth:`record` later)."""
+        return time.perf_counter() - self._epoch_perf
+
+    def wall_of(self, t0_s: float) -> float:
+        """Map a tracer-epoch offset back to approximate epoch wall time
+        (for correlating spans with t_wall-stamped JSONL events)."""
+        return self._epoch_wall + t0_s
+
+    # -- sampling ----------------------------------------------------------
+
+    def sampled(self, trace_id: str | None) -> bool:
+        """Deterministic per-trace sampling decision (every k-th new
+        trace id records; k = ``sample_every``). Batch-level spans pass
+        ``trace_id=None`` and are always recorded."""
+        if not self.enabled:
+            return False
+        if trace_id is None or self.sample_every == 1:
+            return True
+        with self._lock:
+            hit = self._trace_sampled.get(trace_id)
+            if hit is None:
+                hit = (self._trace_seq % self.sample_every) == 0
+                self._trace_seq += 1
+                if len(self._trace_sampled) >= 8192:
+                    self._trace_sampled.clear()   # bounded memory
+                self._trace_sampled[trace_id] = hit
+            return hit
+
+    # -- span recording ----------------------------------------------------
+
+    def span(self, name: str, *, trace_id: str | None = None,
+             parent_id: int | None = None, bucket: str | None = None):
+        """Context manager for one span; nests under the current
+        thread's innermost open span unless ``parent_id`` is given."""
+        if not self.sampled(trace_id):
+            return _NULL
+        t0_perf = time.perf_counter()
+        if parent_id is None:
+            stack = getattr(self._local, "stack", None)
+            if stack:
+                parent_id = stack[-1].span_id
+        span = Span(name, trace_id, next(self._span_ids), parent_id,
+                    bucket, t0_perf - self._epoch_perf,
+                    threading.get_ident())
+        return _SpanContext(self, span, t0_perf)
+
+    def record(self, name: str, *, t0_s: float, dur_s: float,
+               trace_id: str | None = None, parent_id: int | None = None,
+               bucket: str | None = None) -> Span | None:
+        """Record a span with explicit timestamps (``t0_s`` from
+        :meth:`now`) — for phases measured retroactively across threads,
+        like queue wait (stamped at enqueue on the caller's thread,
+        closed at flush on the scheduler's)."""
+        if not self.sampled(trace_id):
+            return None
+        span = Span(name, trace_id, next(self._span_ids), parent_id,
+                    bucket, t0_s, threading.get_ident())
+        span.dur_s = dur_s
+        self._finish(span)
+        return span
+
+    def _push(self, span: Span) -> None:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        stack.append(span)
+
+    def _pop(self, span: Span) -> None:
+        stack = getattr(self._local, "stack", None)
+        if stack and stack[-1] is span:
+            stack.pop()
+
+    def _finish(self, span: Span) -> None:
+        with self._lock:
+            if len(self.spans) < self.max_spans:
+                self.spans.append(span)
+            else:
+                self.dropped += 1
+        if self.registry is not None:
+            self.registry.histogram(
+                f"serve.phase.{span.name}_s").observe(span.dur_s)
+            if span.bucket is not None:
+                self.registry.histogram(
+                    f"serve.phase.{span.name}_s[{span.bucket}]").observe(
+                        span.dur_s)
+        if self.sink is not None:
+            self.sink.event("serve.span", {
+                "trace_id": span.trace_id, "span_id": span.span_id,
+                "parent_id": span.parent_id, "name": span.name,
+                "bucket": span.bucket, "t0_s": round(span.t0_s, 6),
+                "dur_s": round(span.dur_s, 6)})
+
+    # -- exporters ---------------------------------------------------------
+
+    def chrome_trace(self) -> dict:
+        """The recorded spans as a Chrome trace-event JSON object
+        (``{"traceEvents": [...]}``, complete-event ``ph="X"``,
+        microsecond timestamps) — loadable in Perfetto /
+        ``chrome://tracing``. Thread ids are renumbered small so the
+        viewer's track names stay readable."""
+        with self._lock:
+            spans = list(self.spans)
+        tids: dict[int, int] = {}
+        events = [{"name": "process_name", "ph": "M", "pid": os.getpid(),
+                   "tid": 0, "args": {"name": "cbf_tpu serve"}}]
+        for s in spans:
+            tid = tids.setdefault(s.thread, len(tids) + 1)
+            events.append({
+                "name": s.name, "cat": "serve", "ph": "X",
+                "ts": round(s.t0_s * 1e6, 3),
+                "dur": round((s.dur_s or 0.0) * 1e6, 3),
+                "pid": os.getpid(), "tid": tid,
+                "args": {"trace_id": s.trace_id, "span_id": s.span_id,
+                         "parent_id": s.parent_id, "bucket": s.bucket},
+            })
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "otherData": {"epoch_wall": self._epoch_wall,
+                              "dropped_spans": self.dropped}}
+
+    def export_chrome_trace(self, path: str) -> str:
+        """Write :meth:`chrome_trace` to ``path`` and return it."""
+        with open(path, "w") as fh:
+            json.dump(self.chrome_trace(), fh)
+        return path

@@ -43,6 +43,7 @@ from cbf_tpu.ops.pairwise import pairwise_distances
 from cbf_tpu.ops.pallas_knn import knn_gating_banded, knn_gating_pallas
 from cbf_tpu.rollout.engine import StepOutputs, rollout
 from cbf_tpu.rollout.gating import knn_gating
+from cbf_tpu.utils import profiling
 from cbf_tpu.utils.math import l2_cap, match_vma, safe_norm
 
 
@@ -1213,29 +1214,37 @@ def _build_step(cfg: Config, cbf: CBFParams | None = None, *,
             x = projection_points(cfg, state.x, state.theta)
         else:
             x = state.x                                        # (N, 2)
-        if active is None:
-            centroid = jnp.mean(x, axis=0)
-        else:
-            # Padded bucket: the consensus target is the REAL agents'
-            # centroid — parked pads a megameter away would otherwise
-            # drag it off the swarm.
-            n_act = jnp.maximum(jnp.sum(active.astype(dt_)), 1.0)
-            centroid = jnp.sum(jnp.where(active[:, None], x, 0.0),
-                               axis=0) / n_act
-        to_c = centroid[None] - x                              # (N, 2)
-        d_c = jnp.linalg.norm(to_c, axis=1, keepdims=True)
-        # Pull toward the centroid only while outside the packing disk.
-        pull = jnp.maximum(d_c - cfg.pack_radius, 0.0)
-        u0 = cfg.consensus_gain * pull * to_c / jnp.maximum(d_c, 1e-9)
-        if M:
-            obstacles4 = obstacle_states_at(cfg, t, dt_)
-            dodge, d_o = lane_dodge(x, obstacles4, cfg.safety_distance)
-            u0 = u0 + 2.0 * dodge
-        if active is not None:
-            # Pads hold station: zero nominal (and nothing engages their
-            # filter — no neighbor is within any radius of the parking
-            # grid), so u == 0 and the integrator keeps them parked.
-            u0 = jnp.where(active[:, None], u0, 0.0)
+        # Device-phase naming (utils.profiling.annotate = jax.named_scope):
+        # HLO metadata only — zero runtime ops, bit-neutral — so an
+        # --xla-trace profile attributes device time to the same phase
+        # vocabulary the serve layer's host spans use (docs/API.md
+        # "Tracing & SLOs"): consensus, gating, filter, certificate,
+        # integrate.
+        with profiling.annotate("consensus"):
+            if active is None:
+                centroid = jnp.mean(x, axis=0)
+            else:
+                # Padded bucket: the consensus target is the REAL agents'
+                # centroid — parked pads a megameter away would otherwise
+                # drag it off the swarm.
+                n_act = jnp.maximum(jnp.sum(active.astype(dt_)), 1.0)
+                centroid = jnp.sum(jnp.where(active[:, None], x, 0.0),
+                                   axis=0) / n_act
+            to_c = centroid[None] - x                          # (N, 2)
+            d_c = jnp.linalg.norm(to_c, axis=1, keepdims=True)
+            # Pull toward the centroid only while outside the packing disk.
+            pull = jnp.maximum(d_c - cfg.pack_radius, 0.0)
+            u0 = cfg.consensus_gain * pull * to_c / jnp.maximum(d_c, 1e-9)
+            if M:
+                obstacles4 = obstacle_states_at(cfg, t, dt_)
+                dodge, d_o = lane_dodge(x, obstacles4, cfg.safety_distance)
+                u0 = u0 + 2.0 * dodge
+            if active is not None:
+                # Pads hold station: zero nominal (and nothing engages
+                # their filter — no neighbor is within any radius of the
+                # parking grid), so u == 0 and the integrator keeps them
+                # parked.
+                u0 = jnp.where(active[:, None], u0, 0.0)
         # Discrete barrier (single mode): agent velocity slots are zero by
         # construction (u is the unknown the row solves for; a fellow
         # agent's motion is covered by the pairwise (1-2*gamma) bound) —
@@ -1248,37 +1257,42 @@ def _build_step(cfg: Config, cbf: CBFParams | None = None, *,
 
         overflow_count = ()
         new_cache = ()
-        if cache_skin:
-            (obs_slab, mask, _nearest_seen, min_dist, dropped,
-             new_cache) = verlet_gating(cfg, x, states4, state.gating_cache,
-                                        K, use_pallas, pallas_interpret)
-        elif use_banded:
-            # O(N*W) y-sorted banded kernel; window overflow (possible
-            # missed neighbors) is surfaced, never swallowed.
-            obs_slab, mask, nearest, overflow, dropped = knn_gating_banded(
-                states4, cfg.safety_distance, K,
-                window_blocks=window_blocks, interpret=pallas_interpret)
-            min_dist = jnp.min(nearest)
-            overflow_count = jnp.sum(overflow)
-        elif use_pallas:
-            # Fused Pallas kernel: distances + k-NN + nearest-any metric in
-            # one VMEM-resident pass (ops.pallas_knn) — or the streaming
-            # kernel when forced (gating="streaming").
-            obs_slab, mask, nearest, dropped = knn_gating_pallas(
-                states4, cfg.safety_distance, K, interpret=pallas_interpret,
-                kernel=kernel)
-            min_dist = jnp.min(nearest)
-        else:
-            # jnp path: one pairwise-distance computation feeds both the
-            # k-NN gating and the min-distance safety metric.
-            dist = pairwise_distances(x)                       # (N, N)
-            obs_slab, mask, dropped = knn_gating(
-                states4, states4, cfg.safety_distance, K,
-                exclude_self_row=jnp.ones(x.shape[0], bool), dist=dist,
-                with_dropped=True,
-            )
-            off = dist + jnp.where(jnp.eye(x.shape[0], dtype=bool), jnp.inf, 0.0)
-            min_dist = jnp.min(off)
+        with profiling.annotate("gating"):
+            if cache_skin:
+                (obs_slab, mask, _nearest_seen, min_dist, dropped,
+                 new_cache) = verlet_gating(cfg, x, states4,
+                                            state.gating_cache,
+                                            K, use_pallas, pallas_interpret)
+            elif use_banded:
+                # O(N*W) y-sorted banded kernel; window overflow (possible
+                # missed neighbors) is surfaced, never swallowed.
+                obs_slab, mask, nearest, overflow, dropped = \
+                    knn_gating_banded(
+                        states4, cfg.safety_distance, K,
+                        window_blocks=window_blocks,
+                        interpret=pallas_interpret)
+                min_dist = jnp.min(nearest)
+                overflow_count = jnp.sum(overflow)
+            elif use_pallas:
+                # Fused Pallas kernel: distances + k-NN + nearest-any
+                # metric in one VMEM-resident pass (ops.pallas_knn) — or
+                # the streaming kernel when forced (gating="streaming").
+                obs_slab, mask, nearest, dropped = knn_gating_pallas(
+                    states4, cfg.safety_distance, K,
+                    interpret=pallas_interpret, kernel=kernel)
+                min_dist = jnp.min(nearest)
+            else:
+                # jnp path: one pairwise-distance computation feeds both
+                # the k-NN gating and the min-distance safety metric.
+                dist = pairwise_distances(x)                   # (N, N)
+                obs_slab, mask, dropped = knn_gating(
+                    states4, states4, cfg.safety_distance, K,
+                    exclude_self_row=jnp.ones(x.shape[0], bool), dist=dist,
+                    with_dropped=True,
+                )
+                off = dist + jnp.where(jnp.eye(x.shape[0], dtype=bool),
+                                       jnp.inf, 0.0)
+                min_dist = jnp.min(off)
 
         u0 = complete_nominal(cfg, u0, x, state.v, obs_slab, mask)
 
@@ -1288,18 +1302,20 @@ def _build_step(cfg: Config, cbf: CBFParams | None = None, *,
                 obs_slab, mask, obstacles4, d_o, cfg.safety_distance)
             min_dist = jnp.minimum(min_dist, jnp.min(d_o))
 
-        priority, cap = relax_tiers(cfg, mask, priority)
-        # Actuation-bounded modes get the corrected pure actuator box (the
-        # reference's quirky velocity-coupled rows are a parity artifact).
-        plain_box = double or unicycle
-        u_safe, info = safe_controls(
-            states4, obs_slab, mask, f, g, u0, cbf,
-            priority_mask=priority, relax_cap=cap,
-            unroll_relax=unroll_relax,
-            reference_layout=not plain_box,
-            vel_box_rows=not plain_box)
-        engaged = jnp.any(mask, axis=1)
-        u = jnp.where(engaged[:, None], u_safe, u0)
+        with profiling.annotate("filter"):
+            priority, cap = relax_tiers(cfg, mask, priority)
+            # Actuation-bounded modes get the corrected pure actuator box
+            # (the reference's quirky velocity-coupled rows are a parity
+            # artifact).
+            plain_box = double or unicycle
+            u_safe, info = safe_controls(
+                states4, obs_slab, mask, f, g, u0, cbf,
+                priority_mask=priority, relax_cap=cap,
+                unroll_relax=unroll_relax,
+                reference_layout=not plain_box,
+                vel_box_rows=not plain_box)
+            engaged = jnp.any(mask, axis=1)
+            u = jnp.where(engaged[:, None], u_safe, u0)
 
         cert_residual = ()
         cert_dropped = ()
@@ -1309,36 +1325,39 @@ def _build_step(cfg: Config, cbf: CBFParams | None = None, *,
         if cfg.certificate:
             # Second layer of the reference's stack: the joint certificate
             # over the already-filtered si velocities (see Config).
-            res = apply_certificate(
-                cfg, u, x,
-                neighbor_cache=(state.certificate_cache
-                                if cfg.certificate_rebuild_skin else None),
-                solver_state=(state.certificate_solver_state
-                              if cfg.certificate_warm_start else None))
-            u, cert_residual, cert_dropped, cert_iters = res[:4]
-            rest = list(res[4:])
-            if cfg.certificate_rebuild_skin:
-                new_ccache = rest.pop(0)
-            if cfg.certificate_warm_start:
-                new_sstate = rest.pop(0)
+            with profiling.annotate("certificate"):
+                res = apply_certificate(
+                    cfg, u, x,
+                    neighbor_cache=(state.certificate_cache
+                                    if cfg.certificate_rebuild_skin
+                                    else None),
+                    solver_state=(state.certificate_solver_state
+                                  if cfg.certificate_warm_start else None))
+                u, cert_residual, cert_dropped, cert_iters = res[:4]
+                rest = list(res[4:])
+                if cfg.certificate_rebuild_skin:
+                    new_ccache = rest.pop(0)
+                if cfg.certificate_warm_start:
+                    new_sstate = rest.pop(0)
 
         deficit = ()
-        if unicycle:
-            body_new, theta_new, p_new = unicycle_apply(
-                cfg, state.x, state.theta, u)
-            realized = (p_new - x) / cfg.dt
-            # Applied si velocity at the projection point — the actual
-            # velocity the continuous barrier's vslots carry next step.
-            new_state = State(x=body_new, v=realized, theta=theta_new,
-                              gating_cache=new_cache,
-                              certificate_cache=new_ccache,
-                              certificate_solver_state=new_sstate)
-            deficit = jnp.max(safe_norm(u - realized))
-        else:
-            x_new, v_new = integrate(cfg, x, state.v, u)
-            new_state = State(x=x_new, v=v_new, gating_cache=new_cache,
-                              certificate_cache=new_ccache,
-                              certificate_solver_state=new_sstate)
+        with profiling.annotate("integrate"):
+            if unicycle:
+                body_new, theta_new, p_new = unicycle_apply(
+                    cfg, state.x, state.theta, u)
+                realized = (p_new - x) / cfg.dt
+                # Applied si velocity at the projection point — the actual
+                # velocity the continuous barrier's vslots carry next step.
+                new_state = State(x=body_new, v=realized, theta=theta_new,
+                                  gating_cache=new_cache,
+                                  certificate_cache=new_ccache,
+                                  certificate_solver_state=new_sstate)
+                deficit = jnp.max(safe_norm(u - realized))
+            else:
+                x_new, v_new = integrate(cfg, x, state.v, u)
+                new_state = State(x=x_new, v=v_new, gating_cache=new_cache,
+                                  certificate_cache=new_ccache,
+                                  certificate_solver_state=new_sstate)
 
         out = StepOutputs(
             min_pairwise_distance=min_dist,

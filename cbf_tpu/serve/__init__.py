@@ -12,9 +12,11 @@ from cbf_tpu.serve.buckets import (BucketKey, DEFAULT_BUCKET_SIZES,
                                    bucket_key, bucket_n)
 from cbf_tpu.serve.engine import (PendingRequest, RequestResult, ServeEngine,
                                   configure_compilation_cache)
+from cbf_tpu.serve.loadgen import LoadSpec, build_schedule, run_loadgen
 
 __all__ = [
     "BucketKey", "DEFAULT_BUCKET_SIZES", "DEFAULT_HORIZON_QUANTUM",
-    "PendingRequest", "RequestResult", "ServeEngine", "bucket_horizon",
-    "bucket_key", "bucket_n", "configure_compilation_cache",
+    "LoadSpec", "PendingRequest", "RequestResult", "ServeEngine",
+    "bucket_horizon", "bucket_key", "bucket_n", "build_schedule",
+    "configure_compilation_cache", "run_loadgen",
 ]

@@ -33,11 +33,16 @@ from typing import Any
 import numpy as np
 import jax
 
+from cbf_tpu.obs import trace as obs_trace
 from cbf_tpu.parallel.ensemble import lockstep_traced_rollout
 from cbf_tpu.scenarios import swarm
 from cbf_tpu.serve import buckets as _buckets
 from cbf_tpu.serve import pack as _pack
 from cbf_tpu.utils import profiling
+
+#: Generic telemetry event types this module emits (AUD001: together
+#: with obs.trace's, must union to obs.schema.SERVE_EVENT_TYPES).
+EMITTED_EVENT_TYPES: tuple[str, ...] = ("request",)
 
 
 def configure_compilation_cache(cache_dir: str | None = None) -> str | None:
@@ -71,6 +76,7 @@ class RequestResult:
     final_state: Any
     outputs: Any            # StepOutputs, time axes = steps
     latency_s: float        # submit -> result available
+    queue_wait_s: float     # submit -> the batch's execute start
     execute_s: float        # the batch's device wall (shared by members)
     batch_fill: int         # real requests in the flushed batch
 
@@ -121,7 +127,7 @@ class ServeEngine:
     def __init__(self, *, max_batch: int = 8, flush_deadline_s: float = 0.05,
                  bucket_sizes: tuple[int, ...] = _buckets.DEFAULT_BUCKET_SIZES,
                  horizon_quantum: int = _buckets.DEFAULT_HORIZON_QUANTUM,
-                 cache_dir: str | None = None, telemetry=None):
+                 cache_dir: str | None = None, telemetry=None, tracer=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self.max_batch = max_batch
@@ -130,14 +136,23 @@ class ServeEngine:
         self.horizon_quantum = horizon_quantum
         self.cache_dir = configure_compilation_cache(cache_dir)
         self.telemetry = telemetry
+        # Lifecycle span tracer (obs.trace): every request's enqueue ->
+        # queue_wait -> pack -> compile|executable_hit -> execute ->
+        # unpack -> resolve is spanned on the tracer's monotonic clock.
+        # Default wires into the telemetry sink (serve.span events +
+        # per-phase histograms); pass Tracer(enabled=False) to kill it.
+        self.tracer = tracer if tracer is not None \
+            else obs_trace.Tracer(sink=telemetry)
         self.prewarm_s: float | None = None
         self.stats = {"requests": 0, "batches": 0, "pad_slots": 0,
                       "compile_hit": 0, "compile_miss": 0}
         self._execs: dict[_buckets.BucketKey, Any] = {}
         self._ids = itertools.count()
+        self._batch_ids = itertools.count()
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        # bucket key -> list of (PendingRequest, cfg, traced, enqueue_t)
+        # bucket key -> list of (PendingRequest, cfg, traced, enqueue_t);
+        # enqueue_t is on the tracer's monotonic clock (tracer.now()).
         self._queue: dict[_buckets.BucketKey, list] = {}
         self._thread: threading.Thread | None = None
         self._running = False
@@ -200,49 +215,71 @@ class ServeEngine:
 
     def _execute(self, key: _buckets.BucketKey, entries) -> None:
         """Run one micro-batch (1..max_batch queue entries) and resolve
-        every member's PendingRequest."""
+        every member's PendingRequest. Every lifecycle phase is spanned
+        on ``self.tracer``: per-request queue_wait (recorded
+        retroactively from the enqueue stamp), then batch-level
+        pack / compile|executable_hit / execute / unpack, then
+        per-request resolve."""
+        tracer = self.tracer
+        label = key.label()
+        batch_id = f"b{next(self._batch_ids)}"
+        t_exec_start = tracer.now()
+        for pending, _cfg, _tr, t_enq in entries:
+            tracer.record("queue_wait", t0_s=t_enq,
+                          dur_s=t_exec_start - t_enq,
+                          trace_id=pending.request_id, bucket=label)
         try:
-            compiled = self._executable(key)
+            hit = key in self._execs
+            with tracer.span("executable_hit" if hit else "compile",
+                             trace_id=batch_id, bucket=label):
+                compiled = self._executable(key)
             cfgs = [cfg for (_p, cfg, _tr, _t) in entries]
             traced = [tr for (_p, _cfg, tr, _t) in entries]
-            states, traced_b, steps_b = _pack.stack_batch(
-                key, cfgs, traced, self.max_batch)
+            with tracer.span("pack", trace_id=batch_id, bucket=label):
+                states, traced_b, steps_b = _pack.stack_batch(
+                    key, cfgs, traced, self.max_batch)
             t0 = time.perf_counter()
-            final_states, outs = compiled(states, traced_b, steps_b)
-            jax.block_until_ready(final_states.x)
+            with tracer.span("execute", trace_id=batch_id, bucket=label):
+                final_states, outs = compiled(states, traced_b, steps_b)
+                jax.block_until_ready(final_states.x)
             execute_s = time.perf_counter() - t0
         except BaseException as e:
             for pending, *_ in entries:
                 pending._resolve(error=e)
             return
-        final_states = jax.device_get(final_states)
-        outs = jax.device_get(outs)
-        now = time.time()
+        with tracer.span("unpack", trace_id=batch_id, bucket=label):
+            final_states = jax.device_get(final_states)
+            outs = jax.device_get(outs)
         self.stats["batches"] += 1
         self.stats["pad_slots"] += self.max_batch - len(entries)
         for slot, (pending, cfg, _tr, t_enq) in enumerate(entries):
-            final, outs_i = _pack.trim_result(final_states, outs, slot,
-                                              cfg.n, cfg.steps)
-            result = RequestResult(
-                request_id=pending.request_id, bucket=key.label(),
-                n=cfg.n, steps=cfg.steps, final_state=final,
-                outputs=outs_i, latency_s=round(now - t_enq, 6),
-                execute_s=round(execute_s, 6), batch_fill=len(entries))
-            self.stats["requests"] += 1
-            if self.telemetry is not None:
-                self.telemetry.event("request", {
-                    "request_id": result.request_id,
-                    "bucket": result.bucket, "n": cfg.n,
-                    "steps": cfg.steps,
-                    "latency_s": result.latency_s,
-                    "execute_s": result.execute_s,
-                    "batch_fill": result.batch_fill,
-                    "min_pairwise_distance": float(
-                        np.min(outs_i.min_pairwise_distance)),
-                    "infeasible_count": int(
-                        np.sum(outs_i.infeasible_count)),
-                })
-            pending._resolve(result=result)
+            with tracer.span("resolve", trace_id=pending.request_id,
+                             bucket=label):
+                final, outs_i = _pack.trim_result(final_states, outs, slot,
+                                                  cfg.n, cfg.steps)
+                now = tracer.now()
+                result = RequestResult(
+                    request_id=pending.request_id, bucket=label,
+                    n=cfg.n, steps=cfg.steps, final_state=final,
+                    outputs=outs_i, latency_s=round(now - t_enq, 6),
+                    queue_wait_s=round(t_exec_start - t_enq, 6),
+                    execute_s=round(execute_s, 6), batch_fill=len(entries))
+                self.stats["requests"] += 1
+                if self.telemetry is not None:
+                    self.telemetry.event("request", {
+                        "request_id": result.request_id,
+                        "bucket": result.bucket, "n": cfg.n,
+                        "steps": cfg.steps,
+                        "latency_s": result.latency_s,
+                        "queue_wait_s": result.queue_wait_s,
+                        "execute_s": result.execute_s,
+                        "batch_fill": result.batch_fill,
+                        "min_pairwise_distance": float(
+                            np.min(outs_i.min_pairwise_distance)),
+                        "infeasible_count": int(
+                            np.sum(outs_i.infeasible_count)),
+                    })
+                pending._resolve(result=result)
 
     # -- synchronous drain -------------------------------------------------
 
@@ -252,13 +289,13 @@ class ServeEngine:
         order."""
         entries_by_key: dict[_buckets.BucketKey, list] = {}
         pendings = []
-        now = time.time()
         for cfg in configs:
-            key, traced = self.bucket_of(cfg)
             pending = PendingRequest(f"r{next(self._ids)}")
-            pendings.append(pending)
-            entries_by_key.setdefault(key, []).append(
-                (pending, cfg, traced, now))
+            with self.tracer.span("enqueue", trace_id=pending.request_id):
+                key, traced = self.bucket_of(cfg)
+                pendings.append(pending)
+                entries_by_key.setdefault(key, []).append(
+                    (pending, cfg, traced, self.tracer.now()))
         for key, entries in entries_by_key.items():
             for i in range(0, len(entries), self.max_batch):
                 self._execute(key, entries[i:i + self.max_batch])
@@ -280,15 +317,16 @@ class ServeEngine:
         """Enqueue one request (queue mode; call `start()` first). The
         bucket flushes when max_batch requests accumulate or after
         flush_deadline_s, whichever comes first."""
-        key, traced = self.bucket_of(cfg)   # validates before enqueueing
         pending = PendingRequest(request_id or f"r{next(self._ids)}")
-        with self._cond:
-            if not self._running:
-                raise RuntimeError("engine not started — call start() "
-                                   "(or use run() for a one-shot drain)")
-            self._queue.setdefault(key, []).append(
-                (pending, cfg, traced, time.time()))
-            self._cond.notify()
+        with self.tracer.span("enqueue", trace_id=pending.request_id):
+            key, traced = self.bucket_of(cfg)   # validates before enqueueing
+            with self._cond:
+                if not self._running:
+                    raise RuntimeError("engine not started — call start() "
+                                       "(or use run() for a one-shot drain)")
+                self._queue.setdefault(key, []).append(
+                    (pending, cfg, traced, self.tracer.now()))
+                self._cond.notify()
         return pending
 
     def stop(self, drain: bool = True) -> None:
@@ -318,7 +356,7 @@ class ServeEngine:
             with self._cond:
                 if not self._running:
                     return
-                now = time.time()
+                now = self.tracer.now()   # same monotonic clock as enqueue
                 next_deadline = None
                 for key, entries in self._queue.items():
                     while len(entries) >= self.max_batch:

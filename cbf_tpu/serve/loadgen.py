@@ -1,0 +1,192 @@
+"""Open-loop heavy-tailed load generation for the serving engine.
+
+Sustained requests/s and p50/p99 latency under mixed traffic are the
+axis that matters at scale (ROADMAP item 2) — and they can only be
+measured against a generator that does NOT wait for responses: a
+closed-loop driver throttles itself when the server slows down and
+hides queueing collapse. This one is open-loop: arrivals are scheduled
+up front (Poisson process at ``rps``) and submitted on the wall clock
+regardless of completion, so queue-wait genuinely accumulates when the
+engine falls behind.
+
+Traffic shape: request sizes are bounded-Pareto distributed
+(heavy-tailed — many small swarms, occasional big ones) over the
+engine's existing power-of-two bucket ladder; horizons and the traced
+float knobs (safety_distance, consensus_gain) vary per request, so the
+mix exercises exactly the traced-config split the serving layer exists
+for. Everything is seeded (`numpy.random.default_rng(seed)`): the same
+spec replays the same schedule bit-for-bit (AUD004).
+
+Entry points: :func:`build_schedule` (pure, inspectable),
+:func:`run_loadgen` (drive an engine, return the SLO report),
+``python -m cbf_tpu loadgen`` (CLI), and bench.py's ``BENCH_SLO=1``
+mode (docs/BENCH_LOG.md Round 10).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from cbf_tpu.scenarios import swarm
+
+#: Generic telemetry event types this module emits (AUD001-audited
+#: against obs.schema.LOADGEN_EVENT_TYPES).
+EMITTED_EVENT_TYPES: tuple[str, ...] = ("loadgen.summary",)
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadSpec:
+    """One loadgen run's knobs (all seeded/deterministic).
+
+    ``rps`` — offered Poisson arrival rate (requests/s).
+    ``duration_s`` — arrival window; requests submitted in [0, duration).
+    ``n_min``/``n_max`` — bounded-Pareto request-size support.
+    ``pareto_alpha`` — tail index (smaller = heavier tail; 1.3 gives a
+    realistic many-small/few-large mix).
+    ``steps_choices`` — horizon mix (uniform over these).
+    """
+    rps: float = 8.0
+    duration_s: float = 5.0
+    seed: int = 0
+    n_min: int = 8
+    n_max: int = 96
+    pareto_alpha: float = 1.3
+    steps_choices: tuple[int, ...] = (20, 40, 60)
+    gating: str = "jnp"
+
+
+def bounded_pareto(rng: np.random.Generator, alpha: float, lo: float,
+                   hi: float, size=None):
+    """Inverse-CDF samples of the bounded Pareto distribution on
+    [lo, hi] with tail index ``alpha``."""
+    if not (0 < lo <= hi):
+        raise ValueError(f"need 0 < lo <= hi, got [{lo}, {hi}]")
+    u = rng.random(size)
+    la, ha = lo ** alpha, hi ** alpha
+    return (-(u * ha - u * la - ha) / (ha * la)) ** (-1.0 / alpha)
+
+
+def build_schedule(spec: LoadSpec) -> list[tuple[float, swarm.Config]]:
+    """The full arrival schedule for one run: sorted
+    ``(arrival_offset_s, config)`` pairs. Pure function of the spec —
+    same seed, same schedule — so a run can be replayed or inspected
+    without driving an engine."""
+    if spec.rps <= 0 or spec.duration_s <= 0:
+        raise ValueError(f"rps and duration_s must be > 0, got "
+                         f"rps={spec.rps}, duration_s={spec.duration_s}")
+    rng = np.random.default_rng(spec.seed)
+    out: list[tuple[float, swarm.Config]] = []
+    t = float(rng.exponential(1.0 / spec.rps))
+    i = 0
+    while t < spec.duration_s:
+        n = int(np.clip(round(float(bounded_pareto(
+            rng, spec.pareto_alpha, spec.n_min, spec.n_max))),
+            spec.n_min, spec.n_max))
+        steps = int(spec.steps_choices[int(rng.integers(
+            len(spec.steps_choices)))])
+        # Same knob mix as bench.serve_workload: small seeded jitter on
+        # the traced floats — fresh scalars per request, known-safe
+        # ranges (the safety gates hold over them).
+        cfg = swarm.Config(
+            n=n, steps=steps, seed=i, gating=spec.gating,
+            safety_distance=0.4 + 0.003 * int(rng.integers(5)),
+            consensus_gain=1.0 + 0.01 * int(rng.integers(16)))
+        out.append((t, cfg))
+        t += float(rng.exponential(1.0 / spec.rps))
+        i += 1
+    return out
+
+
+def _quantile(sorted_vals: list[float], q: float) -> float | None:
+    """Exact linear-interpolated quantile of an already-sorted list."""
+    if not sorted_vals:
+        return None
+    pos = q * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * (pos - lo)
+
+
+def run_loadgen(engine, spec: LoadSpec, *, telemetry=None,
+                result_timeout_s: float = 300.0) -> dict:
+    """Drive ``engine`` with the spec's open-loop schedule and return
+    the SLO report: sustained RPS + end-to-end latency percentiles +
+    queue-wait/execute breakdown.
+
+    The engine should be prewarmed for the schedule's buckets (use
+    ``engine.prewarm([cfg for _, cfg in build_schedule(spec)])``) —
+    otherwise the first request of each bucket pays its compile inside
+    the measured window, which is a cold-start measurement, not a
+    sustained-rate one. Starts (and then stops) the engine's scheduler
+    thread if the caller has not already."""
+    schedule = build_schedule(spec)
+    started_here = not engine._running
+    if started_here:
+        engine.start()
+    pendings = []
+    t_start = time.perf_counter()
+    try:
+        for arrival_s, cfg in schedule:
+            # Open-loop: sleep to the scheduled arrival, never await
+            # results — lateness here (the generator falling behind)
+            # is reported, not silently absorbed.
+            delay = t_start + arrival_s - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            pendings.append(engine.submit(cfg))
+        results, errors = [], 0
+        for p in pendings:
+            try:
+                results.append(p.result(timeout=result_timeout_s))
+            except Exception:
+                errors += 1
+        drained_s = time.perf_counter() - t_start
+    finally:
+        if started_here:
+            engine.stop(drain=True)
+
+    lat = sorted(r.latency_s for r in results)
+    qwait = sorted(r.queue_wait_s for r in results)
+    execu = sorted(r.execute_s for r in results)
+    completed = len(results)
+    report = {
+        "seed": spec.seed,
+        "offered_rps": round(spec.rps, 3),
+        "achieved_rps": round(completed / drained_s, 3) if drained_s else 0.0,
+        "requests": len(schedule),
+        "completed": completed,
+        "errors": errors,
+        "duration_s": round(drained_s, 3),
+        "latency_p50_s": _quantile(lat, 0.50),
+        "latency_p95_s": _quantile(lat, 0.95),
+        "latency_p99_s": _quantile(lat, 0.99),
+        "latency_max_s": lat[-1] if lat else None,
+        "queue_wait_p50_s": _quantile(qwait, 0.50),
+        "queue_wait_p99_s": _quantile(qwait, 0.99),
+        "execute_p50_s": _quantile(execu, 0.50),
+        "execute_p99_s": _quantile(execu, 0.99),
+        "batch_fill_mean": (round(float(np.mean([r.batch_fill
+                                                 for r in results])), 2)
+                            if results else None),
+        # Safety aggregates over every served request — the loadgen is
+        # still a safety-filter workload, so bench gates hold over it.
+        "min_pairwise_distance": (min(float(np.min(
+            r.outputs.min_pairwise_distance)) for r in results)
+            if results else None),
+        "infeasible_count": (sum(int(np.sum(r.outputs.infeasible_count))
+                                 for r in results) if results else None),
+    }
+    for k, v in list(report.items()):
+        if isinstance(v, float):
+            report[k] = round(v, 6)
+    if telemetry is not None:
+        telemetry.event("loadgen.summary", {
+            k: report[k] for k in (
+                "seed", "offered_rps", "achieved_rps", "requests",
+                "completed", "duration_s", "latency_p50_s",
+                "latency_p95_s", "latency_p99_s", "queue_wait_p99_s",
+                "execute_p99_s")})
+    return report

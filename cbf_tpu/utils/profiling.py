@@ -6,9 +6,9 @@ Three levels:
   loadable protos (XLA op timeline, HBM usage) for a code region.
 - :func:`annotate` — named sub-regions (QP solve, neighbor search,
   integration) that show up as spans inside the device trace.
-- :func:`cost_analysis` / :func:`compile_stats` — static XLA cost model
-  (FLOPs, bytes accessed) and compile-cache counters for a jitted function,
-  usable in tests and benchmarks without running a profiler.
+- :func:`cost_analysis` / :func:`compile_event_counts` — static XLA cost
+  model (FLOPs, bytes accessed) and compile-cache counters for a jitted
+  function, usable in tests and benchmarks without running a profiler.
 """
 
 from __future__ import annotations
@@ -100,12 +100,6 @@ def add_event_count(name: str, value: int = 1) -> None:
     per-bucket executable hit/miss and prewarm wall time) with no
     parallel plumbing."""
     _event_counts[name] = _event_counts.get(name, 0) + int(value)
-
-
-def compile_stats() -> dict[str, int]:
-    """Deprecated alias of :func:`compile_event_counts` (pre-round-7 name,
-    kept for callers)."""
-    return compile_event_counts()
 
 
 class StepTimer:

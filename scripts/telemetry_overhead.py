@@ -11,11 +11,20 @@ under 8 virtual CPU devices the callback machinery costs ~5x its real
 single-device cost — a harness artifact the budget does not govern, so
 the measurement controls its own backend).
 
+``--mode spans`` measures the OTHER instrumentation path under the same
+<= 3% budget (ISSUE 7 / docs/BENCH_LOG.md Round 10): request-lifecycle
+span tracing in the serving engine. Tracer-on vs Tracer(enabled=False)
+legs of the same prewarmed mixed batch through ServeEngine.run, same
+interleaved min-of-R discipline. All span work is host-side (perf_counter
+reads + list appends around the dispatch), so the budget governs the
+engine's request wall, not device time.
+
 Prints one JSON line: {n, steps, every, reps, off_s, on_s, overhead,
-heartbeats, platform}.
+heartbeats, platform} (mode=rollout) or {mode, b, n_base, steps, reps,
+off_s, on_s, overhead, spans, platform} (mode=spans).
 
 Usage: python scripts/telemetry_overhead.py [--n 1024] [--steps 300]
-       [--every 50] [--reps 5]
+       [--every 50] [--reps 5] [--mode rollout|spans]
 """
 
 from __future__ import annotations
@@ -67,14 +76,69 @@ def measure(n: int, steps: int, every: int, reps: int) -> dict:
             "platform": jax.devices()[0].platform}
 
 
+def measure_spans(b: int, n_base: int, steps: int, reps: int) -> dict:
+    """Span-tracing overhead on the serve path: the SAME fixed mixed
+    batch served with the engine's tracer enabled vs replaced by a
+    disabled one. Bucket executables are prewarmed once and shared, so
+    the legs differ only in the host-side span bookkeeping."""
+    import jax
+
+    from cbf_tpu.obs.trace import Tracer
+    from cbf_tpu.scenarios import swarm
+    from cbf_tpu.serve import ServeEngine
+
+    cfgs = [swarm.Config(n=max(4, n_base // (2 ** (i % 3))), steps=steps,
+                         seed=i, gating="jnp",
+                         safety_distance=0.4 + 0.003 * (i % 5))
+            for i in range(b)]
+    engine = ServeEngine(max_batch=8)
+    engine.prewarm(cfgs)
+    tracer_on = engine.tracer
+    tracer_off = Tracer(enabled=False)
+
+    def one(tracer) -> float:
+        engine.tracer = tracer
+        t0 = time.perf_counter()
+        engine.run(cfgs)
+        return time.perf_counter() - t0
+
+    one(tracer_on), one(tracer_off)       # warm both paths end to end
+    offs, ons = [], []
+    for i in range(reps):
+        legs = ((offs, tracer_off), (ons, tracer_on))
+        for acc, tr in (legs if i % 2 == 0 else legs[::-1]):
+            acc.append(one(tr))
+    engine.tracer = tracer_on
+    off_s, on_s = min(offs), min(ons)
+    return {"mode": "spans", "b": b, "n_base": n_base, "steps": steps,
+            "reps": reps, "off_s": round(off_s, 4),
+            "on_s": round(on_s, 4),
+            "overhead": round((on_s - off_s) / off_s, 4),
+            "spans": len(tracer_on.spans),
+            "platform": jax.devices()[0].platform}
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--n", type=int, default=1024)
     p.add_argument("--steps", type=int, default=300)
     p.add_argument("--every", type=int, default=50)
     p.add_argument("--reps", type=int, default=5)
+    p.add_argument("--mode", choices=("rollout", "spans"),
+                   default="rollout")
+    p.add_argument("--b", type=int, default=12,
+                   help="request count for --mode spans")
     args = p.parse_args()
-    print(json.dumps(measure(args.n, args.steps, args.every, args.reps)))
+    if args.mode == "spans":
+        # Spans budget is per-request wall at serving sizes; the rollout
+        # defaults (N=1024) would swamp the signal with device time, so
+        # spans mode sizes down and serves a mixed batch instead.
+        n_base = args.n if args.n != 1024 else 32
+        steps = args.steps if args.steps != 300 else 40
+        print(json.dumps(measure_spans(args.b, n_base, steps, args.reps)))
+    else:
+        print(json.dumps(measure(args.n, args.steps, args.every,
+                                 args.reps)))
     return 0
 
 

@@ -49,7 +49,70 @@ def test_initialize_noop_without_cluster():
     assert "SINGLE_OK" in out.stdout
 
 
-@pytest.mark.skip(reason="pre-existing (PR 1): two-process Gloo/distributed init fails in this container (worker subprocess exits rc=1)")
+_INIT_WORKER = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from cbf_tpu.parallel import multihost
+pid, port = int(sys.argv[1]), int(sys.argv[2])
+multihost.initialize(coordinator_address=f"localhost:{port}",
+                     num_processes=2, process_id=pid)
+multihost.initialize(coordinator_address=f"localhost:{port}",
+                     num_processes=2, process_id=pid)   # idempotent
+assert multihost.process_info() == (pid, 2)
+assert multihost.is_primary() == (pid == 0)
+assert len(jax.devices()) == 8, len(jax.devices())       # global view
+assert len(jax.local_devices()) == 4
+mesh = multihost.global_mesh(n_sp=2)                     # dp=4 x sp=2
+assert mesh.devices.size == 8
+print(f"INIT_OK process={pid}/2", flush=True)
+"""
+
+
+def test_two_process_distributed_init():
+    """The part of the multi-host story this container CAN execute: two
+    OS processes join one distributed runtime over the Gloo coordinator,
+    see one global 8-device view (4 local + 4 remote virtual CPU
+    devices), agree on primary-ness, and build the global (dp, sp) mesh.
+    Everything up to — but not including — running a cross-process XLA
+    computation (see the skip below for why that part cannot run)."""
+    port = _free_port()
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen([sys.executable, "-c", _INIT_WORKER, str(i),
+                          str(port)],
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True, env=env, cwd=repo)
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=200)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {i} failed:\n{out}"
+        assert f"INIT_OK process={i}/2" in out, out
+
+
+@pytest.mark.skip(reason=(
+    "diagnosed 2026-08-05: NOT a Gloo failure — jax.distributed.initialize, "
+    "Gloo coordination, the global 8-device view and the (dp, sp) mesh "
+    "all succeed across 2 processes (pinned by "
+    "test_two_process_distributed_init above). The workers die later, at "
+    "first cross-process EXECUTION: jaxlib 0.4.36's CPU client raises "
+    "'INVALID_ARGUMENT: Multiprocess computations aren't implemented on "
+    "the CPU backend' from sharded_swarm_rollout's executable, so the "
+    "sharded rollout / process-spanning gather / multi-host checkpoint "
+    "cannot run off-TPU in this container. Unskip on a jaxlib whose CPU "
+    "collectives execute cross-process, or on real multi-host TPU."))
 def test_two_process_sharded_rollout(tmp_path):
     port = _free_port()
     env = dict(os.environ)

@@ -31,20 +31,21 @@ def test_cost_analysis_reports_flops():
     assert costs.get("flops", 0) >= 32 * 16 * 8
 
 
-def test_compile_stats_counts_fresh_compiles_not_cache_hits():
+def test_compile_event_counts_fresh_compiles_not_cache_hits():
     def fresh(x):   # unique function object => guaranteed fresh jit entry
         return x * 2.5 + 1.0
 
     jf = jax.jit(fresh)
-    before = profiling.compile_stats()
+    before = profiling.compile_event_counts()
     jf(jnp.ones(11)).block_until_ready()
-    after_compile = profiling.compile_stats()
+    after_compile = profiling.compile_event_counts()
     key = "/jax/core/compile/backend_compile_duration"
     assert after_compile.get(key, 0) > before.get(key, 0)
 
     # Same jitted call again: executable reused, counter must not grow.
     jf(jnp.ones(11)).block_until_ready()
-    assert profiling.compile_stats().get(key) == after_compile.get(key)
+    assert profiling.compile_event_counts().get(key) == \
+        after_compile.get(key)
 
 
 def test_checked_rollout_clean_and_dirty():

@@ -279,6 +279,10 @@ def test_checkpoint_resume_in_phase_with_obstacles(tmp_path):
                                   np.asarray(ref_final.x))
 
 
+# slow: ~12 s 800-step soak; tier-1 keeps the obstacle floor via the
+# moderate-obstacles, ladder-scale, and sharded-parity tests in this file
+# (the soak adds horizon length, not a distinct contract).
+@pytest.mark.slow
 def test_long_horizon_steady_state_recovers_full_floor():
     """Obstacles lapping repeatedly through the packed crowd: after the
     migration transient the system settles to the exact L1 floor and stays

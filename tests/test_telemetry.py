@@ -162,7 +162,8 @@ def test_compile_event_counts_public_accessors():
     after = profiling.compile_event_counts()
     key = "/jax/core/compile/backend_compile_duration"
     assert after.get(key, 0) > before.get(key, 0)
-    assert profiling.compile_stats() == after   # deprecated alias
+    # The pre-round-7 compile_stats alias is gone — one accessor path.
+    assert not hasattr(profiling, "compile_stats")
     profiling.reset_compile_event_counts()
     assert profiling.compile_event_counts() == {}
     # Counting resumes after reset (listeners stay registered).
