@@ -102,6 +102,20 @@ Modes / env knobs:
     BENCH_SLO_SEED (0), BENCH_SLO_NMIN (8), BENCH_SLO_NMAX (96),
     BENCH_SLO_ALPHA (1.3), BENCH_SLO_MAX_BATCH (8), BENCH_SLO_FLUSH
     (0.05). See docs/BENCH_LOG.md Round 10.
+  BENCH_CHAOS=1 — fault-tolerance goodput mode (serve.resilience +
+    utils.faults): the SAME seeded loadgen traffic twice through one
+    engine — a fault-free leg, then a chaos leg with a fixed injection
+    mix (every BENCH_CHAOS_POISON-th request poisoned, transient
+    executor faults, periodic latency spikes). Reports goodput and p99
+    for both legs, the goodput retention ratio, the typed-error census
+    and the engine's retry/shed/quarantine counters; fails the round if
+    any request hangs (completed + errors != requests) or a healthy
+    request is lost to a fault. Knobs: BENCH_CHAOS_RPS (8.0),
+    BENCH_CHAOS_DURATION (10.0), BENCH_CHAOS_SEED (0),
+    BENCH_CHAOS_POISON (7), BENCH_CHAOS_EXEC_FAULTS (2),
+    BENCH_CHAOS_SPIKE_S (0.1), BENCH_CHAOS_SPIKE_EVERY (10), plus the
+    BENCH_SLO_NMIN/NMAX/ALPHA/MAX_BATCH/FLUSH sizing knobs. See
+    docs/BENCH_LOG.md Round 11.
   BENCH_ENSEMBLE=1 (or --ensemble) — dp-sharded ensemble of independent
     swarms over all available devices (the multi-chip measurement path for
     the v4-8 ladder rung); adds "chips" + "scaling_efficiency" fields.
@@ -1251,6 +1265,140 @@ def _child_slo(steps: int) -> dict:
     return result
 
 
+def _child_chaos(steps: int) -> dict:
+    """BENCH_CHAOS mode: fault-tolerance goodput harness
+    (cbf_tpu.serve.resilience + cbf_tpu.utils.faults). Drives the SAME
+    seeded open-loop schedule through one prewarmed engine twice — a
+    fault-free baseline leg, then a chaos leg with a fixed injection
+    mix: every BENCH_CHAOS_POISON-th request's config poisoned
+    (`faults.poison_config` — non-finite in its own vmapped lane),
+    BENCH_CHAOS_EXEC_FAULTS transient executor faults, and a
+    BENCH_CHAOS_SPIKE_S latency spike every BENCH_CHAOS_SPIKE_EVERY-th
+    batch. Reports goodput (healthy completions / wall) and p99 for
+    both legs plus the engine's retry/shed/quarantine/nonfinite
+    counters — the number the fault-tolerance conversation needs is the
+    goodput RETENTION ratio under faults, not peak throughput.
+
+    Two hard gates: every request must RESOLVE (completed + errors ==
+    requests — the zero-hang invariant), and no healthy request may be
+    lost to a neighbor's fault (errors <= poisoned + shed + deadline-
+    expired). Safety-gated over the healthy completions like every
+    serve record."""
+    import jax
+    import numpy as np   # noqa: F401  (parity with sibling modes)
+
+    from cbf_tpu.serve import FaultPolicy, LoadSpec, ServeEngine, \
+        build_schedule, run_loadgen
+    from cbf_tpu.utils import faults
+
+    rps = _env_float("BENCH_CHAOS_RPS", 8.0)
+    duration = _env_float("BENCH_CHAOS_DURATION", 10.0)
+    seed = _env_int("BENCH_CHAOS_SEED", 0)
+    poison_every = _env_int("BENCH_CHAOS_POISON", 7)
+    # Transient injections default to <= the policy's max_retries (2):
+    # a burst the retry budget is provisioned for always recovers, so
+    # the poison is the ONLY intended casualty source and the
+    # blast-radius gate below can be exact. Raising EXEC_FAULTS past
+    # max_retries makes a retry-exhausted singleton batch a legitimate
+    # casualty the gate will flag.
+    exec_faults = _env_int("BENCH_CHAOS_EXEC_FAULTS", 2)
+    spike_s = _env_float("BENCH_CHAOS_SPIKE_S", 0.1)
+    spike_every = _env_int("BENCH_CHAOS_SPIKE_EVERY", 10)
+    n_min = _env_int("BENCH_SLO_NMIN", 8)
+    n_max = _env_int("BENCH_SLO_NMAX", 96)
+    alpha = _env_float("BENCH_SLO_ALPHA", 1.3)
+    max_batch = _env_int("BENCH_SLO_MAX_BATCH", 8)
+    flush = _env_float("BENCH_SLO_FLUSH", 0.05)
+
+    spec = LoadSpec(rps=rps, duration_s=duration, seed=seed, n_min=n_min,
+                    n_max=n_max, pareto_alpha=alpha)
+    engine = ServeEngine(max_batch=max_batch, flush_deadline_s=flush,
+                         fault_policy=FaultPolicy())
+    schedule = build_schedule(spec)
+    print(f"bench: chaos rps={rps} duration={duration}s "
+          f"requests={len(schedule)} poison_every={poison_every} "
+          f"exec_faults={exec_faults} spike={spike_s}s/{spike_every} "
+          f"max_batch={max_batch} cache_dir={engine.cache_dir}",
+          file=sys.stderr)
+    prewarm_s = engine.prewarm([cfg for _, cfg in schedule])
+
+    base = run_loadgen(engine, spec)
+    if base["errors"]:
+        return {"error": f"fault-free leg: {base['errors']}/"
+                         f"{base['requests']} requests failed",
+                "retryable": False}
+    base_stats = dict(engine.stats)
+
+    def mutate(i, cfg):
+        if poison_every and (i + 1) % poison_every == 0:
+            return faults.poison_config(cfg)
+        return cfg
+
+    engine.fault_hook = faults.serve_chaos_hook(
+        faults.serve_executor_fault(times=exec_faults),
+        faults.serve_latency_spike(spike_s, every=spike_every))
+    try:
+        chaos = run_loadgen(engine, spec, mutate=mutate)
+    finally:
+        engine.fault_hook = None
+    delta = {k: engine.stats[k] - base_stats[k]
+             for k in ("retries", "bisects", "nonfinite", "quarantined",
+                       "shed", "deadline_expired", "failed")}
+
+    resolved = chaos["completed"] + chaos["errors"]
+    if resolved != chaos["requests"]:
+        return {"error": f"chaos leg hung: {resolved}/{chaos['requests']} "
+                         f"requests resolved", "retryable": False}
+    poisoned = len(schedule) // poison_every if poison_every else 0
+    tolerated = poisoned + delta["shed"] + delta["deadline_expired"] \
+        + delta["quarantined"]
+    if chaos["errors"] > tolerated:
+        return {"error": f"blast radius: {chaos['errors']} errors > "
+                         f"{tolerated} injected+shed+expired — a healthy "
+                         f"request was lost to a neighbor's fault",
+                "retryable": False}
+    err = _check_safety(chaos["min_pairwise_distance"],
+                        chaos["infeasible_count"],
+                        floor=_dynamics_floor("single"))
+    if err:
+        return {"error": err, "retryable": False}
+
+    # achieved_rps is already goodput: completed (healthy only) / wall.
+    base_goodput = base["achieved_rps"]
+    chaos_goodput = chaos["achieved_rps"]
+    print(f"bench: chaos goodput={chaos_goodput} rps "
+          f"(fault-free {base_goodput}), p99 {chaos['latency_p99_s']}s vs "
+          f"{base['latency_p99_s']}s, errors={chaos['errors']} "
+          f"({chaos.get('errors_by_type')}), faults={delta}",
+          file=sys.stderr)
+    result = {
+        "metric": (f"serve goodput under faults (poison 1/{poison_every}, "
+                   f"{exec_faults} exec faults, open-loop {rps} rps)"),
+        "value": chaos_goodput,
+        "unit": "requests_per_sec",
+        "vs_baseline": 0,   # a robustness axis, not the headline rate
+        "chaos": True,
+        "max_batch": max_batch,
+        "flush_deadline_s": flush,
+        "prewarm_s": round(prewarm_s, 3),
+        "poison_every": poison_every,
+        "exec_faults": exec_faults,
+        "spike_s": spike_s,
+        "spike_every": spike_every,
+        "faultfree_goodput_rps": base_goodput,
+        "faultfree_p99_s": base["latency_p99_s"],
+        "goodput_retention": round(chaos_goodput / base_goodput, 3)
+        if base_goodput else 0,
+        "fault_counters": delta,
+        "errors_by_type": chaos.get("errors_by_type", {}),
+        "buckets": engine.manifest_extra()["serve"]["buckets"],
+        "cache_dir": engine.cache_dir,
+        "platform": jax.devices()[0].platform,
+        **chaos,
+    }
+    return result
+
+
 def _is_permanent_error(e: BaseException) -> bool:
     """Transient device/tunnel deaths raise (XlaRuntimeError: connection
     reset / DEADLINE_EXCEEDED / UNAVAILABLE) rather than hang — those must
@@ -1286,6 +1434,8 @@ def child_main(result_path: str, ensemble: bool) -> None:
     try:
         if os.environ.get("BENCH_VERIFY", "0") == "1":
             result = _child_verify(steps)
+        elif os.environ.get("BENCH_CHAOS", "0") == "1":
+            result = _child_chaos(steps)
         elif os.environ.get("BENCH_SLO", "0") == "1":
             result = _child_slo(steps)
         elif os.environ.get("BENCH_SERVE", "0") == "1":
@@ -1398,6 +1548,8 @@ def main() -> None:
 
     if os.environ.get("BENCH_VERIFY", "0") == "1":
         label = "verify N=%d" % _env_int("BENCH_VERIFY_N", 256)
+    elif os.environ.get("BENCH_CHAOS", "0") == "1":
+        label = "chaos rps=%g" % _env_float("BENCH_CHAOS_RPS", 8.0)
     elif os.environ.get("BENCH_SLO", "0") == "1":
         label = "slo rps=%g" % _env_float("BENCH_SLO_RPS", 8.0)
     elif os.environ.get("BENCH_SERVE", "0") == "1":

@@ -322,6 +322,37 @@ def _load_requests(path: str):
     return cfgs
 
 
+def _add_fault_policy_args(parser) -> None:
+    """The serving fault-tolerance knobs shared by `serve` and `loadgen`
+    (docs/API.md "Fault tolerance"). Defaults mirror
+    serve.resilience.FaultPolicy: retries/bisection/finite-checking on,
+    admission control and deadlines off."""
+    parser.add_argument("--max-retries", type=int, default=2,
+                        help="bounded backoff retries per transient batch "
+                             "failure (default 2)")
+    parser.add_argument("--queue-limit", type=int, default=None,
+                        help="bound the total queued request count; "
+                             "beyond it, submits shed per --shed-policy "
+                             "(default: unbounded)")
+    parser.add_argument("--shed-policy", default="reject-newest",
+                        choices=("reject-newest", "reject-oldest"),
+                        help="what to shed when the bounded queue is "
+                             "full (default reject-newest)")
+    parser.add_argument("--deadline", type=float, default=None,
+                        help="per-request deadline in seconds; expired "
+                             "requests fail fast with DeadlineExceeded "
+                             "(default: none)")
+
+
+def _fault_policy_from(args):
+    from cbf_tpu.serve import FaultPolicy
+
+    return FaultPolicy(max_retries=args.max_retries,
+                       queue_limit=args.queue_limit,
+                       shed_policy=args.shed_policy,
+                       deadline_s=args.deadline)
+
+
 def cmd_serve(args) -> int:
     """Batch-serve a request file through the serving engine (offline
     drain mode): bucket by static signature, pack same-bucket requests
@@ -350,7 +381,8 @@ def cmd_serve(args) -> int:
         sink = obs.TelemetrySink(args.telemetry_dir)
     engine = ServeEngine(max_batch=args.max_batch,
                          flush_deadline_s=args.flush_deadline,
-                         cache_dir=args.cache_dir, telemetry=sink)
+                         cache_dir=args.cache_dir, telemetry=sink,
+                         fault_policy=_fault_policy_from(args))
     prewarm_s = None
     if args.prewarm or args.prewarm_only:
         prewarm_s = engine.prewarm(cfgs)
@@ -444,7 +476,8 @@ def cmd_loadgen(args) -> int:
         sink = obs.TelemetrySink(args.telemetry_dir)
     engine = ServeEngine(max_batch=args.max_batch,
                          flush_deadline_s=args.flush_deadline,
-                         cache_dir=args.cache_dir, telemetry=sink)
+                         cache_dir=args.cache_dir, telemetry=sink,
+                         fault_policy=_fault_policy_from(args))
     schedule = build_schedule(spec)
     prewarm_s = engine.prewarm([cfg for _, cfg in schedule])
     if sink is not None:
@@ -778,6 +811,7 @@ def main(argv=None) -> int:
                         help="write a serve run directory: manifest with "
                              "bucket/compile attribution + one 'request' "
                              "event per served request")
+    _add_fault_policy_args(servep)
     servep.set_defaults(fn=cmd_serve)
 
     loadp = sub.add_parser(
@@ -825,6 +859,7 @@ def main(argv=None) -> int:
                        help="also write a jax.profiler device trace "
                             "here — device time attributes to the same "
                             "phase names as the host spans")
+    _add_fault_policy_args(loadp)
     loadp.set_defaults(fn=cmd_loadgen)
 
     verp = sub.add_parser(

@@ -161,6 +161,37 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
                     f"serve event type {etype!r} has no {table_name} "
                     "payload declaration")
 
+    # Emit-site check (the "both ways" leg of the contract): every type
+    # an emitter DECLARES must also have a literal emit call site in
+    # that module (`.event("type", ...)` or the engine's `._emit(...)`
+    # wrapper) — otherwise the schema and docs advertise an event
+    # nothing can ever produce, which is drift just as surely as an
+    # undeclared emitter. Literal-string first arguments only: every
+    # emitter in this repo names its event types inline, and keeping it
+    # that way is what makes this check (and grep) possible.
+    import inspect
+    for mod in (verify_search, serve_engine, obs_trace, serve_loadgen):
+        try:
+            mod_tree = ast.parse(inspect.getsource(mod))
+        except (OSError, TypeError):
+            problems.append(f"cannot read source of {mod.__name__} for "
+                            "the emit-site check")
+            continue
+        emit_sites = set()
+        for node in ast.walk(mod_tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("event", "_emit") \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                emit_sites.add(node.args[0].value)
+        for etype in mod.EMITTED_EVENT_TYPES:
+            if etype not in emit_sites:
+                problems.append(
+                    f"{mod.__name__} declares emitted event type {etype!r} "
+                    "but has no literal .event()/._emit() call site for it")
+
     # Docs: every heartbeat field + alert kind + verify event must be
     # documented.
     api_path = os.path.join(repo, "docs", "API.md")

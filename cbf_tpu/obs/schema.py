@@ -126,18 +126,42 @@ VERIFY_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
 #: per-request attribution record ServeEngine writes on resolve (latency
 #: breakdown + safety metrics), ``serve.span`` is one request-lifecycle
 #: span from the ``obs.trace`` tracer (enqueue / queue_wait / pack /
-#: compile / executable_hit / execute / unpack / resolve). Same AUD001
-#: contract as the verify events: the emitters' ``EMITTED_EVENT_TYPES``
-#: (serve.engine + obs.trace) must union to this tuple, and every type
-#: and field must be documented in docs/API.md.
-SERVE_EVENT_TYPES: tuple[str, ...] = ("request", "serve.span")
+#: compile / executable_hit / execute / unpack / resolve), and the
+#: ``serve.retry`` / ``serve.shed`` / ``serve.quarantine`` /
+#: ``serve.degrade`` / ``serve.scheduler_crash`` family records every
+#: fault-tolerance recovery decision (PR 8): one event per backoff retry
+#: or bisect, per shed/evicted/deadline-dropped request, per circuit-
+#: breaker transition, per degradation enter/exit, and per scheduler-
+#: thread crash. Same AUD001 contract as the verify events: the
+#: emitters' ``EMITTED_EVENT_TYPES`` (serve.engine + obs.trace) must
+#: union to this tuple, every declared type must have a literal emit
+#: site, and every type and field must be documented in docs/API.md.
+SERVE_EVENT_TYPES: tuple[str, ...] = (
+    "request", "serve.span", "serve.retry", "serve.shed",
+    "serve.quarantine", "serve.degrade", "serve.scheduler_crash")
 
 SERVE_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
     "request": ("request_id", "bucket", "n", "steps", "latency_s",
-                "queue_wait_s", "execute_s", "batch_fill",
+                "queue_wait_s", "execute_s", "batch_fill", "degraded",
                 "min_pairwise_distance", "infeasible_count"),
     "serve.span": ("trace_id", "span_id", "parent_id", "name", "bucket",
                    "t0_s", "dur_s"),
+    # action: "retry" (backoff re-run of the whole batch) | "bisect"
+    # (split to isolate the offender); attempt is 1-based for retries.
+    "serve.retry": ("bucket", "action", "attempt", "batch_size",
+                    "backoff_s", "error"),
+    # reason: "queue_full" (reject-newest refused the submit) |
+    # "oldest_evicted" (reject-oldest made room) | "deadline" (expired
+    # before execute).
+    "serve.shed": ("request_id", "bucket", "reason", "queue_depth"),
+    # scope: "request" (signature breaker) | "bucket" (compile breaker);
+    # state: "open" on trip, "closed" on recovery; signature is the
+    # request signature or the bucket label per scope.
+    "serve.quarantine": ("scope", "signature", "state", "failures",
+                         "bucket"),
+    # state: "enter" | "exit"; steps_frac is the horizon cap in effect.
+    "serve.degrade": ("state", "queue_depth", "steps_frac"),
+    "serve.scheduler_crash": ("error", "resolved"),
 }
 
 #: The load generator's run-end record (``serve.loadgen``): offered vs
@@ -147,8 +171,8 @@ LOADGEN_EVENT_TYPES: tuple[str, ...] = ("loadgen.summary",)
 
 LOADGEN_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
     "loadgen.summary": ("seed", "offered_rps", "achieved_rps", "requests",
-                        "completed", "duration_s", "latency_p50_s",
-                        "latency_p95_s", "latency_p99_s",
+                        "completed", "errors", "duration_s",
+                        "latency_p50_s", "latency_p95_s", "latency_p99_s",
                         "queue_wait_p99_s", "execute_p99_s"),
 }
 

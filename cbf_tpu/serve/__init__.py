@@ -4,7 +4,9 @@ Heavy traffic is many heterogeneous small rollout requests, not one big
 rollout — this package routes them onto the compiled machinery the rest
 of the framework already owns. See `serve.buckets` (static signatures),
 `serve.pack` (padded-agent packing), `serve.engine` (queue, micro-batch
-formation, prewarm, persistent-cache knob), and docs/API.md "Serving".
+formation, prewarm, persistent-cache knob), `serve.resilience` (typed
+error taxonomy, retry/shed/quarantine/degrade policy) and docs/API.md
+"Serving" + "Fault tolerance".
 """
 
 from cbf_tpu.serve.buckets import (BucketKey, DEFAULT_BUCKET_SIZES,
@@ -13,10 +15,19 @@ from cbf_tpu.serve.buckets import (BucketKey, DEFAULT_BUCKET_SIZES,
 from cbf_tpu.serve.engine import (PendingRequest, RequestResult, ServeEngine,
                                   configure_compilation_cache)
 from cbf_tpu.serve.loadgen import LoadSpec, build_schedule, run_loadgen
+from cbf_tpu.serve.resilience import (CircuitBreaker, DeadlineExceeded,
+                                      FaultPolicy, NonFiniteResult,
+                                      QuarantinedError, RequestCancelled,
+                                      SchedulerCrashed, ServeError,
+                                      ShedError, is_retryable,
+                                      request_signature)
 
 __all__ = [
-    "BucketKey", "DEFAULT_BUCKET_SIZES", "DEFAULT_HORIZON_QUANTUM",
-    "LoadSpec", "PendingRequest", "RequestResult", "ServeEngine",
-    "bucket_horizon", "bucket_key", "bucket_n", "build_schedule",
-    "configure_compilation_cache", "run_loadgen",
+    "BucketKey", "CircuitBreaker", "DEFAULT_BUCKET_SIZES",
+    "DEFAULT_HORIZON_QUANTUM", "DeadlineExceeded", "FaultPolicy",
+    "LoadSpec", "NonFiniteResult", "PendingRequest", "QuarantinedError",
+    "RequestCancelled", "RequestResult", "SchedulerCrashed", "ServeEngine",
+    "ServeError", "ShedError", "bucket_horizon", "bucket_key", "bucket_n",
+    "build_schedule", "configure_compilation_cache", "is_retryable",
+    "request_signature", "run_loadgen",
 ]

@@ -1,4 +1,5 @@
-"""Request-serving engine: queue, micro-batch formation, AOT prewarm.
+"""Request-serving engine: queue, micro-batch formation, AOT prewarm,
+fault tolerance.
 
 The throughput layer over the compiled rollout machinery: many
 independent rollout requests (each a `scenarios.swarm.Config`) are
@@ -15,10 +16,24 @@ first's compilations. Executable hit/miss and prewarm wall time fold
 into the `utils.profiling` event counters, which the telemetry manifest
 snapshots.
 
+Failures are first-class (`serve.resilience`): a failed batch retries
+with bounded exponential backoff when transient, then BISECTS so only
+the offending request(s) fail (vmapped lanes are independent — a
+poisoned batch-mate cannot fail the other seven); non-finite per-slot
+results fail alone with `NonFiniteResult`; repeat offenders are
+quarantined per request signature and broken buckets per key (circuit
+breakers); `submit` applies admission control (bounded queue with a
+reject-newest/-oldest shed policy) and per-request deadlines; sustained
+overload degrades gracefully by capping the traced horizon mask (no
+recompile). Every recovery decision emits a schema-versioned telemetry
+event (`serve.retry` / `serve.shed` / `serve.quarantine` /
+`serve.degrade` / `serve.scheduler_crash`) and a registry counter.
+
 The scheduler (queue, deadlines, host clocks) is host-side by
 construction — nothing here runs inside traced scope except the packed
 rollout itself, which is exactly what the TS007/RC003 lint rules assert
-over this package.
+over this package. A scheduler-thread crash resolves every queued
+request with `SchedulerCrashed` instead of hanging them.
 """
 
 from __future__ import annotations
@@ -38,11 +53,14 @@ from cbf_tpu.parallel.ensemble import lockstep_traced_rollout
 from cbf_tpu.scenarios import swarm
 from cbf_tpu.serve import buckets as _buckets
 from cbf_tpu.serve import pack as _pack
+from cbf_tpu.serve import resilience
 from cbf_tpu.utils import profiling
 
 #: Generic telemetry event types this module emits (AUD001: together
 #: with obs.trace's, must union to obs.schema.SERVE_EVENT_TYPES).
-EMITTED_EVENT_TYPES: tuple[str, ...] = ("request",)
+EMITTED_EVENT_TYPES: tuple[str, ...] = (
+    "request", "serve.retry", "serve.shed", "serve.quarantine",
+    "serve.degrade", "serve.scheduler_crash")
 
 
 def configure_compilation_cache(cache_dir: str | None = None) -> str | None:
@@ -65,6 +83,18 @@ def configure_compilation_cache(cache_dir: str | None = None) -> str | None:
     return cache_dir
 
 
+def _all_finite(*trees) -> bool:
+    """Every float leaf of every tree is finite (the per-slot poison
+    check: XLA's min/max reductions swallow NaN, so the output channels
+    alone cannot be trusted to go non-finite — scan everything)."""
+    for tree in trees:
+        for leaf in jax.tree.leaves(tree):
+            arr = np.asarray(leaf)
+            if arr.dtype.kind == "f" and not np.all(np.isfinite(arr)):
+                return False
+    return True
+
+
 @dataclasses.dataclass
 class RequestResult:
     """One served request's outcome (host arrays, trimmed to the
@@ -72,24 +102,29 @@ class RequestResult:
     request_id: str
     bucket: str
     n: int
-    steps: int
+    steps: int              # effective horizon (capped when degraded)
     final_state: Any
     outputs: Any            # StepOutputs, time axes = steps
     latency_s: float        # submit -> result available
     queue_wait_s: float     # submit -> the batch's execute start
     execute_s: float        # the batch's device wall (shared by members)
     batch_fill: int         # real requests in the flushed batch
+    degraded: bool = False  # served under the overload degradation cap
 
 
 class PendingRequest:
     """Queue-mode handle: `result(timeout)` blocks until the scheduler
-    flushes the request's bucket."""
+    flushes the request's bucket; `cancel()` withdraws a still-queued
+    request so a caller that timed out does not leave a zombie occupying
+    a queue slot."""
 
     def __init__(self, request_id: str):
         self.request_id = request_id
         self._event = threading.Event()
         self._result: RequestResult | None = None
         self._error: BaseException | None = None
+        self._engine: "ServeEngine | None" = None
+        self._key = None
 
     def _resolve(self, result=None, error=None):
         self._result, self._error = result, error
@@ -105,6 +140,32 @@ class PendingRequest:
         if self._error is not None:
             raise self._error
         return self._result
+
+    def cancel(self) -> bool:
+        """Withdraw the request from its bucket queue. Returns True when
+        the request was removed (it then fails with `RequestCancelled`);
+        False when it is too late — already packed into a batch, already
+        resolved, or never queued — in which case nothing changes and
+        `result()` behaves as usual. Safe against the scheduler's flush:
+        removal and packing serialize on the engine's queue lock."""
+        engine = self._engine
+        if engine is None or self.done():
+            return False
+        with engine._cond:
+            entries = engine._queue.get(self._key)
+            if not entries:
+                return False
+            for i, entry in enumerate(entries):
+                if entry[0] is self:
+                    del entries[i]
+                    break
+            else:
+                return False
+            engine._count("cancelled")
+        self._resolve(error=resilience.RequestCancelled(
+            f"request {self.request_id} cancelled while queued",
+            request_id=self.request_id))
+        return True
 
 
 class ServeEngine:
@@ -122,12 +183,23 @@ class ServeEngine:
     always padded to ``max_batch`` (`serve.pack.stack_batch`), so a
     deadline-forced partial flush reuses the full-batch program instead
     of compiling a second one.
+
+    Fault tolerance is governed by ``fault_policy``
+    (`serve.resilience.FaultPolicy`; the default is always-on: retries,
+    bisection and finite-checking active, admission control and
+    deadlines off). ``fault_hook`` is the chaos seam: a callable
+    ``hook(key, entries, attempt, phase)`` invoked at ``phase`` in
+    {"compile", "execute"} before that stage of every batch — the
+    `utils.faults` serve injectors plug in here. ``degrade_hook``
+    optionally replaces the built-in horizon cap: called as
+    ``hook(key, steps_b) -> steps_b`` while degraded.
     """
 
     def __init__(self, *, max_batch: int = 8, flush_deadline_s: float = 0.05,
                  bucket_sizes: tuple[int, ...] = _buckets.DEFAULT_BUCKET_SIZES,
                  horizon_quantum: int = _buckets.DEFAULT_HORIZON_QUANTUM,
-                 cache_dir: str | None = None, telemetry=None, tracer=None):
+                 cache_dir: str | None = None, telemetry=None, tracer=None,
+                 fault_policy: resilience.FaultPolicy | None = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self.max_batch = max_batch
@@ -143,19 +215,49 @@ class ServeEngine:
         # per-phase histograms); pass Tracer(enabled=False) to kill it.
         self.tracer = tracer if tracer is not None \
             else obs_trace.Tracer(sink=telemetry)
+        self.fault_policy = fault_policy if fault_policy is not None \
+            else resilience.FaultPolicy()
+        self.fault_hook = None
+        self.degrade_hook = None
         self.prewarm_s: float | None = None
         self.stats = {"requests": 0, "batches": 0, "pad_slots": 0,
-                      "compile_hit": 0, "compile_miss": 0}
+                      "compile_hit": 0, "compile_miss": 0, "retries": 0,
+                      "bisects": 0, "shed": 0, "deadline_expired": 0,
+                      "quarantined": 0, "failed": 0, "nonfinite": 0,
+                      "cancelled": 0, "degraded_requests": 0,
+                      "scheduler_crashes": 0}
         self._execs: dict[_buckets.BucketKey, Any] = {}
         self._ids = itertools.count()
         self._batch_ids = itertools.count()
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        # bucket key -> list of (PendingRequest, cfg, traced, enqueue_t);
-        # enqueue_t is on the tracer's monotonic clock (tracer.now()).
+        # bucket key -> list of (PendingRequest, cfg, traced, enqueue_t,
+        # deadline_t); times are on the tracer's monotonic clock
+        # (tracer.now()); deadline_t is None when the request has none.
         self._queue: dict[_buckets.BucketKey, list] = {}
         self._thread: threading.Thread | None = None
         self._running = False
+        # Jitter rng (seeded — AUD004) + breaker state, all host-side.
+        self._rng = np.random.default_rng(self.fault_policy.seed)
+        self._sig_breakers: dict[str, resilience.CircuitBreaker] = {}
+        self._bucket_breakers: dict[
+            _buckets.BucketKey, resilience.CircuitBreaker] = {}
+        self._degraded = False
+        self._overload_since: float | None = None
+
+    # -- telemetry helpers -------------------------------------------------
+
+    def _count(self, name: str, v: int = 1) -> None:
+        """Bump a resilience stat and its registry counter (when the
+        telemetry sink carries one)."""
+        self.stats[name] = self.stats.get(name, 0) + v
+        reg = getattr(self.telemetry, "registry", None)
+        if reg is not None:
+            reg.counter(f"serve.{name}").add(v)
+
+    def _emit(self, event_type: str, payload: dict) -> None:
+        if self.telemetry is not None:
+            self.telemetry.event(event_type, payload)
 
     # -- buckets / executables --------------------------------------------
 
@@ -200,7 +302,10 @@ class ServeEngine:
     def manifest_extra(self) -> dict:
         """Telemetry-manifest attribution block (cache dir, ladder,
         prewarmed buckets + their compile counters live in the manifest's
-        compile_event_counts snapshot via utils.profiling)."""
+        compile_event_counts snapshot via utils.profiling). The fault
+        policy and the resilience counters (retries/shed/quarantine/...)
+        are snapshotted here so a run's recovery activity is auditable
+        from its manifest alone."""
         return {"serve": {
             "cache_dir": self.cache_dir,
             "max_batch": self.max_batch,
@@ -209,71 +314,192 @@ class ServeEngine:
             "horizon_quantum": self.horizon_quantum,
             "prewarm_s": self.prewarm_s,
             "buckets": sorted(k.label() for k in self._execs),
+            "fault_policy": dataclasses.asdict(self.fault_policy),
+            "fault_stats": {k: self.stats[k] for k in (
+                "retries", "bisects", "shed", "deadline_expired",
+                "quarantined", "failed", "nonfinite", "cancelled",
+                "degraded_requests", "scheduler_crashes")},
         }}
+
+    # -- breakers ----------------------------------------------------------
+
+    def _record_offender(self, cfg: swarm.Config, bucket_label: str) -> None:
+        """One execution failure attributed to THIS request's signature
+        (poison/repeat-offender accounting); opens the signature's
+        quarantine breaker at the policy threshold."""
+        policy = self.fault_policy
+        sig = resilience.request_signature(cfg)
+        now = self.tracer.now()
+        with self._lock:
+            br = self._sig_breakers.setdefault(
+                sig, resilience.CircuitBreaker(
+                    policy.quarantine_threshold,
+                    policy.quarantine_cooldown_s))
+            opened = br.record_failure(now)
+            failures = br.failures
+        if opened:
+            self._emit("serve.quarantine", {
+                "scope": "request", "signature": sig, "state": "open",
+                "failures": failures, "bucket": bucket_label})
+
+    def _record_signature_success(self, cfg: swarm.Config,
+                                  bucket_label: str) -> None:
+        """Close a half-open signature breaker on a successful probe.
+        No-op (one dict truthiness check) while no signature has ever
+        failed — the fault-free path stays unmeasurable."""
+        if not self._sig_breakers:
+            return
+        sig = resilience.request_signature(cfg)
+        with self._lock:
+            br = self._sig_breakers.get(sig)
+            recovered = br.record_success() if br is not None else False
+        if recovered:
+            self._emit("serve.quarantine", {
+                "scope": "request", "signature": sig, "state": "closed",
+                "failures": 0, "bucket": bucket_label})
 
     # -- execution ---------------------------------------------------------
 
     def _execute(self, key: _buckets.BucketKey, entries) -> None:
         """Run one micro-batch (1..max_batch queue entries) and resolve
-        every member's PendingRequest. Every lifecycle phase is spanned
-        on ``self.tracer``: per-request queue_wait (recorded
-        retroactively from the enqueue stamp), then batch-level
-        pack / compile|executable_hit / execute / unpack, then
-        per-request resolve."""
+        every member's PendingRequest — with a result, or with a typed
+        error (`serve.resilience`); never silently. Deadline-expired
+        members are dropped before the batch touches the executor. Every
+        lifecycle phase is spanned on ``self.tracer``: per-request
+        queue_wait (recorded retroactively from the enqueue stamp), then
+        batch-level pack / compile|executable_hit / execute / unpack,
+        then per-request resolve."""
         tracer = self.tracer
         label = key.label()
-        batch_id = f"b{next(self._batch_ids)}"
+        now = tracer.now()
+        alive = []
+        for entry in entries:
+            pending, _cfg, _tr, t_enq, deadline_t = entry
+            if deadline_t is not None and now >= deadline_t:
+                self._count("deadline_expired")
+                self._emit("serve.shed", {
+                    "request_id": pending.request_id, "bucket": label,
+                    "reason": "deadline", "queue_depth": self._queue_depth()})
+                pending._resolve(error=resilience.DeadlineExceeded(
+                    f"request {pending.request_id} missed its deadline after "
+                    f"{now - t_enq:.3f}s queued", request_id=pending.request_id,
+                    bucket=label))
+                continue
+            alive.append(entry)
+        if not alive:
+            return
         t_exec_start = tracer.now()
-        for pending, _cfg, _tr, t_enq in entries:
+        for pending, _cfg, _tr, t_enq, _d in alive:
             tracer.record("queue_wait", t0_s=t_enq,
                           dur_s=t_exec_start - t_enq,
                           trace_id=pending.request_id, bucket=label)
+        self._run_batch(key, alive, t_exec_start)
+
+    def _run_batch(self, key: _buckets.BucketKey, entries,
+                   t_exec_start: float, attempt: int = 0) -> None:
+        """Pack/compile/execute one batch attempt; on failure, hand off
+        to `_on_batch_failure` (retry with backoff, bisect, or resolve
+        the offender with its error)."""
+        policy = self.fault_policy
+        tracer = self.tracer
+        label = key.label()
+        batch_id = f"b{next(self._batch_ids)}"
+        hook = self.fault_hook
+        degraded = self._degraded
+        phase = "compile"
         try:
+            if hook is not None:
+                hook(key, entries, attempt, "compile")
             hit = key in self._execs
             with tracer.span("executable_hit" if hit else "compile",
                              trace_id=batch_id, bucket=label):
                 compiled = self._executable(key)
-            cfgs = [cfg for (_p, cfg, _tr, _t) in entries]
-            traced = [tr for (_p, _cfg, tr, _t) in entries]
+            phase = "pack"
+            cfgs = [e[1] for e in entries]
+            traced = [e[2] for e in entries]
             with tracer.span("pack", trace_id=batch_id, bucket=label):
                 states, traced_b, steps_b = _pack.stack_batch(
                     key, cfgs, traced, self.max_batch)
+            if degraded:
+                # The degradation lever: steps rides as a traced horizon
+                # mask, so capping it shrinks solver work WITHOUT a
+                # recompile (any static budget knob would change the
+                # bucket and force one).
+                if self.degrade_hook is not None:
+                    steps_b = self.degrade_hook(key, steps_b)
+                else:
+                    cap = max(1, int(round(
+                        key.horizon * policy.degrade_steps_frac)))
+                    steps_b = np.minimum(
+                        np.asarray(steps_b), cap).astype(np.int32)
+            phase = "execute"
+            if hook is not None:
+                hook(key, entries, attempt, "execute")
             t0 = time.perf_counter()
             with tracer.span("execute", trace_id=batch_id, bucket=label):
                 final_states, outs = compiled(states, traced_b, steps_b)
                 jax.block_until_ready(final_states.x)
             execute_s = time.perf_counter() - t0
         except BaseException as e:
-            for pending, *_ in entries:
-                pending._resolve(error=e)
+            self._on_batch_failure(key, entries, t_exec_start, attempt,
+                                   phase, e)
             return
+        recovered = False
+        with self._lock:
+            bbr = self._bucket_breakers.get(key)
+            if bbr is not None:
+                recovered = bbr.record_success()
+        if recovered:
+            self._emit("serve.quarantine", {
+                "scope": "bucket", "signature": label, "state": "closed",
+                "failures": 0, "bucket": label})
         with tracer.span("unpack", trace_id=batch_id, bucket=label):
             final_states = jax.device_get(final_states)
             outs = jax.device_get(outs)
         self.stats["batches"] += 1
         self.stats["pad_slots"] += self.max_batch - len(entries)
-        for slot, (pending, cfg, _tr, t_enq) in enumerate(entries):
+        steps_np = np.asarray(steps_b) if degraded else None
+        for slot, (pending, cfg, _tr, t_enq, _d) in enumerate(entries):
             with tracer.span("resolve", trace_id=pending.request_id,
                              bucket=label):
+                eff_steps = int(steps_np[slot]) if degraded else cfg.steps
                 final, outs_i = _pack.trim_result(final_states, outs, slot,
-                                                  cfg.n, cfg.steps)
+                                                  cfg.n, eff_steps)
+                if policy.check_finite and not _all_finite(final, outs_i):
+                    # Vmapped lanes are independent: this slot's poison
+                    # cannot have infected its batch-mates, so only this
+                    # request fails (blast-radius isolation), and its
+                    # signature takes a quarantine strike.
+                    self._count("nonfinite")
+                    self._count("failed")
+                    self._record_offender(cfg, label)
+                    pending._resolve(error=resilience.NonFiniteResult(
+                        f"request {pending.request_id} unpacked non-finite "
+                        f"state/outputs in bucket {label}",
+                        request_id=pending.request_id, bucket=label))
+                    continue
+                self._record_signature_success(cfg, label)
                 now = tracer.now()
                 result = RequestResult(
                     request_id=pending.request_id, bucket=label,
-                    n=cfg.n, steps=cfg.steps, final_state=final,
+                    n=cfg.n, steps=eff_steps, final_state=final,
                     outputs=outs_i, latency_s=round(now - t_enq, 6),
                     queue_wait_s=round(t_exec_start - t_enq, 6),
-                    execute_s=round(execute_s, 6), batch_fill=len(entries))
+                    execute_s=round(execute_s, 6), batch_fill=len(entries),
+                    degraded=degraded)
                 self.stats["requests"] += 1
+                if degraded:
+                    self._count("degraded_requests")
                 if self.telemetry is not None:
                     self.telemetry.event("request", {
                         "request_id": result.request_id,
                         "bucket": result.bucket, "n": cfg.n,
-                        "steps": cfg.steps,
+                        "steps": eff_steps,
                         "latency_s": result.latency_s,
                         "queue_wait_s": result.queue_wait_s,
                         "execute_s": result.execute_s,
                         "batch_fill": result.batch_fill,
+                        "degraded": int(degraded),
                         "min_pairwise_distance": float(
                             np.min(outs_i.min_pairwise_distance)),
                         "infeasible_count": int(
@@ -281,12 +507,76 @@ class ServeEngine:
                     })
                 pending._resolve(result=result)
 
+    def _on_batch_failure(self, key: _buckets.BucketKey, entries,
+                          t_exec_start: float, attempt: int, phase: str,
+                          error: BaseException) -> None:
+        """Recovery ladder for one failed batch attempt:
+
+        1. transient error with retry budget left -> backoff (seeded
+           jitter) and re-run the whole batch;
+        2. multi-request batch failing in pack/execute -> bisect: run
+           the halves separately (retry budget spent — halves bisect
+           straight down to the offender instead of re-backing-off);
+        3. single request -> resolve with the error and charge its
+           signature's quarantine breaker;
+        4. compile-phase failure -> the bucket itself is broken (no
+           request is at fault): resolve ALL members and charge the
+           bucket breaker.
+        """
+        policy = self.fault_policy
+        label = key.label()
+        if resilience.is_retryable(error) and attempt < policy.max_retries:
+            backoff = policy.backoff_s(attempt, self._rng)
+            self._count("retries")
+            self._emit("serve.retry", {
+                "bucket": label, "action": "retry", "attempt": attempt + 1,
+                "batch_size": len(entries), "backoff_s": round(backoff, 4),
+                "error": type(error).__name__})
+            time.sleep(backoff)
+            self._run_batch(key, entries, t_exec_start, attempt + 1)
+            return
+        if phase != "compile" and len(entries) > 1:
+            self._count("bisects")
+            self._emit("serve.retry", {
+                "bucket": label, "action": "bisect", "attempt": attempt,
+                "batch_size": len(entries), "backoff_s": 0.0,
+                "error": type(error).__name__})
+            mid = len(entries) // 2
+            self._run_batch(key, entries[:mid], t_exec_start,
+                            policy.max_retries)
+            self._run_batch(key, entries[mid:], t_exec_start,
+                            policy.max_retries)
+            return
+        if phase == "compile":
+            now = self.tracer.now()
+            with self._lock:
+                bbr = self._bucket_breakers.setdefault(
+                    key, resilience.CircuitBreaker(
+                        policy.breaker_threshold,
+                        policy.quarantine_cooldown_s))
+                opened = bbr.record_failure(now)
+                failures = bbr.failures
+            if opened:
+                self._emit("serve.quarantine", {
+                    "scope": "bucket", "signature": label, "state": "open",
+                    "failures": failures, "bucket": label})
+            for pending, *_ in entries:
+                self._count("failed")
+                pending._resolve(error=error)
+            return
+        pending, cfg, *_ = entries[0]
+        self._count("failed")
+        self._record_offender(cfg, label)
+        pending._resolve(error=error)
+
     # -- synchronous drain -------------------------------------------------
 
     def run(self, configs) -> list[RequestResult]:
         """Serve a request list synchronously: bucket, batch (order-
         preserving within a bucket), execute, return results in request
-        order."""
+        order. Offline mode has no deadlines or admission control (the
+        caller IS the queue), but retries/bisection/finite-checking
+        apply; a failed request raises its typed error here."""
         entries_by_key: dict[_buckets.BucketKey, list] = {}
         pendings = []
         for cfg in configs:
@@ -295,7 +585,7 @@ class ServeEngine:
                 key, traced = self.bucket_of(cfg)
                 pendings.append(pending)
                 entries_by_key.setdefault(key, []).append(
-                    (pending, cfg, traced, self.tracer.now()))
+                    (pending, cfg, traced, self.tracer.now(), None))
         for key, entries in entries_by_key.items():
             for i in range(0, len(entries), self.max_batch):
                 self._execute(key, entries[i:i + self.max_batch])
@@ -312,21 +602,99 @@ class ServeEngine:
                                         name="serve-scheduler", daemon=True)
         self._thread.start()
 
-    def submit(self, cfg: swarm.Config,
-               request_id: str | None = None) -> PendingRequest:
+    def _queue_depth(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._queue.values())
+
+    def submit(self, cfg: swarm.Config, request_id: str | None = None,
+               deadline_s: float | None = None) -> PendingRequest:
         """Enqueue one request (queue mode; call `start()` first). The
         bucket flushes when max_batch requests accumulate or after
-        flush_deadline_s, whichever comes first."""
+        flush_deadline_s, whichever comes first.
+
+        Admission control runs here: a quarantined signature or bucket
+        fails fast with `QuarantinedError`; a full bounded queue
+        (``fault_policy.queue_limit``) sheds per the policy —
+        ``reject-newest`` raises `ShedError`, ``reject-oldest`` evicts
+        the globally oldest queued request (ITS handle resolves with
+        `ShedError`) to admit this one. ``deadline_s`` (default: the
+        policy's) stamps a deadline after which the request fails fast
+        with `DeadlineExceeded` instead of occupying an executor slot."""
+        policy = self.fault_policy
         pending = PendingRequest(request_id or f"r{next(self._ids)}")
+        post_events: list[tuple[str, dict]] = []
+        evicted = None
         with self.tracer.span("enqueue", trace_id=pending.request_id):
             key, traced = self.bucket_of(cfg)   # validates before enqueueing
+            label = key.label()
+            now = self.tracer.now()
+            dl = deadline_s if deadline_s is not None else policy.deadline_s
+            deadline_t = now + dl if dl is not None else None
+            fail: BaseException | None = None
             with self._cond:
                 if not self._running:
                     raise RuntimeError("engine not started — call start() "
                                        "(or use run() for a one-shot drain)")
-                self._queue.setdefault(key, []).append(
-                    (pending, cfg, traced, self.tracer.now()))
-                self._cond.notify()
+                if self._sig_breakers:
+                    sig = resilience.request_signature(cfg)
+                    br = self._sig_breakers.get(sig)
+                    if br is not None and not br.allow(now):
+                        self._count("quarantined")
+                        fail = resilience.QuarantinedError(
+                            f"request signature {sig} is quarantined "
+                            f"({br.failures} failures; state {br.state})",
+                            request_id=pending.request_id, bucket=label)
+                if fail is None:
+                    bbr = self._bucket_breakers.get(key)
+                    if bbr is not None and not bbr.allow(now):
+                        self._count("quarantined")
+                        fail = resilience.QuarantinedError(
+                            f"bucket {label} is quarantined "
+                            f"({bbr.failures} compile failures; state "
+                            f"{bbr.state})",
+                            request_id=pending.request_id, bucket=label)
+                if fail is None and policy.queue_limit is not None:
+                    depth = sum(len(v) for v in self._queue.values())
+                    if depth >= policy.queue_limit:
+                        if policy.shed_policy == "reject-newest":
+                            self._count("shed")
+                            post_events.append(("serve.shed", {
+                                "request_id": pending.request_id,
+                                "bucket": label, "reason": "queue_full",
+                                "queue_depth": depth}))
+                            fail = resilience.ShedError(
+                                f"queue full ({depth}/{policy.queue_limit}) "
+                                f"— request {pending.request_id} shed",
+                                request_id=pending.request_id, bucket=label)
+                        else:   # reject-oldest: evict to admit the new one
+                            oldest_key, oldest_idx = None, None
+                            oldest_t = None
+                            for k, es in self._queue.items():
+                                if es and (oldest_t is None
+                                           or es[0][3] < oldest_t):
+                                    oldest_key, oldest_idx = k, 0
+                                    oldest_t = es[0][3]
+                            evicted = self._queue[oldest_key].pop(oldest_idx)
+                            self._count("shed")
+                            post_events.append(("serve.shed", {
+                                "request_id": evicted[0].request_id,
+                                "bucket": oldest_key.label(),
+                                "reason": "oldest_evicted",
+                                "queue_depth": depth}))
+                if fail is None:
+                    pending._engine, pending._key = self, key
+                    self._queue.setdefault(key, []).append(
+                        (pending, cfg, traced, now, deadline_t))
+                    self._cond.notify()
+        for etype, payload in post_events:
+            self._emit(etype, payload)
+        if evicted is not None:
+            ev_pending = evicted[0]
+            ev_pending._resolve(error=resilience.ShedError(
+                f"request {ev_pending.request_id} evicted by reject-oldest "
+                "under queue pressure", request_id=ev_pending.request_id))
+        if fail is not None:
+            raise fail
         return pending
 
     def stop(self, drain: bool = True) -> None:
@@ -350,30 +718,94 @@ class ServeEngine:
             for key, batch in leftovers:
                 self._execute(key, batch)
 
+    # -- scheduler ---------------------------------------------------------
+
+    def _scan_queue(self, now: float):
+        """Under ``self._lock``: pop every flush-ready batch (full, or
+        oldest member past ``flush_deadline_s``). Returns
+        ``(to_run, next_deadline)``; factored out of the loop so the
+        crash guard has a seam to test against."""
+        to_run, next_deadline = [], None
+        for key, entries in self._queue.items():
+            while len(entries) >= self.max_batch:
+                to_run.append((key, entries[:self.max_batch]))
+                del entries[:self.max_batch]
+            if entries:
+                deadline = entries[0][3] + self.flush_deadline_s
+                if deadline <= now:
+                    to_run.append((key, entries[:]))
+                    entries.clear()
+                elif (next_deadline is None
+                        or deadline < next_deadline):
+                    next_deadline = deadline
+        return to_run, next_deadline
+
+    def _update_degrade(self, now: float):
+        """Under ``self._lock``: track sustained overload and flip the
+        degraded flag. Returns a ("enter"|"exit", depth) transition for
+        the caller to emit outside the lock, or None."""
+        policy = self.fault_policy
+        hw = policy.degrade_high_watermark
+        if hw is None:
+            return None
+        depth = sum(len(v) for v in self._queue.values())
+        if not self._degraded:
+            if depth > hw:
+                if self._overload_since is None:
+                    self._overload_since = now
+                elif now - self._overload_since >= policy.degrade_sustain_s:
+                    self._degraded = True
+                    return ("enter", depth)
+            else:
+                self._overload_since = None
+        elif depth <= policy.degrade_low_watermark:
+            self._degraded = False
+            self._overload_since = None
+            return ("exit", depth)
+        return None
+
     def _scheduler_loop(self) -> None:
+        """Crash-guarded wrapper: any exception escaping the scheduler
+        body resolves every queued request with `SchedulerCrashed`
+        instead of stranding them forever on a silently dead thread."""
+        try:
+            self._scheduler_body()
+        except BaseException as e:   # noqa: BLE001 — the guard IS the point
+            self._on_scheduler_crash(e)
+
+    def _scheduler_body(self) -> None:
         while True:
-            to_run = []
+            transition = None
             with self._cond:
                 if not self._running:
                     return
                 now = self.tracer.now()   # same monotonic clock as enqueue
-                next_deadline = None
-                for key, entries in self._queue.items():
-                    while len(entries) >= self.max_batch:
-                        to_run.append((key, entries[:self.max_batch]))
-                        del entries[:self.max_batch]
-                    if entries:
-                        deadline = entries[0][3] + self.flush_deadline_s
-                        if deadline <= now:
-                            to_run.append((key, entries[:]))
-                            entries.clear()
-                        elif (next_deadline is None
-                                or deadline < next_deadline):
-                            next_deadline = deadline
-                if not to_run:
+                transition = self._update_degrade(now)
+                to_run, next_deadline = self._scan_queue(now)
+                if not to_run and transition is None:
                     self._cond.wait(
                         timeout=None if next_deadline is None
                         else max(next_deadline - now, 1e-3))
                     continue
+            if transition is not None:
+                state, depth = transition
+                self._emit("serve.degrade", {
+                    "state": state, "queue_depth": depth,
+                    "steps_frac": self.fault_policy.degrade_steps_frac})
             for key, batch in to_run:
                 self._execute(key, batch)
+
+    def _on_scheduler_crash(self, error: BaseException) -> None:
+        with self._cond:
+            self._running = False
+            leftovers = [entry for entries in self._queue.values()
+                         for entry in entries]
+            self._queue.clear()
+        for pending, *_ in leftovers:
+            pending._resolve(error=resilience.SchedulerCrashed(
+                f"scheduler thread crashed: {type(error).__name__}: {error}",
+                request_id=pending.request_id))
+        self._count("scheduler_crashes")
+        self._emit("serve.scheduler_crash", {
+            "error": f"{type(error).__name__}: {error}",
+            "resolved": len(leftovers)})

@@ -31,6 +31,7 @@ import time
 import numpy as np
 
 from cbf_tpu.scenarios import swarm
+from cbf_tpu.serve import resilience
 
 #: Generic telemetry event types this module emits (AUD001-audited
 #: against obs.schema.LOADGEN_EVENT_TYPES).
@@ -111,10 +112,22 @@ def _quantile(sorted_vals: list[float], q: float) -> float | None:
 
 
 def run_loadgen(engine, spec: LoadSpec, *, telemetry=None,
-                result_timeout_s: float = 300.0) -> dict:
+                result_timeout_s: float = 300.0, mutate=None) -> dict:
     """Drive ``engine`` with the spec's open-loop schedule and return
     the SLO report: sustained RPS + end-to-end latency percentiles +
-    queue-wait/execute breakdown.
+    queue-wait/execute breakdown + a typed-error census.
+
+    Every scheduled request is accounted for exactly once: completed,
+    or counted under ``errors`` with its exception type tallied in
+    ``errors_by_type`` — submits refused by admission control
+    (`serve.resilience.ShedError` / `QuarantinedError`) count the same
+    way as post-submit failures, so ``completed + errors == requests``
+    is the chaos harness's zero-hang invariant.
+
+    ``mutate`` (optional, ``mutate(i, cfg) -> cfg``) rewrites the i-th
+    scheduled request before submit — the chaos-injection seam (e.g.
+    `utils.faults.poison_config` every k-th request) that keeps the
+    schedule itself seeded/replayable.
 
     The engine should be prewarmed for the schedule's buckets (use
     ``engine.prewarm([cfg for _, cfg in build_schedule(spec)])``) —
@@ -127,22 +140,34 @@ def run_loadgen(engine, spec: LoadSpec, *, telemetry=None,
     if started_here:
         engine.start()
     pendings = []
+    errors_by_type: dict[str, int] = {}
+
+    def _tally(exc: BaseException) -> None:
+        name = type(exc).__name__
+        errors_by_type[name] = errors_by_type.get(name, 0) + 1
+
     t_start = time.perf_counter()
     try:
-        for arrival_s, cfg in schedule:
+        for i, (arrival_s, cfg) in enumerate(schedule):
             # Open-loop: sleep to the scheduled arrival, never await
             # results — lateness here (the generator falling behind)
             # is reported, not silently absorbed.
             delay = t_start + arrival_s - time.perf_counter()
             if delay > 0:
                 time.sleep(delay)
-            pendings.append(engine.submit(cfg))
-        results, errors = [], 0
+            if mutate is not None:
+                cfg = mutate(i, cfg)
+            try:
+                pendings.append(engine.submit(cfg))
+            except resilience.ServeError as e:
+                _tally(e)   # shed/quarantined at admission: typed, counted
+        results = []
         for p in pendings:
             try:
                 results.append(p.result(timeout=result_timeout_s))
-            except Exception:
-                errors += 1
+            except Exception as e:
+                _tally(e)
+        errors = sum(errors_by_type.values())
         drained_s = time.perf_counter() - t_start
     finally:
         if started_here:
@@ -159,6 +184,8 @@ def run_loadgen(engine, spec: LoadSpec, *, telemetry=None,
         "requests": len(schedule),
         "completed": completed,
         "errors": errors,
+        "errors_by_type": errors_by_type,
+        "timeouts": errors_by_type.get("TimeoutError", 0),
         "duration_s": round(drained_s, 3),
         "latency_p50_s": _quantile(lat, 0.50),
         "latency_p95_s": _quantile(lat, 0.95),
@@ -186,7 +213,7 @@ def run_loadgen(engine, spec: LoadSpec, *, telemetry=None,
         telemetry.event("loadgen.summary", {
             k: report[k] for k in (
                 "seed", "offered_rps", "achieved_rps", "requests",
-                "completed", "duration_s", "latency_p50_s",
+                "completed", "errors", "duration_s", "latency_p50_s",
                 "latency_p95_s", "latency_p99_s", "queue_wait_p99_s",
                 "execute_p99_s")})
     return report

@@ -1,0 +1,267 @@
+"""Fault-tolerance primitives for the serving engine.
+
+At serving scale the failure modes that matter are not single-rollout
+crashes but *coupled* ones: one poisoned request in a packed batch must
+not fail its seven batch-mates, a transient executor hiccup must not
+surface to callers at all, and sustained overload must shed or degrade
+instead of letting queue-wait grow without bound (the Round 10 loadgen
+showed queue-wait already dominates p99). This module holds the
+engine-independent pieces of that story:
+
+- the **typed error taxonomy** (:class:`ServeError` and subclasses) —
+  every way a request can fail without a result is a distinct exception
+  type carrying the request id and bucket, so callers and the load
+  generator can classify outcomes instead of pattern-matching strings;
+- :class:`FaultPolicy` — one frozen knob bundle for retries/backoff,
+  admission control, deadlines, quarantine and graceful degradation,
+  validated up front (a typo'd shed policy fails at construction, not
+  mid-traffic);
+- :class:`CircuitBreaker` — the closed/open/half-open state machine
+  shared by the per-request-signature quarantine and the per-bucket
+  compile breaker;
+- :func:`request_signature` / :func:`is_retryable` — the two
+  classification helpers: which config a repeat offender *is*, and which
+  exceptions are worth a backoff retry.
+
+Everything here is host-side and dependency-free (no jax import): the
+scheduler thread consults it between batches, never inside traced code.
+Backoff jitter is seeded (`numpy.random.default_rng`) per AUD004 — the
+same policy replays the same backoff schedule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+import numpy as np
+
+# ------------------------------------------------------------ taxonomy ----
+
+
+class ServeError(Exception):
+    """Base of the serving layer's typed failure taxonomy. Every request
+    that cannot produce a result fails with a subclass of this, carrying
+    ``request_id`` and ``bucket`` (either may be None when the failure
+    precedes assignment — e.g. a shed at admission has no bucket queue
+    slot yet)."""
+
+    def __init__(self, message: str, *, request_id: str | None = None,
+                 bucket: str | None = None):
+        super().__init__(message)
+        self.request_id = request_id
+        self.bucket = bucket
+
+
+class ShedError(ServeError):
+    """Admission control rejected the request: the bounded queue was full
+    and the policy shed it (``reject-newest`` raises this from
+    ``submit``; ``reject-oldest`` resolves the evicted oldest request's
+    handle with it)."""
+
+
+class DeadlineExceeded(ServeError):
+    """The request's deadline passed before its batch executed. Expired
+    requests are dropped at flush time — they never occupy an executor
+    slot — and fail fast with this."""
+
+
+class QuarantinedError(ServeError):
+    """Rejected by an open circuit breaker: either the request's
+    signature accumulated too many failures (a repeat offender) or its
+    bucket's executable keeps failing to compile. Clears after the
+    breaker's cooldown admits a successful probe."""
+
+
+class NonFiniteResult(ServeError):
+    """The batch executed, but this request's slot unpacked non-finite
+    state or outputs (NaN/inf). The batch-mates are unaffected — vmapped
+    lanes are independent — so only this request fails, and its
+    signature takes a quarantine strike."""
+
+
+class SchedulerCrashed(ServeError):
+    """The scheduler thread died on an unexpected exception. Every
+    queued request is resolved with this instead of hanging forever
+    (the pre-PR-8 behavior)."""
+
+
+class RequestCancelled(ServeError):
+    """The caller cancelled the request (``PendingRequest.cancel()``)
+    while it was still queued."""
+
+
+#: Exception types retrying cannot fix: bad inputs and code bugs, the
+#: same classification bench.py's ``_is_permanent_error`` uses. The
+#: typed taxonomy above is also permanent — a shed or quarantine verdict
+#: does not improve with backoff. Everything else (RuntimeError,
+#: XlaRuntimeError, OSError, injected executor faults) is presumed
+#: transient and worth the bounded retry budget.
+PERMANENT_ERROR_TYPES: tuple[type, ...] = (
+    ValueError, TypeError, KeyError, AttributeError, AssertionError,
+    ImportError, ServeError)
+
+
+def is_retryable(error: BaseException) -> bool:
+    """Whether a batch failure is worth a backoff retry (transient) as
+    opposed to deterministic (permanent input/code error)."""
+    return not isinstance(error, PERMANENT_ERROR_TYPES)
+
+
+def request_signature(cfg) -> str:
+    """Stable short signature identifying WHAT a request asks for —
+    the quarantine's repeat-offender key. Hashes the config's repr with
+    ``seed`` zeroed (spawn randomness is not part of the offense: the
+    same poisoned knob set resubmitted under a fresh seed must match its
+    quarantine record)."""
+    canon = dataclasses.replace(cfg, seed=0)
+    return hashlib.sha1(repr(canon).encode()).hexdigest()[:12]
+
+
+# -------------------------------------------------------------- policy ----
+
+SHED_POLICIES = ("reject-newest", "reject-oldest")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPolicy:
+    """One serving engine's fault-tolerance knobs (immutable; swap the
+    whole policy to change behavior).
+
+    Retries: a failed batch retries up to ``max_retries`` times when the
+    error is transient (:func:`is_retryable`), sleeping
+    ``backoff_base_s * backoff_factor**attempt`` plus up to
+    ``backoff_jitter`` of itself (seeded rng — AUD004). Exhausted or
+    permanent multi-request batches bisect so only offenders fail.
+
+    Admission control: ``queue_limit`` bounds the TOTAL queued request
+    count across buckets; a submit beyond it sheds per ``shed_policy``
+    (``reject-newest``: the new request is refused with
+    :class:`ShedError`; ``reject-oldest``: the globally oldest queued
+    request is evicted to make room). ``deadline_s`` is the default
+    per-request deadline (None = none; ``submit(deadline_s=...)``
+    overrides per request).
+
+    Quarantine: a request signature accumulating
+    ``quarantine_threshold`` execution failures opens its breaker for
+    ``quarantine_cooldown_s``; submits of that signature fail fast with
+    :class:`QuarantinedError` until a post-cooldown probe succeeds.
+    A bucket whose executable fails to build ``breaker_threshold``
+    times opens a bucket-wide breaker under the same cooldown.
+
+    Degradation: when total queue depth stays above
+    ``degrade_high_watermark`` for ``degrade_sustain_s``, the engine
+    enters degraded mode and caps every request's horizon at
+    ``degrade_steps_frac`` of its bucket horizon (``steps`` rides as a
+    traced mask, so the cap needs NO recompilation — it is the one
+    solver-budget lever that cannot cause a bucket miss). Exits when
+    depth falls to ``degrade_low_watermark``. None disables.
+
+    ``check_finite`` gates the per-slot NaN/inf scan of unpacked
+    results (:class:`NonFiniteResult`); disable only for overhead
+    measurement legs.
+    """
+    max_retries: int = 2
+    backoff_base_s: float = 0.02
+    backoff_factor: float = 2.0
+    backoff_jitter: float = 0.5
+    seed: int = 0
+    queue_limit: int | None = None
+    shed_policy: str = "reject-newest"
+    deadline_s: float | None = None
+    quarantine_threshold: int = 3
+    quarantine_cooldown_s: float = 1.0
+    breaker_threshold: int = 5
+    check_finite: bool = True
+    degrade_high_watermark: int | None = None
+    degrade_low_watermark: int = 0
+    degrade_sustain_s: float = 0.25
+    degrade_steps_frac: float = 0.5
+
+    def __post_init__(self):
+        if self.shed_policy not in SHED_POLICIES:
+            raise ValueError(f"shed_policy must be one of {SHED_POLICIES}, "
+                             f"got {self.shed_policy!r}")
+        if self.max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, "
+                             f"got {self.max_retries}")
+        if self.queue_limit is not None and self.queue_limit < 1:
+            raise ValueError(f"queue_limit must be >= 1 (or None), "
+                             f"got {self.queue_limit}")
+        if self.quarantine_threshold < 1 or self.breaker_threshold < 1:
+            raise ValueError("quarantine_threshold and breaker_threshold "
+                             "must be >= 1")
+        if not (0.0 < self.degrade_steps_frac <= 1.0):
+            raise ValueError(f"degrade_steps_frac must be in (0, 1], "
+                             f"got {self.degrade_steps_frac}")
+
+    def backoff_s(self, attempt: int, rng: np.random.Generator) -> float:
+        """The sleep before retry number ``attempt + 1`` (exponential in
+        the attempt index, plus seeded jitter so lockstep clients
+        de-synchronize)."""
+        base = self.backoff_base_s * self.backoff_factor ** attempt
+        return base * (1.0 + self.backoff_jitter * float(rng.random()))
+
+
+# ------------------------------------------------------------- breaker ----
+
+
+class CircuitBreaker:
+    """Closed -> open -> half-open failure breaker (host-side, caller
+    holds whatever lock serializes it — the engine uses its queue lock).
+
+    ``record_failure`` counts consecutive failures; at ``threshold`` the
+    breaker OPENS and ``allow`` refuses until ``cooldown_s`` elapses,
+    after which exactly one probe is admitted (HALF-OPEN). The probe's
+    ``record_success`` CLOSES the breaker (counts reset); its
+    ``record_failure`` re-opens it for another cooldown. State-changing
+    calls return True so the caller can emit quarantine telemetry only
+    on transitions, not on every strike."""
+
+    def __init__(self, threshold: int, cooldown_s: float):
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self.state = "closed"
+        self.failures = 0
+        self._opened_at: float | None = None
+        self._probing = False
+
+    def allow(self, now: float) -> bool:
+        """Whether a request may pass. In OPEN state, the first call
+        after the cooldown flips to HALF-OPEN and admits one probe."""
+        if self.state == "closed":
+            return True
+        if self.state == "open":
+            if self._opened_at is not None and \
+                    now - self._opened_at >= self.cooldown_s:
+                self.state = "half_open"
+                self._probing = True
+                return True
+            return False
+        # half_open: one probe in flight, everyone else waits.
+        if self._probing:
+            return False
+        self._probing = True
+        return True
+
+    def record_success(self) -> bool:
+        """Returns True when this success CLOSED a non-closed breaker
+        (quarantine recovery)."""
+        recovered = self.state != "closed"
+        self.state = "closed"
+        self.failures = 0
+        self._opened_at = None
+        self._probing = False
+        return recovered
+
+    def record_failure(self, now: float) -> bool:
+        """Returns True when this failure OPENED the breaker (threshold
+        reached, or a half-open probe failed)."""
+        self.failures += 1
+        if self.state == "half_open" or self.failures >= self.threshold:
+            already_open = self.state == "open"
+            self.state = "open"
+            self._opened_at = now
+            self._probing = False
+            return not already_open
+        return False

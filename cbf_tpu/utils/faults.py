@@ -7,15 +7,31 @@ gating overflow counts); this module injects the corresponding faults into
 an otherwise-healthy rollout so tests — and operators debugging a flaky
 model — can confirm each signal trips where expected, inside compiled code.
 
-All injectors are pure step-fn wrappers: they compose with ``rollout``,
-``checked_rollout``, ``rollout_chunked`` and ``scan`` like any step.
+Rollout-level injectors are pure step-fn wrappers: they compose with
+``rollout``, ``checked_rollout``, ``rollout_chunked`` and ``scan`` like
+any step.
 
     step = faults.nan_at_step(step, step_index=50)
     checked_rollout(step, state0, 100)      # -> JaxRuntimeError at t=50
+
+SERVE-level injectors (the chaos harness for `serve.engine`'s fault-
+tolerance layer) plug into ``ServeEngine.fault_hook`` — a callable
+``hook(key, entries, attempt, phase)`` the engine invokes before the
+"compile" and "execute" stage of every batch attempt:
+
+    engine.fault_hook = faults.serve_executor_fault(times=2)
+    # first two batches raise InjectedExecutorFault -> engine retries
+
+:func:`poison_config` is the data-plane poison: a request config that
+passes validation (``consensus_gain`` is an unbounded traced scalar)
+but blows its own vmapped lane up to non-finite values at runtime —
+the blast-radius-isolation test's payload.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import time as _time
 from typing import Callable
 
 import jax
@@ -179,3 +195,90 @@ def teleport_at_step(step_fn: Callable, step_index: int,
         return step_fn(state._replace(x=x2), t)
 
     return wrapped
+
+
+# ------------------------------------------------- serve-level chaos ----
+
+
+class InjectedExecutorFault(RuntimeError):
+    """The chaos harness's transient executor failure. A RuntimeError on
+    purpose: `serve.resilience.is_retryable` classifies RuntimeErrors as
+    transient, so the engine's backoff-retry path — not the bisect/fail
+    path — is what these exercise."""
+
+
+def serve_executor_fault(times: int, exc: BaseException | None = None
+                         ) -> Callable:
+    """Engine fault hook raising at the EXECUTE phase for the first
+    ``times`` batch attempts it sees, then going quiet — the transient
+    executor fault (preempted device, flaky interconnect). Default
+    exception is :class:`InjectedExecutorFault` (retryable); pass e.g.
+    a ``ValueError`` to simulate a permanent fault that must bisect."""
+    remaining = [times]
+
+    def hook(key, entries, attempt, phase):
+        if phase == "execute" and remaining[0] > 0:
+            remaining[0] -= 1
+            raise exc if exc is not None else InjectedExecutorFault(
+                f"injected executor fault ({remaining[0]} left) for bucket "
+                f"{key.label()}")
+
+    return hook
+
+
+def serve_compile_failure(times: int) -> Callable:
+    """Engine fault hook raising at the COMPILE phase for the first
+    ``times`` batch attempts — the transient compile/lowering failure
+    (cache race, OOM during lowering). Retryable; when the retry budget
+    is exhausted the engine charges the BUCKET breaker (no request is at
+    fault when the bucket cannot build)."""
+    remaining = [times]
+
+    def hook(key, entries, attempt, phase):
+        if phase == "compile" and remaining[0] > 0:
+            remaining[0] -= 1
+            raise InjectedExecutorFault(
+                f"injected compile failure ({remaining[0]} left) for bucket "
+                f"{key.label()}")
+
+    return hook
+
+
+def serve_latency_spike(seconds: float, every: int = 1) -> Callable:
+    """Engine fault hook sleeping ``seconds`` before every ``every``-th
+    execute — the latency-spike fault (GC pause, noisy neighbor). Never
+    raises: it exercises deadline expiry and queue growth, not the
+    retry path."""
+    count = [0]
+
+    def hook(key, entries, attempt, phase):
+        if phase == "execute":
+            count[0] += 1
+            if count[0] % every == 0:
+                _time.sleep(seconds)
+
+    return hook
+
+
+def serve_chaos_hook(*hooks: Callable) -> Callable:
+    """Compose several serve fault hooks into one (each called in order;
+    the first to raise wins)."""
+    def hook(key, entries, attempt, phase):
+        for h in hooks:
+            h(key, entries, attempt, phase)
+
+    return hook
+
+
+def poison_config(cfg):
+    """A data-plane poisoned request: same bucket as ``cfg`` (only a
+    TRACED scalar changes), passes `scenarios.swarm.validate_config`
+    (``dt`` is an unbounded traced scalar for the default dynamics),
+    but a 1e30 timestep overflows the position integration to inf —
+    and the next step's pairwise math to NaN — in its own vmapped lane
+    only. The engine's per-slot finite check must catch it as
+    `NonFiniteResult` while the batch-mates' independent lanes resolve
+    untouched. (Command-magnitude knobs like ``consensus_gain`` do NOT
+    work as poison: the safety filter's speed clamps saturate them back
+    to finite commands — which is the filter doing its job.)"""
+    return dataclasses.replace(cfg, dt=1e30)

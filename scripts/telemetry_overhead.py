@@ -19,12 +19,21 @@ interleaved min-of-R discipline. All span work is host-side (perf_counter
 reads + list appends around the dispatch), so the budget governs the
 engine's request wall, not device time.
 
+``--mode faults`` measures the fault-tolerance layer's IDLE cost under
+the same <= 3% budget (ISSUE 8 / docs/BENCH_LOG.md Round 11): the
+default FaultPolicy (retries armed, per-slot finite checks on, breakers
+empty) vs a disabled policy (check_finite=False, max_retries=0) over the
+same prewarmed mixed batch — fault-free traffic, so the legs differ only
+in the host-side guard work. The compiled executable is shared between
+legs, which is also the bit-neutrality argument: an idle policy cannot
+change results it never touches.
+
 Prints one JSON line: {n, steps, every, reps, off_s, on_s, overhead,
 heartbeats, platform} (mode=rollout) or {mode, b, n_base, steps, reps,
-off_s, on_s, overhead, spans, platform} (mode=spans).
+off_s, on_s, overhead, ..., platform} (mode=spans|faults).
 
 Usage: python scripts/telemetry_overhead.py [--n 1024] [--steps 300]
-       [--every 50] [--reps 5] [--mode rollout|spans]
+       [--every 50] [--reps 5] [--mode rollout|spans|faults]
 """
 
 from __future__ import annotations
@@ -118,24 +127,71 @@ def measure_spans(b: int, n_base: int, steps: int, reps: int) -> dict:
             "platform": jax.devices()[0].platform}
 
 
+def measure_faults(b: int, n_base: int, steps: int, reps: int) -> dict:
+    """Idle fault-tolerance overhead on the serve path: the SAME fixed
+    mixed batch served under the default FaultPolicy vs a disabled one
+    (no finite checks, no retry budget). One engine, one executable set
+    — the legs differ only in host-side guard work, and no fault fires
+    (the 'enabled but idle' budget of ISSUE 8's acceptance gate)."""
+    import jax
+
+    from cbf_tpu.obs.trace import Tracer
+    from cbf_tpu.scenarios import swarm
+    from cbf_tpu.serve import FaultPolicy, ServeEngine
+
+    cfgs = [swarm.Config(n=max(4, n_base // (2 ** (i % 3))), steps=steps,
+                         seed=i, gating="jnp",
+                         safety_distance=0.4 + 0.003 * (i % 5))
+            for i in range(b)]
+    # Tracer disabled in both legs: spans have their own budget (--mode
+    # spans); this measurement isolates the fault machinery.
+    engine = ServeEngine(max_batch=8, tracer=Tracer(enabled=False))
+    engine.prewarm(cfgs)
+    policy_on = FaultPolicy()
+    policy_off = FaultPolicy(check_finite=False, max_retries=0)
+
+    def one(policy) -> float:
+        engine.fault_policy = policy
+        t0 = time.perf_counter()
+        engine.run(cfgs)
+        return time.perf_counter() - t0
+
+    one(policy_on), one(policy_off)       # warm both paths end to end
+    offs, ons = [], []
+    for i in range(reps):
+        legs = ((offs, policy_off), (ons, policy_on))
+        for acc, pol in (legs if i % 2 == 0 else legs[::-1]):
+            acc.append(one(pol))
+    engine.fault_policy = policy_on
+    off_s, on_s = min(offs), min(ons)
+    return {"mode": "faults", "b": b, "n_base": n_base, "steps": steps,
+            "reps": reps, "off_s": round(off_s, 4),
+            "on_s": round(on_s, 4),
+            "overhead": round((on_s - off_s) / off_s, 4),
+            "retries": engine.stats["retries"],
+            "nonfinite": engine.stats["nonfinite"],
+            "platform": jax.devices()[0].platform}
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--n", type=int, default=1024)
     p.add_argument("--steps", type=int, default=300)
     p.add_argument("--every", type=int, default=50)
     p.add_argument("--reps", type=int, default=5)
-    p.add_argument("--mode", choices=("rollout", "spans"),
+    p.add_argument("--mode", choices=("rollout", "spans", "faults"),
                    default="rollout")
     p.add_argument("--b", type=int, default=12,
-                   help="request count for --mode spans")
+                   help="request count for --mode spans/faults")
     args = p.parse_args()
-    if args.mode == "spans":
-        # Spans budget is per-request wall at serving sizes; the rollout
-        # defaults (N=1024) would swamp the signal with device time, so
-        # spans mode sizes down and serves a mixed batch instead.
+    if args.mode in ("spans", "faults"):
+        # Serve-path budgets are per-request wall at serving sizes; the
+        # rollout defaults (N=1024) would swamp the signal with device
+        # time, so these modes size down and serve a mixed batch instead.
         n_base = args.n if args.n != 1024 else 32
         steps = args.steps if args.steps != 300 else 40
-        print(json.dumps(measure_spans(args.b, n_base, steps, args.reps)))
+        fn = measure_spans if args.mode == "spans" else measure_faults
+        print(json.dumps(fn(args.b, n_base, steps, args.reps)))
     else:
         print(json.dumps(measure(args.n, args.steps, args.every,
                                  args.reps)))

@@ -1,0 +1,479 @@
+"""Fault tolerance in the serving engine (cbf_tpu.serve.resilience +
+the engine's recovery ladder + the utils.faults serve injectors).
+
+The load-bearing pins:
+
+- BLAST-RADIUS ISOLATION (ISSUE 8 acceptance): ONE poisoned request in
+  a FULL max_batch=8 batch fails alone with `NonFiniteResult` — its 7
+  healthy batch-mates all succeed (vmapped lanes are independent).
+- ZERO-HANG INVARIANT: every path that takes a request away from the
+  happy path — retry exhaustion, bisected offender, shed, deadline,
+  quarantine, cancel, even a crashed scheduler thread — RESOLVES the
+  request with a typed `ServeError`; nothing blocks forever. The chaos
+  soak drives the whole stack under injected faults and checks
+  ``completed + errors == requests``.
+- BIT-NEUTRALITY: the fault machinery enabled-but-idle serves the same
+  bytes as disabled (same engine, same executable — the guards never
+  touch device values), and its idle wall cost is <= 3%
+  (scripts/telemetry_overhead.py --mode faults, subprocess).
+
+Every engine here shares ONE prewarmed bucket executable (module
+fixture): n<=16, horizon 8 — the tests exercise host-side recovery
+logic, not compilation.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from cbf_tpu.obs.trace import Tracer  # noqa: E402
+from cbf_tpu.scenarios import swarm  # noqa: E402
+from cbf_tpu.serve import (DeadlineExceeded, FaultPolicy,  # noqa: E402
+                           LoadSpec, NonFiniteResult, QuarantinedError,
+                           RequestCancelled, SchedulerCrashed, ServeEngine,
+                           ShedError, is_retryable, request_signature,
+                           run_loadgen)
+from cbf_tpu.utils import faults  # noqa: E402
+
+
+def _cfg(seed=0, **kw):
+    kw.setdefault("n", 10)
+    kw.setdefault("steps", 8)
+    kw.setdefault("gating", "jnp")
+    return swarm.Config(seed=seed, **kw)
+
+
+class _Sink:
+    """Minimal telemetry stub: records (event_type, payload) pairs."""
+
+    def __init__(self):
+        self.events = []
+
+    def event(self, event_type, payload):
+        self.events.append((event_type, dict(payload)))
+
+    def of(self, event_type):
+        return [p for t, p in self.events if t == event_type]
+
+
+def _engine(sink=None, **kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("bucket_sizes", (16,))
+    kw.setdefault("horizon_quantum", 8)
+    kw.setdefault("flush_deadline_s", 0.15)
+    return ServeEngine(telemetry=sink, tracer=Tracer(enabled=False), **kw)
+
+
+@pytest.fixture(scope="module")
+def warm_execs():
+    """Compile the one (n16, t8) bucket executable once; every engine in
+    this module reuses it (BucketKey is hashable — sharing the _execs
+    dict is exactly the executable-cache contract)."""
+    eng = _engine()
+    eng.prewarm([_cfg()])
+    return eng._execs
+
+
+@pytest.fixture()
+def sink():
+    return _Sink()
+
+
+@pytest.fixture()
+def engine(warm_execs, sink):
+    eng = _engine(sink=sink)
+    eng._execs = warm_execs
+    return eng
+
+
+# ----------------------------------------------------------- taxonomy --
+
+def test_error_taxonomy_and_classification():
+    for exc in (ShedError, DeadlineExceeded, QuarantinedError,
+                NonFiniteResult, SchedulerCrashed, RequestCancelled):
+        e = exc("boom", request_id="r1", bucket="b")
+        assert e.request_id == "r1"
+        # Typed serve errors are deliberate verdicts — never retryable.
+        assert not is_retryable(e)
+    assert is_retryable(RuntimeError("transient"))
+    assert is_retryable(faults.InjectedExecutorFault("flaky"))
+    assert not is_retryable(ValueError("code bug"))
+
+
+def test_request_signature_ignores_seed_and_tracks_knobs():
+    a, b = _cfg(seed=1), _cfg(seed=99)
+    assert request_signature(a) == request_signature(b)
+    assert request_signature(a) != request_signature(
+        faults.poison_config(a))
+
+
+def test_fault_policy_validates():
+    with pytest.raises(ValueError, match="shed_policy"):
+        FaultPolicy(shed_policy="drop-random")
+    with pytest.raises(ValueError, match="max_retries"):
+        FaultPolicy(max_retries=-1)
+
+
+# ------------------------------------------- blast-radius isolation --
+
+def test_poisoned_request_fails_alone_in_full_batch(engine, sink):
+    """THE acceptance pin: a full batch of max_batch=8 with one poisoned
+    member — the poison fails alone, the 7 healthy lanes all succeed."""
+    cfgs = [_cfg(seed=i) for i in range(8)]
+    cfgs[3] = faults.poison_config(cfgs[3])
+    engine.start()
+    try:
+        pendings = [engine.submit(c) for c in cfgs]   # fills the batch
+        for i, p in enumerate(pendings):
+            if i == 3:
+                with pytest.raises(NonFiniteResult):
+                    p.result(timeout=120)
+            else:
+                res = p.result(timeout=120)
+                assert res.batch_fill == 8            # one shared flush
+                assert np.all(np.isfinite(res.final_state.x))
+    finally:
+        engine.stop()
+    assert engine.stats["batches"] == 1               # no re-execution
+    assert engine.stats["nonfinite"] == 1
+    assert engine.stats["requests"] == 7
+    assert engine.stats["bisects"] == 0               # per-slot check, not
+
+
+def test_transient_executor_fault_is_retried(engine, sink):
+    engine.fault_hook = faults.serve_executor_fault(times=1)
+    results = engine.run([_cfg(seed=i) for i in range(4)])
+    assert len(results) == 4
+    assert engine.stats["retries"] == 1
+    (retry,) = sink.of("serve.retry")
+    assert retry["action"] == "retry" and retry["attempt"] == 1
+    assert retry["error"] == "InjectedExecutorFault"
+    assert retry["backoff_s"] > 0
+
+
+def test_permanent_fault_bisects_to_offender(engine, sink):
+    """A permanent (ValueError) batch failure bisects down to the one
+    offending request; everyone else is re-run clean and succeeds."""
+    bad = 5
+
+    def hook(key, entries, attempt, phase):
+        if phase == "execute" and any(e[1].seed == bad for e in entries):
+            raise ValueError("request with seed=5 breaks the batch")
+
+    engine.fault_hook = hook
+    engine.start()
+    try:
+        pendings = [engine.submit(_cfg(seed=i)) for i in range(8)]
+        for i, p in enumerate(pendings):
+            if i == bad:
+                with pytest.raises(ValueError):
+                    p.result(timeout=120)
+            else:
+                p.result(timeout=120)
+    finally:
+        engine.stop()
+    assert engine.stats["retries"] == 0               # permanent: no retry
+    assert engine.stats["bisects"] == 3               # 8 -> 4 -> 2 -> 1
+    assert engine.stats["failed"] == 1
+    assert engine.stats["requests"] == 7
+    assert all(e["action"] == "bisect" for e in sink.of("serve.retry"))
+
+
+def test_compile_failure_fails_batch_without_bisecting(engine, sink):
+    """A compile-phase failure means the BUCKET is broken, not any
+    request: no bisection (it would recompile 2N times), every member
+    gets the error, the bucket breaker is charged."""
+    engine.fault_policy = FaultPolicy(max_retries=0)
+    engine.fault_hook = faults.serve_compile_failure(times=1)
+    engine.start()
+    try:
+        pendings = [engine.submit(_cfg(seed=i)) for i in range(8)]
+        for p in pendings:
+            with pytest.raises(faults.InjectedExecutorFault):
+                p.result(timeout=120)
+    finally:
+        engine.stop()
+    assert engine.stats["bisects"] == 0
+    assert engine.stats["failed"] == 8
+    assert engine._bucket_breakers                    # breaker charged
+
+
+# --------------------------------------------------- admission control --
+
+def test_admission_reject_newest(warm_execs, sink):
+    eng = _engine(sink=sink, flush_deadline_s=60.0)
+    eng._execs = warm_execs
+    eng.fault_policy = FaultPolicy(queue_limit=2)
+    eng.start()
+    try:
+        a = eng.submit(_cfg(seed=0))
+        b = eng.submit(_cfg(seed=1))
+        with pytest.raises(ShedError):
+            eng.submit(_cfg(seed=2))
+    finally:
+        eng.stop(drain=True)                          # flushes a and b
+    assert a.result(timeout=0).n == 10 and b.result(timeout=0).n == 10
+    assert eng.stats["shed"] == 1
+    (shed,) = sink.of("serve.shed")
+    assert shed["reason"] == "queue_full" and shed["queue_depth"] == 2
+
+
+def test_admission_reject_oldest_evicts(warm_execs, sink):
+    eng = _engine(sink=sink, flush_deadline_s=60.0)
+    eng._execs = warm_execs
+    eng.fault_policy = FaultPolicy(queue_limit=2,
+                                   shed_policy="reject-oldest")
+    eng.start()
+    try:
+        a = eng.submit(_cfg(seed=0))
+        b = eng.submit(_cfg(seed=1))
+        c = eng.submit(_cfg(seed=2))                  # evicts a
+        with pytest.raises(ShedError):
+            a.result(timeout=1)
+    finally:
+        eng.stop(drain=True)
+    assert b.result(timeout=0).n == 10 and c.result(timeout=0).n == 10
+    (shed,) = sink.of("serve.shed")
+    assert shed["reason"] == "oldest_evicted"
+    assert shed["request_id"] == a.request_id
+
+
+def test_deadline_expired_request_dropped_before_execute(engine, sink):
+    engine.start()
+    try:
+        pa = engine.submit(_cfg(seed=0), deadline_s=0.01)
+        pb = engine.submit(_cfg(seed=1))              # same bucket, no dl
+        with pytest.raises(DeadlineExceeded):
+            pa.result(timeout=120)                    # flush at 0.15s > dl
+        assert pb.result(timeout=120).batch_fill == 1  # expired not packed
+    finally:
+        engine.stop()
+    assert engine.stats["deadline_expired"] == 1
+    (shed,) = sink.of("serve.shed")
+    assert shed["reason"] == "deadline"
+
+
+# -------------------------------------------------- quarantine breaker --
+
+def test_quarantine_trips_and_recovers(warm_execs, sink):
+    """Two strikes open the signature breaker (submits fail fast with
+    QuarantinedError); after the cooldown one probe is admitted, and its
+    success closes the breaker again."""
+    eng = _engine(sink=sink, flush_deadline_s=0.02)
+    eng._execs = warm_execs
+    eng.fault_policy = FaultPolicy(max_retries=0, quarantine_threshold=2,
+                                   quarantine_cooldown_s=0.3)
+    eng.fault_hook = faults.serve_executor_fault(times=2, exc=ValueError(
+        "permanent model bug"))
+    cfg = _cfg(seed=0)
+    eng.start()
+    try:
+        for _ in range(2):                            # two strikes -> open
+            with pytest.raises(ValueError):
+                eng.submit(cfg).result(timeout=120)
+        with pytest.raises(QuarantinedError):         # fail-fast admission
+            eng.submit(dataclasses.replace(cfg, seed=7))  # same signature
+        assert eng.stats["quarantined"] == 1
+        time.sleep(0.35)                              # past the cooldown
+        probe = eng.submit(cfg)                       # half-open: admitted
+        assert probe.result(timeout=120).n == 10      # hook exhausted
+        eng.submit(cfg).result(timeout=120)           # breaker closed
+    finally:
+        eng.stop()
+    states = [e["state"] for e in sink.of("serve.quarantine")]
+    assert states == ["open", "closed"]
+
+
+# -------------------------------------------- scheduler crash + cancel --
+
+def test_scheduler_crash_resolves_queued_requests(warm_execs, sink,
+                                                  monkeypatch):
+    """A bug escaping the scheduler thread must not strand queued
+    requests on a silently dead thread: they resolve SchedulerCrashed."""
+    eng = _engine(sink=sink, flush_deadline_s=60.0)
+    eng._execs = warm_execs
+    eng.start()
+    try:
+        p = eng.submit(_cfg(seed=0))
+        time.sleep(0.05)                # scheduler parked on its cond wait
+
+        def boom(now):
+            raise RuntimeError("injected scheduler bug")
+
+        monkeypatch.setattr(eng, "_scan_queue", boom)
+        with eng._cond:
+            eng._cond.notify()
+        with pytest.raises(SchedulerCrashed):
+            p.result(timeout=10)
+    finally:
+        eng.stop(drain=False)
+    assert eng.stats["scheduler_crashes"] == 1
+    (crash,) = sink.of("serve.scheduler_crash")
+    assert crash["resolved"] == 1 and "RuntimeError" in crash["error"]
+
+
+def test_cancel_queued_and_cancel_too_late(warm_execs, sink):
+    eng = _engine(sink=sink, flush_deadline_s=60.0)
+    eng._execs = warm_execs
+    eng.start()
+    try:
+        p = eng.submit(_cfg(seed=0))
+        assert p.cancel() is True
+        with pytest.raises(RequestCancelled):
+            p.result(timeout=1)
+        assert p.cancel() is False                    # idempotent: gone
+        eng.flush_deadline_s = 0.05
+        q = eng.submit(_cfg(seed=1))
+        res = q.result(timeout=120)                   # already served
+        assert q.cancel() is False                    # too late: no change
+        assert q.result(timeout=0) is res
+    finally:
+        eng.stop()
+    assert eng.stats["cancelled"] == 1
+    assert eng.stats["requests"] == 1                 # cancelled never ran
+
+
+# ------------------------------------------------ graceful degradation --
+
+def test_sustained_overload_degrades_horizon(warm_execs, sink):
+    """Queue depth past the high watermark for the sustain window flips
+    the engine into degraded mode: the traced horizon mask is capped
+    (same executable — no recompile), results say so."""
+    eng = _engine(sink=sink, flush_deadline_s=0.3)
+    eng._execs = warm_execs
+    eng.fault_policy = FaultPolicy(degrade_high_watermark=2,
+                                   degrade_sustain_s=0.05,
+                                   degrade_steps_frac=0.5)
+    eng.start()
+    try:
+        pendings = [eng.submit(_cfg(seed=i)) for i in range(6)]
+        results = [p.result(timeout=120) for p in pendings]
+    finally:
+        eng.stop()
+    assert all(r.degraded for r in results)
+    assert all(r.steps == 4 for r in results)         # horizon 8 * 0.5
+    assert results[0].outputs.min_pairwise_distance.shape == (4,)
+    assert eng.stats["degraded_requests"] == 6
+    enter = sink.of("serve.degrade")[0]
+    assert enter["state"] == "enter" and enter["queue_depth"] >= 3
+    assert eng.stats["batches"] == 1                  # reused executable
+
+
+# ----------------------------------------- idle neutrality + manifest --
+
+def test_idle_fault_machinery_is_bit_neutral(engine):
+    """Fault tolerance enabled-but-idle returns the same bytes as
+    disabled: same engine, same executable, only host-side guards differ
+    — they never touch device values."""
+    cfgs = [_cfg(seed=i) for i in range(3)]
+    on = engine.run(cfgs)
+    engine.fault_policy = FaultPolicy(check_finite=False, max_retries=0)
+    off = engine.run(cfgs)
+    for a, b in zip(on, off):
+        np.testing.assert_array_equal(a.final_state.x, b.final_state.x)
+        np.testing.assert_array_equal(a.outputs.min_pairwise_distance,
+                                      b.outputs.min_pairwise_distance)
+    assert engine.stats["retries"] == 0
+    assert engine.stats["nonfinite"] == 0
+
+
+def test_manifest_snapshots_fault_policy_and_counters(engine):
+    engine.run([_cfg(seed=0)])
+    extra = engine.manifest_extra()["serve"]
+    assert extra["fault_policy"]["max_retries"] == 2
+    assert extra["fault_policy"]["check_finite"] is True
+    for k in ("retries", "bisects", "shed", "deadline_expired",
+              "quarantined", "failed", "nonfinite", "cancelled",
+              "degraded_requests", "scheduler_crashes"):
+        assert extra["fault_stats"][k] == 0, k
+
+
+# ------------------------------------------------------------ chaos soak --
+
+@pytest.mark.slow
+def test_chaos_soak_resolves_every_request(warm_execs, sink):
+    """The standing chaos gate: open-loop traffic with every injector
+    live at once — poisoned configs, transient executor faults, latency
+    spikes, a bounded queue with deadlines — and EVERY request resolves:
+    completed + errors == requests, every error is a typed ServeError,
+    zero hangs (no TimeoutError)."""
+    spec = LoadSpec(rps=40.0, duration_s=1.5, seed=0, n_min=8, n_max=12,
+                    steps_choices=(8,))
+    eng = _engine(sink=sink, flush_deadline_s=0.05)
+    eng._execs = warm_execs
+    eng.fault_policy = FaultPolicy(queue_limit=32, deadline_s=5.0,
+                                   quarantine_threshold=3,
+                                   quarantine_cooldown_s=0.5)
+    # times=2 == the default max_retries: a transient burst the retry
+    # budget is provisioned for always recovers, so the only expected
+    # casualties are the typed shed/deadline/quarantine/poison verdicts.
+    eng.fault_hook = faults.serve_chaos_hook(
+        faults.serve_executor_fault(times=2),
+        faults.serve_latency_spike(0.05, every=4))
+
+    def mutate(i, cfg):
+        return faults.poison_config(cfg) if i % 5 == 4 else cfg
+
+    report = run_loadgen(eng, spec, mutate=mutate, result_timeout_s=60.0)
+    assert report["requests"] > 20
+    assert report["completed"] + report["errors"] == report["requests"]
+    assert report["completed"] > 0
+    assert report["errors"] > 0                       # faults really fired
+    allowed = {"NonFiniteResult", "ShedError", "DeadlineExceeded",
+               "QuarantinedError"}
+    assert set(report["errors_by_type"]) <= allowed, report["errors_by_type"]
+    assert report["errors_by_type"].get("NonFiniteResult", 0) > 0
+    assert eng.stats["retries"] >= 1                  # transients recovered
+    # Healthy completions stayed safe under chaos.
+    assert report["min_pairwise_distance"] > 0.1
+
+
+@pytest.mark.slow
+def test_fault_overhead_within_budget():
+    """Idle fault machinery costs <= 3% of the engine's request wall —
+    same budget and interleaved min-of-R methodology as the heartbeat
+    tap and span tracing (subprocess for a clean single-device
+    backend)."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts",
+                                      "telemetry_overhead.py"),
+         "--mode", "faults", "--reps", "5"],
+        capture_output=True, text=True, env=env, cwd=ROOT, timeout=560)
+    assert out.returncode == 0, out.stderr[-800:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["retries"] == 0 and rec["nonfinite"] == 0   # truly idle
+    assert rec["overhead"] <= 0.03, (
+        f"idle fault-tolerance overhead {rec['overhead']:.1%} > 3% budget "
+        f"(off {rec['off_s']}s, on {rec['on_s']}s)")
+
+
+# ---------------------------------------------------------------- docs --
+
+def test_fault_tolerance_documented():
+    """docs/API.md 'Fault tolerance' stays in lockstep with the code —
+    the same audit-enforcement style as the Serving section (AUD001
+    additionally pins the event-type tables both ways)."""
+    with open(os.path.join(ROOT, "docs", "API.md")) as fh:
+        text = fh.read()
+    assert "## Fault tolerance" in text
+    for needle in ("FaultPolicy", "ShedError", "DeadlineExceeded",
+                   "QuarantinedError", "NonFiniteResult",
+                   "SchedulerCrashed", "RequestCancelled",
+                   "serve.retry", "serve.shed", "serve.quarantine",
+                   "serve.degrade", "serve.scheduler_crash",
+                   "max_retries", "queue_limit", "shed_policy",
+                   "deadline_s", "quarantine_threshold",
+                   "degrade_steps_frac", "cancel", "bisect",
+                   "poison_config", "fault_hook", "BENCH_CHAOS"):
+        assert needle in text, f"docs/API.md Fault tolerance: missing {needle!r}"
