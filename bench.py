@@ -116,6 +116,20 @@ Modes / env knobs:
     BENCH_CHAOS_SPIKE_S (0.1), BENCH_CHAOS_SPIKE_EVERY (10), plus the
     BENCH_SLO_NMIN/NMAX/ALPHA/MAX_BATCH/FLUSH sizing knobs. See
     docs/BENCH_LOG.md Round 11.
+  BENCH_PREEMPT=1 — kill-driven durability mode (cbf_tpu.durable +
+    utils.faults): an uninterrupted durable-runner reference, then the
+    same spec SIGKILLed at seeded points across BENCH_PREEMPT_ROUNDS
+    rounds through the real CLI, one deliberate checkpoint corruption,
+    a final `run --resume` to completion, and a journaled serve run
+    killed mid-batch then replayed via `serve --recover`. Gates:
+    resumed outputs BIT-IDENTICAL to the reference, the corrupted step
+    skipped (never trusted), zero acknowledged serve requests lost,
+    and recovery time (MTTR, the reported value) under
+    BENCH_PREEMPT_MTTR_BOUND. Knobs: BENCH_PREEMPT_ROUNDS (3),
+    BENCH_PREEMPT_SEED (0), BENCH_PREEMPT_N (512),
+    BENCH_PREEMPT_STEPS (4000), BENCH_PREEMPT_CHUNK (400),
+    BENCH_PREEMPT_MTTR_BOUND (60 s). Subprocesses run on CPU (the axis
+    is durability, not rate). See docs/BENCH_LOG.md Round 12.
   BENCH_ENSEMBLE=1 (or --ensemble) — dp-sharded ensemble of independent
     swarms over all available devices (the multi-chip measurement path for
     the v4-8 ladder rung); adds "chips" + "scaling_efficiency" fields.
@@ -1399,6 +1413,299 @@ def _child_chaos(steps: int) -> dict:
     return result
 
 
+def _child_preempt(steps: int) -> dict:
+    """BENCH_PREEMPT mode: kill-driven durability harness
+    (cbf_tpu.durable + cbf_tpu.utils.faults). Two legs, both driven
+    through the real CLI in subprocesses so the kills hit whole
+    processes, not in-process mocks:
+
+    - rollout: an uninterrupted reference run of the durable runner
+      (`run swarm --durable-dir`), then the SAME spec SIGKILLed at
+      seeded random points across BENCH_PREEMPT_ROUNDS rounds (each
+      kill anchored a seeded delay after observed forward progress, so
+      every round both advances and dies), one deliberate checkpoint
+      corruption, and a final `run --resume` to completion. Gates:
+      every resume restores (corruption is SKIPPED to the previous
+      intact step, never trusted), the stitched outputs are
+      BIT-IDENTICAL to the reference (sha256 over every chunk array),
+      safety holds, and the measured in-process recovery time (MTTR,
+      from resume_log.jsonl) stays under BENCH_PREEMPT_MTTR_BOUND.
+    - serve: a journaled serve run (`serve --journal`) SIGKILLed
+      mid-batch, then `serve --journal --recover`. Gate: ZERO
+      acknowledged requests lost — the journal folds to no unresolved
+      entries after recovery.
+
+    Subprocesses run --platform cpu: the axis is durability, not rate,
+    and the parent may hold the TPU lease."""
+    import hashlib
+    import shutil
+    import subprocess
+    import tempfile as _tempfile
+    import time as _time
+
+    import numpy as np
+
+    from cbf_tpu.durable.journal import replay_journal
+    from cbf_tpu.utils import faults
+
+    rounds = _env_int("BENCH_PREEMPT_ROUNDS", 3)
+    seed = _env_int("BENCH_PREEMPT_SEED", 0)
+    n = _env_int("BENCH_PREEMPT_N", 512)
+    steps = _env_int("BENCH_PREEMPT_STEPS", 4000)
+    chunk = _env_int("BENCH_PREEMPT_CHUNK", 400)
+    mttr_bound = _env_float("BENCH_PREEMPT_MTTR_BOUND", 60.0)
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    work = _tempfile.mkdtemp(prefix="bench_preempt_")
+    ref_dir = os.path.join(work, "ref")
+    kill_dir = os.path.join(work, "killed")
+
+    def run_argv(d):
+        return [sys.executable, "-m", "cbf_tpu", "run", "swarm",
+                "--durable-dir", d, "--platform", "cpu",
+                "--set", f"n={n}", "--steps", str(steps),
+                "--chunk", str(chunk)]
+
+    def chunk_files(d):
+        out = os.path.join(d, "outputs")
+        if not os.path.isdir(out):
+            return []
+        return [os.path.join(out, f) for f in sorted(os.listdir(out))
+                if f.endswith(".npz")]
+
+    def digest_outputs(d):
+        # Hash the ARRAY bytes, not the files: npz zip metadata carries
+        # timestamps, the arrays carry the actual StepOutputs.
+        h = hashlib.sha256()
+        for path in chunk_files(d):
+            with np.load(path) as z:
+                for k in sorted(z.files):
+                    h.update(np.ascontiguousarray(z[k]).tobytes())
+        return h.hexdigest()
+
+    # ---- leg 1: uninterrupted reference ----------------------------------
+    print(f"bench: preempt reference run (N={n}, {steps} steps, "
+          f"chunk {chunk}) in {ref_dir}", file=sys.stderr)
+    proc = subprocess.run(run_argv(ref_dir), env=env,
+                          stdout=subprocess.DEVNULL,
+                          stderr=subprocess.DEVNULL, timeout=300)
+    if proc.returncode != 0:
+        return {"error": f"preempt reference run failed rc={proc.returncode}",
+                "retryable": True}
+    ref_digest = digest_outputs(ref_dir)
+
+    # ---- leg 2: seeded kill campaign on the same spec --------------------
+    delays = faults.kill_schedule(seed, rounds, 0.5, 3.0)
+    kills = 0
+    for r, delay in enumerate(delays):
+        t_launch = _time.time()
+
+        def should_kill(elapsed, t_launch=t_launch, delay=delay,
+                        armed=[None]):
+            if armed[0] is None:
+                # Arm on forward progress: a chunk file WRITTEN BY THIS
+                # process (mtime after launch) — a kill inside startup
+                # or compile would only re-run step 0.
+                if any(os.path.getmtime(p) >= t_launch
+                       for p in chunk_files(kill_dir)):
+                    armed[0] = elapsed
+                return False
+            return elapsed - armed[0] >= delay
+
+        rc, killed, elapsed = faults.run_process_until(
+            run_argv(kill_dir), should_kill, poll_s=0.05, timeout_s=300,
+            env=env)
+        if not killed:
+            if rc != 0:
+                return {"error": f"preempt round {r} exited rc={rc} "
+                                 f"before the kill", "retryable": True}
+            print(f"bench: preempt round {r} completed before the kill "
+                  f"({elapsed:.1f}s) — workload too small for the "
+                  f"schedule", file=sys.stderr)
+            break
+        kills += 1
+        print(f"bench: preempt round {r} SIGKILL at {elapsed:.1f}s "
+              f"(+{delay:.2f}s after progress), "
+              f"{len(chunk_files(kill_dir))} chunks on disk",
+              file=sys.stderr)
+
+    # ---- leg 3: deliberate checkpoint corruption -------------------------
+    ckpt_dir = os.path.join(kill_dir, "ckpt")
+
+    def committed_steps():
+        if not os.path.isdir(ckpt_dir):
+            return []
+        return sorted(
+            (int(s) for s in os.listdir(ckpt_dir) if s.isdigit()
+             and os.path.exists(os.path.join(ckpt_dir, s,
+                                             "integrity.json"))),
+            reverse=True)
+
+    # The corruption round needs a committed step to damage AND an older
+    # intact one to walk back to. A SIGKILL often lands mid-save (the
+    # newest step dir is torn, pre-manifest), leaving only ONE committed
+    # step — so arm extra rounds on a fresh manifest COMMIT, killing
+    # just after it: retention (max_to_keep=2) then guarantees the pair.
+    committed = committed_steps()
+    extra_round = 0
+    while len(committed) < 2 and extra_round < 2:
+        extra_round += 1
+        prior = len(committed)
+
+        def kill_on_commit(elapsed, armed=[None], prior=prior):
+            if armed[0] is None:
+                if len(committed_steps()) > prior:
+                    armed[0] = elapsed
+                return False
+            return elapsed - armed[0] >= 0.3
+
+        rc, killed, elapsed = faults.run_process_until(
+            run_argv(kill_dir), kill_on_commit, poll_s=0.05, timeout_s=300,
+            env=env)
+        committed = committed_steps()
+        if not killed:
+            break
+        kills += 1
+        print(f"bench: preempt commit-armed round SIGKILL at "
+              f"{elapsed:.1f}s, committed steps: {committed}",
+              file=sys.stderr)
+    corrupted_step = None
+    if len(committed) >= 2:
+        # Corrupt the NEWEST committed step (every data file under
+        # default/ — orbax spreads leaf bytes over several); the resume
+        # must walk back to the previous intact step, never trust it.
+        corrupted_step = committed[0]
+        step_dir = os.path.join(ckpt_dir, str(corrupted_step), "default")
+        for root, _, names in os.walk(step_dir):
+            for name in names:
+                path = os.path.join(root, name)
+                if os.path.getsize(path):
+                    with open(path, "r+b") as fh:
+                        fh.seek(0)
+                        first = fh.read(1)
+                        fh.seek(0)
+                        fh.write(bytes([first[0] ^ 0xFF]))
+        print(f"bench: corrupted checkpoint step {corrupted_step} "
+              f"(intact fallback: {committed[1]})", file=sys.stderr)
+
+    # ---- leg 4: final resume to completion -------------------------------
+    final = subprocess.run(
+        [sys.executable, "-m", "cbf_tpu", "run", "--resume", kill_dir,
+         "--platform", "cpu"],
+        env=env, capture_output=True, text=True, timeout=300)
+    if final.returncode != 0:
+        return {"error": f"final `run --resume` failed rc="
+                         f"{final.returncode}: {final.stderr[-300:]}",
+                "retryable": False}
+    record = json.loads(final.stdout.splitlines()[-1])
+
+    resume_log = []
+    log_path = os.path.join(kill_dir, "resume_log.jsonl")
+    if os.path.exists(log_path):
+        with open(log_path) as fh:
+            resume_log = [json.loads(ln) for ln in fh if ln.strip()]
+    if kills and not resume_log:
+        return {"error": f"{kills} kills produced no resume-log entry — "
+                         "no round actually restored from a checkpoint",
+                "retryable": True}
+    if corrupted_step is not None and not any(
+            e["corrupt_skipped"] for e in resume_log):
+        return {"error": f"corrupted step {corrupted_step} was never "
+                         "skipped — the resume trusted damaged state",
+                "retryable": False}
+
+    kill_digest = digest_outputs(kill_dir)
+    if kill_digest != ref_digest:
+        return {"error": "resumed outputs diverge from the uninterrupted "
+                         f"reference ({kill_digest[:12]}… != "
+                         f"{ref_digest[:12]}…) — resume is not bit-exact",
+                "retryable": False}
+    err = _check_safety(record["min_pairwise_distance"],
+                        record["infeasible_agent_steps"],
+                        floor=_dynamics_floor("single"))
+    if err:
+        return {"error": err, "retryable": False}
+    mttr = max(e["recovery_s"] for e in resume_log) if resume_log else 0.0
+    if mttr > mttr_bound:
+        return {"error": f"MTTR {mttr:.1f}s exceeds the "
+                         f"{mttr_bound:.0f}s bound", "retryable": False}
+
+    # ---- leg 5: serve WAL kill + recovery --------------------------------
+    reqs_path = os.path.join(work, "requests.json")
+    with open(reqs_path, "w") as fh:
+        json.dump([{"steps": 10, "seed": 1, "overrides": {"n": 8},
+                    "repeat": 3},
+                   {"steps": 20, "seed": 2, "overrides": {"n": 8},
+                    "repeat": 3}], fh)
+    journal = os.path.join(work, "wal.jsonl")
+    serve_argv = [sys.executable, "-m", "cbf_tpu", "serve", reqs_path,
+                  "--journal", journal, "--platform", "cpu",
+                  "--max-batch", "4"]
+    serve_delay = faults.kill_schedule(seed + 1, 1, 0.0, 0.5)[0]
+
+    def serve_kill(elapsed, armed=[None]):
+        if armed[0] is None:
+            # Arm once the journal holds an acknowledged request.
+            try:
+                with open(journal) as fh:
+                    if sum(1 for ln in fh if ln.strip()):
+                        armed[0] = elapsed
+            except OSError:
+                pass
+            return False
+        return elapsed - armed[0] >= serve_delay
+
+    rc, killed, elapsed = faults.run_process_until(
+        serve_argv, serve_kill, poll_s=0.02, timeout_s=300, env=env)
+    unresolved_before = len(replay_journal(journal).unresolved)
+    print(f"bench: serve leg {'SIGKILL at %.1fs' % elapsed if killed else 'completed (rc=%s)' % rc}, "
+          f"{unresolved_before} acknowledged-unresolved in the journal",
+          file=sys.stderr)
+    recover = subprocess.run(
+        [sys.executable, "-m", "cbf_tpu", "serve", "--journal", journal,
+         "--recover", "--platform", "cpu", "--max-batch", "4"],
+        env=env, capture_output=True, text=True, timeout=300)
+    if recover.returncode != 0:
+        return {"error": f"serve --recover failed rc={recover.returncode}: "
+                         f"{recover.stderr[-300:]}", "retryable": False}
+    lost = len(replay_journal(journal).unresolved)
+    if lost:
+        return {"error": f"{lost} acknowledged requests still unresolved "
+                         "after recovery — requests were lost",
+                "retryable": False}
+
+    shutil.rmtree(work, ignore_errors=True)
+    result = {
+        "metric": (f"durable-execution MTTR under {kills} seeded SIGKILLs "
+                   f"(N={n}, {steps} steps, chunk {chunk}, "
+                   "+ serve WAL recovery)"),
+        "value": round(mttr, 4),
+        "unit": "seconds",
+        "vs_baseline": 0,   # a durability axis, not the headline rate
+        "preempt": True,
+        "rounds": rounds,
+        "kills": kills,
+        "seed": seed,
+        "bit_exact": True,
+        "output_sha256": ref_digest,
+        "resumes": len(resume_log),
+        "resumed_from_steps": [e["resumed_from_step"] for e in resume_log],
+        "recovery_s": [round(e["recovery_s"], 4) for e in resume_log],
+        "mttr_bound_s": mttr_bound,
+        "corrupted_step": corrupted_step,
+        "corrupt_skipped": sorted({s for e in resume_log
+                                   for s in e["corrupt_skipped"]}),
+        "serve_killed": killed,
+        "serve_unresolved_before_recover": unresolved_before,
+        "serve_lost_after_recover": 0,
+        "min_pairwise_distance": record["min_pairwise_distance"],
+        "platform": "cpu",
+    }
+    return result
+
+
 def _is_permanent_error(e: BaseException) -> bool:
     """Transient device/tunnel deaths raise (XlaRuntimeError: connection
     reset / DEADLINE_EXCEEDED / UNAVAILABLE) rather than hang — those must
@@ -1432,7 +1739,9 @@ def child_main(result_path: str, ensemble: bool) -> None:
     # the r02 rate; the 420 s attempt timeout has ample slack).
     steps = _env_int("BENCH_STEPS", 10_000)
     try:
-        if os.environ.get("BENCH_VERIFY", "0") == "1":
+        if os.environ.get("BENCH_PREEMPT", "0") == "1":
+            result = _child_preempt(steps)
+        elif os.environ.get("BENCH_VERIFY", "0") == "1":
             result = _child_verify(steps)
         elif os.environ.get("BENCH_CHAOS", "0") == "1":
             result = _child_chaos(steps)
@@ -1546,7 +1855,9 @@ def main() -> None:
             time.sleep(backoff)
             backoff *= 2
 
-    if os.environ.get("BENCH_VERIFY", "0") == "1":
+    if os.environ.get("BENCH_PREEMPT", "0") == "1":
+        label = "preempt rounds=%d" % _env_int("BENCH_PREEMPT_ROUNDS", 3)
+    elif os.environ.get("BENCH_VERIFY", "0") == "1":
         label = "verify N=%d" % _env_int("BENCH_VERIFY_N", 256)
     elif os.environ.get("BENCH_CHAOS", "0") == "1":
         label = "chaos rps=%g" % _env_float("BENCH_CHAOS_RPS", 8.0)

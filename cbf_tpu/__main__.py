@@ -113,6 +113,71 @@ def _apply_overrides(cfg, pairs: list[str], steps: int | None,
     return dataclasses.replace(cfg, **updates)
 
 
+def _run_durable(args) -> int:
+    """``run --durable-dir D`` / ``run --resume D``: dispatch through the
+    crash-recoverable runner (cbf_tpu.durable.rollout). Exit 2 on a
+    missing/corrupt run spec or a scenario/config mismatch against an
+    existing run directory — never a traceback for operator errors."""
+    from cbf_tpu.durable import rollout as durable
+    from cbf_tpu.utils.debug import summarize
+
+    directory = args.resume or args.durable_dir
+    if args.resume and args.durable_dir and \
+            os.path.abspath(args.resume) != os.path.abspath(args.durable_dir):
+        print("run: --resume and --durable-dir name different directories",
+              file=sys.stderr)
+        return 2
+    scenario = cfg = None
+    if args.resume:
+        try:
+            scenario = durable.load_spec(directory)["scenario"]
+        except (FileNotFoundError, ValueError) as e:
+            print(f"run: {e}", file=sys.stderr)
+            return 2
+    else:
+        if args.scenario is None:
+            print("run: a scenario is required with --durable-dir "
+                  "(or use --resume DIR)", file=sys.stderr)
+            return 2
+        scenario = args.scenario
+        module, steps_field, _, _ = _scenarios()[scenario]
+        cfg = _apply_overrides(module.Config(), args.set, args.steps,
+                               steps_field, need_trajectory=False)
+
+    sink = None
+    if args.telemetry_dir:
+        from cbf_tpu import obs
+
+        sink = obs.TelemetrySink(
+            args.telemetry_dir,
+            manifest=obs.build_manifest(cfg, extra={
+                "scenario": scenario,
+                "durable_dir": os.path.abspath(directory)}))
+    try:
+        out = durable.run_durable(
+            directory, scenario=None if args.resume else scenario, cfg=cfg,
+            chunk=args.chunk, telemetry=sink,
+            telemetry_every=args.telemetry_every)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"run: {e}", file=sys.stderr)
+        return 2
+
+    record = {"scenario": scenario,
+              "durable_dir": os.path.abspath(directory),
+              "steps": out["steps"],
+              "resumed_from_step": out["resumed_from_step"],
+              "recovery_s": round(out["recovery_s"], 4),
+              "corrupt_skipped": out["corrupt_skipped"]}
+    if out["outputs"] is not None:
+        record.update(summarize(out["outputs"]))
+    if sink is not None:
+        sink.summary()
+        sink.close()
+        record["telemetry"] = sink.run_dir
+    print(json.dumps(record))
+    return 0
+
+
 def cmd_run(args) -> int:
     import contextlib
 
@@ -120,6 +185,13 @@ def cmd_run(args) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+
+    if args.resume or args.durable_dir:
+        return _run_durable(args)
+    if args.scenario is None:
+        print("run: a scenario is required (or --resume DIR)",
+              file=sys.stderr)
+        return 2
 
     from cbf_tpu.rollout.engine import rollout, rollout_chunked
     from cbf_tpu.utils import profiling
@@ -372,7 +444,44 @@ def cmd_serve(args) -> int:
     from cbf_tpu.serve import ServeEngine
     from cbf_tpu.utils import profiling
 
-    cfgs = _load_requests(args.requests)
+    if args.recover and not args.journal:
+        print("serve: --recover requires --journal", file=sys.stderr)
+        return 2
+    if args.requests is None and not args.recover:
+        print("serve: a requests file is required (or --journal PATH "
+              "--recover)", file=sys.stderr)
+        return 2
+
+    request_ids = None
+    recovered = []
+    if args.recover:
+        # Fold the previous process's journal FIRST (fail fast, exit 2)
+        # — the engine below then journals the re-run outcomes to the
+        # same file, closing the at-least-once loop.
+        from cbf_tpu.durable.journal import replay_journal
+        from cbf_tpu.serve import RecoveryError
+
+        try:
+            replay = replay_journal(args.journal)
+        except (OSError, RecoveryError) as e:
+            print(f"serve: {e}", file=sys.stderr)
+            return 2
+        recovered = replay.unresolved_configs()
+        cfgs = [cfg for _, cfg in recovered]
+        request_ids = [rid for rid, _ in recovered]
+        if args.requests:
+            # Fresh requests ride along under a distinct id prefix so
+            # they can never collide with (and silently reopen) ids the
+            # previous process already journaled.
+            extra = _load_requests(args.requests)
+            cfgs.extend(extra)
+            request_ids.extend(f"n{i}" for i in range(len(extra)))
+        if not cfgs:
+            print(json.dumps({"requests": 0, "recovered": 0,
+                              "journal": os.path.abspath(args.journal)}))
+            return 0
+    else:
+        cfgs = _load_requests(args.requests)
 
     sink = None
     if args.telemetry_dir:
@@ -382,7 +491,8 @@ def cmd_serve(args) -> int:
     engine = ServeEngine(max_batch=args.max_batch,
                          flush_deadline_s=args.flush_deadline,
                          cache_dir=args.cache_dir, telemetry=sink,
-                         fault_policy=_fault_policy_from(args))
+                         fault_policy=_fault_policy_from(args),
+                         journal=args.journal)
     prewarm_s = None
     if args.prewarm or args.prewarm_only:
         prewarm_s = engine.prewarm(cfgs)
@@ -395,6 +505,11 @@ def cmd_serve(args) -> int:
             None, extra=engine.manifest_extra()))
     record = {"requests": len(cfgs), "cache_dir": engine.cache_dir,
               "max_batch": args.max_batch}
+    if args.journal:
+        record["journal"] = os.path.abspath(args.journal)
+    if args.recover:
+        record["recovered"] = len(recovered)
+        record["recovered_request_ids"] = [rid for rid, _ in recovered]
     if prewarm_s is not None:
         record["prewarm_s"] = prewarm_s
         record["buckets"] = engine.manifest_extra()["serve"]["buckets"]
@@ -405,8 +520,23 @@ def cmd_serve(args) -> int:
             sink.close()
         return 0
 
+    # Preemption notice (SIGTERM) becomes a graceful drain: every
+    # acknowledged request resolves (and journals its terminal record)
+    # before the process dies. ValueError = embedded off the main
+    # thread, where the signal module refuses handlers — skip quietly.
+    prev_term = None
+    try:
+        prev_term = engine.install_sigterm_handler()
+    except ValueError:
+        pass
     t0 = _time.perf_counter()
-    results = engine.run(cfgs)
+    try:
+        results = engine.run(cfgs, request_ids=request_ids)
+    finally:
+        if prev_term is not None:
+            import signal as _signal
+
+            _signal.signal(_signal.SIGTERM, prev_term)
     wall = _time.perf_counter() - t0
     lat = sorted(r.latency_s for r in results)
     qwait = sorted(r.queue_wait_s for r in results)
@@ -601,9 +731,16 @@ def cmd_verify(args) -> int:
                     "engines": args.engine, "seed": settings.seed}}))
 
     engines = tuple(args.engine) if args.engine else ("random", "cem")
-    results = V.falsify(
-        args.scenario, cfg, settings=settings, engines=engines, cbf=cbf,
-        thresholds=thresholds, telemetry=sink, mesh=mesh)
+    try:
+        results = V.falsify(
+            args.scenario, cfg, settings=settings, engines=engines, cbf=cbf,
+            thresholds=thresholds, telemetry=sink, mesh=mesh,
+            state_dir=args.state_dir, resume=args.resume)
+    except ValueError as e:
+        # Fingerprint mismatch: --state-dir holds a campaign run under
+        # different settings. Operator error, not a traceback.
+        print(f"verify: {e}", file=sys.stderr)
+        return 2
 
     from cbf_tpu.obs.schema import json_scalar
 
@@ -716,7 +853,8 @@ def main(argv=None) -> int:
     sub = p.add_subparsers(dest="command", required=True)
 
     runp = sub.add_parser("run", help="run a scenario")
-    runp.add_argument("scenario", choices=sorted(_scenarios()))
+    runp.add_argument("scenario", nargs="?", default=None,
+                      choices=sorted(_scenarios()))
     runp.add_argument("--platform", default=None, choices=("cpu", "tpu"),
                       help="force a JAX backend before first use (the TPU "
                            "plugin here ignores the JAX_PLATFORMS env var, "
@@ -735,6 +873,17 @@ def main(argv=None) -> int:
     runp.add_argument("--chunk", type=int, default=1000,
                       help="steps per compiled chunk when checkpointing")
     runp.add_argument("--no-resume", action="store_true")
+    runp.add_argument("--durable-dir", default=None, metavar="DIR",
+                      help="run through the crash-recoverable runner "
+                           "(docs/API.md 'Durable execution'): run spec + "
+                           "integrity-checked checkpoints + per-chunk "
+                           "outputs land here; a killed run continues "
+                           "bit-exactly via `run --resume DIR`")
+    runp.add_argument("--resume", default=None, metavar="DIR",
+                      help="continue a killed durable run from its "
+                           "directory alone (scenario/config come from "
+                           "its run.json; exit 2 when the spec is "
+                           "missing or corrupt)")
     runp.add_argument("--profile-dir", default=None,
                       help="write a jax.profiler trace here")
     runp.add_argument("--checked", action="store_true",
@@ -785,10 +934,11 @@ def main(argv=None) -> int:
         "serve", help="batch-serve a rollout request file through the "
                       "shape-bucketed serving engine (docs/API.md "
                       "'Serving')")
-    servep.add_argument("requests",
+    servep.add_argument("requests", nargs="?", default=None,
                         help="JSON request file: a list (or {'requests': "
                              "[...]}) of {steps, seed, overrides{...}, "
-                             "repeat} objects over swarm.Config fields")
+                             "repeat} objects over swarm.Config fields "
+                             "(optional with --recover)")
     servep.add_argument("--platform", default=None, choices=("cpu", "tpu"),
                         help="force a JAX backend before first use")
     servep.add_argument("--max-batch", type=int, default=8,
@@ -811,6 +961,18 @@ def main(argv=None) -> int:
                         help="write a serve run directory: manifest with "
                              "bucket/compile attribution + one 'request' "
                              "event per served request")
+    servep.add_argument("--journal", default=None, metavar="PATH",
+                        help="write-ahead request journal (docs/API.md "
+                             "'Durable execution'): every accepted "
+                             "request is fsynced to this JSONL file "
+                             "before it is acknowledged, every outcome "
+                             "before the caller unblocks")
+    servep.add_argument("--recover", action="store_true",
+                        help="with --journal: re-run every acknowledged-"
+                             "but-unresolved request from a previous "
+                             "process's journal instead of (or before) a "
+                             "requests file; exit 2 when the journal is "
+                             "missing or unreadable")
     _add_fault_policy_args(servep)
     servep.set_defaults(fn=cmd_serve)
 
@@ -907,6 +1069,18 @@ def main(argv=None) -> int:
     verp.add_argument("--mesh-dp", type=int, default=None,
                       help="shard the candidate batch over a dp mesh of "
                            "this many devices")
+    verp.add_argument("--state-dir", default=None, metavar="DIR",
+                      help="persist per-round search state here "
+                           "(docs/API.md 'Durable execution'): a killed "
+                           "campaign continues from its last completed "
+                           "round on the next identical invocation")
+    verp.add_argument("--resume", dest="resume", action="store_true",
+                      default=True,
+                      help="continue a persisted --state-dir campaign "
+                           "(the default)")
+    verp.add_argument("--no-resume", dest="resume", action="store_false",
+                      help="ignore persisted --state-dir state and "
+                           "restart from round 0")
     verp.add_argument("--telemetry-dir", default=None,
                       help="stream verify.round/verify.margin events "
                            "into this run directory")

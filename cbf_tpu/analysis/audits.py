@@ -145,9 +145,24 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
             f"{serve_loadgen.EMITTED_EVENT_TYPES!r} != "
             f"obs.schema.LOADGEN_EVENT_TYPES "
             f"{schema.LOADGEN_EVENT_TYPES!r} — emitter and schema drifted")
+    # Durable-execution event drift: the WAL journal and the durable
+    # rollout runner each declare what they emit; together they must
+    # cover the schema's durable family exactly.
+    from cbf_tpu.durable import journal as durable_journal
+    from cbf_tpu.durable import rollout as durable_rollout
+    durable_emitted = tuple(durable_journal.EMITTED_EVENT_TYPES) + \
+        tuple(durable_rollout.EMITTED_EVENT_TYPES)
+    if tuple(sorted(durable_emitted)) != \
+            tuple(sorted(schema.DURABLE_EVENT_TYPES)):
+        problems.append(
+            f"durable emitters (journal+rollout) {durable_emitted!r} != "
+            f"obs.schema.DURABLE_EVENT_TYPES {schema.DURABLE_EVENT_TYPES!r} "
+            "— emitter and schema drifted")
     for table_name, types_name, fields, types in (
             ("SERVE_EVENT_FIELDS", "SERVE_EVENT_TYPES",
              schema.SERVE_EVENT_FIELDS, schema.SERVE_EVENT_TYPES),
+            ("DURABLE_EVENT_FIELDS", "DURABLE_EVENT_TYPES",
+             schema.DURABLE_EVENT_FIELDS, schema.DURABLE_EVENT_TYPES),
             ("LOADGEN_EVENT_FIELDS", "LOADGEN_EVENT_TYPES",
              schema.LOADGEN_EVENT_FIELDS, schema.LOADGEN_EVENT_TYPES)):
         for etype in fields:
@@ -170,7 +185,8 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
     # emitter in this repo names its event types inline, and keeping it
     # that way is what makes this check (and grep) possible.
     import inspect
-    for mod in (verify_search, serve_engine, obs_trace, serve_loadgen):
+    for mod in (verify_search, serve_engine, obs_trace, serve_loadgen,
+                durable_journal, durable_rollout):
         try:
             mod_tree = ast.parse(inspect.getsource(mod))
         except (OSError, TypeError):
@@ -216,6 +232,7 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
         for family, table in (
                 ("verify", schema.VERIFY_EVENT_FIELDS),
                 ("serve", schema.SERVE_EVENT_FIELDS),
+                ("durable", schema.DURABLE_EVENT_FIELDS),
                 ("loadgen", schema.LOADGEN_EVENT_FIELDS)):
             for etype, fields in table.items():
                 if f"`{etype}`" not in api_text:

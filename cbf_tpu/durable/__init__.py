@@ -1,0 +1,53 @@
+"""Durable execution: crash recovery across process boundaries.
+
+The serve/rollout/verify layers survive faults *inside* a live process
+(serve/resilience.py); this package makes them survive the process
+itself dying — a preempted VM, an OOM kill, a SIGKILL mid-sweep:
+
+- `durable.integrity` — per-leaf checksum manifests over orbax
+  checkpoints, written atomically (temp-file + rename) and verified on
+  restore independent of orbax metadata, so this orbax build's silent
+  zero-pad hazard becomes a typed :class:`CheckpointCorrupt` error and
+  corrupt/truncated checkpoints are skipped to the last good step;
+- `durable.rollout` — resumable long rollouts: a durable run directory
+  holds the run spec, per-chunk StepOutputs, and integrity-checked
+  checkpoints; :func:`cbf_tpu.durable.rollout.resume` continues a
+  killed run BIT-EXACTLY (byte-identical final outputs vs the
+  uninterrupted run);
+- `durable.journal` — a schema-versioned write-ahead request journal
+  (JSONL: submitted/packed/resolved) for the serve engine;
+  :func:`cbf_tpu.durable.journal.recover_into` re-enqueues every
+  acknowledged-but-unresolved request after a crash.
+
+See docs/API.md "Durable execution" and `BENCH_PREEMPT=1` in bench.py
+for the kill-driven chaos harness that gates the whole layer.
+"""
+
+from cbf_tpu.durable.integrity import (CheckpointCorrupt, MANIFEST_NAME,
+                                       MANIFEST_SCHEMA_VERSION, read_manifest,
+                                       verify_restored, write_manifest)
+
+# journal/rollout resolve lazily (PEP 562): utils/checkpoint.py imports
+# this package for the integrity layer, and durable.rollout imports
+# utils/checkpoint back — eager imports here would cycle.
+_LAZY = {
+    "JOURNAL_SCHEMA_VERSION": "journal", "JournalReplay": "journal",
+    "RequestJournal": "journal", "recover_into": "journal",
+    "replay_journal": "journal",
+    "load_spec": "rollout", "resume": "rollout", "run_durable": "rollout",
+}
+
+__all__ = [
+    "CheckpointCorrupt", "MANIFEST_NAME", "MANIFEST_SCHEMA_VERSION",
+    "read_manifest", "verify_restored", "write_manifest",
+    *sorted(_LAZY),
+]
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(f"cbf_tpu.durable.{_LAZY[name]}")
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,209 @@
+"""Integrity manifests over orbax checkpoints.
+
+This orbax build has one known sharp edge (utils/checkpoint.py): a
+restore whose template shapes disagree with the stored arrays silently
+ZERO-PADS or truncates instead of raising, and its stored metadata is
+best-effort — unreadable metadata used to mean "skip validation". Both
+hazards hand a resumed run fabricated state that explodes far from the
+cause. The manifest closes them independently of orbax:
+
+- at save time, :func:`write_manifest` records a SHA-256 digest plus
+  shape/dtype for every leaf of the saved pytree, keyed by name path,
+  and commits the manifest atomically (temp file + ``os.replace``)
+  INSIDE the step directory (``<dir>/<step>/integrity.json``), so orbax
+  retention deletes it with the step and a manifest's existence marks a
+  fully committed save;
+- at restore time, :func:`verify_restored` re-digests the restored
+  leaves and compares — any divergence (bit rot, truncation, a torn
+  write that orbax's own commit marker missed, the zero-pad path) is a
+  typed :class:`CheckpointCorrupt`, never silent wrong data.
+
+Digests cover the exact host bytes (``np.asarray(leaf).tobytes()``),
+so verification doubles as the bit-exactness witness the durable
+rollout resume path relies on.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Any
+
+import jax
+import numpy as np
+
+MANIFEST_NAME = "integrity.json"
+MANIFEST_SCHEMA_VERSION = 1
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint failed integrity verification: a leaf digest
+    mismatched its manifest, the step's data is unreadable despite a
+    committed manifest, or neither orbax metadata nor a manifest exists
+    to validate against (fail closed — silently restoring zero-padded
+    state is the one outcome this layer exists to prevent)."""
+
+    def __init__(self, message: str, *, directory: str | None = None,
+                 step: int | None = None):
+        super().__init__(message)
+        self.directory = directory
+        self.step = step
+
+
+def _leaf_key(path) -> str:
+    return "/".join(
+        str(getattr(p, "name", None) or getattr(p, "key", None)
+            or getattr(p, "idx", None) or p) for p in path)
+
+
+def _leaf_items(tree: Any):
+    """(name-path key, host ndarray) for every leaf, dict keys and
+    namedtuple fields normalized the same way utils/checkpoint.py's
+    ``_leaf_shapes`` does (restored states come back as dicts)."""
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        yield _leaf_key(path), np.asarray(leaf)
+
+
+def leaf_digests(tree: Any) -> dict[str, dict]:
+    """Per-leaf integrity records: key -> {sha256, shape, dtype}."""
+    out = {}
+    for key, arr in _leaf_items(tree):
+        out[key] = {
+            "sha256": hashlib.sha256(arr.tobytes()).hexdigest(),
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+        }
+    return out
+
+
+def manifest_path(directory: str, step: int) -> str:
+    return os.path.join(os.path.abspath(directory), str(step), MANIFEST_NAME)
+
+
+def write_atomic(path: str, data: str) -> None:
+    """Commit ``data`` to ``path`` via temp-file + ``os.replace`` so a
+    kill mid-write leaves either the old file or the new one, never a
+    torn half. The temp file lives in the target directory (rename must
+    not cross filesystems)."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp-", suffix="~")
+    try:
+        with os.fdopen(fd, "w") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def write_npz_atomic(path: str, arrays: dict[str, Any]) -> None:
+    """:func:`write_atomic` for binary npz payloads (chunked rollout
+    outputs, verify search state): savez to a temp file in the target
+    directory, fsync, ``os.replace``."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp-", suffix=".npz~")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            np.savez(fh, **arrays)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def manifest_json(step: int, leaves: dict[str, dict]) -> str:
+    """Serialized manifest from precomputed :func:`leaf_digests` records
+    (the async CheckpointWriter digests at save time but commits later)."""
+    return json.dumps({
+        "schema": MANIFEST_SCHEMA_VERSION,
+        "step": int(step),
+        "algorithm": "sha256",
+        "leaves": leaves,
+    }, sort_keys=True)
+
+
+def write_manifest(directory: str, step: int, state: Any) -> dict:
+    """Digest ``state`` and atomically commit the manifest for ``step``.
+    Call only AFTER the orbax write for the step has fully finished —
+    the manifest is the durable layer's commit marker."""
+    leaves = leaf_digests(state)
+    write_atomic(manifest_path(directory, step), manifest_json(step, leaves))
+    return {"schema": MANIFEST_SCHEMA_VERSION, "step": int(step),
+            "algorithm": "sha256", "leaves": leaves}
+
+
+def read_manifest(directory: str, step: int) -> dict | None:
+    """The committed manifest for ``step``, or None when the step
+    predates the integrity layer. An unreadable/garbled manifest is
+    :class:`CheckpointCorrupt` — the atomic commit protocol cannot
+    produce one, so damage did."""
+    path = manifest_path(directory, step)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as fh:
+            manifest = json.load(fh)
+        if manifest["schema"] != MANIFEST_SCHEMA_VERSION:
+            raise CheckpointCorrupt(
+                f"integrity manifest schema {manifest['schema']} != "
+                f"{MANIFEST_SCHEMA_VERSION} at {path}",
+                directory=directory, step=step)
+        manifest["leaves"]
+        return manifest
+    except CheckpointCorrupt:
+        raise
+    except Exception as e:
+        raise CheckpointCorrupt(
+            f"unreadable integrity manifest at {path}: {e}",
+            directory=directory, step=step) from e
+
+
+def manifest_shapes(manifest: dict) -> dict[tuple, tuple]:
+    """Name-path -> shape in the ``_leaf_shapes`` key convention, for
+    template validation when orbax's own metadata is unreadable."""
+    return {tuple(k.split("/")): tuple(rec["shape"])
+            for k, rec in manifest["leaves"].items()}
+
+
+def verify_restored(directory: str, step: int, restored: Any,
+                    *, manifest: dict | None = None) -> bool:
+    """Re-digest ``restored`` against the step's manifest. Returns False
+    when no manifest exists (pre-integrity checkpoint: nothing to check);
+    raises :class:`CheckpointCorrupt` listing every divergent leaf
+    otherwise. Leaves present in only one side are ignored (the
+    pre-theta compat path restores a pruned subset by design)."""
+    if manifest is None:
+        manifest = read_manifest(directory, step)
+    if manifest is None:
+        return False
+    want = manifest["leaves"]
+    bad = []
+    for key, arr in _leaf_items(restored):
+        rec = want.get(key)
+        if rec is None:
+            continue
+        digest = hashlib.sha256(arr.tobytes()).hexdigest()
+        if digest != rec["sha256"]:
+            bad.append(f"{key}: restored sha256 {digest[:12]}… != saved "
+                       f"{rec['sha256'][:12]}… (shape {list(arr.shape)} vs "
+                       f"saved {rec['shape']})")
+    if bad:
+        raise CheckpointCorrupt(
+            f"checkpoint under {directory} (step {step}) failed integrity "
+            "verification: " + "; ".join(bad),
+            directory=directory, step=step)
+    return True

@@ -1,0 +1,334 @@
+"""Durable rollout runs: kill a chunked rollout at any moment, resume
+bit-exactly.
+
+A durable run directory owns everything needed to continue after the
+process dies:
+
+- ``run.json`` — the run spec (scenario name, full config as typed
+  JSON, steps/chunk, telemetry cadence), written once, atomically;
+  :func:`resume` rebuilds the step function and initial state from it
+  with no CLI flags;
+- ``ckpt/`` — integrity-checked orbax checkpoints at every chunk
+  boundary (utils/checkpoint.py): the carry state, which includes the
+  solver warm-start carry, and whose spawn randomness is fixed by the
+  spec's recorded seed;
+- ``outputs/chunk_<t0>.npz`` — each chunk's host-offloaded StepOutputs,
+  committed atomically BEFORE the boundary checkpoint (the
+  ``durable_hook`` ordering in rollout_chunked), so an intact
+  checkpoint at step t implies every output up to t is on disk;
+- ``cursor.json`` — the progress cursor (next chunk start + the
+  telemetry cadence, so resumed heartbeats land on the same global
+  steps an uninterrupted run's would);
+- ``resume_log.jsonl`` — one line per resume: the restored step, the
+  measured in-process recovery time, and any corrupt checkpoint steps
+  skipped on the walk back (the bench's MTTR source).
+
+Bit-exactness: completed chunks are never re-run — their persisted
+bytes are stitched verbatim — and the remaining chunks re-run from the
+restored carry through the same executables, so the final stitched
+StepOutputs of a killed-and-resumed run are byte-identical to the
+uninterrupted run's (pinned by tests/test_durable.py and gated by
+``BENCH_PREEMPT=1``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cbf_tpu.durable import integrity
+
+EMITTED_EVENT_TYPES = ("durable.resume",)
+
+SPEC_SCHEMA_VERSION = 1
+SPEC_NAME = "run.json"
+CURSOR_NAME = "cursor.json"
+RESUME_LOG_NAME = "resume_log.jsonl"
+OUTPUTS_DIR = "outputs"
+CKPT_DIR = "ckpt"
+
+
+# ---------------------------------------------------------- run spec ----
+
+
+def config_to_json(cfg) -> dict:
+    """A scenario config as typed JSON. The one non-JSON-native field is
+    ``dtype`` (a type object) — encoded by numpy name; tuples become
+    lists (restored by :func:`config_from_json` against the field's
+    default type)."""
+    out = {}
+    for f in dataclasses.fields(cfg):
+        v = getattr(cfg, f.name)
+        if isinstance(v, type):
+            v = np.dtype(v).name
+        elif isinstance(v, tuple):
+            v = list(v)
+        out[f.name] = v
+    return out
+
+
+def config_from_json(config_cls, data: dict):
+    """Invert :func:`config_to_json` against ``config_cls``'s defaults."""
+    default = config_cls()
+    updates = {}
+    for f in dataclasses.fields(default):
+        if f.name not in data:
+            continue
+        v = data[f.name]
+        cur = getattr(default, f.name)
+        if isinstance(cur, type) and isinstance(v, str):
+            v = jnp.dtype(v).type
+        elif isinstance(cur, tuple) and isinstance(v, list):
+            v = tuple(v)
+        updates[f.name] = v
+    return dataclasses.replace(default, **updates)
+
+
+def _scenario(name: str):
+    import importlib
+
+    module = importlib.import_module(f"cbf_tpu.scenarios.{name}")
+    steps_field = "iterations" if hasattr(module.Config(), "iterations") \
+        else "steps"
+    return module, steps_field
+
+
+def load_spec(directory: str) -> dict:
+    path = os.path.join(directory, SPEC_NAME)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no durable run spec at {path}")
+    with open(path) as fh:
+        spec = json.load(fh)
+    if spec.get("schema") != SPEC_SCHEMA_VERSION:
+        raise ValueError(f"durable run spec schema {spec.get('schema')} != "
+                         f"{SPEC_SCHEMA_VERSION} at {path}")
+    return spec
+
+
+def _write_spec(directory: str, scenario: str, cfg, *, steps_field: str,
+                chunk: int, telemetry_every: int) -> dict:
+    spec = {
+        "schema": SPEC_SCHEMA_VERSION,
+        "scenario": scenario,
+        "config": config_to_json(cfg),
+        "steps_field": steps_field,
+        "steps": int(getattr(cfg, steps_field)),
+        "chunk": int(chunk),
+        "telemetry_every": int(telemetry_every),
+    }
+    integrity.write_atomic(os.path.join(directory, SPEC_NAME),
+                           json.dumps(spec, sort_keys=True))
+    return spec
+
+
+# ------------------------------------------------------ chunk storage ----
+
+
+def _chunk_path(directory: str, t0: int) -> str:
+    return os.path.join(directory, OUTPUTS_DIR, f"chunk_{t0:010d}.npz")
+
+
+def _save_chunk(directory: str, t0: int, t1: int, outs_host) -> None:
+    """Persist one chunk's StepOutputs atomically. Leaves are stored
+    positionally (tree order) — the tree structure is recovered from the
+    spec's step function via ``jax.eval_shape`` at stitch time, so
+    untracked ``()`` fields and nested-tuple trajectories round-trip."""
+    d = os.path.join(directory, OUTPUTS_DIR)
+    os.makedirs(d, exist_ok=True)
+    leaves = jax.tree.leaves(outs_host)
+    payload = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp-", suffix=".npz~")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            np.savez(fh, t0=np.int64(t0), t1=np.int64(t1), **payload)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, _chunk_path(directory, t0))
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _chunk_files(directory: str) -> dict[int, str]:
+    d = os.path.join(directory, OUTPUTS_DIR)
+    if not os.path.isdir(d):
+        return {}
+    out = {}
+    for name in os.listdir(d):
+        if name.startswith("chunk_") and name.endswith(".npz"):
+            out[int(name[len("chunk_"):-len(".npz")])] = os.path.join(d, name)
+    return out
+
+
+def _stitch_outputs(directory: str, treedef, steps: int):
+    """Load every persisted chunk, check contiguous coverage of
+    ``[0, steps)``, and concatenate along the time axis."""
+    from cbf_tpu.rollout.engine import stack_host_chunks
+
+    files = _chunk_files(directory)
+    parts = []
+    expect = 0
+    for t0 in sorted(files):
+        if t0 != expect:
+            raise ValueError(
+                f"durable run under {directory} has a chunk-output gap: "
+                f"expected chunk at step {expect}, found {t0}")
+        with np.load(files[t0]) as z:
+            t1 = int(z["t1"])
+            leaves = [z[f"leaf_{i}"] for i in range(len(z.files) - 2)]
+        parts.append(jax.tree_util.tree_unflatten(treedef, leaves))
+        expect = t1
+        if expect >= steps:
+            break
+    if expect != steps:
+        raise ValueError(
+            f"durable run under {directory} is missing chunk outputs: "
+            f"covered [0, {expect}) of [0, {steps})")
+    return stack_host_chunks(parts, axis=0) if parts else None
+
+
+# ------------------------------------------------------------ running ----
+
+
+def _append_jsonl(path: str, record: dict) -> None:
+    with open(path, "a") as fh:
+        fh.write(json.dumps(record, sort_keys=True) + "\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+
+
+def run_durable(directory: str, *, scenario: str | None = None, cfg=None,
+                chunk: int = 1000, telemetry=None, telemetry_every: int = 50,
+                donate_carry: bool | None = None) -> dict:
+    """Start — or transparently continue — a durable rollout run.
+
+    First call: ``scenario`` + ``cfg`` are required and the run spec is
+    committed to ``directory``. Later calls (including after a SIGKILL)
+    may omit them — the spec rebuilds everything; passing them again is
+    allowed only if they MATCH the spec (a changed config under the same
+    directory raises ValueError instead of silently mixing two runs).
+
+    Returns ``{"final_state", "outputs", "steps", "resumed_from_step",
+    "recovery_s", "corrupt_skipped"}`` where ``outputs`` is the FULL
+    stitched StepOutputs over ``[0, steps)`` — completed chunks loaded
+    from disk byte-verbatim, remaining chunks executed — so the result
+    is byte-identical whether or not the run was ever interrupted.
+    """
+    from cbf_tpu.rollout.engine import rollout_chunked
+    from cbf_tpu.utils import checkpoint as ckpt
+
+    os.makedirs(directory, exist_ok=True)
+    spec_path = os.path.join(directory, SPEC_NAME)
+    if os.path.exists(spec_path):
+        spec = load_spec(directory)
+        if scenario is not None and scenario != spec["scenario"]:
+            raise ValueError(
+                f"durable run under {directory} was started for scenario "
+                f"{spec['scenario']!r}, not {scenario!r}")
+        module, steps_field = _scenario(spec["scenario"])
+        spec_cfg = config_from_json(module.Config, spec["config"])
+        if cfg is not None and config_to_json(cfg) != spec["config"]:
+            raise ValueError(
+                f"durable run under {directory} was started with a "
+                "different config; refusing to mix runs (use a fresh "
+                "directory or omit the config to continue)")
+        cfg = spec_cfg
+        scenario = spec["scenario"]
+        chunk = spec["chunk"]
+        telemetry_every = spec["telemetry_every"]
+    else:
+        if scenario is None or cfg is None:
+            raise FileNotFoundError(
+                f"no durable run spec under {directory} — pass scenario= "
+                "and cfg= to start one")
+        module, steps_field = _scenario(scenario)
+        spec = _write_spec(directory, scenario, cfg, steps_field=steps_field,
+                           chunk=chunk, telemetry_every=telemetry_every)
+    steps = spec["steps"]
+    state0, step_fn = module.make(cfg)
+
+    # ---- recovery probe: restore + verify + scan, the measured MTTR ----
+    ckpt_dir = os.path.join(directory, CKPT_DIR)
+    t_rec = time.perf_counter()
+    start, skipped = 0, []
+    if ckpt.latest_step(ckpt_dir) is not None:
+        _, start, skipped = ckpt.restore_intact(ckpt_dir, state0)
+        for s in skipped:
+            # A corrupt step must not shadow the resumed run's re-save of
+            # the same boundary (orbax refuses to overwrite a live step).
+            import shutil
+
+            shutil.rmtree(os.path.join(ckpt_dir, str(s)),
+                          ignore_errors=True)
+    for t0, path in _chunk_files(directory).items():
+        if t0 >= start:
+            # Stale partial progress past the last committed checkpoint
+            # (killed between output write and checkpoint commit) — the
+            # resumed run re-executes and rewrites these chunks.
+            os.unlink(path)
+    recovery_s = time.perf_counter() - t_rec
+    # Logged on any restore AND on any corrupt skip — a walk-back that
+    # falls all the way to step 0 is still a recovery event (the
+    # corruption was detected, not trusted) and the bench's corruption
+    # gate reads it from here.
+    if start > 0 or skipped:
+        _append_jsonl(os.path.join(directory, RESUME_LOG_NAME), {
+            "resumed_from_step": int(start),
+            "recovery_s": recovery_s,
+            "corrupt_skipped": [int(s) for s in skipped],
+            "t_wall": time.time(),
+        })
+        if telemetry is not None:
+            telemetry.event("durable.resume", {
+                "directory": os.path.abspath(directory),
+                "resumed_from_step": int(start),
+                "chunks_loaded": len(_chunk_files(directory)),
+                "steps": int(steps),
+            })
+
+    def durable_hook(t1, state, outs_host):
+        t0 = t1 - jax.tree.leaves(outs_host)[0].shape[0]
+        _save_chunk(directory, int(t0), int(t1), outs_host)
+        integrity.write_atomic(
+            os.path.join(directory, CURSOR_NAME),
+            json.dumps({"next_t0": int(t1), "steps": int(steps),
+                        "telemetry_every": int(telemetry_every)},
+                       sort_keys=True))
+
+    final, _, start2 = rollout_chunked(
+        step_fn, state0, steps, chunk=chunk, checkpoint_dir=ckpt_dir,
+        resume=True, telemetry=telemetry, telemetry_every=telemetry_every,
+        donate_carry=donate_carry, durable_hook=durable_hook)
+
+    _, outs_sds = jax.eval_shape(step_fn, state0, jnp.zeros((), jnp.int32))
+    treedef = jax.tree_util.tree_structure(outs_sds)
+    outputs = _stitch_outputs(directory, treedef, steps)
+    return {
+        "final_state": final,
+        "outputs": outputs,
+        "steps": int(steps),
+        "resumed_from_step": int(start2),
+        "recovery_s": recovery_s,
+        "corrupt_skipped": [int(s) for s in skipped],
+    }
+
+
+def resume(directory: str, *, telemetry=None,
+           donate_carry: bool | None = None) -> dict:
+    """Continue a killed durable run from its directory alone — the
+    spec rebuilds the scenario, config, chunking and telemetry cadence.
+    Raises FileNotFoundError when ``directory`` holds no run spec."""
+    load_spec(directory)
+    return run_durable(directory, telemetry=telemetry,
+                       donate_carry=donate_carry)

@@ -164,6 +164,27 @@ SERVE_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
     "serve.scheduler_crash": ("error", "resolved"),
 }
 
+#: The durable-execution layer's events (PR 9): ``durable.journal`` is
+#: written once when a write-ahead request journal opens (how much
+#: history it already holds), ``durable.recover`` once per journal
+#: replay onto a fresh engine (how many acknowledged-but-unresolved
+#: requests were re-enqueued vs refused at admission), and
+#: ``durable.resume`` once whenever a durable rollout run restarts from
+#: a checkpoint instead of step 0 (which step, how many persisted chunks
+#: were reloaded). Same AUD001 contract: the emitters'
+#: ``EMITTED_EVENT_TYPES`` (durable.journal + durable.rollout modules)
+#: must union to this tuple, every declared type must have a literal
+#: emit site, and every type and field must be documented in docs/API.md.
+DURABLE_EVENT_TYPES: tuple[str, ...] = (
+    "durable.journal", "durable.recover", "durable.resume")
+
+DURABLE_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
+    "durable.journal": ("path", "records", "unresolved"),
+    "durable.recover": ("path", "records", "reenqueued", "refused"),
+    "durable.resume": ("directory", "resumed_from_step", "chunks_loaded",
+                       "steps"),
+}
+
 #: The load generator's run-end record (``serve.loadgen``): offered vs
 #: achieved rates and the end-to-end latency percentiles of one open-loop
 #: traffic run. One event per loadgen run.

@@ -119,7 +119,8 @@ def rollout_chunked(step_fn: Callable, state0, steps: int, *,
                     chunk: int = 1000, checkpoint_dir: str | None = None,
                     resume: bool = True, unroll: int = 1,
                     telemetry=None, telemetry_every: int = 50,
-                    donate_carry: bool | None = None):
+                    donate_carry: bool | None = None,
+                    durable_hook=None):
     """Run a long rollout in ``chunk``-step compiled segments, checkpointing
     the state pytree at every boundary (SURVEY.md §5 checkpoint/resume —
     absent in the reference).
@@ -142,9 +143,20 @@ def rollout_chunked(step_fn: Callable, state0, steps: int, *,
     entry. Default (None) = auto: donate exactly when no checkpoint
     writer runs — the async boundary save may still be READING the state
     in a background thread while the next chunk would donate it away, so
-    checkpointed runs keep the non-donating executable. Pass an explicit
-    bool to pin the choice (bench warmup must compile the same executable
-    the measured configuration reuses).
+    auto-checkpointed runs keep the non-donating executable. An explicit
+    ``donate_carry=True`` WITH a checkpoint writer composes via a
+    completion barrier: each boundary save is drained
+    (``CheckpointWriter.wait_until_finished``) before the next chunk
+    donates the buffers — donation's memory win at the cost of the async
+    overlap. Pass an explicit bool to pin the choice (bench warmup must
+    compile the same executable the measured configuration reuses).
+
+    ``durable_hook``: called after every chunk as
+    ``durable_hook(t1, state, outs_host)`` with the post-chunk global
+    step, the on-device carry, and the chunk's host-offloaded outputs —
+    BEFORE the boundary checkpoint save, so a committed checkpoint at
+    step t implies every chunk output up to t is already persisted
+    (the ordering `cbf_tpu.durable.rollout` relies on).
 
     Returns (final_state, StepOutputs stacked over executed steps,
     start_step).
@@ -166,11 +178,6 @@ def rollout_chunked(step_fn: Callable, state0, steps: int, *,
     writer = ckpt.CheckpointWriter(checkpoint_dir) if checkpoint_dir else None
     if donate_carry is None:
         donate_carry = writer is None
-    if donate_carry and writer is not None:
-        raise ValueError(
-            "donate_carry=True with a checkpoint_dir is unsafe: the async "
-            "boundary save may still be reading the state buffers the next "
-            "chunk donates away")
     run = _rollout_from_donated if donate_carry else _rollout_from
     if donate_carry:
         # The first chunk's input is the CALLER's state0 (reused by tests
@@ -188,10 +195,18 @@ def rollout_chunked(step_fn: Callable, state0, steps: int, *,
             # trajectories, and (measured on the TPU bench) beats deferring
             # the transfer, which contends with the async checkpoint
             # writer's own device reads.
-            parts.append(jax.device_get(outs))
+            outs_host = jax.device_get(outs)
+            parts.append(outs_host)
             t0 += n
+            if durable_hook is not None:
+                durable_hook(t0, state, outs_host)
             if writer is not None:
                 writer.save(t0, state)
+                if donate_carry:
+                    # Donation barrier: the next chunk donates the carry's
+                    # buffers away, and the async save may still be
+                    # reading them — drain it first.
+                    writer.wait_until_finished()
     finally:
         if writer is not None:
             writer.close()

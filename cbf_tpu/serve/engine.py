@@ -125,9 +125,18 @@ class PendingRequest:
         self._error: BaseException | None = None
         self._engine: "ServeEngine | None" = None
         self._key = None
+        self._journal = None   # set at admission when the engine journals
 
     def _resolve(self, result=None, error=None):
         self._result, self._error = result, error
+        # WAL ordering: the terminal record is durable BEFORE the
+        # caller's handle unblocks — a crash after result() returned
+        # cannot resurrect this request at recovery.
+        if self._journal is not None:
+            try:
+                self._journal.resolved(self.request_id, error)
+            except (OSError, ValueError):
+                pass   # journal gone/closed: resolving beats stranding
         self._event.set()
 
     def done(self) -> bool:
@@ -199,7 +208,8 @@ class ServeEngine:
                  bucket_sizes: tuple[int, ...] = _buckets.DEFAULT_BUCKET_SIZES,
                  horizon_quantum: int = _buckets.DEFAULT_HORIZON_QUANTUM,
                  cache_dir: str | None = None, telemetry=None, tracer=None,
-                 fault_policy: resilience.FaultPolicy | None = None):
+                 fault_policy: resilience.FaultPolicy | None = None,
+                 journal=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self.max_batch = max_batch
@@ -219,6 +229,15 @@ class ServeEngine:
             else resilience.FaultPolicy()
         self.fault_hook = None
         self.degrade_hook = None
+        # Write-ahead request journal (durable execution): a path string
+        # opens/appends a `durable.journal.RequestJournal` there; a
+        # ready-made journal object is used as-is; None (default)
+        # disables journaling entirely (no per-request fsync cost).
+        if isinstance(journal, (str, os.PathLike)):
+            from cbf_tpu.durable.journal import RequestJournal
+
+            journal = RequestJournal(os.fspath(journal), telemetry=telemetry)
+        self.journal = journal
         self.prewarm_s: float | None = None
         self.stats = {"requests": 0, "batches": 0, "pad_slots": 0,
                       "compile_hit": 0, "compile_miss": 0, "retries": 0,
@@ -388,6 +407,10 @@ class ServeEngine:
             alive.append(entry)
         if not alive:
             return
+        if self.journal is not None:
+            # Breadcrumb, not a commit point: batch formation is
+            # re-derivable at recovery, so no fsync.
+            self.journal.packed(label, [e[0].request_id for e in alive])
         t_exec_start = tracer.now()
         for pending, _cfg, _tr, t_enq, _d in alive:
             tracer.record("queue_wait", t0_s=t_enq,
@@ -571,18 +594,34 @@ class ServeEngine:
 
     # -- synchronous drain -------------------------------------------------
 
-    def run(self, configs) -> list[RequestResult]:
+    def run(self, configs, request_ids=None) -> list[RequestResult]:
         """Serve a request list synchronously: bucket, batch (order-
         preserving within a bucket), execute, return results in request
         order. Offline mode has no deadlines or admission control (the
         caller IS the queue), but retries/bisection/finite-checking
-        apply; a failed request raises its typed error here."""
+        apply; a failed request raises its typed error here.
+
+        With a journal attached, each request's ``submitted`` record is
+        durable before its batch runs and its terminal record before
+        ``result()`` returns — same WAL contract as queue mode.
+        ``request_ids`` (parallel to ``configs``) preserves identities
+        across a recovery replay (the CLI's ``serve --recover`` path);
+        default: fresh ``r<i>`` ids."""
+        if request_ids is not None and len(request_ids) != len(configs):
+            raise ValueError(
+                f"request_ids has {len(request_ids)} entries for "
+                f"{len(configs)} configs")
         entries_by_key: dict[_buckets.BucketKey, list] = {}
         pendings = []
-        for cfg in configs:
-            pending = PendingRequest(f"r{next(self._ids)}")
+        for i, cfg in enumerate(configs):
+            rid = request_ids[i] if request_ids is not None \
+                else f"r{next(self._ids)}"
+            pending = PendingRequest(rid)
             with self.tracer.span("enqueue", trace_id=pending.request_id):
                 key, traced = self.bucket_of(cfg)
+                if self.journal is not None:
+                    pending._journal = self.journal
+                    self.journal.submitted(pending.request_id, cfg)
                 pendings.append(pending)
                 entries_by_key.setdefault(key, []).append(
                     (pending, cfg, traced, self.tracer.now(), None))
@@ -683,6 +722,14 @@ class ServeEngine:
                                 "queue_depth": depth}))
                 if fail is None:
                     pending._engine, pending._key = self, key
+                    if self.journal is not None:
+                        # Durable acknowledgment, written UNDER the queue
+                        # lock: the scheduler cannot flush (and journal a
+                        # `resolved`) before this `submitted` is on disk.
+                        # A refused request (shed/quarantined above) is
+                        # never journaled — it was never acknowledged.
+                        pending._journal = self.journal
+                        self.journal.submitted(pending.request_id, cfg)
                     self._queue.setdefault(key, []).append(
                         (pending, cfg, traced, now, deadline_t))
                     self._cond.notify()
@@ -699,7 +746,9 @@ class ServeEngine:
 
     def stop(self, drain: bool = True) -> None:
         """Stop the scheduler; by default flush whatever is queued
-        first."""
+        first (graceful SIGTERM drain: every acknowledged request still
+        resolves — with a result or a typed error — and, when
+        journaling, gets its terminal record before this returns)."""
         with self._cond:
             self._running = False
             self._cond.notify()
@@ -717,6 +766,33 @@ class ServeEngine:
                 self._queue.clear()
             for key, batch in leftovers:
                 self._execute(key, batch)
+
+    # -- durable execution -------------------------------------------------
+
+    def recover(self, journal_path: str) -> list:
+        """Re-enqueue every acknowledged-but-unresolved request from a
+        previous process's write-ahead journal (at-least-once recovery:
+        see `cbf_tpu.durable.journal`). Call after `start()`; the engine
+        should itself be journaling — usually to the same path — so the
+        recovered requests' outcomes are journaled too. Returns the
+        re-enqueued `PendingRequest` handles."""
+        from cbf_tpu.durable.journal import recover_into
+
+        return recover_into(self, journal_path)
+
+    def install_sigterm_handler(self):
+        """Register a SIGTERM handler that stops the scheduler and
+        DRAINS the queue (``stop(drain=True)``) — preemption notice
+        becomes a graceful drain, so every queued request resolves
+        before the process dies; a SIGKILL (no notice) instead relies on
+        the journal + `recover`. Main-thread only (signal module
+        constraint); returns the previous handler."""
+        import signal
+
+        def _drain(signum, frame):
+            self.stop(drain=True)
+
+        return signal.signal(signal.SIGTERM, _drain)
 
     # -- scheduler ---------------------------------------------------------
 

@@ -91,6 +91,14 @@ class RequestCancelled(ServeError):
     while it was still queued."""
 
 
+class RecoveryError(ServeError):
+    """Crash recovery could not honor the write-ahead journal: the
+    journal file is missing/garbled beyond the torn-final-line the
+    append protocol permits, or its schema version is unknown. Raised by
+    :func:`cbf_tpu.durable.journal.replay_journal` — an unreadable
+    journal must fail loudly, not silently drop acknowledged requests."""
+
+
 #: Exception types retrying cannot fix: bad inputs and code bugs, the
 #: same classification bench.py's ``_is_permanent_error`` uses. The
 #: typed taxonomy above is also permanent — a shed or quarantine verdict

@@ -8,6 +8,14 @@ host writes its shards — the same API the TPU pod path uses). The rollout
 engine's :func:`cbf_tpu.rollout.engine.rollout_chunked` calls this between
 ``lax.scan`` chunks, so a 10k-step run interrupted at step 7000 resumes from
 the last boundary instead of restarting.
+
+Every save additionally commits a per-leaf SHA-256 manifest
+(:mod:`cbf_tpu.durable.integrity`) inside the step directory, and
+:func:`restore` verifies restored bytes against it: corruption —
+including this orbax build's silent zero-pad/truncate on mismatched
+restores — surfaces as a typed
+:class:`~cbf_tpu.durable.integrity.CheckpointCorrupt`, and a latest
+restore walks back past corrupt steps to the last intact one.
 """
 
 from __future__ import annotations
@@ -17,6 +25,12 @@ from typing import Any
 
 import jax
 import numpy as np
+
+from cbf_tpu.durable import integrity
+from cbf_tpu.durable.integrity import CheckpointCorrupt
+
+__all__ = ["CheckpointCorrupt", "CheckpointWriter", "latest_step",
+           "restore", "restore_intact", "save"]
 
 
 def _saveable(state: Any) -> Any:
@@ -42,12 +56,16 @@ def save(directory: str, step: int, state: Any, *, max_to_keep: int | None = 2
          ) -> None:
     """Save a state pytree under ``directory`` keyed by ``step``
     (synchronous one-shot; for repeated boundary saves inside a run use
-    :class:`CheckpointWriter`, whose async writes overlap compute)."""
+    :class:`CheckpointWriter`, whose async writes overlap compute).
+    Commits the integrity manifest after the orbax write finishes — the
+    manifest is the durable commit marker."""
     import orbax.checkpoint as ocp
 
+    saveable = _saveable(state)
     with _manager(directory, max_to_keep) as mgr:
-        mgr.save(step, args=ocp.args.StandardSave(_saveable(state)))
+        mgr.save(step, args=ocp.args.StandardSave(saveable))
         mgr.wait_until_finished()
+    integrity.write_manifest(directory, step, saveable)
 
 
 class CheckpointWriter:
@@ -58,26 +76,63 @@ class CheckpointWriter:
     TPU bench this removes the per-boundary write stall of one-shot
     :func:`save`. ``close`` drains pending writes; always call it (the
     rollout engine does so in a ``finally``).
+
+    The integrity manifest for a step is digested at ``save`` time (from
+    the same host snapshot) but committed only once the async orbax
+    write has finished — at the next ``save``, at
+    :meth:`wait_until_finished`, or at :meth:`close` — so a manifest's
+    existence always means the step is fully on disk.
+
+    ``wait_until_finished`` is also the completion barrier that lets
+    carry donation compose with async checkpointing: orbax's background
+    write may still be reading the state buffers, so a caller about to
+    donate them (``rollout_chunked(donate_carry=True)``) must drain the
+    write first.
     """
 
     def __init__(self, directory: str, max_to_keep: int | None = 2):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
+        self._dir = os.path.abspath(directory)
+        self._pending_manifest: tuple[int, Any] | None = None
         self._mgr = ocp.CheckpointManager(
-            os.path.abspath(directory),
+            self._dir,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, create=True,
                 enable_async_checkpointing=True,
             ),
         )
 
+    def _flush_manifest(self) -> None:
+        if self._pending_manifest is not None:
+            step, digests = self._pending_manifest
+            self._pending_manifest = None
+            integrity.write_atomic(
+                integrity.manifest_path(self._dir, step),
+                integrity.manifest_json(step, digests))
+
     def save(self, step: int, state: Any) -> None:
-        self._mgr.save(step,
-                       args=self._ocp.args.StandardSave(_saveable(state)))
+        if self._pending_manifest is not None:
+            # The previous step's async write must be on disk before its
+            # manifest (= commit marker) appears.
+            self._mgr.wait_until_finished()
+            self._flush_manifest()
+        saveable = _saveable(state)
+        digests = integrity.leaf_digests(saveable)
+        self._mgr.save(step, args=self._ocp.args.StandardSave(saveable))
+        self._pending_manifest = (step, digests)
+
+    def wait_until_finished(self) -> None:
+        """Block until every issued save is fully committed (orbax write
+        drained + integrity manifest on disk). Safe to call repeatedly;
+        after it returns the saved state's buffers are no longer read by
+        any background thread, so the caller may donate them."""
+        self._mgr.wait_until_finished()
+        self._flush_manifest()
 
     def close(self) -> None:
-        self._mgr.wait_until_finished()
+        self.wait_until_finished()
         self._mgr.close()
 
 
@@ -104,20 +159,31 @@ def _leaf_shapes(tree) -> dict[tuple, tuple]:
     return out
 
 
-def _validate_against_stored(directory: str, step: int, abstract) -> None:
+def _validate_against_stored(directory: str, step: int, abstract,
+                             manifest: dict | None) -> None:
     """Raise ValueError when the restore template's leaf shapes disagree
-    with the checkpoint's stored array metadata. Best-effort by design:
-    metadata that cannot be read (older orbax layouts) skips validation —
-    the check exists to turn SILENT pad/truncate corruption into a loud
-    error, not to add a new failure mode to healthy restores."""
+    with the checkpoint's stored array metadata. Exists to turn SILENT
+    pad/truncate corruption into a loud error. When orbax's own metadata
+    cannot be read (older layouts, truncated step dirs) the integrity
+    manifest's recorded shapes take over; with NEITHER source readable
+    the restore fails closed with :class:`CheckpointCorrupt` — a
+    checkpoint that cannot be validated must not be trusted."""
     import orbax.checkpoint as ocp
 
     try:
         meta = ocp.StandardCheckpointer().metadata(
             os.path.join(os.path.abspath(directory), str(step), "default"))
         stored = _leaf_shapes(meta)
-    except Exception:
-        return
+    except Exception as e:
+        if manifest is not None:
+            stored = integrity.manifest_shapes(manifest)
+        else:
+            raise CheckpointCorrupt(
+                f"checkpoint under {directory} (step {step}): orbax "
+                f"metadata unreadable ({e}) and no integrity manifest — "
+                "refusing to restore unvalidated state (this orbax build "
+                "silently zero-pads mismatched restores)",
+                directory=directory, step=step) from e
     if not stored:
         return
     tmpl = _leaf_shapes(abstract)
@@ -130,8 +196,61 @@ def _validate_against_stored(directory: str, step: int, abstract) -> None:
             "the restore template: " + "; ".join(bad))
 
 
+def _restore_step(mgr, directory: str, step: int, like: Any, abstract):
+    """Restore + integrity-verify one specific step. Raises
+    :class:`CheckpointCorrupt` when the step's data is damaged,
+    ValueError when the caller's template mismatches a healthy step."""
+    import orbax.checkpoint as ocp
+
+    manifest = integrity.read_manifest(directory, step)  # corrupt -> raises
+    # This orbax build does NOT raise on a template-shape mismatch — it
+    # silently ZERO-PADS (or truncates) the stored array into the
+    # template, so a wrong-`like` restore (N=9 template over an N=4
+    # checkpoint) would hand the resumed rollout fabricated state and
+    # explode far from the cause. Validate template shapes against the
+    # STORED array metadata (or the manifest's recorded shapes) up front.
+    _validate_against_stored(directory, step, abstract, manifest)
+    try:
+        restored = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+    except Exception as e:
+        # Forward compatibility for grown state pytrees: State gained a
+        # third field (theta, () outside unicycle mode) in round 3, so a
+        # checkpoint written by the 2-field State fails StandardRestore's
+        # structure match against the 3-field template even though the
+        # new field holds no arrays. Retry with the leafless fields
+        # pruned and graft the empty values back. A genuine failure
+        # (shape mismatch, corrupt checkpoint, IO) fails the pruned
+        # retry too — then the ORIGINAL error surfaces (typed as
+        # corruption when a committed manifest proves the save was once
+        # whole), so real errors are never masked and the detection
+        # doesn't depend on parsing orbax's mismatch message.
+        empty = [f for f in getattr(like, "_fields", ())
+                 if not jax.tree.leaves(getattr(like, f))]
+        pruned = {f: getattr(abstract, f) for f in like._fields
+                  if f not in empty} if empty else None
+        if pruned is not None:
+            try:
+                part = mgr.restore(step, args=ocp.args.StandardRestore(pruned))
+            except Exception:
+                part = None
+            if part is not None:
+                restored = type(like)(
+                    **part, **{f: getattr(like, f) for f in empty})
+                integrity.verify_restored(directory, step, restored,
+                                          manifest=manifest)
+                return restored, step
+        if manifest is not None:
+            raise CheckpointCorrupt(
+                f"checkpoint under {directory} (step {step}) has a "
+                f"committed integrity manifest but failed to restore: {e}",
+                directory=directory, step=step) from e
+        raise e
+    integrity.verify_restored(directory, step, restored, manifest=manifest)
+    return restored, step
+
+
 def restore(directory: str, like: Any, step: int | None = None):
-    """Restore the pytree saved at ``step`` (default: latest).
+    """Restore the pytree saved at ``step`` (default: latest intact).
 
     ``like`` is an example pytree (e.g. the initial state) fixing structure,
     dtypes, and shardings of the restored leaves: a ``jax.Array`` leaf
@@ -139,53 +258,49 @@ def restore(directory: str, like: Any, step: int | None = None):
     ensemble state round-trips with its ``NamedSharding`` intact — each host
     reads only its shards on the multi-host path); any other leaf restores
     as host numpy.
+
+    Restored bytes are verified against the step's integrity manifest; a
+    mismatch (or an unvalidatable step) raises
+    :class:`CheckpointCorrupt`. With ``step=None`` corrupt steps are
+    skipped newest-to-oldest to the last good one (use
+    :func:`restore_intact` to also learn which steps were skipped);
+    an explicit ``step`` fails loudly instead of falling back.
     """
-    import orbax.checkpoint as ocp
+    restored, found, _skipped = restore_intact(directory, like, step=step)
+    return restored, found
+
+
+def restore_intact(directory: str, like: Any, step: int | None = None):
+    """:func:`restore` plus the list of corrupt steps skipped on the
+    walk back: ``(restored, step, skipped)``. ``skipped`` is newest
+    first and empty on a clean restore. Raises
+    :class:`CheckpointCorrupt` when every candidate step is corrupt,
+    FileNotFoundError when there are no steps at all."""
 
     def _abstract(x):
         if isinstance(x, jax.Array):
             return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
         return np.asarray(x)
 
+    abstract = jax.tree.map(_abstract, like)
     with _manager(directory) as mgr:
-        if step is None:
-            step = mgr.latest_step()
-        if step is None:
+        if step is not None:
+            restored, found = _restore_step(mgr, directory, step, like,
+                                            abstract)
+            return restored, found, []
+        steps = sorted(mgr.all_steps(), reverse=True)
+        if not steps:
             raise FileNotFoundError(f"no checkpoint under {directory}")
-        abstract = jax.tree.map(_abstract, like)
-        # This orbax build does NOT raise on a template-shape mismatch — it
-        # silently ZERO-PADS (or truncates) the stored array into the
-        # template, so a wrong-`like` restore (N=9 template over an N=4
-        # checkpoint) would hand the resumed rollout fabricated state and
-        # explode far from the cause. Validate template shapes against the
-        # STORED array metadata up front (best-effort: unavailable
-        # metadata skips the check rather than failing a good restore).
-        _validate_against_stored(directory, step, abstract)
-        try:
-            return (mgr.restore(step, args=ocp.args.StandardRestore(abstract)),
-                    step)
-        except Exception as e:
-            # Forward compatibility for grown state pytrees: State gained a
-            # third field (theta, () outside unicycle mode) in round 3, so a
-            # checkpoint written by the 2-field State fails StandardRestore's
-            # structure match against the 3-field template even though the
-            # new field holds no arrays. Retry with the leafless fields
-            # pruned and graft the empty values back. A genuine failure
-            # (shape mismatch, corrupt checkpoint, IO) fails the pruned
-            # retry too — then the ORIGINAL error surfaces, so real errors
-            # are never masked and the detection doesn't depend on parsing
-            # orbax's (version-dependent) mismatch message.
-            empty = [f for f in getattr(like, "_fields", ())
-                     if not jax.tree.leaves(getattr(like, f))]
-            if not empty:
-                raise
-            pruned = {f: getattr(abstract, f) for f in like._fields
-                      if f not in empty}
+        skipped: list[int] = []
+        errors: list[str] = []
+        for s in steps:
             try:
-                restored = mgr.restore(
-                    step, args=ocp.args.StandardRestore(pruned))
-            except Exception:
-                raise e
-            return (type(like)(**restored,
-                               **{f: getattr(like, f) for f in empty}),
-                    step)
+                restored, found = _restore_step(mgr, directory, s, like,
+                                                abstract)
+                return restored, found, skipped
+            except CheckpointCorrupt as e:
+                skipped.append(s)
+                errors.append(str(e))
+        raise CheckpointCorrupt(
+            f"all {len(steps)} checkpoint step(s) under {directory} are "
+            "corrupt: " + " | ".join(errors), directory=directory)

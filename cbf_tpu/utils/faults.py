@@ -270,6 +270,67 @@ def serve_chaos_hook(*hooks: Callable) -> Callable:
     return hook
 
 
+# ------------------------------------------------ process-level kills ----
+
+
+def kill_schedule(seed: int, rounds: int, t_min: float,
+                  t_max: float) -> list:
+    """Seeded SIGKILL times for a preemption campaign: ``rounds``
+    uniform draws from ``[t_min, t_max)`` seconds. Seeded
+    (``np.random.default_rng``) so a failing bench round replays with
+    the same kill points."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return [float(t) for t in rng.uniform(float(t_min), float(t_max),
+                                          size=int(rounds))]
+
+
+def run_process_until(argv, should_kill, *, poll_s: float = 0.1,
+                      timeout_s: float = 600.0, env=None,
+                      sig=None) -> tuple:
+    """Run ``argv`` as a subprocess, polling ``should_kill(elapsed_s)``;
+    deliver ``sig`` (default SIGKILL — the preemption model: no warning,
+    no cleanup) the first time it returns True. Returns ``(returncode,
+    killed, elapsed_s)`` — ``killed`` False when the process finished
+    first. A process that outlives ``timeout_s`` is killed and reported
+    as ``returncode None`` (a harness bug, not a preemption)."""
+    import signal
+    import subprocess
+    import time
+
+    if sig is None:
+        sig = signal.SIGKILL
+    t0 = time.monotonic()
+    proc = subprocess.Popen(argv, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        while True:
+            rc = proc.poll()
+            elapsed = time.monotonic() - t0
+            if rc is not None:
+                return rc, False, elapsed
+            if elapsed > timeout_s:
+                proc.kill()
+                proc.wait()
+                return None, True, elapsed
+            if should_kill(elapsed):
+                proc.send_signal(sig)
+                proc.wait()
+                return proc.returncode, True, time.monotonic() - t0
+            time.sleep(poll_s)
+    except BaseException:
+        proc.kill()
+        proc.wait()
+        raise
+
+
+def run_until_killed(argv, kill_after_s: float, **kw) -> tuple:
+    """:func:`run_process_until` with a fixed kill time: SIGKILL ``argv``
+    after ``kill_after_s`` seconds unless it exits first."""
+    return run_process_until(argv, lambda t: t >= kill_after_s, **kw)
+
+
 def poison_config(cfg):
     """A data-plane poisoned request: same bucket as ``cfg`` (only a
     TRACED scalar changes), passes `scenarios.swarm.validate_config`

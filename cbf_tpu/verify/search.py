@@ -31,12 +31,16 @@ as ``verify.round`` telemetry events and their verdict as a
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
+import os
 from typing import Any, Callable, NamedTuple
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from cbf_tpu.durable.integrity import write_atomic, write_npz_atomic
 from cbf_tpu.rollout.engine import _rollout_body
 from cbf_tpu.utils.math import l2_cap
 from cbf_tpu.verify.properties import (DIFFERENTIABLE_PROPERTIES,
@@ -347,22 +351,135 @@ def _worst_per_candidate(margins) -> np.ndarray:
     return np.asarray(jnp.min(margins, axis=1), np.float64)
 
 
+# ------------------------------------------------- campaign persistence --
+#
+# A falsification campaign is hours of candidate rollouts; a preemption
+# must not restart it from round 0. The random/cem engines persist
+# per-round state under ``state_dir`` — counters + best candidate (+ the
+# CEM proposal) — and resume bit-identically: every round's key is
+# ``fold_in(engine_key, r)``, so round r re-runs to the same candidates
+# whether or not rounds 0..r-1 happened in this process.
+
+SEARCH_STATE_SCHEMA_VERSION = 1
+
+
+def _campaign_fingerprint(engine: str, adapter: Adapter,
+                          settings: SearchSettings) -> str:
+    """What a persisted campaign is a campaign OF. Resuming under a
+    different budget/proposal/scenario would splice incompatible round
+    streams, so the fingerprint pins everything that shapes them."""
+    blob = json.dumps({
+        "engine": engine, "scenario": adapter.scenario,
+        "delta_shape": list(adapter.delta_shape), "steps": adapter.steps,
+        "settings": dataclasses.asdict(settings)},
+        sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def _state_paths(state_dir: str, engine: str) -> tuple[str, str]:
+    d = os.path.abspath(state_dir)
+    return (os.path.join(d, f"{engine}_state.json"),
+            os.path.join(d, f"{engine}_state.npz"))
+
+
+def _save_round_state(state_dir, engine, fingerprint, *, next_round,
+                      evaluated, best, done, extra_arrays=None) -> None:
+    """Persist one completed round atomically: arrays first, the JSON
+    counter file last (the commit marker). A kill between the two leaves
+    the previous round's counters pointing at a newer npz — harmless,
+    because re-running that round is idempotent under fold_in
+    determinism (same candidates, best only updates on strict
+    improvement)."""
+    jpath, npath = _state_paths(state_dir, engine)
+    arrays = dict(extra_arrays or {})
+    if best[1] is not None:
+        arrays["best_delta"] = np.asarray(best[1])
+        arrays["best_margins"] = np.asarray(best[2])
+    write_npz_atomic(npath, arrays)
+    write_atomic(jpath, json.dumps({
+        "schema": SEARCH_STATE_SCHEMA_VERSION, "engine": engine,
+        "fingerprint": fingerprint, "next_round": int(next_round),
+        "evaluated": int(evaluated),
+        "best_margin": None if best[1] is None else float(best[0]),
+        "done": bool(done)}, sort_keys=True))
+
+
+def _load_round_state(state_dir: str, engine: str, fingerprint: str):
+    """(counters, arrays) of a resumable campaign, or None when nothing
+    is persisted yet. A fingerprint mismatch raises: silently continuing
+    a campaign under different settings would fabricate a round stream
+    no single-run invocation could produce."""
+    jpath, npath = _state_paths(state_dir, engine)
+    if not os.path.exists(jpath):
+        return None
+    with open(jpath) as fh:
+        counters = json.load(fh)
+    if counters.get("schema") != SEARCH_STATE_SCHEMA_VERSION:
+        raise ValueError(
+            f"search state schema {counters.get('schema')!r} at {jpath} "
+            f"!= {SEARCH_STATE_SCHEMA_VERSION}")
+    if counters.get("fingerprint") != fingerprint:
+        raise ValueError(
+            f"persisted {engine} campaign in {state_dir} was run under "
+            "different settings/scenario (fingerprint mismatch) — refusing "
+            "to splice; use a fresh state dir or the original settings")
+    arrays = {}
+    if os.path.exists(npath):
+        with np.load(npath) as z:
+            arrays = {k: z[k] for k in z.files}
+    return counters, arrays
+
+
+def _resume_engine_state(state_dir, engine, fingerprint, resume, rounds,
+                         best, evaluated):
+    """Shared resume preamble: returns (first_round, evaluated, best,
+    finished, arrays) with ``finished`` True when the persisted campaign
+    already completed (violation found or budget exhausted); ``arrays``
+    carries engine-specific extras (the CEM proposal mean/std)."""
+    if state_dir is None or not resume:
+        return 0, evaluated, best, False, {}
+    st = _load_round_state(state_dir, engine, fingerprint)
+    if st is None:
+        return 0, evaluated, best, False, {}
+    counters, arrays = st
+    r0 = int(counters["next_round"])
+    evaluated = int(counters["evaluated"])
+    if counters["best_margin"] is not None:
+        best = (counters["best_margin"], arrays["best_delta"],
+                arrays["best_margins"])
+    return r0, evaluated, best, bool(counters["done"]) or r0 >= rounds, arrays
+
+
 # -------------------------------------------------------------- engines --
 
 def random_search(adapter: Adapter, settings: SearchSettings = SearchSettings(),
-                  *, telemetry=None, mesh=None) -> SearchResult:
+                  *, telemetry=None, mesh=None, state_dir: str | None = None,
+                  resume: bool = True) -> SearchResult:
     """Batched seeded random search: breadth-first coverage of the attack
     neighborhood. Stops after the first round that finds a violation (the
-    whole round still evaluates — determinism over latency)."""
+    whole round still evaluates — determinism over latency).
+
+    ``state_dir``: persist per-round campaign state there (atomic; see
+    "campaign persistence" above) and, with ``resume`` (default), pick a
+    killed campaign up at its next round — bit-identical to an
+    uninterrupted run, since round keys are fold_in-derived."""
     settings = round_batch(settings, mesh)
-    eval_b = make_eval_batch(adapter, settings, mesh)
     key = jax.random.fold_in(jax.random.PRNGKey(settings.seed),
                              _ENGINE_TAG["random"])
     B = settings.batch
     rounds = max(1, -(-settings.budget // B))
     best = (np.inf, None, None)          # (worst margin, delta, margins row)
-    evaluated = 0
-    for r in range(rounds):
+    fp = _campaign_fingerprint("random", adapter, settings) \
+        if state_dir is not None else None
+    r0, evaluated, best, finished, _ = _resume_engine_state(
+        state_dir, "random", fp, resume, rounds, best, 0)
+    if finished:
+        result = _result("random", adapter, settings, best[1], best[2],
+                         evaluated, r0)
+        _emit_result(telemetry, result)
+        return result
+    eval_b = make_eval_batch(adapter, settings, mesh)
+    for r in range(r0, rounds):
         deltas = settings.perturb_scale * jax.random.normal(
             jax.random.fold_in(key, r), (B,) + adapter.delta_shape,
             _state_dtype(adapter))
@@ -376,6 +493,10 @@ def random_search(adapter: Adapter, settings: SearchSettings = SearchSettings(),
                 np.asarray(margins)[i])
         _emit_round(telemetry, "random", r, B, best[0],
                     int((worst < 0).sum()), evaluated)
+        if state_dir is not None:
+            _save_round_state(state_dir, "random", fp, next_round=r + 1,
+                              evaluated=evaluated, best=best,
+                              done=bool(best[0] < 0))
         if best[0] < 0:
             break
     result = _result("random", adapter, settings, best[1], best[2],
@@ -447,12 +568,17 @@ def gradient_search(adapter: Adapter,
 
 
 def cem_search(adapter: Adapter, settings: SearchSettings = SearchSettings(),
-               *, telemetry=None, mesh=None) -> SearchResult:
+               *, telemetry=None, mesh=None, state_dir: str | None = None,
+               resume: bool = True) -> SearchResult:
     """Cross-entropy refinement: fit the proposal to the elite (lowest
     worst-margin) candidates each round — the zoom-in stage after random
-    breadth, gradient-free (works on every scenario and property)."""
+    breadth, gradient-free (works on every scenario and property).
+
+    ``state_dir``/``resume``: same per-round campaign persistence as
+    :func:`random_search`; here the proposal (mean/std) rides in the
+    persisted arrays, so a resumed round r samples exactly the deltas an
+    uninterrupted run's round r would have."""
     settings = round_batch(settings, mesh)
-    eval_b = make_eval_batch(adapter, settings, mesh)
     B = settings.batch
     rounds = max(1, min(settings.cem_rounds, -(-settings.budget // B)))
     n_elite = max(1, int(settings.cem_elite_frac * B))
@@ -462,8 +588,20 @@ def cem_search(adapter: Adapter, settings: SearchSettings = SearchSettings(),
     key = jax.random.fold_in(jax.random.PRNGKey(settings.seed),
                              _ENGINE_TAG["cem"])
     best = (np.inf, None, None)
-    evaluated = 0
-    for r in range(rounds):
+    fp = _campaign_fingerprint("cem", adapter, settings) \
+        if state_dir is not None else None
+    r0, evaluated, best, finished, arrays = _resume_engine_state(
+        state_dir, "cem", fp, resume, rounds, best, 0)
+    if "mean" in arrays:
+        mean = jnp.asarray(arrays["mean"], dt_)
+        std = jnp.asarray(arrays["std"], dt_)
+    if finished:
+        result = _result("cem", adapter, settings, best[1], best[2],
+                         evaluated, r0)
+        _emit_result(telemetry, result)
+        return result
+    eval_b = make_eval_batch(adapter, settings, mesh)
+    for r in range(r0, rounds):
         noise = jax.random.normal(jax.random.fold_in(key, r),
                                   (B,) + adapter.delta_shape, dt_)
         deltas = mean[None] + std[None] * noise
@@ -478,11 +616,20 @@ def cem_search(adapter: Adapter, settings: SearchSettings = SearchSettings(),
                 np.asarray(margins)[i])
         _emit_round(telemetry, "cem", r, B, best[0],
                     int((worst < 0).sum()), evaluated)
-        if best[0] < 0:
+        done = bool(best[0] < 0)
+        if not done:
+            elite = jnp.asarray(np.asarray(deltas)[order[:n_elite]])
+            mean = jnp.mean(elite, axis=0)
+            std = jnp.maximum(jnp.std(elite, axis=0), settings.cem_std_floor)
+        if state_dir is not None:
+            # mean/std here are the NEXT round's proposal — the piece of
+            # cross-round state fold_in determinism alone cannot rebuild.
+            _save_round_state(state_dir, "cem", fp, next_round=r + 1,
+                              evaluated=evaluated, best=best, done=done,
+                              extra_arrays={"mean": np.asarray(mean),
+                                            "std": np.asarray(std)})
+        if done:
             break
-        elite = jnp.asarray(np.asarray(deltas)[order[:n_elite]])
-        mean = jnp.mean(elite, axis=0)
-        std = jnp.maximum(jnp.std(elite, axis=0), settings.cem_std_floor)
     result = _result("cem", adapter, settings, best[1], best[2],
                      evaluated, r + 1)
     _emit_result(telemetry, result)
@@ -498,7 +645,8 @@ def falsify(scenario: str, cfg=None, *,
             engines=("random", "cem"), cbf=None,
             thresholds: PropertyThresholds | None = None,
             steps=None, telemetry=None, mesh=None,
-            stop_on_find: bool = True) -> list[SearchResult]:
+            stop_on_find: bool = True, state_dir: str | None = None,
+            resume: bool = True) -> list[SearchResult]:
     """Run the requested engines in order against one scenario config.
 
     Each engine gets ``settings.budget`` candidate rollouts. The
@@ -506,7 +654,9 @@ def falsify(scenario: str, cfg=None, *,
     exists (swarm without certificate/caches); requesting it elsewhere
     raises. Returns every engine's :class:`SearchResult` (ordered as
     run); with ``stop_on_find`` the sweep stops at the first engine that
-    violates."""
+    violates. ``state_dir``/``resume`` thread through to the
+    round-persistent engines (random/cem) so a killed campaign continues
+    instead of restarting (the CLI's ``verify --state-dir --resume``)."""
     unknown = set(engines) - set(ENGINES)
     if unknown:
         raise ValueError(f"unknown engines {sorted(unknown)}; have "
@@ -516,12 +666,15 @@ def falsify(scenario: str, cfg=None, *,
     results = []
     for engine in engines:
         a = adapter
+        kw = {}
         if engine == "grad":
             a = make_adapter(scenario, cfg, cbf=cbf, steps=steps,
                              thresholds=thresholds, differentiable=True,
                              unroll_relax=settings.unroll_relax)
-        results.append(_ENGINE_FNS[engine](a, settings,
-                                           telemetry=telemetry, mesh=mesh))
+        else:
+            kw = {"state_dir": state_dir, "resume": resume}
+        results.append(_ENGINE_FNS[engine](a, settings, telemetry=telemetry,
+                                           mesh=mesh, **kw))
         if stop_on_find and results[-1].found:
             break
     return results

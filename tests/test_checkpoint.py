@@ -222,3 +222,107 @@ def test_resume_preserves_certificate_warm_state(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(outs.certificate_iterations),
         np.asarray(ref_outs.certificate_iterations)[16:])
+
+
+# -------------------- integrity fail-closed (ISSUE 9 satellite) ----------
+
+def _damage_step(directory, step):
+    """Flip the first byte of every non-empty file under the step's
+    data dir — the chaos harness's corruption model."""
+    import os
+
+    root = os.path.join(directory, str(step), "default")
+    flipped = 0
+    for dirpath, _, files in os.walk(root):
+        for name in files:
+            path = os.path.join(dirpath, name)
+            if os.path.getsize(path) == 0:
+                continue
+            with open(path, "r+b") as fh:
+                b = fh.read(1)
+                fh.seek(0)
+                fh.write(bytes([b[0] ^ 0xFF]))
+            flipped += 1
+    assert flipped, f"no data files under {root}"
+
+
+def test_corrupt_newest_step_walked_back(scenario, tmp_path):
+    """Damaged newest checkpoint: restore_intact skips it to the last
+    intact step and reports the skip; an EXPLICIT step=<damaged> fails
+    loudly instead of falling back."""
+    cfg, state0, step = scenario
+    d = str(tmp_path / "ckpt")
+    rollout_chunked(step, state0, 8, chunk=4, checkpoint_dir=d)
+    assert ckpt.latest_step(d) == 8
+    _damage_step(d, 8)
+
+    restored, found, skipped = ckpt.restore_intact(d, state0)
+    assert found == 4 and skipped == [8]
+    clean, _, _ = rollout_chunked(step, state0, 4, chunk=4)
+    np.testing.assert_array_equal(np.asarray(restored.x),
+                                  np.asarray(clean.x))
+    with pytest.raises(ckpt.CheckpointCorrupt):
+        ckpt.restore(d, state0, step=8)
+
+
+def test_hand_truncated_step_fails_closed(scenario, tmp_path):
+    """Hand-truncated checkpoint dir (every file 0 bytes, manifest
+    removed): orbax's metadata is unreadable AND there is no integrity
+    manifest to validate against — restore must refuse with the typed
+    CheckpointCorrupt (this orbax build would otherwise silently
+    zero-pad the template), never hand back fabricated state."""
+    import os
+
+    cfg, state0, step = scenario
+    d = str(tmp_path / "ckpt")
+    ckpt.save(d, 4, state0)
+    os.remove(os.path.join(d, "4", "integrity.json"))
+    for dirpath, _, files in os.walk(os.path.join(d, "4")):
+        for name in files:
+            with open(os.path.join(dirpath, name), "w"):
+                pass                                # truncate to 0 bytes
+
+    with pytest.raises(ckpt.CheckpointCorrupt, match="refusing"):
+        ckpt.restore(d, state0, step=4)
+    # Walk-back with EVERY candidate damaged: aggregated corruption
+    # error, not a silent step-0 cold start.
+    with pytest.raises(ckpt.CheckpointCorrupt):
+        ckpt.restore(d, state0)
+
+
+def test_durable_resume_skips_corrupt_newest_bit_exact(scenario, tmp_path):
+    """durable.resume over a dir whose NEWEST committed checkpoint is
+    damaged: the skip is detected and logged, the run walks back to the
+    last intact step, and the result is still bit-exact; with EVERY
+    step damaged it fails closed (CheckpointCorrupt) rather than
+    silently cold-starting on a dir known to hold damage."""
+    import json
+    import os
+
+    from cbf_tpu.durable import rollout as dr
+    from cbf_tpu.durable.integrity import CheckpointCorrupt
+
+    cfg, state0, step = scenario
+    d = str(tmp_path / "run")
+    dr.run_durable(d, scenario="swarm", cfg=cfg, chunk=4)
+    ckpt_dir = os.path.join(d, "ckpt")
+    committed = sorted(int(s) for s in os.listdir(ckpt_dir) if s.isdigit())
+    assert len(committed) >= 2          # max_to_keep=2 retains the pair
+    _damage_step(ckpt_dir, committed[-1])
+
+    out = dr.resume(d)
+    assert out["resumed_from_step"] == committed[-2]
+    assert out["corrupt_skipped"] == [committed[-1]]
+    entry = [json.loads(ln) for ln in
+             open(os.path.join(d, "resume_log.jsonl"))][-1]
+    assert entry["corrupt_skipped"] == [committed[-1]]
+
+    ref_final, _ = rollout(step, state0, cfg.steps)
+    np.testing.assert_array_equal(np.asarray(out["final_state"].x),
+                                  np.asarray(ref_final.x))
+
+    # Every remaining step damaged: refuse, don't trust or cold-start.
+    for s in (s for s in os.listdir(ckpt_dir) if s.isdigit()):
+        _damage_step(ckpt_dir, int(s))
+    with pytest.raises(CheckpointCorrupt):
+        dr.resume(d)

@@ -137,3 +137,79 @@ def test_set_types_none_default_fields(capsys):
     # when they are not numeric.
     assert main(["run", "swarm", "--steps", "2", "--set", "n=9",
                  "--set", "gating_window_blocks=none"]) == 0
+
+
+# ------------------------ durable execution flags (ISSUE 9 satellite) ----
+
+def test_run_durable_dir_and_resume_roundtrip(tmp_path, capsys):
+    """`run --durable-dir` + `run --resume DIR`: the resume rebuilds the
+    run from the directory alone (no scenario argument) and reports the
+    recovery on the record."""
+    d = str(tmp_path / "run")
+    assert main(["run", "swarm", "--durable-dir", d, "--steps", "12",
+                 "--set", "n=8", "--chunk", "6"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["scenario"] == "swarm" and rec["steps"] == 12
+    assert rec["durable_dir"] == d
+    assert rec["resumed_from_step"] == 0
+    mpd = rec["min_pairwise_distance"]
+
+    assert main(["run", "--resume", d]) == 0
+    rec2 = json.loads(capsys.readouterr().out)
+    assert rec2["resumed_from_step"] == 12      # complete: pure restore
+    assert rec2["corrupt_skipped"] == []
+    assert rec2["min_pairwise_distance"] == mpd
+
+
+def test_run_durable_exit_codes(tmp_path, capsys):
+    """Operator errors exit 2 (documented in docs/API.md 'Durable
+    execution'), with a one-line reason on stderr."""
+    missing = str(tmp_path / "nowhere")
+    assert main(["run"]) == 2                   # no scenario, no --resume
+    assert "scenario" in capsys.readouterr().err
+    assert main(["run", "--resume", missing]) == 2
+    assert "no durable run spec" in capsys.readouterr().err
+    d = str(tmp_path / "run")
+    assert main(["run", "swarm", "--durable-dir", d, "--steps", "4",
+                 "--set", "n=8", "--chunk", "2"]) == 0
+    capsys.readouterr()
+    other = str(tmp_path / "other")
+    assert main(["run", "--resume", d, "--durable-dir", other]) == 2
+    assert "--durable-dir" in capsys.readouterr().err
+
+
+def test_serve_recover_exit_codes_and_empty_journal(tmp_path, capsys):
+    missing = str(tmp_path / "nowhere.jsonl")
+    assert main(["serve", "--recover"]) == 2    # --recover needs --journal
+    assert "--journal" in capsys.readouterr().err
+    assert main(["serve"]) == 2                 # no requests, no recovery
+    assert "requests file" in capsys.readouterr().err
+    assert main(["serve", "--journal", missing, "--recover"]) == 2
+    assert "no request journal" in capsys.readouterr().err
+
+    # A journal with nothing unresolved recovers to a clean no-op.
+    from cbf_tpu.durable.journal import RequestJournal
+    from cbf_tpu.scenarios import swarm
+
+    path = str(tmp_path / "j.jsonl")
+    j = RequestJournal(path)
+    j.submitted("r0", swarm.Config(n=8, steps=4, gating="jnp"))
+    j.resolved("r0")
+    j.close()
+    assert main(["serve", "--journal", path, "--recover"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec == {"requests": 0, "recovered": 0,
+                   "journal": path}
+
+
+def test_verify_state_dir_fingerprint_mismatch_exits_2(tmp_path, capsys):
+    d = str(tmp_path / "campaign")
+    assert main(["verify", "swarm", "--engine", "random", "--budget", "8",
+                 "--batch", "4", "--set", "n=9", "--steps", "20",
+                 "--state-dir", d]) == 0
+    capsys.readouterr()
+    # Same campaign dir, different budget: fail closed.
+    assert main(["verify", "swarm", "--engine", "random", "--budget", "16",
+                 "--batch", "4", "--set", "n=9", "--steps", "20",
+                 "--state-dir", d]) == 2
+    assert "fingerprint" in capsys.readouterr().err

@@ -1,0 +1,309 @@
+"""Durable execution (cbf_tpu.durable, ISSUE 9): crash recovery across
+process boundaries.
+
+The load-bearing pins:
+
+- BIT-EXACT RESUME: a durable rollout SIGKILLed at an arbitrary point
+  and resumed from its directory alone produces byte-identical outputs
+  and final state vs the uninterrupted run (the tentpole acceptance).
+- WAL CONTRACT: the request journal's fold tolerates exactly the tear a
+  killed single appender can produce (a torn FINAL line); every other
+  damage is a typed RecoveryError, and recovery re-runs exactly the
+  acknowledged-but-unresolved set under the original request ids.
+- GRACEFUL DRAIN: `stop(drain=True)` — and the serve CLI's SIGTERM
+  handler that calls it — resolves every acknowledged request before
+  the process dies, leaving the journal with zero unresolved entries.
+- VERIFY CAMPAIGNS: persisted search state resumes bit-identically and
+  fails closed (ValueError) on a settings/scenario fingerprint mismatch.
+- DOCS LOCKSTEP: docs/API.md "Durable execution" names every public
+  surface this package ships (the same audit-enforcement style as the
+  Serving and Fault tolerance sections).
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import jax  # noqa: E402
+
+from cbf_tpu.durable import journal as dj  # noqa: E402
+from cbf_tpu.durable import rollout as dr  # noqa: E402
+from cbf_tpu.scenarios import swarm  # noqa: E402
+from cbf_tpu.serve import RecoveryError, ServeEngine  # noqa: E402
+from cbf_tpu.utils import faults  # noqa: E402
+
+
+def _cli_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _leaves_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert np.asarray(x).tobytes() == np.asarray(y).tobytes()
+
+
+# ------------------------------------------------- resumable rollouts ----
+
+def test_run_durable_matches_plain_and_resumes_complete(tmp_path):
+    """A durable run's stitched outputs are byte-identical to a plain
+    in-memory rollout, and `resume` on a COMPLETE directory is a pure
+    restore (no re-execution, same bytes)."""
+    from cbf_tpu.rollout.engine import rollout
+
+    cfg = swarm.Config(n=16, steps=24, gating="jnp")
+    d = str(tmp_path / "run")
+    out = dr.run_durable(d, scenario="swarm", cfg=cfg, chunk=8)
+    assert out["steps"] == 24 and out["resumed_from_step"] == 0
+    assert out["corrupt_skipped"] == []
+
+    state0, step = swarm.make(cfg)
+    ref_final, ref_outs = rollout(step, state0, cfg.steps)
+    _leaves_equal(out["outputs"], ref_outs)
+    _leaves_equal(out["final_state"], ref_final)
+
+    spec = dr.load_spec(d)
+    assert spec["scenario"] == "swarm" and spec["steps"] == 24
+
+    out2 = dr.resume(d)
+    assert out2["resumed_from_step"] == 24
+    _leaves_equal(out2["outputs"], out["outputs"])
+    _leaves_equal(out2["final_state"], out["final_state"])
+
+
+def test_run_durable_refuses_mixed_runs(tmp_path):
+    d = str(tmp_path / "run")
+    dr.run_durable(d, scenario="swarm",
+                   cfg=swarm.Config(n=8, steps=8, gating="jnp"), chunk=4)
+    with pytest.raises(ValueError, match="different config"):
+        dr.run_durable(d, scenario="swarm",
+                       cfg=swarm.Config(n=16, steps=8, gating="jnp"))
+    with pytest.raises(FileNotFoundError):
+        dr.resume(str(tmp_path / "nowhere"))
+
+
+def test_sigkill_midrun_resume_bit_exact(tmp_path):
+    """The tentpole acceptance: SIGKILL the CLI mid-run, resume from the
+    directory alone, require byte-identical outputs vs an uninterrupted
+    run of the same spec."""
+    cfg = swarm.Config(n=256, steps=2000, gating="jnp")
+    ref = dr.run_durable(str(tmp_path / "ref"), scenario="swarm", cfg=cfg,
+                         chunk=200)
+
+    kill_dir = str(tmp_path / "kill")
+    argv = [sys.executable, "-m", "cbf_tpu", "run", "swarm",
+            "--durable-dir", kill_dir, "--platform", "cpu",
+            "--set", "n=256", "--set", "gating=jnp",
+            "--steps", "2000", "--chunk", "200"]
+
+    # Arm on the first COMMITTED checkpoint (its integrity manifest is
+    # the commit marker, written one boundary after the save) so the
+    # resume provably restarts from a step > 0.
+    def first_commit_on_disk(_elapsed):
+        return bool(glob.glob(
+            os.path.join(kill_dir, "ckpt", "*", "integrity.json")))
+
+    rc, killed, _ = faults.run_process_until(
+        argv, first_commit_on_disk, poll_s=0.05, timeout_s=300.0,
+        env=_cli_env())
+    assert killed, f"process finished (rc={rc}) before the kill armed"
+
+    res = dr.resume(kill_dir)
+    assert res["resumed_from_step"] > 0, "resume saw no saved progress"
+    _leaves_equal(res["outputs"], ref["outputs"])
+    _leaves_equal(res["final_state"], ref["final_state"])
+    # The recovery event is on the durable record.
+    log = os.path.join(kill_dir, dr.RESUME_LOG_NAME)
+    entries = [json.loads(ln) for ln in open(log)]
+    assert entries and entries[-1]["resumed_from_step"] > 0
+
+
+# ------------------------------------------------------- WAL journal ----
+
+def _mk_cfg(**kw):
+    return swarm.Config(**{"n": 8, "steps": 6, "gating": "jnp", **kw})
+
+
+def test_journal_fold_and_unresolved_order(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = dj.RequestJournal(path)
+    j.submitted("r0", _mk_cfg(seed=3))
+    j.submitted("r1", _mk_cfg(seed=4))
+    j.packed("n8_t8", ["r0", "r1"])
+    j.resolved("r0")
+    j.close()
+
+    replay = dj.replay_journal(path)
+    assert [rid for rid, _ in replay.unresolved] == ["r1"]
+    (rid, cfg), = replay.unresolved_configs()
+    assert rid == "r1" and isinstance(cfg, swarm.Config) and cfg.seed == 4
+
+
+def test_journal_resubmit_reopens(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = dj.RequestJournal(path)
+    j.submitted("r0", _mk_cfg())
+    j.resolved("r0")
+    j.submitted("r0", _mk_cfg())    # recovery re-acknowledged it
+    j.close()
+    assert [rid for rid, _ in dj.replay_journal(path).unresolved] == ["r0"]
+
+
+def test_journal_torn_final_line_tolerated(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = dj.RequestJournal(path)
+    j.submitted("r0", _mk_cfg())
+    j.close()
+    with open(path, "a") as fh:
+        fh.write('{"type": "submitted", "requ')   # killed mid-append
+    replay = dj.replay_journal(path)
+    assert [rid for rid, _ in replay.unresolved] == ["r0"]
+
+
+def test_journal_garbled_middle_raises(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = dj.RequestJournal(path)
+    j.submitted("r0", _mk_cfg())
+    j.submitted("r1", _mk_cfg())
+    j.close()
+    lines = open(path).read().splitlines()
+    lines[0] = lines[0][: len(lines[0]) // 2]     # damage a NON-final line
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    with pytest.raises(RecoveryError, match="garbled"):
+        dj.replay_journal(path)
+
+
+def test_journal_unknown_schema_and_missing_file_raise(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    with pytest.raises(RecoveryError, match="no request journal"):
+        dj.replay_journal(path)
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"type": "submitted", "request_id": "r0",
+                             "config": {}, "schema": 99}) + "\n")
+    with pytest.raises(RecoveryError, match="schema"):
+        dj.replay_journal(path)
+
+
+# ------------------------------------------- drain + crash recovery ----
+
+def test_stop_drain_resolves_every_queued_request(tmp_path):
+    """`stop(drain=True)` under load: every acknowledged request
+    resolves (result, not timeout) and journals its terminal record —
+    the in-process half of the SIGTERM drain contract."""
+    path = str(tmp_path / "j.jsonl")
+    engine = ServeEngine(max_batch=2, flush_deadline_s=60.0, journal=path)
+    engine.start()
+    # flush_deadline far out: nothing flushes on its own; the drain in
+    # stop() is what must execute these.
+    handles = [engine.submit(_mk_cfg(seed=i)) for i in range(5)]
+    engine.stop(drain=True)
+    for h in handles:
+        r = h.result(timeout=0)
+        assert r.request_id == h.request_id
+    assert dj.replay_journal(path).unresolved == []
+
+
+def test_recover_reruns_only_unresolved_under_original_ids(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = dj.RequestJournal(path)                  # the "crashed" process
+    j.submitted("r0", _mk_cfg(seed=0))
+    j.submitted("r1", _mk_cfg(seed=1))
+    j.submitted("r2", _mk_cfg(seed=2))
+    j.resolved("r1")
+    j.close()
+
+    engine = ServeEngine(max_batch=4, flush_deadline_s=0.05, journal=path)
+    engine.start()
+    handles = engine.recover(path)
+    assert sorted(h.request_id for h in handles) == ["r0", "r2"]
+    for h in handles:
+        h.result(timeout=60)
+    engine.stop()
+    assert dj.replay_journal(path).unresolved == []
+
+
+def test_serve_cli_sigterm_graceful_drain(tmp_path):
+    """Preemption notice end-to-end: SIGTERM the serve CLI mid-batch;
+    it must drain (exit 0, full JSON record, every request in
+    `results`) and leave the journal with zero unresolved entries."""
+    reqs = str(tmp_path / "reqs.json")
+    with open(reqs, "w") as fh:
+        json.dump([{"overrides": {"n": 8, "gating": "jnp"}, "steps": 12,
+                    "repeat": 6}], fh)
+    journal = str(tmp_path / "j.jsonl")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cbf_tpu", "serve", reqs,
+         "--journal", journal, "--platform", "cpu", "--max-batch", "2"],
+        cwd=ROOT, env=_cli_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if os.path.exists(journal) and os.path.getsize(journal) > 0:
+            break
+        if proc.poll() is not None:
+            break
+        time.sleep(0.02)
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+    out, err = proc.communicate(timeout=180)
+    assert proc.returncode == 0, f"serve died rc={proc.returncode}: {err}"
+    record = json.loads(out.strip().splitlines()[-1])
+    assert record["requests"] == 6
+    assert len(record["results"]) == 6
+    assert dj.replay_journal(journal).unresolved == []
+
+
+# ------------------------------------------------- verify campaigns ----
+
+def test_verify_campaign_resumes_and_fails_closed(tmp_path):
+    from cbf_tpu.verify import search
+
+    cfg = swarm.Config(n=9, steps=30, gating="jnp")
+    a = search.make_adapter("swarm", cfg)
+    small = search.SearchSettings(budget=16, batch=8, seed=0)
+    d = str(tmp_path / "campaign")
+
+    r1 = search.random_search(a, small, state_dir=d)
+    # A completed campaign resumes as a pure replay of its final state.
+    r2 = search.random_search(a, small, state_dir=d)
+    assert r2.evaluated == r1.evaluated
+    assert np.isclose(r2.margin, r1.margin)
+    # Changed settings under the same state_dir: fail closed, never mix.
+    with pytest.raises(ValueError, match="fingerprint"):
+        search.random_search(
+            a, search.SearchSettings(budget=32, batch=8, seed=0),
+            state_dir=d)
+
+
+# -------------------------------------------------------------- docs ----
+
+def test_durable_documented():
+    """docs/API.md 'Durable execution' stays in lockstep with the code
+    (same enforcement style as the Serving/Fault tolerance sections;
+    AUD001 additionally pins the durable.* event tables both ways)."""
+    with open(os.path.join(ROOT, "docs", "API.md")) as fh:
+        text = fh.read()
+    assert "## Durable execution" in text
+    for needle in ("CheckpointCorrupt", "integrity.json", "restore_intact",
+                   "run_durable", "resume", "RequestJournal",
+                   "replay_journal", "recover", "submitted", "resolved",
+                   "packed", "durable.resume", "durable.recover",
+                   "durable.journal", "--durable-dir", "--resume",
+                   "--journal", "--recover", "state_dir",
+                   "BENCH_PREEMPT", "SIGTERM"):
+        assert needle in text, f"docs/API.md Durable execution: missing {needle!r}"

@@ -271,14 +271,37 @@ def test_chunked_donation_matches_plain_and_preserves_state0():
                                   np.asarray(final_again.x))
 
 
-def test_donation_with_checkpoint_writer_rejected(tmp_path):
-    cfg = swarm.Config(n=16, steps=10, gating="jnp")
+def test_donation_composes_with_checkpoint_writer(tmp_path):
+    """donate_carry=True now composes with the async CheckpointWriter
+    (ISSUE 9 satellite): the writer's wait_until_finished() barrier at
+    each chunk boundary drains the in-flight save BEFORE the next
+    donated dispatch can invalidate the carry buffers.  Pin: the
+    donated+checkpointed run is bit-identical to the undonated one
+    (use-after-donate would corrupt leaves), and every saved step is
+    intact and resumable."""
+    cfg = swarm.Config(n=16, steps=30, gating="jnp")
     state0, step = swarm.make(cfg)
     from cbf_tpu.rollout.engine import rollout_chunked
 
-    with pytest.raises(ValueError, match="donate_carry"):
-        rollout_chunked(step, state0, cfg.steps, chunk=5,
-                        checkpoint_dir=str(tmp_path), donate_carry=True)
+    final_p, outs_p, _ = rollout_chunked(step, state0, cfg.steps, chunk=10,
+                                         checkpoint_dir=str(tmp_path / "a"),
+                                         donate_carry=False)
+    final_d, outs_d, _ = rollout_chunked(step, state0, cfg.steps, chunk=10,
+                                         checkpoint_dir=str(tmp_path / "b"),
+                                         donate_carry=True)
+    np.testing.assert_array_equal(np.asarray(final_p.x),
+                                  np.asarray(final_d.x))
+    np.testing.assert_array_equal(np.asarray(outs_p.min_pairwise_distance),
+                                  np.asarray(outs_d.min_pairwise_distance))
+
+    # Every boundary the donated run saved passes integrity verification
+    # (a save racing a donation would have written garbage bytes).
+    from cbf_tpu.utils import checkpoint as ckpt
+    restored, found, skipped = ckpt.restore_intact(str(tmp_path / "b"),
+                                                   state0)
+    assert found == cfg.steps and skipped == []
+    np.testing.assert_array_equal(np.asarray(restored.x),
+                                  np.asarray(final_d.x))
 
 
 # ------------------------------------------------------ throughput gate --
