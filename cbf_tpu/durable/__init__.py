@@ -33,7 +33,7 @@ from cbf_tpu.durable.integrity import (CheckpointCorrupt, MANIFEST_NAME,
 _LAZY = {
     "JOURNAL_SCHEMA_VERSION": "journal", "JournalReplay": "journal",
     "RequestJournal": "journal", "recover_into": "journal",
-    "replay_journal": "journal",
+    "repair_torn_tail": "journal", "replay_journal": "journal",
     "load_spec": "rollout", "resume": "rollout", "run_durable": "rollout",
 }
 

@@ -14,15 +14,20 @@ re-runs; none is ever silently dropped (`BENCH_PREEMPT=1` gates zero
 lost acknowledged requests).
 
 Format: schema-versioned JSONL, append-only. A SIGKILL can tear at most
-the FINAL line (single-writer appends), so replay tolerates exactly
-that; a garbled line anywhere else is real damage and raises the typed
-:class:`~cbf_tpu.serve.resilience.RecoveryError`.
+the FINAL line (serialized appends), so replay tolerates exactly that;
+a garbled line anywhere else is real damage and raises the typed
+:class:`~cbf_tpu.serve.resilience.RecoveryError`. Reopening a journal
+REPAIRS the tear first (truncating the torn fragment back to the last
+complete record) so the next append starts on a clean line — otherwise
+the first post-restart record would concatenate onto the fragment,
+garbling a NON-final line and losing that acknowledged record.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Any
 
@@ -35,33 +40,41 @@ JOURNAL_SCHEMA_VERSION = 1
 
 
 class RequestJournal:
-    """Append-only WAL handle. Thread-safety rides on the engine's queue
-    lock — the engine writes ``submitted`` under it, and ``resolved``
-    from whichever thread resolves, serialized by the GIL around the
-    single buffered ``write`` + ``flush`` pair."""
+    """Append-only WAL handle. ``submitted`` arrives from submitter
+    threads and ``resolved`` from whichever thread resolves, so a
+    journal-owned lock serializes the ``write``/``flush``/``fsync``
+    triple — interleaved records mid-file would be unrecoverable damage
+    (:func:`replay_journal` only forgives the final line)."""
 
     def __init__(self, path: str, *, telemetry=None):
         self.path = os.path.abspath(path)
+        self._lock = threading.Lock()
         d = os.path.dirname(self.path)
         if d:
             os.makedirs(d, exist_ok=True)
-        existing = replay_journal(self.path) \
-            if os.path.exists(self.path) else None
+        repaired = 0
+        existing = None
+        if os.path.exists(self.path):
+            repaired = repair_torn_tail(self.path)
+            existing = replay_journal(self.path)
         self._fh = open(self.path, "a")
         if telemetry is not None:
             telemetry.event("durable.journal", {
                 "path": self.path,
                 "records": existing.records if existing else 0,
                 "unresolved": len(existing.unresolved) if existing else 0,
+                "repaired_bytes": repaired,
             })
 
     def _append(self, record: dict, *, fsync: bool) -> None:
         record["schema"] = JOURNAL_SCHEMA_VERSION
         record["t"] = time.time()
-        self._fh.write(json.dumps(record, sort_keys=True) + "\n")
-        self._fh.flush()
-        if fsync:
-            os.fsync(self._fh.fileno())
+        line = json.dumps(record, sort_keys=True) + "\n"
+        with self._lock:
+            self._fh.write(line)
+            self._fh.flush()
+            if fsync:
+                os.fsync(self._fh.fileno())
 
     def submitted(self, request_id: str, cfg) -> None:
         """The acknowledgment record — durable (fsync) BEFORE the caller
@@ -108,6 +121,42 @@ class JournalReplay:
 
         return [(rid, config_from_json(swarm.Config, data))
                 for rid, data in self.unresolved]
+
+
+def repair_torn_tail(path: str) -> int:
+    """Truncate the tear a killed appender can leave — a final line with
+    no trailing newline (the write died mid-append) or a newline-
+    terminated final line that is not valid JSON (the buffer flushed
+    partially) — back to the end of the last complete record. Returns
+    the number of bytes dropped (0 when the file is already clean).
+
+    Run before reopening a journal for append: a record concatenated
+    onto a torn fragment garbles a NON-final line, which loses that
+    acknowledged record and makes every later replay raise. A dropped
+    fragment was never fsync-acknowledged, so no caller was told it was
+    durable. Damage farther from the tail is left alone for
+    :func:`replay_journal` to surface as :class:`RecoveryError`."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    keep = len(data)
+    if not data:
+        return 0
+    if not data.endswith(b"\n"):
+        keep = data.rfind(b"\n") + 1   # 0 when no complete line exists
+    else:
+        start = data.rfind(b"\n", 0, len(data) - 1) + 1
+        last = data[start:]
+        if last.strip():
+            try:
+                json.loads(last)
+            except ValueError:
+                keep = start
+    if keep != len(data):
+        with open(path, "r+b") as fh:
+            fh.truncate(keep)
+            fh.flush()
+            os.fsync(fh.fileno())
+    return len(data) - keep
 
 
 def replay_journal(path: str) -> JournalReplay:

@@ -166,7 +166,8 @@ SERVE_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
 
 #: The durable-execution layer's events (PR 9): ``durable.journal`` is
 #: written once when a write-ahead request journal opens (how much
-#: history it already holds), ``durable.recover`` once per journal
+#: history it already holds, and how many torn-tail bytes the reopen
+#: repaired), ``durable.recover`` once per journal
 #: replay onto a fresh engine (how many acknowledged-but-unresolved
 #: requests were re-enqueued vs refused at admission), and
 #: ``durable.resume`` once whenever a durable rollout run restarts from
@@ -179,7 +180,7 @@ DURABLE_EVENT_TYPES: tuple[str, ...] = (
     "durable.journal", "durable.recover", "durable.resume")
 
 DURABLE_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
-    "durable.journal": ("path", "records", "unresolved"),
+    "durable.journal": ("path", "records", "unresolved", "repaired_bytes"),
     "durable.recover": ("path", "records", "reenqueued", "refused"),
     "durable.resume": ("directory", "resumed_from_step", "chunks_loaded",
                        "steps"),

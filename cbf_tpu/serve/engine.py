@@ -256,6 +256,13 @@ class ServeEngine:
         self._queue: dict[_buckets.BucketKey, list] = {}
         self._thread: threading.Thread | None = None
         self._running = False
+        # Preemption notice (SIGTERM): the signal handler ONLY sets this
+        # event; the drain itself runs in normal control flow (the
+        # scheduler thread, or stop()). _preempt_poll_s bounds the
+        # scheduler's condition wait once a handler is installed, so the
+        # notice is observed without the handler touching any lock.
+        self._preempt = threading.Event()
+        self._preempt_poll_s: float | None = None
         # Jitter rng (seeded — AUD004) + breaker state, all host-side.
         self._rng = np.random.default_rng(self.fault_policy.seed)
         self._sig_breakers: dict[str, resilience.CircuitBreaker] = {}
@@ -756,16 +763,26 @@ class ServeEngine:
             self._thread.join()
             self._thread = None
         if drain:
-            leftovers = []
-            with self._lock:
-                for key in sorted(self._queue, key=lambda k: k.label()):
-                    entries = self._queue[key]
-                    while entries:
-                        leftovers.append((key, entries[:self.max_batch]))
-                        del entries[:self.max_batch]
-                self._queue.clear()
-            for key, batch in leftovers:
-                self._execute(key, batch)
+            self._drain_leftovers()
+
+    def _drain_leftovers(self) -> None:
+        """The graceful-drain body: stop admissions, pop everything still
+        queued, and execute it to resolution. Runs in NORMAL control
+        flow only — the caller of stop(), or the scheduler thread after
+        a SIGTERM notice — never inside a signal handler, which must not
+        join threads, run batches, or re-enter a journal append it may
+        have interrupted mid-write."""
+        leftovers = []
+        with self._lock:
+            self._running = False
+            for key in sorted(self._queue, key=lambda k: k.label()):
+                entries = self._queue[key]
+                while entries:
+                    leftovers.append((key, entries[:self.max_batch]))
+                    del entries[:self.max_batch]
+            self._queue.clear()
+        for key, batch in leftovers:
+            self._execute(key, batch)
 
     # -- durable execution -------------------------------------------------
 
@@ -781,18 +798,38 @@ class ServeEngine:
         return recover_into(self, journal_path)
 
     def install_sigterm_handler(self):
-        """Register a SIGTERM handler that stops the scheduler and
-        DRAINS the queue (``stop(drain=True)``) — preemption notice
-        becomes a graceful drain, so every queued request resolves
-        before the process dies; a SIGKILL (no notice) instead relies on
-        the journal + `recover`. Main-thread only (signal module
-        constraint); returns the previous handler."""
+        """Register a SIGTERM handler that turns a preemption notice
+        into a graceful drain, so every queued request resolves before
+        the process dies; a SIGKILL (no notice) instead relies on the
+        journal + `recover`. The handler itself only sets the preempt
+        flag — draining means joining the scheduler, running batches,
+        and fsyncing journal records, none of which belongs inside a
+        signal handler (it can fire mid `_append`, between write and
+        fsync). The drain runs from normal control flow: the scheduler
+        thread observes the flag (queue mode — it drains and exits, so
+        pending `result()` calls unblock), while a synchronous `run()`
+        simply keeps executing to completion on the main thread instead
+        of dying to the default SIGTERM action. Main-thread only
+        (signal module constraint); returns the previous handler."""
         import signal
 
-        def _drain(signum, frame):
-            self.stop(drain=True)
+        # Bound the scheduler's idle wait so the flag is observed even
+        # when it is parked in an open-ended cond.wait: the handler
+        # cannot safely notify (the main thread may already hold the
+        # non-reentrant queue lock when the signal fires).
+        self._preempt_poll_s = 0.05
+        with self._cond:
+            self._cond.notify()   # re-park any open-ended wait, bounded
 
-        return signal.signal(signal.SIGTERM, _drain)
+        def _notice(signum, frame):
+            self._preempt.set()
+            if self._cond.acquire(blocking=False):   # opportunistic wake
+                try:
+                    self._cond.notify()
+                finally:
+                    self._cond.release()
+
+        return signal.signal(signal.SIGTERM, _notice)
 
     # -- scheduler ---------------------------------------------------------
 
@@ -852,17 +889,30 @@ class ServeEngine:
     def _scheduler_body(self) -> None:
         while True:
             transition = None
+            preempted = False
             with self._cond:
                 if not self._running:
                     return
-                now = self.tracer.now()   # same monotonic clock as enqueue
-                transition = self._update_degrade(now)
-                to_run, next_deadline = self._scan_queue(now)
-                if not to_run and transition is None:
-                    self._cond.wait(
-                        timeout=None if next_deadline is None
-                        else max(next_deadline - now, 1e-3))
-                    continue
+                preempted = self._preempt.is_set()
+                if not preempted:
+                    now = self.tracer.now()  # same clock as enqueue
+                    transition = self._update_degrade(now)
+                    to_run, next_deadline = self._scan_queue(now)
+                    if not to_run and transition is None:
+                        timeout = None if next_deadline is None \
+                            else max(next_deadline - now, 1e-3)
+                        poll = self._preempt_poll_s
+                        if poll is not None:
+                            timeout = poll if timeout is None \
+                                else min(timeout, poll)
+                        self._cond.wait(timeout)
+                        continue
+            if preempted:
+                # SIGTERM notice: the handler only set the flag; the
+                # drain happens HERE, in the scheduler's own (normal)
+                # control flow, then the thread exits.
+                self._drain_leftovers()
+                return
             if transition is not None:
                 state, depth = transition
                 self._emit("serve.degrade", {

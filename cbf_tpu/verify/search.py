@@ -40,7 +40,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from cbf_tpu.durable.integrity import write_atomic, write_npz_atomic
+from cbf_tpu.durable.integrity import write_npz_atomic
 from cbf_tpu.rollout.engine import _rollout_body
 from cbf_tpu.utils.math import l2_cap
 from cbf_tpu.verify.properties import (DIFFERENTIABLE_PROPERTIES,
@@ -356,9 +356,11 @@ def _worst_per_candidate(margins) -> np.ndarray:
 # A falsification campaign is hours of candidate rollouts; a preemption
 # must not restart it from round 0. The random/cem engines persist
 # per-round state under ``state_dir`` — counters + best candidate (+ the
-# CEM proposal) — and resume bit-identically: every round's key is
-# ``fold_in(engine_key, r)``, so round r re-runs to the same candidates
-# whether or not rounds 0..r-1 happened in this process.
+# CEM proposal), all in ONE atomically-replaced npz per engine so a
+# kill mid-save can never mix rounds — and resume bit-identically:
+# every round's key is ``fold_in(engine_key, r)``, so round r re-runs
+# to the same candidates whether or not rounds 0..r-1 happened in this
+# process.
 
 SEARCH_STATE_SCHEMA_VERSION = 1
 
@@ -376,32 +378,34 @@ def _campaign_fingerprint(engine: str, adapter: Adapter,
     return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
 
-def _state_paths(state_dir: str, engine: str) -> tuple[str, str]:
-    d = os.path.abspath(state_dir)
-    return (os.path.join(d, f"{engine}_state.json"),
-            os.path.join(d, f"{engine}_state.npz"))
+#: npz member carrying the JSON counters blob; everything else in the
+#: archive is a payload array (best candidate, CEM proposal).
+_COUNTERS_KEY = "__counters__"
+
+
+def _state_path(state_dir: str, engine: str) -> str:
+    return os.path.join(os.path.abspath(state_dir), f"{engine}_state.npz")
 
 
 def _save_round_state(state_dir, engine, fingerprint, *, next_round,
                       evaluated, best, done, extra_arrays=None) -> None:
-    """Persist one completed round atomically: arrays first, the JSON
-    counter file last (the commit marker). A kill between the two leaves
-    the previous round's counters pointing at a newer npz — harmless,
-    because re-running that round is idempotent under fold_in
-    determinism (same candidates, best only updates on strict
-    improvement)."""
-    jpath, npath = _state_paths(state_dir, engine)
+    """Persist one completed round as a SINGLE atomically-replaced npz:
+    the counters ride inside the archive (a uint8-encoded JSON member)
+    next to the arrays they describe, so a kill can never pair round-r
+    counters with round-(r+1) arrays — for CEM those arrays are the
+    next round's proposal mean/std, the one piece of cross-round state
+    fold_in determinism cannot rebuild."""
     arrays = dict(extra_arrays or {})
     if best[1] is not None:
         arrays["best_delta"] = np.asarray(best[1])
         arrays["best_margins"] = np.asarray(best[2])
-    write_npz_atomic(npath, arrays)
-    write_atomic(jpath, json.dumps({
+    arrays[_COUNTERS_KEY] = np.frombuffer(json.dumps({
         "schema": SEARCH_STATE_SCHEMA_VERSION, "engine": engine,
         "fingerprint": fingerprint, "next_round": int(next_round),
         "evaluated": int(evaluated),
         "best_margin": None if best[1] is None else float(best[0]),
-        "done": bool(done)}, sort_keys=True))
+        "done": bool(done)}, sort_keys=True).encode(), np.uint8)
+    write_npz_atomic(_state_path(state_dir, engine), arrays)
 
 
 def _load_round_state(state_dir: str, engine: str, fingerprint: str):
@@ -409,24 +413,21 @@ def _load_round_state(state_dir: str, engine: str, fingerprint: str):
     is persisted yet. A fingerprint mismatch raises: silently continuing
     a campaign under different settings would fabricate a round stream
     no single-run invocation could produce."""
-    jpath, npath = _state_paths(state_dir, engine)
-    if not os.path.exists(jpath):
+    npath = _state_path(state_dir, engine)
+    if not os.path.exists(npath):
         return None
-    with open(jpath) as fh:
-        counters = json.load(fh)
+    with np.load(npath) as z:
+        arrays = {k: z[k] for k in z.files}
+    counters = json.loads(bytes(arrays.pop(_COUNTERS_KEY)).decode())
     if counters.get("schema") != SEARCH_STATE_SCHEMA_VERSION:
         raise ValueError(
-            f"search state schema {counters.get('schema')!r} at {jpath} "
+            f"search state schema {counters.get('schema')!r} at {npath} "
             f"!= {SEARCH_STATE_SCHEMA_VERSION}")
     if counters.get("fingerprint") != fingerprint:
         raise ValueError(
             f"persisted {engine} campaign in {state_dir} was run under "
             "different settings/scenario (fingerprint mismatch) — refusing "
             "to splice; use a fresh state dir or the original settings")
-    arrays = {}
-    if os.path.exists(npath):
-        with np.load(npath) as z:
-            arrays = {k: z[k] for k in z.files}
     return counters, arrays
 
 

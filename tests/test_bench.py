@@ -298,6 +298,11 @@ def test_bench_end_to_end_certificate_cpu():
     assert "certificate max_residual=" in stderr
 
 
+# slow: ~13 s subprocess bench; the sparse joint solve and its
+# dropped-count plumbing are covered at N>128 by test_sparse_certificate
+# in tier-1, and test_bench_end_to_end_certificate_cpu keeps the
+# certificate bench gate.
+@pytest.mark.slow
 def test_bench_end_to_end_certificate_sparse_cpu():
     """The certificate bench at N > 128 (auto -> SPARSE backend): exercises
     the matrix-free joint solve plus its certificate_dropped_count plumbing
@@ -448,6 +453,11 @@ def test_bench_gating_skin_in_ensemble_mode():
     assert "BENCH_ENSEMBLE_E=1" in out["error"]
 
 
+# slow: ~20 s subprocess bench; tier-1 keeps certificate labeling/gating
+# via test_bench_end_to_end_certificate_cpu, ensemble mode via
+# test_bench_end_to_end_ensemble_mode_cpu, and the lever labels via
+# test_bench_certificate_levers_label_record.
+@pytest.mark.slow
 def test_bench_end_to_end_ensemble_certificate_cpu():
     """BENCH_ENSEMBLE=1 + BENCH_CERTIFICATE=1 (advisor r4: the combo was
     silently certificate-free): the two-layer ensemble runs, gates on

@@ -7,12 +7,14 @@ The load-bearing pins:
   and resumed from its directory alone produces byte-identical outputs
   and final state vs the uninterrupted run (the tentpole acceptance).
 - WAL CONTRACT: the request journal's fold tolerates exactly the tear a
-  killed single appender can produce (a torn FINAL line); every other
-  damage is a typed RecoveryError, and recovery re-runs exactly the
-  acknowledged-but-unresolved set under the original request ids.
-- GRACEFUL DRAIN: `stop(drain=True)` — and the serve CLI's SIGTERM
-  handler that calls it — resolves every acknowledged request before
-  the process dies, leaving the journal with zero unresolved entries.
+  killed appender can produce (a torn FINAL line); every other damage
+  is a typed RecoveryError; reopening a journal REPAIRS the tear so
+  post-restart appends stay replayable; and recovery re-runs exactly
+  the acknowledged-but-unresolved set under the original request ids.
+- GRACEFUL DRAIN: `stop(drain=True)` — and the SIGTERM notice that
+  triggers the same drain from normal control flow (the handler only
+  sets a flag) — resolves every acknowledged request before the
+  process dies, leaving the journal with zero unresolved entries.
 - VERIFY CAMPAIGNS: persisted search state resumes bit-identically and
   fails closed (ValueError) on a settings/scenario fingerprint mismatch.
 - DOCS LOCKSTEP: docs/API.md "Durable execution" names every public
@@ -174,6 +176,45 @@ def test_journal_torn_final_line_tolerated(tmp_path):
     assert [rid for rid, _ in replay.unresolved] == ["r0"]
 
 
+def test_journal_reopen_repairs_torn_tail(tmp_path):
+    """The restart-after-tear hazard: reopening a journal whose final
+    line is torn must truncate the fragment BEFORE appending — else the
+    first post-restart record concatenates onto it, the acknowledged
+    record is lost inside a garbled NON-final line, and every later
+    replay (including the next reopen) raises RecoveryError."""
+    path = str(tmp_path / "j.jsonl")
+    j = dj.RequestJournal(path)
+    j.submitted("r0", _mk_cfg())
+    j.close()
+    with open(path, "a") as fh:
+        fh.write('{"type": "submitted", "requ')   # killed mid-append
+    j2 = dj.RequestJournal(path)                  # restart: repairs tail
+    j2.submitted("r1", _mk_cfg())                 # post-restart ack
+    j2.close()
+    replay = dj.replay_journal(path)
+    assert [rid for rid, _ in replay.unresolved] == ["r0", "r1"]
+    # Third generation replays clean too — the tear never metastasized.
+    dj.RequestJournal(path).close()
+
+
+def test_journal_repair_drops_garbled_final_line_with_newline(tmp_path):
+    """A torn buffered flush can also leave a garbled but newline-
+    terminated final line; repair must drop it too, or the next append
+    would demote it to unforgivable mid-file damage."""
+    path = str(tmp_path / "j.jsonl")
+    j = dj.RequestJournal(path)
+    j.submitted("r0", _mk_cfg())
+    j.close()
+    with open(path, "a") as fh:
+        fh.write('{"type": "submitted", "requ\n')
+    assert dj.repair_torn_tail(path) > 0
+    j2 = dj.RequestJournal(path)
+    j2.submitted("r1", _mk_cfg())
+    j2.close()
+    assert [rid for rid, _ in dj.replay_journal(path).unresolved] \
+        == ["r0", "r1"]
+
+
 def test_journal_garbled_middle_raises(tmp_path):
     path = str(tmp_path / "j.jsonl")
     j = dj.RequestJournal(path)
@@ -215,6 +256,29 @@ def test_stop_drain_resolves_every_queued_request(tmp_path):
     for h in handles:
         r = h.result(timeout=0)
         assert r.request_id == h.request_id
+    assert dj.replay_journal(path).unresolved == []
+
+
+def test_sigterm_drains_from_scheduler_not_the_handler(tmp_path):
+    """Queue-mode preemption notice: the SIGTERM handler only sets the
+    preempt flag; the scheduler thread performs the drain from its own
+    (normal) control flow, so every acknowledged request resolves and
+    journals its terminal record — no batch execution, thread join, or
+    journal fsync ever runs inside the signal handler."""
+    path = str(tmp_path / "j.jsonl")
+    engine = ServeEngine(max_batch=2, flush_deadline_s=60.0, journal=path)
+    engine.start()
+    prev = engine.install_sigterm_handler()
+    try:
+        # flush_deadline far out: only the preempt drain can flush these.
+        handles = [engine.submit(_mk_cfg(seed=i)) for i in range(3)]
+        os.kill(os.getpid(), signal.SIGTERM)
+        for h in handles:
+            r = h.result(timeout=120)
+            assert r.request_id == h.request_id
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+        engine.stop(drain=True)
     assert dj.replay_journal(path).unresolved == []
 
 
@@ -288,6 +352,45 @@ def test_verify_campaign_resumes_and_fails_closed(tmp_path):
         search.random_search(
             a, search.SearchSettings(budget=32, batch=8, seed=0),
             state_dir=d)
+
+
+def test_cem_campaign_interrupted_resume_bit_exact(tmp_path):
+    """The cross-round CEM hazard: the proposal mean/std is the one
+    piece of state fold_in determinism cannot rebuild, and it now
+    commits in the SAME atomic file as the round counters. Kill a
+    campaign between rounds and resume: the final result must be
+    byte-identical to an uninterrupted run."""
+    from cbf_tpu.verify import search
+
+    cfg = swarm.Config(n=4, steps=16, gating="jnp")
+    a = search.make_adapter("swarm", cfg)
+    s = search.SearchSettings(budget=8, batch=4, seed=1)    # 2 CEM rounds
+    ref = search.cem_search(a, s)
+    assert ref.rounds >= 2, "need a multi-round campaign to interrupt"
+
+    class _Abort(RuntimeError):
+        pass
+
+    class _KillAfterFirstRound:
+        rounds = 0
+
+        def event(self, etype, payload):
+            if etype == "verify.round":
+                self.rounds += 1
+                if self.rounds == 2:    # round 0 committed, round 1 not
+                    raise _Abort()
+
+    d = str(tmp_path / "campaign")
+    with pytest.raises(_Abort):
+        search.cem_search(a, s, telemetry=_KillAfterFirstRound(),
+                          state_dir=d)
+    # Counters and the proposal live in ONE atomically-replaced file —
+    # there is no commit window that can pair them across rounds.
+    assert os.listdir(d) == ["cem_state.npz"]
+    res = search.cem_search(a, s, state_dir=d)
+    assert res.evaluated == ref.evaluated and res.rounds == ref.rounds
+    assert res.margin == ref.margin and res.property == ref.property
+    np.testing.assert_array_equal(res.delta, ref.delta)
 
 
 # -------------------------------------------------------------- docs ----

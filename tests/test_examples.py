@@ -4,6 +4,7 @@ import importlib.util
 import os
 
 import numpy as np
+import pytest
 
 _EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "examples")
@@ -32,6 +33,10 @@ def test_cross_and_rescue_compat_runs(tmp_path):
     assert (tmp_path / "v.gif").exists()
 
 
+# slow: ~16 s; test_post_training_safety_floor_holds trains through the
+# same 100-step remat horizon in tier-1 (and asserts the stronger
+# post-training floor), and test_parallel keeps train-step descent.
+@pytest.mark.slow
 def test_train_safety_params_example_moves_params(tmp_path):
     """The differentiable-training demo gets real gradient signal through
     the full 100-step remat horizon (a flat loss means the filter never

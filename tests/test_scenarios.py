@@ -242,6 +242,11 @@ def test_family_floors_across_seeds(dyn):
             f"{dyn} seed={seed}")
 
 
+# slow: ~14 s full 3000-iteration x64 replay; tier-1 keeps per-step
+# oracle parity via test_meet_at_center_trace_oracle_parity and the
+# cross_and_rescue behavior/certificate tests in this file (the full
+# horizon adds length, not a distinct contract).
+@pytest.mark.slow
 def test_cross_and_rescue_full_horizon_oracle_parity(x64):
     """Full-length golden parity for the certificate-stacked scenario
     (VERDICT r03 item 8): replay ALL 3000 reference iterations

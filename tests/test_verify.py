@@ -146,6 +146,10 @@ def test_cem_search_refines_to_violation():
     assert r.rounds > 1          # refinement, not first-round luck
 
 
+# slow: ~9 s; margin correctness is pinned by the numpy parity test and
+# defaults-are-safe by the scenario floor tests (test_scenarios,
+# test_swarm_packs_safely, family floors) in tier-1.
+@pytest.mark.slow
 def test_default_configs_survive_the_same_budget():
     """The falsifier's null hypothesis: the DEFAULT filter parameters
     survive the exact budget that kills the weakened ones — on the
@@ -356,6 +360,12 @@ def _cli(*argv):
     return main(list(argv))
 
 
+# slow: ~21 s (two full budget-16 CLI searches + shrink + corpus); tier-1
+# keeps the verify CLI via test_cli_property_selection (exit 0, --json
+# record) and test_cli's fingerprint-mismatch exit-2 test; the found ->
+# shrink -> corpus pipeline itself stays tier-1 in-process via the
+# shrinker/corpus tests above.
+@pytest.mark.slow
 def test_cli_exit_codes(tmp_path, capsys):
     base = ["verify", "swarm", "--set", "n=16", "--set", "steps=140",
             "--set", "k_neighbors=4", "--set", "gating=jnp",
