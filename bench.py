@@ -116,6 +116,16 @@ Modes / env knobs:
     BENCH_CHAOS_SPIKE_S (0.1), BENCH_CHAOS_SPIKE_EVERY (10), plus the
     BENCH_SLO_NMIN/NMAX/ALPHA/MAX_BATCH/FLUSH sizing knobs. See
     docs/BENCH_LOG.md Round 11.
+  BENCH_RTA=1 — runtime-assurance chaos mode (cbf_tpu.rta +
+    utils.faults in-compiled-code injectors): two rollout legs under a
+    seeded fault mix (teleport clump -> rung 1, NaN row -> rung 3,
+    warm-carry blowup -> rung 2), gated on every rung engaging, both
+    legs reaching their horizon finite, latch recovery by the final
+    step, and the separation floor holding outside each injection's
+    recovery window. Knobs: BENCH_RTA_N (64), BENCH_RTA_STEPS
+    (min(BENCH_STEPS, 600)), BENCH_RTA_SEED (0). The idle cost of the
+    armed-but-healthy ladder is budgeted <= 3% separately
+    (scripts/telemetry_overhead.py --mode rta).
   BENCH_PREEMPT=1 — kill-driven durability mode (cbf_tpu.durable +
     utils.faults): an uninterrupted durable-runner reference, then the
     same spec SIGKILLed at seeded points across BENCH_PREEMPT_ROUNDS
@@ -1413,6 +1423,154 @@ def _child_chaos(steps: int) -> dict:
     return result
 
 
+def _child_rta(steps: int) -> dict:
+    """BENCH_RTA mode: runtime-assurance chaos harness (cbf_tpu.rta +
+    the utils.faults in-compiled-code injectors). Two legs because
+    validate_config keeps certificate and moving obstacles apart:
+
+    - obstacles leg (rungs 1 and 3): a seeded teleport clumps 8 agents
+      inside the safety radius mid-run (relax-cap infeasibility ->
+      rung 1 boosted re-solve), later one agent's state row is NaNed
+      (rung 3 lane scrub);
+    - certificate leg (rung 2): the ADMM warm carry is scaled to 1e8
+      mid-run (certificate residual blows through the trust gate ->
+      rung 2 backup controller).
+
+    Hard gates: every rung engages at least once, every leg reaches its
+    horizon finite, and the ladder disengages by the final step (latch
+    recovery). The floor gate matches what the ladder can actually
+    promise: CBF filtering is FORWARD INVARIANCE — it keeps safe pairs
+    safe, it cannot restore a pair the injection placed inside the
+    floor (the clump pair settles at its injected sub-floor separation
+    once agents converge). So the obstacles leg gates containment: the
+    global floor before the first injection, and the floor among the
+    NON-INJECTED agents outside the clump's transient window — the
+    blast radius stays inside the injected set (the scattering clump
+    briefly presses the crowd a few mm into the calibration slack
+    during the transient itself). The certificate leg's injection
+    never moves an agent, so its global floor must hold outside the
+    latch-recovery window. The reported rate is the chaos legs'
+    combined agent-steps/sec — a robustness axis, not the headline
+    number."""
+    import jax
+    import numpy as np
+
+    from cbf_tpu.rollout.engine import rollout
+    from cbf_tpu.scenarios import swarm
+    from cbf_tpu.utils import faults
+
+    n = _env_int("BENCH_RTA_N", 64)
+    steps = _env_int("BENCH_RTA_STEPS", min(steps, 600))
+    seed = _env_int("BENCH_RTA_SEED", 0)
+    recover = 10
+    # Slack before a post-injection floor gate re-arms: latch
+    # hysteresis plus settle time after the injection transient.
+    window = recover + 60
+    rng = np.random.default_rng(seed)   # AUD004: seeded injection mix
+    floor = SAFETY_FLOOR
+    clump_agents = tuple(range(8))
+
+    def leg(cfg, wrap):
+        state0, step = swarm.make(cfg)
+        stepf = wrap(step)
+        t0 = time.perf_counter()
+        final, outs = rollout(stepf, state0, cfg.steps)
+        jax.block_until_ready(final.x)
+        wall = time.perf_counter() - t0
+        return {"wall": wall, "modes": np.asarray(outs.rta_mode),
+                "finite": bool(np.all(np.isfinite(np.asarray(final.x)))),
+                "outs": outs}
+
+    # -- obstacles leg: rung 1 (clump -> infeasible) + rung 3 (NaN row)
+    cfg1 = swarm.Config(n=n, steps=steps, seed=seed, n_obstacles=4,
+                        record_trajectory=True, rta=True,
+                        rta_recover_steps=recover)
+    t_clump = int(rng.integers(steps // 5, 2 * steps // 5))
+    t_poison = int(rng.integers(3 * steps // 5, 4 * steps // 5))
+    print(f"bench: rta obstacles leg n={n} steps={steps} "
+          f"clump@{t_clump} poison@{t_poison}", file=sys.stderr)
+    leg1 = leg(cfg1,
+               lambda s: faults.poison_agent_at_step(
+                   faults.teleport_clump_at_step(
+                       s, t_clump, agents=clump_agents, spacing=0.08),
+                   t_poison, agent=0))
+    # Containment floors: global before the first injection; among the
+    # non-injected agents outside the clump's transient window.
+    mpd1 = np.asarray(leg1["outs"].min_pairwise_distance)
+    traj = np.asarray(leg1["outs"].trajectory)
+    others = np.delete(traj, clump_agents, axis=1)
+    diffs = others[:, :, None, :] - others[:, None, :, :]
+    iu = np.triu_indices(others.shape[1], 1)
+    mpd_others = np.linalg.norm(diffs, axis=-1)[:, iu[0], iu[1]].min(axis=1)
+    mask1 = np.ones(cfg1.steps, bool)
+    mask1[t_clump:t_clump + window] = False
+    leg1["floor_min"] = min(float(mpd1[:t_clump].min()),
+                            float(mpd_others[mask1].min()))
+    leg1["recovered"] = bool(leg1["modes"][-1] == 0)
+
+    # -- certificate leg: rung 2 (warm-carry blowup -> residual gate)
+    cfg2 = swarm.Config(n=max(16, n // 2), steps=steps, seed=seed,
+                        record_trajectory=False, certificate=True,
+                        certificate_backend="sparse",
+                        certificate_warm_start=True,
+                        certificate_iters=50, certificate_cg_iters=6,
+                        rta=True, rta_recover_steps=recover)
+    t_blow = int(rng.integers(steps // 4, 3 * steps // 4))
+    print(f"bench: rta certificate leg n={cfg2.n} steps={steps} "
+          f"carry-blowup@{t_blow}", file=sys.stderr)
+    leg2 = leg(cfg2, lambda s: faults.residual_blowup_at_step(s, t_blow))
+    mpd2 = np.asarray(leg2["outs"].min_pairwise_distance)
+    mask = np.ones(cfg2.steps, bool)
+    mask[t_blow:t_blow + window] = False
+    leg2["floor_min"] = float(mpd2[mask].min())
+    leg2["recovered"] = bool(leg2["modes"][-1] == 0)
+
+    engaged = sorted(set(np.unique(leg1["modes"]).tolist())
+                     | set(np.unique(leg2["modes"]).tolist()))
+    for rung, where in ((1, leg1), (3, leg1), (2, leg2)):
+        if rung not in np.unique(where["modes"]):
+            return {"error": f"rta rung {rung} never engaged "
+                             f"(modes seen {engaged})",
+                    "retryable": False}
+    for name, lg in (("obstacles", leg1), ("certificate", leg2)):
+        if not lg["finite"]:
+            return {"error": f"rta {name} leg did not reach its horizon "
+                             "finite", "retryable": False}
+        if not lg["recovered"]:
+            return {"error": f"rta {name} leg still latched at the final "
+                             "step — recovery hysteresis never released",
+                    "retryable": False}
+        if lg["floor_min"] < floor:
+            return {"error": f"rta {name} leg broke its containment "
+                             f"floor: {lg['floor_min']:.4f} < {floor}",
+                    "retryable": False}
+
+    agent_steps = cfg1.n * cfg1.steps + cfg2.n * cfg2.steps
+    wall = leg1["wall"] + leg2["wall"]
+    rate = round(agent_steps / wall, 1)
+    print(f"bench: rta chaos rate={rate} agent-steps/s "
+          f"(obstacles {leg1['wall']:.2f}s, certificate "
+          f"{leg2['wall']:.2f}s), rungs engaged {engaged}",
+          file=sys.stderr)
+    return {
+        "metric": (f"rta chaos agent-steps/sec (rungs 1+3 via clump+NaN, "
+                   f"rung 2 via carry blowup, N={n})"),
+        "value": rate,
+        "unit": "agent_steps_per_sec",
+        "vs_baseline": 0,   # a robustness axis, not the headline rate
+        "rta": True,
+        "n": n, "steps": steps, "seed": seed,
+        "injections": {"clump_step": t_clump, "poison_step": t_poison,
+                       "carry_blowup_step": t_blow},
+        "rungs_engaged": [int(r) for r in engaged if r > 0],
+        "floor": floor,
+        "floor_min_obstacles": leg1["floor_min"],
+        "floor_min_certificate": leg2["floor_min"],
+        "recovered": True,
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def _child_preempt(steps: int) -> dict:
     """BENCH_PREEMPT mode: kill-driven durability harness
     (cbf_tpu.durable + cbf_tpu.utils.faults). Two legs, both driven
@@ -1743,6 +1901,8 @@ def child_main(result_path: str, ensemble: bool) -> None:
             result = _child_preempt(steps)
         elif os.environ.get("BENCH_VERIFY", "0") == "1":
             result = _child_verify(steps)
+        elif os.environ.get("BENCH_RTA", "0") == "1":
+            result = _child_rta(steps)
         elif os.environ.get("BENCH_CHAOS", "0") == "1":
             result = _child_chaos(steps)
         elif os.environ.get("BENCH_SLO", "0") == "1":
@@ -1859,6 +2019,8 @@ def main() -> None:
         label = "preempt rounds=%d" % _env_int("BENCH_PREEMPT_ROUNDS", 3)
     elif os.environ.get("BENCH_VERIFY", "0") == "1":
         label = "verify N=%d" % _env_int("BENCH_VERIFY_N", 256)
+    elif os.environ.get("BENCH_RTA", "0") == "1":
+        label = "rta N=%d" % _env_int("BENCH_RTA_N", 64)
     elif os.environ.get("BENCH_CHAOS", "0") == "1":
         label = "chaos rps=%g" % _env_float("BENCH_CHAOS_RPS", 8.0)
     elif os.environ.get("BENCH_SLO", "0") == "1":

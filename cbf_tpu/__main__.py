@@ -199,8 +199,13 @@ def cmd_run(args) -> int:
 
     module, steps_field, renderer, traj_layout = _scenarios()[args.scenario]
     need_traj = args.video is not None or args.traj is not None
-    cfg = _apply_overrides(module.Config(), args.set, args.steps, steps_field,
-                           need_trajectory=need_traj)
+    overrides = list(args.set)
+    if getattr(args, "rta", False):
+        # Shorthand; a non-swarm scenario rejects the unknown field with
+        # the same message any bad --set gets.
+        overrides.append("rta=true")
+    cfg = _apply_overrides(module.Config(), overrides, args.steps,
+                           steps_field, need_trajectory=need_traj)
     state0, step = module.make(cfg)
     steps = getattr(cfg, steps_field)
 
@@ -251,6 +256,12 @@ def cmd_run(args) -> int:
     if start:
         record["resumed_from_step"] = start
     if sink is not None:
+        if outs is not None and not isinstance(
+                getattr(outs, "rta_mode", ()), tuple):
+            from cbf_tpu.rta.monitor import emit_rta_events
+
+            record["rta"] = emit_rta_events(sink, outs.rta_mode,
+                                            step_offset=start)
         sink.summary()
         sink.close()
         record["telemetry"] = sink.run_dir
@@ -414,6 +425,12 @@ def _add_fault_policy_args(parser) -> None:
                         help="per-request deadline in seconds; expired "
                              "requests fail fast with DeadlineExceeded "
                              "(default: none)")
+    parser.add_argument("--rta-fallback", action="store_true",
+                        help="re-run a non-finite request alone under the "
+                             "runtime-assurance ladder (rta=true) for a "
+                             "degraded completion instead of a "
+                             "NonFiniteResult (docs/API.md 'Runtime "
+                             "assurance')")
 
 
 def _fault_policy_from(args):
@@ -422,7 +439,8 @@ def _fault_policy_from(args):
     return FaultPolicy(max_retries=args.max_retries,
                        queue_limit=args.queue_limit,
                        shed_policy=args.shed_policy,
-                       deadline_s=args.deadline)
+                       deadline_s=args.deadline,
+                       rta_fallback=getattr(args, "rta_fallback", False))
 
 
 def cmd_serve(args) -> int:
@@ -709,7 +727,8 @@ def cmd_verify(args) -> int:
                "obstacle_clearance": ("obstacle_floor", -float("inf")),
                "sustained_infeasibility": ("infeasible_streak_limit",
                                            10 ** 9),
-               "goal_reach": ("goal_radius", None)}
+               "goal_reach": ("goal_radius", None),
+               "rta_soundness": ("rta_floor", -float("inf"))}
         thresholds = _dc.replace(thresholds, **{
             field: value for name, (field, value) in vac.items()
             if name not in selected})
@@ -888,6 +907,10 @@ def main(argv=None) -> int:
                       help="write a jax.profiler trace here")
     runp.add_argument("--checked", action="store_true",
                       help="run under checkify NaN/inf validation")
+    runp.add_argument("--rta", action="store_true",
+                      help="arm the runtime-assurance fallback ladder "
+                           "(swarm scenario; shorthand for --set rta=true; "
+                           "docs/API.md 'Runtime assurance')")
     runp.add_argument("--telemetry-dir", default=None,
                       help="stream in-flight telemetry (manifest + JSONL "
                            "heartbeats/alerts) into this run directory; "

@@ -158,13 +158,25 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
             f"durable emitters (journal+rollout) {durable_emitted!r} != "
             f"obs.schema.DURABLE_EVENT_TYPES {schema.DURABLE_EVENT_TYPES!r} "
             "— emitter and schema drifted")
+    # Runtime-assurance event drift: the rta monitor's declared emissions
+    # must match the schema's rta family exactly (same contract).
+    from cbf_tpu.rta import monitor as rta_monitor
+    if tuple(rta_monitor.EMITTED_EVENT_TYPES) != \
+            tuple(schema.RTA_EVENT_TYPES):
+        problems.append(
+            f"rta.monitor.EMITTED_EVENT_TYPES "
+            f"{rta_monitor.EMITTED_EVENT_TYPES!r} != "
+            f"obs.schema.RTA_EVENT_TYPES {schema.RTA_EVENT_TYPES!r} "
+            "— emitter and schema drifted")
     for table_name, types_name, fields, types in (
             ("SERVE_EVENT_FIELDS", "SERVE_EVENT_TYPES",
              schema.SERVE_EVENT_FIELDS, schema.SERVE_EVENT_TYPES),
             ("DURABLE_EVENT_FIELDS", "DURABLE_EVENT_TYPES",
              schema.DURABLE_EVENT_FIELDS, schema.DURABLE_EVENT_TYPES),
             ("LOADGEN_EVENT_FIELDS", "LOADGEN_EVENT_TYPES",
-             schema.LOADGEN_EVENT_FIELDS, schema.LOADGEN_EVENT_TYPES)):
+             schema.LOADGEN_EVENT_FIELDS, schema.LOADGEN_EVENT_TYPES),
+            ("RTA_EVENT_FIELDS", "RTA_EVENT_TYPES",
+             schema.RTA_EVENT_FIELDS, schema.RTA_EVENT_TYPES)):
         for etype in fields:
             if etype not in types:
                 problems.append(
@@ -186,7 +198,7 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
     # that way is what makes this check (and grep) possible.
     import inspect
     for mod in (verify_search, serve_engine, obs_trace, serve_loadgen,
-                durable_journal, durable_rollout):
+                durable_journal, durable_rollout, rta_monitor):
         try:
             mod_tree = ast.parse(inspect.getsource(mod))
         except (OSError, TypeError):
@@ -233,7 +245,8 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
                 ("verify", schema.VERIFY_EVENT_FIELDS),
                 ("serve", schema.SERVE_EVENT_FIELDS),
                 ("durable", schema.DURABLE_EVENT_FIELDS),
-                ("loadgen", schema.LOADGEN_EVENT_FIELDS)):
+                ("loadgen", schema.LOADGEN_EVENT_FIELDS),
+                ("rta", schema.RTA_EVENT_FIELDS)):
             for etype, fields in table.items():
                 if f"`{etype}`" not in api_text:
                     problems.append(

@@ -19,7 +19,11 @@ Events are JSON objects, one per line (JSONL), every one carrying
   over).
 - ``alert`` — structured watchdog verdict: ``kind`` (one of
   ``obs.watchdog.ALERT_KINDS``), ``step`` (int or null for host-side
-  alerts like stalls), ``detail`` (human-readable one-liner), ``t_wall``.
+  alerts like stalls), ``detail`` (human-readable one-liner),
+  ``severity`` (``"critical"``, or ``"warning"`` when the runtime-
+  assurance ladder absorbed the fault), ``t_wall``, and — when the run
+  streams an ``rta_mode`` gauge — the triggering heartbeat's
+  ``rta_mode``.
 - ``summary`` — run-end aggregate: the sink's counters/gauges/histograms
   snapshot (``metrics``) plus ``heartbeats`` / ``alerts`` totals.
 
@@ -90,6 +94,9 @@ HEARTBEAT_FIELDS: tuple[HeartbeatField, ...] = (
     # reliably goes non-finite — this one counts the corruption directly
     # and the watchdog's `nan` alert triggers on it (> 0).
     HeartbeatField("nonfinite_state_count", None, None, "sum", "gauge"),
+    HeartbeatField("certificate_carry_resets", "certificate_carry_resets",
+                   None, "sum", "counter"),
+    HeartbeatField("rta_mode", "rta_mode", None, "max", "gauge"),
 )
 
 #: StepOutputs fields deliberately NOT streamed, with the reason — the
@@ -143,11 +150,13 @@ SERVE_EVENT_TYPES: tuple[str, ...] = (
 SERVE_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
     "request": ("request_id", "bucket", "n", "steps", "latency_s",
                 "queue_wait_s", "execute_s", "batch_fill", "degraded",
-                "min_pairwise_distance", "infeasible_count"),
+                "rta_engaged", "min_pairwise_distance", "infeasible_count"),
     "serve.span": ("trace_id", "span_id", "parent_id", "name", "bucket",
                    "t0_s", "dur_s"),
     # action: "retry" (backoff re-run of the whole batch) | "bisect"
-    # (split to isolate the offender); attempt is 1-based for retries.
+    # (split to isolate the offender) | "rta_rescue" (single-request
+    # re-run under rta=True after a non-finite unpack); attempt is
+    # 1-based for retries.
     "serve.retry": ("bucket", "action", "attempt", "batch_size",
                     "backoff_s", "error"),
     # reason: "queue_full" (reject-newest refused the submit) |
@@ -196,6 +205,22 @@ LOADGEN_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
                         "completed", "errors", "duration_s",
                         "latency_p50_s", "latency_p95_s", "latency_p99_s",
                         "queue_wait_p99_s", "execute_p99_s"),
+}
+
+#: The runtime-assurance auditor's events (``cbf_tpu.rta.monitor``):
+#: ``rta.engage`` once per rung RISE in a rollout's recorded
+#: ``StepOutputs.rta_mode`` series (step index, the rung engaged, the
+#: rung it rose from), ``rta.recover`` once per return to nominal (step
+#: index, the peak rung of the episode, how many steps it stayed
+#: engaged). Same AUD001 contract as the verify/serve/durable tables:
+#: ``rta.monitor.EMITTED_EVENT_TYPES`` must equal this tuple, every type
+#: needs a literal emit site, and every type and field must be
+#: documented in docs/API.md.
+RTA_EVENT_TYPES: tuple[str, ...] = ("rta.engage", "rta.recover")
+
+RTA_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
+    "rta.engage": ("step", "rung", "prev_rung"),
+    "rta.recover": ("step", "peak_rung", "engaged_steps"),
 }
 
 

@@ -412,13 +412,17 @@ class TelemetrySink:
         return event
 
     def alert(self, kind: str, step: int | None = None,
-              detail: str = "") -> dict:
+              detail: str = "", severity: str = "critical",
+              rta_mode: float | None = None) -> dict:
         with self._lock:
             self.alert_count += 1
             self.registry.counter(f"alerts.{kind}").add(1)
         event = {"event": "alert", "schema": schema.SCHEMA_VERSION,
                  "kind": kind, "step": step, "detail": detail,
+                 "severity": severity,
                  "t_wall": round(time.time(), 6)}
+        if rta_mode is not None:
+            event["rta_mode"] = schema.json_scalar(rta_mode)
         self._emit(event)
         return event
 

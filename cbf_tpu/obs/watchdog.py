@@ -26,6 +26,14 @@ Alerts are appended to the run's JSONL stream (event "alert"), collected
 in ``Watchdog.alerts``, and forwarded to ``on_alert`` when given. Edge-
 triggered: each class re-arms only after a healthy heartbeat, so a
 100-step blow-up is one alert, not 100.
+
+When the run has runtime assurance enabled (``Config.rta``), the
+heartbeat carries an ``rta_mode`` gauge. ``certificate_blowup`` and
+``sustained_infeasibility`` raised while ``rta_mode > 0`` are the RTA
+ladder doing its job — the fault is being absorbed, not ignored — so
+those alerts are downgraded to ``severity="warning"`` and annotated
+with the absorbing rung. ``nan`` alerts stay critical: a non-finite
+value that reaches the heartbeat escaped the ladder.
 """
 
 from __future__ import annotations
@@ -50,6 +58,10 @@ class Alert(NamedTuple):
     step: int | None
     detail: str
     t_wall: float
+    severity: str = "critical"
+    # rta_mode gauge from the triggering heartbeat (None when the run has
+    # no RTA channel or the alert is host-side, e.g. stall).
+    rta_mode: float | None = None
 
 
 class Watchdog:
@@ -106,11 +118,15 @@ class Watchdog:
 
     # -- checks ------------------------------------------------------------
 
-    def _raise_alert(self, kind: str, step: int | None, detail: str) -> None:
-        alert = Alert(kind, step, detail, time.time())
+    def _raise_alert(self, kind: str, step: int | None, detail: str, *,
+                     severity: str = "critical",
+                     rta_mode: float | None = None) -> None:
+        alert = Alert(kind, step, detail, time.time(),
+                      severity=severity, rta_mode=rta_mode)
         with self._lock:
             self.alerts.append(alert)
-        self.sink.alert(kind, step=step, detail=detail)
+        self.sink.alert(kind, step=step, detail=detail, severity=severity,
+                        rta_mode=rta_mode)
         if self.on_alert is not None:
             try:
                 self.on_alert(alert)
@@ -123,6 +139,10 @@ class Watchdog:
         step = event.get("step")
         values = {f.name: schema.scalar_value(event[f.name])
                   for f in schema.HEARTBEAT_FIELDS if f.name in event}
+        rta = values.get("rta_mode")
+        # NaN-safe: a poisoned rta_mode channel must NOT be treated as an
+        # engaged ladder (that would downgrade a real critical alert).
+        absorbed = rta is not None and rta == rta and rta > 0
 
         bad = sorted(n for n, v in values.items()
                      if v != v or abs(v) == float("inf"))
@@ -135,9 +155,12 @@ class Watchdog:
         if bad:
             if self._armed[ALERT_NAN]:
                 self._armed[ALERT_NAN] = False
+                # Stays critical even while the ladder is engaged: a
+                # non-finite value on the stream escaped the ladder.
                 self._raise_alert(
                     ALERT_NAN, step,
-                    f"non-finite heartbeat channel(s): {', '.join(bad)}")
+                    f"non-finite heartbeat channel(s): {', '.join(bad)}",
+                    rta_mode=rta)
         else:
             self._armed[ALERT_NAN] = True
 
@@ -146,10 +169,14 @@ class Watchdog:
             if res == res and res > self.residual_threshold:
                 if self._armed[ALERT_CERT_BLOWUP]:
                     self._armed[ALERT_CERT_BLOWUP] = False
+                    detail = (f"certificate residual {res:.3e} > threshold "
+                              f"{self.residual_threshold:.1e}")
+                    if absorbed:
+                        detail += f" (absorbed by RTA rung {int(rta)})"
                     self._raise_alert(
-                        ALERT_CERT_BLOWUP, step,
-                        f"certificate residual {res:.3e} > threshold "
-                        f"{self.residual_threshold:.1e}")
+                        ALERT_CERT_BLOWUP, step, detail,
+                        severity="warning" if absorbed else "critical",
+                        rta_mode=rta)
             else:
                 self._armed[ALERT_CERT_BLOWUP] = True
 
@@ -160,11 +187,15 @@ class Watchdog:
                 if (self._infeasible_streak >= self.infeasible_patience
                         and self._armed[ALERT_INFEASIBLE]):
                     self._armed[ALERT_INFEASIBLE] = False
+                    detail = (f"infeasible QPs on {self._infeasible_streak} "
+                              "consecutive heartbeats "
+                              f"(last count {int(inf)})")
+                    if absorbed:
+                        detail += f" (absorbed by RTA rung {int(rta)})"
                     self._raise_alert(
-                        ALERT_INFEASIBLE, step,
-                        f"infeasible QPs on {self._infeasible_streak} "
-                        "consecutive heartbeats "
-                        f"(last count {int(inf)})")
+                        ALERT_INFEASIBLE, step, detail,
+                        severity="warning" if absorbed else "critical",
+                        rta_mode=rta)
             else:
                 self._infeasible_streak = 0
                 self._armed[ALERT_INFEASIBLE] = True

@@ -63,6 +63,16 @@ class StepOutputs(NamedTuple):
     # early / escalates; bench reports its mean+max); () where no sparse
     # certificate runs.
     certificate_iterations: Any = ()
+    # Warm-start carry cold-resets this step (0/1): the certificate's
+    # solver carry arrived non-finite and was branch-free reset to the
+    # all-zero cold start (sim.certificates.sanitize_solver_state) —
+    # without the reset a single NaN iterate would poison every
+    # subsequent warm solve; () when certificate_warm_start is off.
+    certificate_carry_resets: Any = ()
+    # Runtime-assurance ladder mode after this step (max latched rung
+    # across agents: 0 nominal, 1 boosted re-solve, 2 backup controller,
+    # 3 lane scrub — cbf_tpu.rta); () when Config.rta is off.
+    rta_mode: Any = ()
 
 
 def rollout(step_fn: Callable, state0, steps: int, *, unroll: int = 1,

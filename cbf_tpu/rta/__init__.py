@@ -1,0 +1,35 @@
+"""Runtime assurance (RTA): in-rollout recovery from safety-filter failure.
+
+PRs 8-9 made the *process* robust (retry/bisect/quarantine, crash-
+recoverable journals) but inside a compiled rollout nothing recovered: a
+QP that exhausts its relax budget just raises a flag, a certificate solve
+whose residual blows past the 1e-4 gate keeps steering the swarm, and a
+non-finite value poisons every subsequent step. This package is the
+in-compiled-code counterpart — a simplex-style runtime-assurance layer
+(cf. the resource-aware-computation argument in PAPERS.md: a cheap filter
+is only deployable behind a trust test that falls back to a guaranteed
+controller) wired into the scenario step behind ``Config.rta``:
+
+- :mod:`cbf_tpu.rta.core` — the jit/vmap-safe pieces: a per-agent
+  branch-free **health word**, the rung mapping, the engagement **latch
+  with recovery hysteresis**, and the closed-form **backup controller**.
+- :mod:`cbf_tpu.rta.monitor` — the host-side auditor: turns the
+  ``StepOutputs.rta_mode`` series into schema-versioned ``rta.engage`` /
+  ``rta.recover`` events and registry counters.
+
+The ladder itself (rung 1 boosted re-solve, rung 2 backup braking,
+rung 3 lane scrub) is applied inside ``scenarios.swarm._build_step`` with
+``jnp.where``/``lax.cond`` — no Python branching on tracers, bit-identical
+rollouts when ``Config.rta`` is off (every new channel is ``()``).
+"""
+
+from cbf_tpu.rta.core import (                                # noqa: F401
+    BIT_ACTUATION_DEFICIT, BIT_CARRY_RESET, BIT_CERT_RESIDUAL,
+    BIT_CONTROL_NONFINITE, BIT_INFEASIBLE, BIT_STATE_NONFINITE,
+    HEALTH_BIT_NAMES, RUNG_BACKUP, RUNG_NOMINAL, RUNG_RESOLVE, RUNG_SCRUB,
+    backup_control, demanded_rung, finite_rows, health_word, latch_update,
+    rta_seed,
+)
+from cbf_tpu.rta.monitor import (                             # noqa: F401
+    EMITTED_EVENT_TYPES, emit_rta_events, rta_transitions,
+)

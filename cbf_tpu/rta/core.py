@@ -1,0 +1,192 @@
+"""Jit-safe, vmap-compatible runtime-assurance primitives.
+
+Everything here is pure jnp on already-computed step signals — no Python
+control flow on tracers, no host callbacks — so the scenario step can
+assemble the health word, update the latch, and select fallback controls
+inside the one compiled ``lax.scan`` program (and under the serving
+layer's vmap and the falsifier's vmapped candidate evaluation).
+
+Health word
+-----------
+A per-agent ``(N,)`` int32 bit-field built from signals the step already
+computes (nothing new is solved to *diagnose*):
+
+- ``BIT_INFEASIBLE`` — the agent's CBF-QP exhausted its relax budget /
+  per-row cap and returned a least-violating control
+  (``QPInfo.feasible`` False while engaged).
+- ``BIT_CERT_RESIDUAL`` — the joint certificate's ADMM residual exceeded
+  the trust gate (``Config.rta_residual_gate``, default the same 1e-4
+  the tests assert): the joint correction this step is untrusted.
+  A joint solve has no per-agent attribution, so the bit is swarm-wide.
+- ``BIT_CARRY_RESET`` — the certificate's warm carry arrived non-finite
+  and was cold-start reset (``sim.certificates.sanitize_solver_state``);
+  swarm-wide for the same reason.
+- ``BIT_ACTUATION_DEFICIT`` — unicycle mode: the wheel saturation eroded
+  the commanded si velocity by more than ``Config.rta_deficit_gate``.
+  A *trailing* indicator (the realized velocity exists only after the
+  actuator step), so it engages the latch from the next step.
+- ``BIT_STATE_NONFINITE`` — the agent's carried state row arrived (or
+  left the integrator) non-finite.
+- ``BIT_CONTROL_NONFINITE`` — the filtered/certified control row is
+  non-finite.
+
+Fallback ladder
+---------------
+The bits map to a demanded rung (:func:`demanded_rung`), highest wins:
+
+- rung 1 (``RUNG_RESOLVE``) — boosted-budget selective re-solve: the
+  flagged agents' QPs are re-solved with the relax cap lifted and a
+  larger ``max_relax`` budget (``Config.rta_boost_budget``) under one
+  ``lax.cond`` (zero work on healthy steps off the vmapped paths).
+- rung 2 (``RUNG_BACKUP``) — :func:`backup_control`: closed-form
+  braking-to-stop, no iterative solve. Provably safe under the analytic
+  CBF argument: a zero si command holds the projection point, so the
+  agent contributes no decrease to any pairwise ``h`` (the discrete
+  pairwise bound ``h' >= (1-2*gamma)*h`` one-sidedly improves); in
+  double mode maximal braking monotonically shrinks ``|v|`` toward the
+  same fixed point.
+- rung 3 (``RUNG_SCRUB``) — lane scrub: a non-finite state row is
+  replaced by the last-known-good carried row plus a stop command.
+
+Latch with recovery hysteresis
+------------------------------
+:func:`latch_update`: an engaged rung stays latched until
+``recover_steps`` CONSECUTIVE healthy steps pass (no mode chatter —
+alternating fault/healthy steps never recovers); escalation is
+immediate (``max(mode, demanded)``), recovery resets the streak so a
+re-engagement pays the full window again.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from cbf_tpu.utils.math import l2_cap
+
+# -- health-word bits (per agent, int32) -----------------------------------
+
+BIT_INFEASIBLE = 1 << 0          # rung 1: relax-budget/cap exhaustion
+BIT_CERT_RESIDUAL = 1 << 1       # rung 2: certificate residual > gate
+BIT_CARRY_RESET = 1 << 2         # rung 2: non-finite warm carry reset
+BIT_ACTUATION_DEFICIT = 1 << 3   # rung 2: unicycle saturation deficit
+BIT_STATE_NONFINITE = 1 << 4     # rung 3: non-finite state row
+BIT_CONTROL_NONFINITE = 1 << 5   # rung 3: non-finite control row
+
+#: bit name -> value — the documented vocabulary (docs/API.md "Runtime
+#: assurance") and the monitor's decode table.
+HEALTH_BIT_NAMES: dict[str, int] = {
+    "infeasible": BIT_INFEASIBLE,
+    "cert_residual": BIT_CERT_RESIDUAL,
+    "carry_reset": BIT_CARRY_RESET,
+    "actuation_deficit": BIT_ACTUATION_DEFICIT,
+    "state_nonfinite": BIT_STATE_NONFINITE,
+    "control_nonfinite": BIT_CONTROL_NONFINITE,
+}
+
+# -- ladder rungs ----------------------------------------------------------
+
+RUNG_NOMINAL = 0
+RUNG_RESOLVE = 1    # boosted-budget selective QP re-solve
+RUNG_BACKUP = 2     # closed-form braking-to-stop backup controller
+RUNG_SCRUB = 3      # lane scrub: last-known-good state + stop command
+
+_RUNG3_MASK = BIT_STATE_NONFINITE | BIT_CONTROL_NONFINITE
+_RUNG2_MASK = BIT_CERT_RESIDUAL | BIT_CARRY_RESET | BIT_ACTUATION_DEFICIT
+_RUNG1_MASK = BIT_INFEASIBLE
+
+
+def finite_rows(*leaves):
+    """(N,) bool — per-agent all-finite over every given leaf's row.
+
+    Leaves are (N,), (N, d), ... arrays; ``()`` (a disabled channel) is
+    skipped. At least one real leaf is required.
+    """
+    ok = None
+    for leaf in leaves:
+        if isinstance(leaf, tuple):
+            continue
+        f = jnp.isfinite(leaf)
+        if f.ndim > 1:
+            f = jnp.all(f.reshape(f.shape[0], -1), axis=1)
+        ok = f if ok is None else ok & f
+    if ok is None:
+        raise ValueError("finite_rows needs at least one non-() leaf")
+    return ok
+
+
+def health_word(n: int, *, infeasible=None, cert_residual=None,
+                carry_reset=None, actuation_deficit=None,
+                state_nonfinite=None, control_nonfinite=None):
+    """(N,) int32 health word from the step's signals (None = bit absent
+    in this configuration, e.g. no certificate). Scalar flags (the
+    swarm-wide certificate bits) broadcast to every agent."""
+    word = jnp.zeros((n,), jnp.int32)
+    for bit, flag in ((BIT_INFEASIBLE, infeasible),
+                      (BIT_CERT_RESIDUAL, cert_residual),
+                      (BIT_CARRY_RESET, carry_reset),
+                      (BIT_ACTUATION_DEFICIT, actuation_deficit),
+                      (BIT_STATE_NONFINITE, state_nonfinite),
+                      (BIT_CONTROL_NONFINITE, control_nonfinite)):
+        if flag is None:
+            continue
+        hit = jnp.broadcast_to(jnp.asarray(flag, bool), (n,))
+        word = word | jnp.where(hit, jnp.int32(bit), jnp.int32(0))
+    return word
+
+
+def demanded_rung(health):
+    """(N,) int32 rung demanded by a health word — highest wins."""
+    r3 = (health & _RUNG3_MASK) > 0
+    r2 = (health & _RUNG2_MASK) > 0
+    r1 = (health & _RUNG1_MASK) > 0
+    return jnp.where(
+        r3, jnp.int32(RUNG_SCRUB),
+        jnp.where(r2, jnp.int32(RUNG_BACKUP),
+                  jnp.where(r1, jnp.int32(RUNG_RESOLVE),
+                            jnp.int32(RUNG_NOMINAL))))
+
+
+def latch_update(mode, streak, demanded, recover_steps: int):
+    """One latch step: ``(mode', streak')`` from the carried per-agent
+    latch and this step's demanded rung.
+
+    Engagement/escalation is immediate (``max``); recovery requires
+    ``recover_steps`` consecutive demanded-0 steps (the hysteresis that
+    prevents mode chatter) and resets the streak, so the next engagement
+    pays the full window again. Branch-free; the streak is clamped at
+    ``recover_steps`` (no unbounded growth over long horizons).
+    """
+    streak = jnp.where(demanded > 0, jnp.int32(0),
+                       jnp.minimum(streak + 1, jnp.int32(recover_steps)))
+    latched = jnp.maximum(mode, demanded)
+    recovered = (demanded == 0) & (streak >= recover_steps) & (latched > 0)
+    mode_new = jnp.where(recovered, jnp.int32(RUNG_NOMINAL), latched)
+    streak_new = jnp.where(recovered, jnp.int32(0), streak)
+    return mode_new.astype(jnp.int32), streak_new.astype(jnp.int32)
+
+
+def backup_control(v, *, dynamics: str, vel_tracking_tau: float = 0.2,
+                   accel_limit: float = 1.0):
+    """(N, 2) closed-form provably-safe backup command (rungs 2-3).
+
+    single/unicycle (velocity-space commands): a zero command — the
+    agent holds its position/projection point, contributing no decrease
+    to any pairwise barrier. double (acceleration commands): maximal
+    braking toward zero velocity, the velocity-tracking PD at a zero
+    setpoint capped at the actuator limit. No iterative solve on this
+    path — it must work precisely when the solvers don't.
+    """
+    if dynamics == "double":
+        return l2_cap(-v / vel_tracking_tau, accel_limit)
+    return jnp.zeros_like(v)
+
+
+def rta_seed(x, v, theta=()):
+    """Fresh RTA carry for ``State.rta``: ``(mode (N,) int32,
+    streak (N,) int32, lkg_x, lkg_v, lkg_theta)`` — everyone nominal,
+    last-known-good = the (finite by construction) spawn state. ``theta``
+    is ``()`` outside unicycle mode (the usual empty-pytree-node
+    convention)."""
+    n = x.shape[0]
+    return (jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32),
+            x, v, theta)

@@ -1,0 +1,91 @@
+"""Host-side RTA auditor: rta_mode series -> events + registry counters.
+
+The compiled step only *carries* the ladder (rung selection, latch,
+backup controls); this module is the auditable half the parallelcbf
+argument asks for — after a rollout, the recorded per-step
+``StepOutputs.rta_mode`` scalar (max engaged rung across agents) is
+scanned on the host for transitions and turned into schema-versioned
+``rta.engage`` / ``rta.recover`` telemetry events plus registry
+counters, mirroring how ``durable``/``serve`` emit their lifecycle
+events (and covered by the same AUD001 emit-site/schema/docs audit).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+#: Event types this module emits — cross-checked against
+#: ``obs.schema.RTA_EVENT_TYPES`` by AUD001.
+EMITTED_EVENT_TYPES = ("rta.engage", "rta.recover")
+
+
+def rta_transitions(rta_mode) -> list[dict[str, Any]]:
+    """Decode a recorded ``(steps,)`` rta_mode series into transition
+    records: one ``rta.engage`` per rung *rise* (payload: step, rung,
+    prev_rung) and one ``rta.recover`` per return to nominal (payload:
+    step, peak_rung, engaged_steps). A disabled channel (``()``) or an
+    empty series yields no transitions."""
+    if isinstance(rta_mode, tuple):
+        return []
+    series = np.asarray(rta_mode).reshape(-1)
+    out: list[dict[str, Any]] = []
+    prev = 0
+    peak = 0
+    engaged_at = 0
+    for step, mode in enumerate(int(m) for m in series):
+        if mode > prev:
+            if prev == 0:
+                engaged_at = step
+            out.append({"type": "rta.engage", "step": step,
+                        "rung": mode, "prev_rung": prev})
+            peak = max(peak, mode)
+        elif mode == 0 and prev > 0:
+            out.append({"type": "rta.recover", "step": step,
+                        "peak_rung": peak,
+                        "engaged_steps": step - engaged_at})
+            peak = 0
+        prev = mode
+    return out
+
+
+def emit_rta_events(telemetry, rta_mode, *, step_offset: int = 0
+                    ) -> dict[str, Any]:
+    """Emit the series' transitions through a TelemetrySink (or any
+    object with ``.event``; a missing/None sink only skips emission) and
+    bump registry counters. Returns a summary dict: ``engagements``,
+    ``recoveries``, ``peak_rung``, ``engaged_steps``.
+
+    ``step_offset`` shifts recorded step indices into a global frame
+    (e.g. when a resumed rollout replays a chunk).
+    """
+    transitions = rta_transitions(rta_mode)
+    registry = getattr(telemetry, "registry", None)
+    engagements = 0
+    recoveries = 0
+    for tr in transitions:
+        payload = {k: v for k, v in tr.items() if k != "type"}
+        payload["step"] = payload["step"] + step_offset
+        if tr["type"] == "rta.engage":
+            engagements += 1
+            if telemetry is not None:
+                telemetry.event("rta.engage", payload)
+            if registry is not None:
+                registry.counter("rta_engagements").add(1)
+                registry.counter(f"rta_rung_{tr['rung']}").add(1)
+        else:
+            recoveries += 1
+            if telemetry is not None:
+                telemetry.event("rta.recover", payload)
+            if registry is not None:
+                registry.counter("rta_recoveries").add(1)
+    if isinstance(rta_mode, tuple) or np.asarray(rta_mode).size == 0:
+        peak = 0
+        engaged_steps = 0
+    else:
+        series = np.asarray(rta_mode).reshape(-1)
+        peak = int(series.max())
+        engaged_steps = int((series > 0).sum())
+    return {"engagements": engagements, "recoveries": recoveries,
+            "peak_rung": peak, "engaged_steps": engaged_steps}

@@ -43,6 +43,9 @@ from cbf_tpu.ops.pairwise import pairwise_distances
 from cbf_tpu.ops.pallas_knn import knn_gating_banded, knn_gating_pallas
 from cbf_tpu.rollout.engine import StepOutputs, rollout
 from cbf_tpu.rollout.gating import knn_gating
+from cbf_tpu.rta.core import (RUNG_BACKUP, RUNG_RESOLVE, backup_control,
+                              demanded_rung, finite_rows, health_word,
+                              latch_update, rta_seed)
 from cbf_tpu.utils import profiling
 from cbf_tpu.utils.math import l2_cap, match_vma, safe_norm
 
@@ -299,6 +302,38 @@ class Config:
     # disk), so real-agent solutions are unchanged. Static per bucket.
     arena_half_override: float | None = None
 
+    # Runtime assurance (cbf_tpu.rta): in-rollout recovery from
+    # safety-filter failure. A per-agent health word is assembled
+    # branch-free from signals the step already computes (QP relax
+    # exhaustion, certificate residual vs rta_residual_gate, non-finite
+    # state/control/warm-carry, unicycle actuation deficit) and drives a
+    # three-rung fallback ladder through jnp.where/lax.cond: boosted-
+    # budget selective re-solve, closed-form braking-to-stop backup
+    # controller, lane scrub to last-known-good state + stop. An
+    # engagement latch with recovery hysteresis (rta_recover_steps
+    # consecutive healthy steps to disengage) prevents mode chatter;
+    # the max latched rung is surfaced as StepOutputs.rta_mode. Off by
+    # default — rta=False rollouts are bit-identical to pre-RTA builds
+    # (every new channel is the empty-tuple disabled value). All rta_*
+    # knobs are static (part of the serving layer's bucket signature).
+    rta: bool = False
+    # Consecutive healthy steps required before a latched rung releases.
+    rta_recover_steps: int = 10
+    # Certificate-residual trust gate: a joint solve whose primal
+    # residual exceeds this is treated as failed (rung 2) instead of
+    # silently steering the swarm. Default = the 1e-4 convergence gate
+    # the certificate tests assert.
+    rta_residual_gate: float = 1e-4
+    # Unicycle actuation-deficit gate (si speed units): wheel saturation
+    # eroding a commanded velocity by more than this engages rung 2
+    # (default 0.15 = 75% of the default speed_limit — an evasion mostly
+    # truncated by physics).
+    rta_deficit_gate: float = 0.15
+    # Rung-1 relax budget: flagged agents' QPs are re-solved with the
+    # per-row cap lifted and this max_relax (> the default 64 —
+    # feasibility the normal budget couldn't restore).
+    rta_boost_budget: int = 128
+
     @property
     def spawn_half_width(self) -> float:
         # Scale the spawn box with sqrt(N) to keep initial density safe
@@ -348,6 +383,13 @@ class State(NamedTuple):
     # sound whatever the step did to the neighbor set (see the solver's
     # warm_state contract), () when disabled.
     certificate_solver_state: tuple = ()
+    # Runtime-assurance carry — Config.rta only: (mode (N,) int32 latched
+    # rung per agent, streak (N,) int32 consecutive-healthy counter,
+    # lkg_x (N, 2), lkg_v (N, 2), lkg_theta (N,)|() — last-known-good
+    # finite state for the rung-3 lane scrub). Seeded by
+    # cbf_tpu.rta.rta_seed; () when disabled (the usual empty-pytree-node
+    # convention).
+    rta: tuple = ()
 
 
 def spawn_positions(cfg: Config, seed) -> jnp.ndarray:
@@ -627,6 +669,24 @@ def validate_config(cfg: Config) -> None:
                 f"wheel-realizable max {vmax:.3f} (wheel_radius * "
                 "max_wheel_speed) — commands beyond it are physically "
                 "truncated with no infeasibility signal")
+    if cfg.rta:
+        # Honored-or-rejected like the certificate knobs: a nonsensical
+        # gate/budget must raise, not silently run a ladder that can
+        # never (or always) engage.
+        if cfg.rta_recover_steps < 1:
+            raise ValueError(
+                f"rta_recover_steps must be >= 1, got "
+                f"{cfg.rta_recover_steps}")
+        if not cfg.rta_residual_gate > 0:
+            raise ValueError(
+                f"rta_residual_gate must be > 0, got "
+                f"{cfg.rta_residual_gate}")
+        if not cfg.rta_deficit_gate > 0:
+            raise ValueError(
+                f"rta_deficit_gate must be > 0, got {cfg.rta_deficit_gate}")
+        if cfg.rta_boost_budget < 1:
+            raise ValueError(
+                f"rta_boost_budget must be >= 1, got {cfg.rta_boost_budget}")
     if cfg.barrier not in ("auto", "continuous", "discrete"):
         raise ValueError(
             f"barrier must be auto|continuous|discrete, got {cfg.barrier!r}")
@@ -748,9 +808,12 @@ def initial_state(cfg: Config) -> State:
         from cbf_tpu.sim.certificates import certificate_solver_seed
         sstate = certificate_solver_seed(cfg.n, cfg.certificate_k,
                                          cfg.dtype)
+    rta = ()
+    if cfg.rta:
+        rta = rta_seed(x0, jnp.zeros_like(x0), theta0)
     return State(x=x0, v=jnp.zeros_like(x0), theta=theta0,
                  gating_cache=cache, certificate_cache=ccache,
-                 certificate_solver_state=sstate)
+                 certificate_solver_state=sstate, rta=rta)
 
 
 def separation_bias(cfg: Config, x, obs_slab, mask):
@@ -1207,6 +1270,21 @@ def _build_step(cfg: Config, cbf: CBFParams | None = None, *,
                 (band + 2 * pallas_knn.RTILE) / pallas_knn.CTILE)) + 1
 
     def step(state: State, t):
+        scrub_bit = ()
+        if cfg.rta:
+            # Rung-3 entry half (lane scrub): a non-finite carried row —
+            # an upstream fault or a poisoned lane — is replaced by the
+            # last-known-good row BEFORE any geometry touches it. 0*NaN
+            # propagates, so one bad row would otherwise poison the
+            # consensus centroid (and with it every agent) in one step.
+            mode_prev, streak_prev, lkg_x, lkg_v, lkg_th = state.rta
+            ok_rows = finite_rows(state.x, state.v, state.theta)
+            scrub_bit = ~ok_rows
+            state = state._replace(
+                x=jnp.where(ok_rows[:, None], state.x, lkg_x),
+                v=jnp.where(ok_rows[:, None], state.v, lkg_v),
+                theta=(jnp.where(ok_rows, state.theta, lkg_th)
+                       if unicycle else state.theta))
         if unicycle:
             # Work in si space: the projection point l ahead of the wheel
             # axis is what the filter sees and guarantees (the reference
@@ -1317,12 +1395,49 @@ def _build_step(cfg: Config, cbf: CBFParams | None = None, *,
             engaged = jnp.any(mask, axis=1)
             u = jnp.where(engaged[:, None], u_safe, u0)
 
+        if cfg.rta:
+            # Rung 1: boosted-budget selective re-solve. An exhausted
+            # relax budget / per-row cap left the agent on a least-
+            # violating control; re-solving with the cap lifted and a
+            # larger budget can restore feasibility the normal policy
+            # couldn't. One lax.cond guards the extra QP pass — healthy
+            # steps pay a scalar any-reduction, nothing more — and the
+            # jnp.where applies it only to flagged rows.
+            bit_infeas = ~info.feasible & engaged
+            flag1 = bit_infeas | (mode_prev == RUNG_RESOLVE)
+
+            def _boosted(_):
+                ub, _ = safe_controls(
+                    states4, obs_slab, mask, f, g, u0, cbf,
+                    priority_mask=priority, relax_cap=None,
+                    max_relax=cfg.rta_boost_budget,
+                    unroll_relax=unroll_relax,
+                    reference_layout=not plain_box,
+                    vel_box_rows=not plain_box)
+                return ub
+
+            u_boost = lax.cond(jnp.any(flag1), _boosted,
+                               lambda _: u_safe, None)
+            u = jnp.where((flag1 & engaged)[:, None], u_boost, u)
+
         cert_residual = ()
         cert_dropped = ()
         cert_iters = ()
         new_ccache = ()
         new_sstate = ()
+        carry_resets = ()
+        carry_reset = None
         if cfg.certificate:
+            sstate_in = None
+            if cfg.certificate_warm_start:
+                # Branch-free warm-carry sanitize (independent of the
+                # RTA ladder): a non-finite ADMM carry cold-resets
+                # instead of being reused verbatim and poisoning every
+                # subsequent warm solve; resets are counted.
+                from cbf_tpu.sim.certificates import sanitize_solver_state
+                sstate_in, carry_reset = sanitize_solver_state(
+                    state.certificate_solver_state)
+                carry_resets = carry_reset.astype(jnp.int32)
             # Second layer of the reference's stack: the joint certificate
             # over the already-filtered si velocities (see Config).
             with profiling.annotate("certificate"):
@@ -1331,8 +1446,7 @@ def _build_step(cfg: Config, cbf: CBFParams | None = None, *,
                     neighbor_cache=(state.certificate_cache
                                     if cfg.certificate_rebuild_skin
                                     else None),
-                    solver_state=(state.certificate_solver_state
-                                  if cfg.certificate_warm_start else None))
+                    solver_state=sstate_in)
                 u, cert_residual, cert_dropped, cert_iters = res[:4]
                 rest = list(res[4:])
                 if cfg.certificate_rebuild_skin:
@@ -1340,24 +1454,79 @@ def _build_step(cfg: Config, cbf: CBFParams | None = None, *,
                 if cfg.certificate_warm_start:
                     new_sstate = rest.pop(0)
 
+        rta_mode = ()
+        if cfg.rta:
+            # Rungs 2-3, pre-integration half: assemble the health word
+            # from this step's signals and select the backup command for
+            # every agent whose effective rung demands it (latched-from-
+            # previous-steps OR demanded now — escalation is immediate,
+            # release waits for the latch's hysteresis below).
+            health = health_word(
+                cfg.n,
+                infeasible=bit_infeas,
+                # ~(r <= gate), not r > gate: a NaN residual must TRIP
+                # the trust gate, and NaN compares False both ways.
+                cert_residual=(~(cert_residual <= cfg.rta_residual_gate)
+                               if cfg.certificate else None),
+                carry_reset=carry_reset,
+                state_nonfinite=scrub_bit,
+                control_nonfinite=~finite_rows(u))
+            mode_eff = jnp.maximum(mode_prev, demanded_rung(health))
+            u = jnp.where((mode_eff >= RUNG_BACKUP)[:, None],
+                          backup_control(
+                              state.v, dynamics=cfg.dynamics,
+                              vel_tracking_tau=cfg.vel_tracking_tau,
+                              accel_limit=cfg.accel_limit),
+                          u)
+            # Last-ditch guard: whatever produced it, a non-finite
+            # command never reaches the integrator.
+            u = jnp.where(jnp.isfinite(u), u, jnp.zeros_like(u))
+
         deficit = ()
+        deficit_pa = None
         with profiling.annotate("integrate"):
             if unicycle:
                 body_new, theta_new, p_new = unicycle_apply(
                     cfg, state.x, state.theta, u)
-                realized = (p_new - x) / cfg.dt
                 # Applied si velocity at the projection point — the actual
                 # velocity the continuous barrier's vslots carry next step.
-                new_state = State(x=body_new, v=realized, theta=theta_new,
-                                  gating_cache=new_cache,
-                                  certificate_cache=new_ccache,
-                                  certificate_solver_state=new_sstate)
-                deficit = jnp.max(safe_norm(u - realized))
+                x_new, v_new = body_new, (p_new - x) / cfg.dt
+                deficit_pa = safe_norm(u - v_new)
+                deficit = jnp.max(deficit_pa)
             else:
                 x_new, v_new = integrate(cfg, x, state.v, u)
-                new_state = State(x=x_new, v=v_new, gating_cache=new_cache,
-                                  certificate_cache=new_ccache,
-                                  certificate_solver_state=new_sstate)
+                theta_new = state.theta
+
+        rta_carry = ()
+        if cfg.rta:
+            # Rung-3 exit half: a row the integrator just broke (e.g. an
+            # overflowing dt) is held at its pre-step value with a stop
+            # outcome (v = 0) so the CARRIED state stays finite, and the
+            # trailing health bits (post-integration non-finiteness, the
+            # unicycle actuation deficit) fold into the latch — they
+            # engage the ladder from the next step.
+            post_ok = finite_rows(x_new, v_new,
+                                  theta_new if unicycle else ())
+            x_new = jnp.where(post_ok[:, None], x_new, state.x)
+            v_new = jnp.where(post_ok[:, None], v_new,
+                              jnp.zeros_like(v_new))
+            if unicycle:
+                theta_new = jnp.where(post_ok, theta_new, state.theta)
+            health = health | health_word(
+                cfg.n, state_nonfinite=~post_ok,
+                actuation_deficit=(deficit_pa > cfg.rta_deficit_gate
+                                   if unicycle else None))
+            mode_new, streak_new = latch_update(
+                mode_prev, streak_prev, demanded_rung(health),
+                cfg.rta_recover_steps)
+            rta_mode = jnp.max(mode_new)
+            rta_carry = (mode_new, streak_new, x_new, v_new,
+                         theta_new if unicycle else ())
+        new_state = State(x=x_new, v=v_new, theta=theta_new,
+                          gating_cache=new_cache,
+                          certificate_cache=new_ccache,
+                          certificate_solver_state=new_sstate,
+                          rta=rta_carry)
 
         out = StepOutputs(
             min_pairwise_distance=min_dist,
@@ -1371,6 +1540,8 @@ def _build_step(cfg: Config, cbf: CBFParams | None = None, *,
             certificate_dropped_count=cert_dropped,
             saturation_deficit=deficit,
             certificate_iterations=cert_iters,
+            certificate_carry_resets=carry_resets,
+            rta_mode=rta_mode,
         )
         return new_state, out
 

@@ -20,7 +20,10 @@ Failures are first-class (`serve.resilience`): a failed batch retries
 with bounded exponential backoff when transient, then BISECTS so only
 the offending request(s) fail (vmapped lanes are independent — a
 poisoned batch-mate cannot fail the other seven); non-finite per-slot
-results fail alone with `NonFiniteResult`; repeat offenders are
+results fail alone with `NonFiniteResult` — or, with
+``FaultPolicy.rta_fallback``, are re-run solo under the runtime-
+assurance ladder (``rta=True``) for a degraded completion
+(`RequestResult.rta_engaged`); repeat offenders are
 quarantined per request signature and broken buckets per key (circuit
 breakers); `submit` applies admission control (bounded queue with a
 reject-newest/-oldest shed policy) and per-request deadlines; sustained
@@ -110,6 +113,10 @@ class RequestResult:
     execute_s: float        # the batch's device wall (shared by members)
     batch_fill: int         # real requests in the flushed batch
     degraded: bool = False  # served under the overload degradation cap
+    # The runtime-assurance ladder engaged during this rollout (any step
+    # with rta_mode > 0) — the request completed, but degraded: some
+    # agents rode a fallback rung rather than the nominal filter.
+    rta_engaged: bool = False
 
 
 class PendingRequest:
@@ -244,7 +251,7 @@ class ServeEngine:
                       "bisects": 0, "shed": 0, "deadline_expired": 0,
                       "quarantined": 0, "failed": 0, "nonfinite": 0,
                       "cancelled": 0, "degraded_requests": 0,
-                      "scheduler_crashes": 0}
+                      "scheduler_crashes": 0, "rta_rescued": 0}
         self._execs: dict[_buckets.BucketKey, Any] = {}
         self._ids = itertools.count()
         self._batch_ids = itertools.count()
@@ -344,7 +351,8 @@ class ServeEngine:
             "fault_stats": {k: self.stats[k] for k in (
                 "retries", "bisects", "shed", "deadline_expired",
                 "quarantined", "failed", "nonfinite", "cancelled",
-                "degraded_requests", "scheduler_crashes")},
+                "degraded_requests", "scheduler_crashes",
+                "rta_rescued")},
         }}
 
     # -- breakers ----------------------------------------------------------
@@ -501,6 +509,10 @@ class ServeEngine:
                     # request fails (blast-radius isolation), and its
                     # signature takes a quarantine strike.
                     self._count("nonfinite")
+                    if policy.rta_fallback and not cfg.rta \
+                            and self._rta_rescue(pending, cfg, label,
+                                                 t_enq, t_exec_start):
+                        continue
                     self._count("failed")
                     self._record_offender(cfg, label)
                     pending._resolve(error=resilience.NonFiniteResult(
@@ -509,6 +521,9 @@ class ServeEngine:
                         request_id=pending.request_id, bucket=label))
                     continue
                 self._record_signature_success(cfg, label)
+                rta_ch = outs_i.rta_mode
+                rta_engaged = not isinstance(rta_ch, tuple) \
+                    and bool(np.max(np.asarray(rta_ch), initial=0) > 0)
                 now = tracer.now()
                 result = RequestResult(
                     request_id=pending.request_id, bucket=label,
@@ -516,7 +531,7 @@ class ServeEngine:
                     outputs=outs_i, latency_s=round(now - t_enq, 6),
                     queue_wait_s=round(t_exec_start - t_enq, 6),
                     execute_s=round(execute_s, 6), batch_fill=len(entries),
-                    degraded=degraded)
+                    degraded=degraded, rta_engaged=rta_engaged)
                 self.stats["requests"] += 1
                 if degraded:
                     self._count("degraded_requests")
@@ -530,12 +545,39 @@ class ServeEngine:
                         "execute_s": result.execute_s,
                         "batch_fill": result.batch_fill,
                         "degraded": int(degraded),
+                        "rta_engaged": int(rta_engaged),
                         "min_pairwise_distance": float(
                             np.min(outs_i.min_pairwise_distance)),
                         "infeasible_count": int(
                             np.sum(outs_i.infeasible_count)),
                     })
                 pending._resolve(result=result)
+
+    def _rta_rescue(self, pending, cfg: swarm.Config, from_label: str,
+                    t_enq: float, t_exec_start: float) -> bool:
+        """Runtime-assurance rescue of one non-finite request: re-run
+        it ALONE under ``replace(cfg, rta=True)`` so the in-rollout
+        fallback ladder (`cbf_tpu.rta`) absorbs the fault and the caller
+        gets a degraded completion (``RequestResult.rta_engaged``)
+        instead of a `NonFiniteResult`. The rescue bucket is distinct
+        (rta knobs are static), so the first rescue per bucket costs a
+        compile. Returns True once the rescue batch has resolved the
+        request — with a result, or (if even the ladder cannot keep the
+        lane finite) its own typed error. Terminates: the rescue cfg has
+        ``rta=True``, which is never rescued again."""
+        try:
+            rescue_cfg = dataclasses.replace(cfg, rta=True)
+            key, traced = self.bucket_of(rescue_cfg)
+        except (ValueError, TypeError):
+            return False   # cfg does not validate under rta: fail normally
+        self._count("rta_rescued")
+        self._emit("serve.retry", {
+            "bucket": from_label, "action": "rta_rescue", "attempt": 0,
+            "batch_size": 1, "backoff_s": 0.0,
+            "error": "NonFiniteResult"})
+        self._run_batch(key, [(pending, rescue_cfg, traced, t_enq, None)],
+                        t_exec_start, attempt=self.fault_policy.max_retries)
+        return True
 
     def _on_batch_failure(self, key: _buckets.BucketKey, entries,
                           t_exec_start: float, attempt: int, phase: str,

@@ -68,9 +68,13 @@ def padded_initial_state(cfg: swarm.Config, key: BucketKey) -> swarm.State:
         from cbf_tpu.sim.certificates import certificate_solver_seed
         sstate = certificate_solver_seed(bcfg.n, cfg.certificate_k,
                                          cfg.dtype)
+    rta: tuple = ()
+    if cfg.rta:
+        from cbf_tpu.rta.core import rta_seed
+        rta = rta_seed(x0, jnp.zeros_like(x0), theta0)
     return swarm.State(x=x0, v=jnp.zeros_like(x0), theta=theta0,
                        gating_cache=cache, certificate_cache=ccache,
-                       certificate_solver_state=sstate)
+                       certificate_solver_state=sstate, rta=rta)
 
 
 def stack_batch(key: BucketKey, requests, traced_list, max_batch: int):

@@ -168,6 +168,15 @@ class FaultPolicy:
     ``check_finite`` gates the per-slot NaN/inf scan of unpacked
     results (:class:`NonFiniteResult`); disable only for overhead
     measurement legs.
+
+    ``rta_fallback`` arms the runtime-assurance rescue: a request whose
+    slot unpacked non-finite results is re-run ALONE under
+    ``dataclasses.replace(cfg, rta=True)`` — the in-rollout fallback
+    ladder (``cbf_tpu.rta``) absorbs the fault and the caller receives a
+    degraded completion (``RequestResult.rta_engaged=True``) instead of
+    a :class:`NonFiniteResult`. Off by default: the rescue bucket is a
+    distinct executable (the rta knobs are static), so first engagement
+    costs a compile.
     """
     max_retries: int = 2
     backoff_base_s: float = 0.02
@@ -181,6 +190,7 @@ class FaultPolicy:
     quarantine_cooldown_s: float = 1.0
     breaker_threshold: int = 5
     check_finite: bool = True
+    rta_fallback: bool = False
     degrade_high_watermark: int | None = None
     degrade_low_watermark: int = 0
     degrade_sustain_s: float = 0.25

@@ -223,6 +223,30 @@ def certificate_solver_seed(N: int, k: int, dtype=jnp.float32):
     return (z2n, zr, z2n, zr, z2n)
 
 
+def sanitize_solver_state(solver_state):
+    """Branch-free warm-carry sanitizer: ``(clean_state, reset)``.
+
+    A non-finite value anywhere in the ADMM carry would otherwise be
+    reused verbatim and poison every subsequent warm solve (the NaN
+    tap in PR 2 watches the *state*, not this carry). If ANY leaf holds
+    a non-finite value the WHOLE carry is reset to the all-zero cold
+    start (partial scrubbing would hand the solver an inconsistent
+    primal/dual pair — the cold start is the one point known sound),
+    selected with ``jnp.where`` so the check runs inside the compiled
+    step. ``reset`` is a scalar bool; callers surface it
+    (``StepOutputs.certificate_carry_resets``). ``()`` (the disabled
+    channel) passes through unchanged with ``reset=False``.
+    """
+    if isinstance(solver_state, tuple) and len(solver_state) == 0:
+        return solver_state, jnp.zeros((), bool)
+    bad = jnp.zeros((), bool)
+    for leaf in solver_state:
+        bad = bad | ~jnp.all(jnp.isfinite(leaf))
+    clean = tuple(jnp.where(bad, jnp.zeros_like(leaf), leaf)
+                  for leaf in solver_state)
+    return clean, bad
+
+
 def si_barrier_certificate_sparse(
         dxi, x, params: CertificateParams = CertificateParams(),
         settings: SparseADMMSettings = SparseADMMSettings(),

@@ -197,6 +197,77 @@ def teleport_at_step(step_fn: Callable, step_index: int,
     return wrapped
 
 
+# ---------------------------------------------- RTA ladder injectors ----
+# Each forces one rung of the cbf_tpu.rta fallback ladder to engage from
+# INSIDE compiled code: the corruption is applied to the real carried
+# state with jnp.where on the traced step counter, so the in-step health
+# word sees a genuine fault (corrupt_output_at_step only forges the
+# record — useless here). All three are scan/jit-safe step wrappers.
+
+
+def poison_agent_at_step(step_fn: Callable, step_index: int,
+                         agent: int = 0) -> Callable:
+    """NaN-poison ONE agent's position row at ``t == step_index`` — the
+    rung-3 (lane scrub) fault: with ``Config.rta`` the entry scrub must
+    replace the row with its last-known-good carry plus a stop command
+    while every decoupled agent's trajectory stays bit-untouched; without
+    RTA the 0*NaN consensus centroid poisons the whole swarm in one
+    step. ``step_index < 0`` never fires (the blast-radius test's clean
+    twin: identical program, fault disabled by data)."""
+    def wrapped(state, t):
+        hit = t == step_index
+        x = state.x.at[agent].set(
+            jnp.where(hit, jnp.full((2,), jnp.nan, state.x.dtype),
+                      state.x[agent]))
+        return step_fn(state._replace(x=x), t)
+
+    return wrapped
+
+
+def residual_blowup_at_step(step_fn: Callable, step_index: int,
+                            scale: float = 1e8) -> Callable:
+    """Scale every leaf of the certificate's warm-start ADMM carry by
+    ``scale`` at ``t == step_index`` — the rung-2 (backup controller)
+    fault. The corruption is FINITE on purpose: the warm-carry sanitizer
+    (sim.certificates.sanitize_solver_state) must not reset it, so the
+    solver genuinely fails to converge within its budget and the
+    residual blows past the trust gate — a real certificate failure, not
+    a forged record. Needs ``certificate_warm_start=True``."""
+    def wrapped(state, t):
+        ss = state.certificate_solver_state
+        if isinstance(ss, tuple) and len(ss) == 0:
+            raise ValueError(
+                "residual_blowup_at_step corrupts the warm-start ADMM "
+                "carry — enable certificate_warm_start")
+        hit = t == step_index
+        ss = tuple(jnp.where(hit, leaf * scale, leaf) for leaf in ss)
+        return step_fn(state._replace(certificate_solver_state=ss), t)
+
+    return wrapped
+
+
+def teleport_clump_at_step(step_fn: Callable, step_index: int,
+                           agents, spacing: float = 0.01,
+                           center=(0.0, 0.0)) -> Callable:
+    """Teleport ``agents`` into a sub-floor line clump (``spacing``
+    apart around ``center``) at ``t == step_index`` — the rung-1
+    (boosted re-solve) fault: deep mutual violation drives the clumped
+    agents' QPs past the relax cap / budget to infeasibility, and the
+    boosted-budget selective re-solve must restore feasibility and
+    unpack the clump."""
+    agents = list(agents)
+    half = 0.5 * spacing * (len(agents) - 1)
+
+    def wrapped(state, t):
+        tgt = state.x.at[jnp.asarray(agents)].set(jnp.asarray(
+            [[center[0] - half + i * spacing, center[1]]
+             for i in range(len(agents))], state.x.dtype))
+        hit = t == step_index
+        return step_fn(state._replace(x=jnp.where(hit, tgt, state.x)), t)
+
+    return wrapped
+
+
 # ------------------------------------------------- serve-level chaos ----
 
 

@@ -34,6 +34,11 @@ Properties (vacuous ones report +inf, never silently 0):
   a silently-neutered filter).
 - ``goal_reach`` — liveness: a filter that parks everyone at spawn
   trivially "never collides"; the swarm must still pack into its disk.
+- ``rta_soundness`` — the runtime-assurance claim: on every step where
+  the fallback ladder is engaged (``rta_mode > 0``) the separation
+  floor must STILL hold — a fallback that trades safety for liveness
+  is unsound. Vacuous (+inf) when the run has no RTA channel or the
+  ladder never engaged.
 """
 
 from __future__ import annotations
@@ -55,13 +60,16 @@ class Margins(NamedTuple):
     obstacle_clearance: Any
     sustained_infeasibility: Any
     goal_reach: Any
+    rta_soundness: Any
 
 
 PROPERTY_NAMES: tuple[str, ...] = Margins._fields
 
 #: Properties with a usable gradient w.r.t. the initial state — the
 #: gradient-descent engine's objective set (``sustained_infeasibility``
-#: is a count of boolean flags: its cotangent is identically zero).
+#: is a count of boolean flags: its cotangent is identically zero, and
+#: ``rta_soundness`` gates on the integer latch mode — likewise
+#: gradient-dead).
 DIFFERENTIABLE_PROPERTIES: tuple[str, ...] = (
     "separation", "boundary", "obstacle_clearance", "goal_reach")
 
@@ -85,6 +93,10 @@ class PropertyThresholds:
     #: final step; ``goal_radius`` None = vacuous.
     goal_slack: float = 0.5
     goal_radius: float | None = None
+    #: rta_soundness floor; None (default) = same as ``separation_floor``
+    #: (the ladder promises the SAME floor the nominal filter holds).
+    #: Set -inf to vacuate the property (the CLI's ``--properties``).
+    rta_floor: float | None = None
 
 
 def thresholds_for(scenario: str, cfg) -> PropertyThresholds:
@@ -182,9 +194,23 @@ def rollout_margins(th: PropertyThresholds, outs, final_positions, *,
         d_c = jnp.linalg.norm(final_positions - c[None], axis=1)
         goal = (th.goal_radius + th.goal_slack - jnp.max(d_c)).astype(dt_)
 
+    rm = getattr(outs, "rta_mode", ())
+    if isinstance(rm, tuple):
+        rta_soundness = inf          # no RTA channel in this rollout
+    else:
+        # Floor restricted to engaged steps; all-healthy run -> +inf
+        # (vacuously sound), matching the other vacuous conventions.
+        rta_floor = (th.separation_floor if th.rta_floor is None
+                     else th.rta_floor)
+        rta_soundness = (jnp.min(jnp.where(rm > 0,
+                                           outs.min_pairwise_distance,
+                                           inf))
+                         - rta_floor).astype(dt_)
+
     return Margins(separation=separation, boundary=boundary,
                    obstacle_clearance=obstacle_clearance,
-                   sustained_infeasibility=sustained, goal_reach=goal)
+                   sustained_infeasibility=sustained, goal_reach=goal,
+                   rta_soundness=rta_soundness)
 
 
 def _obstacles_over_time(obstacle_fn: Callable, ts):
@@ -243,6 +269,15 @@ def margin_series_np(th: PropertyThresholds, outs, *, trajectory=None,
             runs[t] = run
         lim = float(th.infeasible_streak_limit)
         return (lim - runs) / lim
+    if prop == "rta_soundness":
+        rm = getattr(outs, "rta_mode", ())
+        if isinstance(rm, tuple):
+            return None
+        floor = (th.separation_floor if th.rta_floor is None
+                 else th.rta_floor)
+        eng = np.asarray(rm) > 0
+        mpd = np.asarray(outs.min_pairwise_distance, np.float64)
+        return np.where(eng, mpd - floor, np.inf)
     if prop == "goal_reach":
         return None
     raise KeyError(prop)
@@ -256,11 +291,13 @@ def rollout_margins_np(th: PropertyThresholds, outs, final_positions, *,
     property name -> float margin."""
     out = {}
     for prop in ("separation", "boundary", "obstacle_clearance",
-                 "sustained_infeasibility"):
+                 "sustained_infeasibility", "rta_soundness"):
         series = margin_series_np(th, outs, trajectory=trajectory,
                                   obstacle_fn_np=obstacle_fn_np, prop=prop)
         if series is not None:
             out[prop] = float(series.min())
+    if "rta_soundness" not in out:
+        out["rta_soundness"] = np.inf
     fp = np.asarray(final_positions, np.float64)
     if "boundary" not in out:
         out["boundary"] = (float(th.boundary_half - np.abs(fp).max())

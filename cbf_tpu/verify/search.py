@@ -26,6 +26,14 @@ key is ``fold_in``-derived; no host entropy), stream per-round progress
 as ``verify.round`` telemetry events and their verdict as a
 ``verify.margin`` event (``obs.schema.VERIFY_EVENT_TYPES``), and return
 :class:`SearchResult` records the shrinker and corpus consume.
+
+The hybrid (filter + runtime-assurance ladder, ``Config(rta=True)``)
+enrolls here like any other config: the adapter's step carries the
+ladder, so the falsifier attacks filter and fallback TOGETHER, and the
+``rta_soundness`` margin (floor restricted to engaged steps) is part of
+every candidate's margin vector. The soundness claim is that the
+default-budget sweep fails to break it while still breaking a
+deliberately weakened filter — tests/test_rta.py pins both directions.
 """
 
 from __future__ import annotations

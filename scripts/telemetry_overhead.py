@@ -28,12 +28,21 @@ in the host-side guard work. The compiled executable is shared between
 legs, which is also the bit-neutrality argument: an idle policy cannot
 change results it never touches.
 
+``--mode rta`` measures the runtime-assurance ladder's IDLE cost under
+the same <= 3% budget (ISSUE 10): a healthy rta=True rollout (health
+word assembled, latch updated, every select taken on the nominal side —
+the ladder never engages) vs the plain rta=False program, same
+interleaved min-of-R discipline. Unlike the host-side modes these are
+two DIFFERENT compiled programs — the ladder's selects are in the
+compiled step — so the budget governs compiled device time.
+
 Prints one JSON line: {n, steps, every, reps, off_s, on_s, overhead,
 heartbeats, platform} (mode=rollout) or {mode, b, n_base, steps, reps,
-off_s, on_s, overhead, ..., platform} (mode=spans|faults).
+off_s, on_s, overhead, ..., platform} (mode=spans|faults) or {mode, n,
+steps, reps, off_s, on_s, overhead, engaged_steps, platform} (mode=rta).
 
 Usage: python scripts/telemetry_overhead.py [--n 1024] [--steps 300]
-       [--every 50] [--reps 5] [--mode rollout|spans|faults]
+       [--every 50] [--reps 5] [--mode rollout|spans|faults|rta]
 """
 
 from __future__ import annotations
@@ -173,18 +182,63 @@ def measure_faults(b: int, n_base: int, steps: int, reps: int) -> dict:
             "platform": jax.devices()[0].platform}
 
 
+def measure_rta(n: int, steps: int, reps: int) -> dict:
+    """Idle runtime-assurance overhead on the rollout path: a HEALTHY
+    rta=True rollout vs the plain program. No fault fires, so the on-leg
+    pays exactly the ladder's always-on work (health word, latch, the
+    value-identity selects) — the 'armed but idle' budget of ISSUE 10's
+    acceptance gate."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from cbf_tpu.rollout.engine import rollout
+    from cbf_tpu.scenarios import swarm
+
+    cfg_off = swarm.Config(n=n, steps=steps, record_trajectory=False)
+    cfg_on = dataclasses.replace(cfg_off, rta=True)
+    state_off, step_off = swarm.make(cfg_off)
+    state_on, step_on = swarm.make(cfg_on)
+
+    def one(state0, step_fn) -> float:
+        t0 = time.perf_counter()
+        final, outs = rollout(step_fn, state0, steps)
+        jax.block_until_ready(final.x)
+        return time.perf_counter() - t0, outs
+
+    one(state_off, step_off), one(state_on, step_on)   # compile both
+    offs, ons = [], []
+    engaged = 0
+    for i in range(reps):
+        legs = ((offs, state_off, step_off), (ons, state_on, step_on))
+        for acc, st, fn in (legs if i % 2 == 0 else legs[::-1]):
+            wall, outs = one(st, fn)
+            acc.append(wall)
+            if acc is ons:
+                engaged = int(np.sum(np.asarray(outs.rta_mode) > 0))
+    off_s, on_s = min(offs), min(ons)
+    return {"mode": "rta", "n": n, "steps": steps, "reps": reps,
+            "off_s": round(off_s, 4), "on_s": round(on_s, 4),
+            "overhead": round((on_s - off_s) / off_s, 4),
+            "engaged_steps": engaged,   # must be 0: idle means idle
+            "platform": jax.devices()[0].platform}
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--n", type=int, default=1024)
     p.add_argument("--steps", type=int, default=300)
     p.add_argument("--every", type=int, default=50)
     p.add_argument("--reps", type=int, default=5)
-    p.add_argument("--mode", choices=("rollout", "spans", "faults"),
+    p.add_argument("--mode", choices=("rollout", "spans", "faults", "rta"),
                    default="rollout")
     p.add_argument("--b", type=int, default=12,
                    help="request count for --mode spans/faults")
     args = p.parse_args()
-    if args.mode in ("spans", "faults"):
+    if args.mode == "rta":
+        print(json.dumps(measure_rta(args.n, args.steps, args.reps)))
+    elif args.mode in ("spans", "faults"):
         # Serve-path budgets are per-request wall at serving sizes; the
         # rollout defaults (N=1024) would swamp the signal with device
         # time, so these modes size down and serve a mixed batch instead.

@@ -1,0 +1,127 @@
+"""Tier-1 wall-clock budget audit (AUD005): measure, don't hope.
+
+AUD002 (`scripts/tier1_marker_audit.py`) keeps budget-SHAPED tests out
+of tier 1 by static shape inspection; this audit closes the loop on the
+tests that pass the shape gate but are slow anyway. It times the actual
+tier-1 suite (`pytest -m 'not slow'` with per-test durations) against
+the 800 s watermark — deliberately under the driver's hard 870 s
+timeout, so the audit trips BEFORE the harness starts killing runs —
+and, when over, suggests the cheapest set of demotions: the slowest
+tests whose combined removal brings the suite back under the watermark.
+A suggestion is exactly that — the fix is `@pytest.mark.slow` on the
+named tests (or making them cheaper), re-run to confirm.
+
+The selection logic (:func:`suggest_demotions`) is pure and unit-tested
+fast (tests/test_rta.py); the measured end-to-end audit is itself a
+`slow`-marked test — a tier-1 budget audit inside tier 1 would spend
+the very budget it polices.
+
+Usage: python scripts/tier1_budget_audit.py [--watermark 800]
+       [--pytest-args "-m 'not slow'"] [--json]
+Exit 1 when the measured tier-1 wall exceeds the watermark.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import shlex
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+#: Fail the audit when the measured tier-1 wall exceeds this (seconds).
+#: 800 = the driver's 870 s hard timeout minus collection/startup slack.
+WATERMARK_S = 800.0
+
+#: A pytest `--durations` report line: "12.34s call tests/t.py::test_x".
+_DURATION_RE = re.compile(
+    r"^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+(\S+)\s*$")
+
+
+def parse_durations(text: str) -> list[tuple[str, float]]:
+    """(test_id, seconds) pairs from a pytest ``--durations=0 -vv`` run,
+    call/setup/teardown phases summed per test, slowest first."""
+    acc: dict[str, float] = {}
+    for line in text.splitlines():
+        m = _DURATION_RE.match(line)
+        if m:
+            acc[m.group(3)] = acc.get(m.group(3), 0.0) + float(m.group(1))
+    return sorted(acc.items(), key=lambda kv: -kv[1])
+
+
+def suggest_demotions(durations: list[tuple[str, float]], total_s: float,
+                      watermark_s: float = WATERMARK_S,
+                      target_frac: float = 0.9) -> list[tuple[str, float]]:
+    """The cheapest demotion set: slowest tests first, until the
+    projected wall (``total_s`` minus the demoted tests' time) falls to
+    ``target_frac * watermark_s`` — aiming BELOW the watermark so the
+    next flaky-scheduler run doesn't trip the audit again. Empty when
+    the suite is already under the watermark."""
+    if total_s <= watermark_s:
+        return []
+    target = target_frac * watermark_s
+    out, projected = [], total_s
+    for test_id, dur in sorted(durations, key=lambda kv: -kv[1]):
+        if projected <= target:
+            break
+        out.append((test_id, dur))
+        projected -= dur
+    return out
+
+
+def run_audit(watermark_s: float = WATERMARK_S,
+              pytest_args: str = "-m 'not slow'") -> dict:
+    """Time the tier-1 suite as a subprocess (same env shape as the
+    driver: CPU backend, 8 virtual devices) and return the verdict."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    cmd = [sys.executable, "-m", "pytest", "tests/", "-q",
+           "--durations=0", "--durations-min=0.1",
+           *shlex.split(pytest_args)]
+    t0 = time.perf_counter()
+    proc = subprocess.run(cmd, cwd=_REPO, env=env, capture_output=True,
+                          text=True)
+    wall = time.perf_counter() - t0
+    durations = parse_durations(proc.stdout)
+    demote = suggest_demotions(durations, wall, watermark_s)
+    return {"rule": "AUD005", "wall_s": round(wall, 1),
+            "watermark_s": watermark_s,
+            "ok": wall <= watermark_s and proc.returncode == 0,
+            "pytest_exit": proc.returncode,
+            "slowest": [[t, round(d, 1)] for t, d in durations[:10]],
+            "demote": [[t, round(d, 1)] for t, d in demote]}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--watermark", type=float, default=WATERMARK_S,
+                   help=f"fail beyond this wall (default {WATERMARK_S}s)")
+    p.add_argument("--pytest-args", default="-m 'not slow'",
+                   help="extra pytest selection args (default tier 1)")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args()
+    verdict = run_audit(args.watermark, args.pytest_args)
+    if args.json:
+        print(json.dumps(verdict))
+    elif verdict["ok"]:
+        print(f"tier-1 budget audit OK: {verdict['wall_s']}s <= "
+              f"{verdict['watermark_s']}s watermark")
+    else:
+        print(f"tier-1 budget audit FAILED: {verdict['wall_s']}s wall "
+              f"(watermark {verdict['watermark_s']}s, pytest exit "
+              f"{verdict['pytest_exit']})")
+        if verdict["demote"]:
+            print("suggest demoting (mark @pytest.mark.slow):")
+            for test_id, dur in verdict["demote"]:
+                print(f"  {dur:8.1f}s  {test_id}")
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -216,6 +216,10 @@ def test_double_with_moderate_obstacles_holds_floor():
     assert int(np.asarray(outs.infeasible_count).sum()) == 0
 
 
+# slow: ~11 s; double-dynamics obstacle floors stay tier-1 in
+# test_double_with_moderate_obstacles_holds_floor and the sharded
+# obstacle parity test — this is the adversarial 10x-speed transient.
+@pytest.mark.slow
 def test_double_fast_obstacles_recover_and_surface_infeasibility():
     """A 10x-agent-speed obstacle cannot always be evaded with |a| <= 1 —
     that is physics, not a filter bug. The contract: the transient stays

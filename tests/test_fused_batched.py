@@ -456,6 +456,11 @@ def test_tier1_marker_audit():
     assert not problems, "\n".join(problems)
 
 
+# slow: ~11 s; the fused certificate's numerics stay tier-1 in
+# test_ensemble_lockstep_fused_warm_adaptive and its config plumbing in
+# test_config_certificate_fused_validation — this is the single-swarm
+# scenario-path soak at n=256.
+@pytest.mark.slow
 def test_scenario_rollout_fused_certificate():
     """The single-swarm scenario path under certificate_fused: certified
     spacing, residual gate, zero infeasible — the same bar the default

@@ -1,0 +1,563 @@
+"""Runtime assurance (cbf_tpu.rta, ISSUE 10): in-rollout recovery from
+safety-filter failure via a branch-free, provably-safe fallback ladder.
+
+The load-bearing pins:
+
+- EVERY RUNG ENGAGES (the tentpole acceptance): each rung of the ladder
+  is driven by an IN-COMPILED-CODE fault injector (`utils.faults`) and
+  must engage, carry the rollout to its horizon finite, and release the
+  latch — no rung exists only on paper.
+- BLAST RADIUS: a NaN-poisoned agent is scrubbed in-place; every other
+  agent's trajectory is BIT-EQUAL to a clean twin of the SAME compiled
+  program through the injection step (the `step_index=-1` twin idiom:
+  comparing across two different programs shows 1-ulp XLA fusion noise,
+  comparing within one program shows exactly the fault's effect).
+  Without RTA the same poison reaches the consensus centroid and takes
+  the whole swarm non-finite — the contrast that makes the scrub claim
+  meaningful.
+- OFF = ABSENT: `rta=False` keeps the carry and outputs channels as the
+  empty-tuple `()` convention — nothing enters the compiled program, so
+  rta-off rollouts are bit-identical to pre-RTA builds.
+- LATCH HYSTERESIS: escalation immediate, recovery only after
+  `rta_recover_steps` CONSECUTIVE healthy steps; chatter never releases.
+- ABSORPTION: watchdog alerts the ladder is actively absorbing
+  (certificate_blowup, sustained_infeasibility) downgrade to `warning`;
+  `nan` stays critical always; a NaN rta_mode never downgrades anything.
+- SERVE RESCUE: `FaultPolicy(rta_fallback=True)` turns a
+  `NonFiniteResult` into a degraded completion on an rta-enabled twin
+  bucket, flagged `RequestResult.rta_engaged`.
+- FALSIFIER HONESTY: the hybrid (default filter + ladder) survives the
+  budget that kills the weakened bare filter, and arming RTA does NOT
+  mask a genuinely unsafe filter from the falsifier.
+- DOCS LOCKSTEP: docs/API.md "Runtime assurance" names every public
+  surface (AUD001 additionally pins the rta.* event tables both ways).
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from cbf_tpu.obs import TelemetrySink, Watchdog  # noqa: E402
+from cbf_tpu.rollout.engine import rollout  # noqa: E402
+from cbf_tpu.rta import core, monitor  # noqa: E402
+from cbf_tpu.scenarios import swarm  # noqa: E402
+from cbf_tpu.sim.certificates import sanitize_solver_state  # noqa: E402
+from cbf_tpu.utils import faults  # noqa: E402
+from cbf_tpu.verify import (PROPERTY_NAMES, PropertyThresholds,  # noqa: E402
+                            SearchSettings, properties, search)
+
+from scripts.tier1_budget_audit import (parse_durations,  # noqa: E402
+                                        suggest_demotions)
+
+
+def _rollout(cfg, wrap=None):
+    state0, step = swarm.make(cfg)
+    if wrap is not None:
+        step = wrap(step)
+    final, outs = rollout(step, state0, cfg.steps)
+    return final, outs
+
+
+# ------------------------------------------------------------- core ----
+
+def test_health_word_bits_and_rungs():
+    word = core.health_word(
+        4,
+        infeasible=jnp.array([True, False, False, False]),
+        cert_residual=False,
+        carry_reset=jnp.array([False, True, False, False]),
+        state_nonfinite=jnp.array([False, False, True, False]))
+    word = np.asarray(word)
+    assert word.tolist() == [core.BIT_INFEASIBLE, core.BIT_CARRY_RESET,
+                             core.BIT_STATE_NONFINITE, 0]
+    rung = np.asarray(core.demanded_rung(jnp.asarray(word)))
+    assert rung.tolist() == [core.RUNG_RESOLVE, core.RUNG_BACKUP,
+                             core.RUNG_SCRUB, core.RUNG_NOMINAL]
+    # highest wins: every bit at once demands the scrub rung
+    all_bits = sum(core.HEALTH_BIT_NAMES.values())
+    assert int(core.demanded_rung(jnp.full((1,), all_bits,
+                                           jnp.int32))[0]) \
+        == core.RUNG_SCRUB
+    # swarm-wide scalar flags broadcast
+    word = np.asarray(core.health_word(3, cert_residual=True))
+    assert word.tolist() == [core.BIT_CERT_RESIDUAL] * 3
+
+
+def test_finite_rows():
+    x = jnp.array([[0.0, 1.0], [np.nan, 0.0], [np.inf, 2.0]])
+    v = jnp.array([0.0, 1.0, 2.0])
+    ok = np.asarray(core.finite_rows(x, v, ()))   # () skipped
+    assert ok.tolist() == [True, False, False]
+    with pytest.raises(ValueError):
+        core.finite_rows((), ())
+
+
+def test_latch_escalates_immediately_recovers_with_hysteresis():
+    recover = 4
+    mode = jnp.zeros((1,), jnp.int32)
+    streak = jnp.zeros((1,), jnp.int32)
+    # escalation lands the same step it is demanded
+    mode, streak = core.latch_update(mode, streak,
+                                     jnp.full((1,), 2, jnp.int32), recover)
+    assert int(mode[0]) == 2
+    # a higher demand escalates, a lower one does not de-escalate
+    mode, streak = core.latch_update(mode, streak,
+                                     jnp.full((1,), 3, jnp.int32), recover)
+    assert int(mode[0]) == 3
+    mode, streak = core.latch_update(mode, streak,
+                                     jnp.full((1,), 1, jnp.int32), recover)
+    assert int(mode[0]) == 3
+    # recovery needs `recover` consecutive healthy steps, then resets
+    for i in range(recover):
+        mode, streak = core.latch_update(
+            mode, streak, jnp.zeros((1,), jnp.int32), recover)
+        expected = 0 if i == recover - 1 else 3
+        assert int(mode[0]) == expected, f"healthy step {i}"
+    assert int(streak[0]) == 0    # the next engagement pays a full window
+
+
+def test_latch_chatter_never_recovers():
+    recover = 3
+    mode = jnp.zeros((2,), jnp.int32)
+    streak = jnp.zeros((2,), jnp.int32)
+    # agent 0 flaps fault/healthy, agent 1 is demanded once then healthy
+    for i in range(20):
+        demanded = jnp.array([1 if i % 2 == 0 else 0,
+                              1 if i == 0 else 0], jnp.int32)
+        mode, streak = core.latch_update(mode, streak, demanded, recover)
+    assert int(mode[0]) == 1      # chatter: never `recover` healthy in a row
+    assert int(mode[1]) == 0      # one fault, long quiet: released
+
+
+def test_backup_control_closed_form():
+    v = jnp.array([[3.0, 4.0], [0.1, 0.0]])
+    assert np.all(np.asarray(core.backup_control(v, dynamics="single"))
+                  == 0.0)
+    u = np.asarray(core.backup_control(v, dynamics="double",
+                                       vel_tracking_tau=0.2,
+                                       accel_limit=1.0))
+    # braking: opposite to v, capped at the actuator limit
+    assert np.linalg.norm(u[0]) <= 1.0 + 1e-6
+    assert float(np.dot(u[0], np.asarray(v)[0])) < 0
+    np.testing.assert_allclose(u[1], -np.asarray(v)[1] / 0.2, rtol=1e-6)
+
+
+def test_rta_seed_shapes():
+    x = jnp.zeros((5, 2))
+    mode, streak, lkg_x, lkg_v, lkg_th = core.rta_seed(
+        x, jnp.zeros_like(x))
+    assert mode.shape == (5,) and mode.dtype == jnp.int32
+    assert streak.shape == (5,)
+    assert lkg_x.shape == (5, 2) and lkg_th == ()
+
+
+def test_sanitize_solver_state():
+    clean = (jnp.ones((3,)), jnp.zeros((2, 2)))
+    out, reset = sanitize_solver_state(clean)
+    assert not bool(reset)
+    for a, b in zip(out, clean):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # ONE non-finite leaf resets the WHOLE carry to the cold start
+    dirty = (jnp.ones((3,)), jnp.array([[np.nan, 0.0], [0.0, 0.0]]))
+    out, reset = sanitize_solver_state(dirty)
+    assert bool(reset)
+    for leaf in out:
+        assert np.all(np.asarray(leaf) == 0.0)
+    # the disabled channel passes through
+    out, reset = sanitize_solver_state(())
+    assert out == () and not bool(reset)
+
+
+# ---------------------------------------------------------- monitor ----
+
+def test_rta_transitions_decode():
+    series = np.array([0, 1, 1, 3, 0, 2, 0])
+    trs = monitor.rta_transitions(series)
+    assert [t["type"] for t in trs] == ["rta.engage", "rta.engage",
+                                       "rta.recover", "rta.engage",
+                                       "rta.recover"]
+    assert trs[0] == {"type": "rta.engage", "step": 1, "rung": 1,
+                      "prev_rung": 0}
+    assert trs[1]["rung"] == 3 and trs[1]["prev_rung"] == 1
+    assert trs[2] == {"type": "rta.recover", "step": 4, "peak_rung": 3,
+                      "engaged_steps": 3}
+    assert trs[4]["peak_rung"] == 2 and trs[4]["engaged_steps"] == 1
+    assert monitor.rta_transitions(()) == []
+
+
+def test_emit_rta_events_sink_and_counters(tmp_path):
+    sink = TelemetrySink(str(tmp_path / "obs"))
+    summary = monitor.emit_rta_events(
+        sink, np.array([0, 1, 0, 2, 2, 0]), step_offset=100)
+    sink.close()
+    assert summary == {"engagements": 2, "recoveries": 2, "peak_rung": 2,
+                       "engaged_steps": 3}
+    events = [json.loads(line) for line in
+              open(os.path.join(sink.run_dir, "events.jsonl"))]
+    rta_events = [e for e in events
+                  if e.get("event", "").startswith("rta.")]
+    assert [e["event"] for e in rta_events] == \
+        ["rta.engage", "rta.recover", "rta.engage", "rta.recover"]
+    assert rta_events[0]["step"] == 101        # step_offset applied
+    reg = sink.registry
+    assert reg.counter("rta_engagements").total == 2
+    assert reg.counter("rta_rung_1").total == 1
+    assert reg.counter("rta_rung_2").total == 1
+    assert reg.counter("rta_recoveries").total == 2
+
+
+# ------------------------------------------------- rung engagement ----
+
+def test_rta_off_channels_absent():
+    cfg = swarm.Config(n=8, steps=5, record_trajectory=False)
+    state0, _ = swarm.make(cfg)
+    assert state0.rta == ()
+    final, outs = _rollout(cfg)
+    assert final.rta == ()
+    assert outs.rta_mode == ()
+    assert outs.certificate_carry_resets == ()
+
+
+def test_rung3_poison_engages_scrubs_and_recovers():
+    """The rung-3 acceptance: a NaN-poisoned state row engages the lane
+    scrub, the rollout reaches its horizon finite, and the latch
+    releases after the hysteresis window."""
+    cfg = swarm.Config(n=16, steps=80, record_trajectory=False,
+                       rta=True, rta_recover_steps=10)
+    final, outs = _rollout(
+        cfg, lambda s: faults.poison_agent_at_step(s, 30, agent=0))
+    modes = np.asarray(outs.rta_mode)
+    assert core.RUNG_SCRUB in modes
+    assert int(modes[30]) == core.RUNG_SCRUB   # engaged the fault step
+    assert int(modes[-1]) == 0                  # latch released
+    assert np.all(np.isfinite(np.asarray(final.x)))
+    assert np.all(np.isfinite(np.asarray(outs.min_pairwise_distance)))
+
+
+def test_rung3_contrast_without_rta_poison_spreads():
+    """The claim rung 3 defends against: without RTA the poisoned row
+    reaches the consensus centroid and the whole swarm goes non-finite."""
+    cfg = swarm.Config(n=16, steps=40, record_trajectory=False)
+    final, _ = _rollout(
+        cfg, lambda s: faults.poison_agent_at_step(s, 30, agent=0))
+    x = np.asarray(final.x)
+    assert not np.any(np.isfinite(x))           # every agent poisoned
+
+
+def test_rung1_clump_engages_boosted_resolve_and_recovers():
+    """The rung-1 acceptance: a sub-floor teleported clump near the
+    obstacle ring exhausts the relax cap; the boosted-budget selective
+    re-solve engages and the swarm unpacks the clump."""
+    cfg = swarm.Config(n=16, steps=120, n_obstacles=4,
+                       record_trajectory=False, rta=True,
+                       rta_recover_steps=10)
+    final, outs = _rollout(
+        cfg, lambda s: faults.teleport_clump_at_step(
+            s, 10, agents=tuple(range(8)), spacing=0.01))
+    modes = np.asarray(outs.rta_mode)
+    assert core.RUNG_RESOLVE in modes
+    assert int(modes[-1]) == 0
+    assert np.all(np.isfinite(np.asarray(final.x)))
+
+
+def test_rung2_residual_blowup_engages_backup():
+    """The rung-2 acceptance: a finite warm-carry corruption (the
+    sanitizer must NOT reset it) blows the certificate residual past
+    the trust gate and the backup controller takes over. n=32: at n=16
+    the packing never activates constraints, so the warm carry is still
+    all-zeros at the injection step and scaling it is a no-op."""
+    cfg = swarm.Config(n=32, steps=80, record_trajectory=False,
+                       certificate=True, certificate_backend="sparse",
+                       certificate_warm_start=True, certificate_iters=50,
+                       certificate_cg_iters=6, rta=True,
+                       rta_recover_steps=10)
+    final, outs = _rollout(
+        cfg, lambda s: faults.residual_blowup_at_step(s, 25))
+    modes = np.asarray(outs.rta_mode)
+    assert core.RUNG_BACKUP in modes
+    assert int(modes[25]) == core.RUNG_BACKUP   # engaged the fault step
+    assert np.all(np.isfinite(np.asarray(final.x)))
+
+
+def test_blast_radius_same_program_twin():
+    """One poisoned agent, bounded blast radius: vs the clean twin of
+    the SAME compiled program (`step_index=-1` — injection disabled by
+    data, so there is no cross-program fusion noise), every other
+    agent's trajectory is BIT-EQUAL through the injection step, and the
+    poisoned lane re-enters from its last-known-good row (also
+    bit-equal at the injection step — the scrub restores the exact
+    pre-fault state)."""
+    t_inj = 30
+    cfg = swarm.Config(n=12, steps=60, record_trajectory=True,
+                       rta=True, rta_recover_steps=10)
+    state0, step = swarm.make(cfg)
+
+    def run(step_index):
+        stepf = faults.poison_agent_at_step(step, step_index, agent=0)
+        _, outs = rollout(stepf, state0, cfg.steps)
+        return np.asarray(outs.trajectory), np.asarray(outs.rta_mode)
+
+    traj_clean, modes_clean = run(-1)
+    traj_pois, modes_pois = run(t_inj)
+    assert not np.any(modes_clean)              # twin is genuinely clean
+    assert int(modes_pois[t_inj]) == core.RUNG_SCRUB
+    # all OTHER agents: bit-equal through the injection step
+    np.testing.assert_array_equal(traj_pois[:t_inj + 1, 1:],
+                                  traj_clean[:t_inj + 1, 1:])
+    # the scrubbed lane itself: restored to the exact pre-fault row
+    np.testing.assert_array_equal(traj_pois[t_inj, 0],
+                                  traj_clean[t_inj, 0])
+    # and the whole run stays finite for everyone
+    assert np.all(np.isfinite(traj_pois))
+
+
+# --------------------------------------------------------- watchdog ----
+
+def _beat(sink, step, **values):
+    values.setdefault("min_pairwise_distance", 0.5)
+    sink.heartbeat(step, values)
+
+
+def test_watchdog_absorbed_alerts_downgrade(tmp_path):
+    sink = TelemetrySink(str(tmp_path / "obs"))
+    wd = Watchdog(sink, residual_threshold=1e-2, infeasible_patience=2)
+    _beat(sink, 0, certificate_residual=5.0, rta_mode=2.0)
+    _beat(sink, 1, infeasible_count=3.0, rta_mode=1.0)
+    _beat(sink, 2, infeasible_count=3.0, rta_mode=1.0)
+    wd.stop()
+    sink.close()
+    kinds = {a.kind: a for a in wd.alerts}
+    blow = kinds["certificate_blowup"]
+    assert blow.severity == "warning" and blow.rta_mode == 2.0
+    assert "absorbed by RTA rung 2" in blow.detail
+    infeas = kinds["sustained_infeasibility"]
+    assert infeas.severity == "warning" and infeas.rta_mode == 1.0
+    # the alert events carry severity + rta_mode on the stream too
+    events = [json.loads(line) for line in
+              open(os.path.join(sink.run_dir, "events.jsonl"))]
+    alerts = [e for e in events if e.get("event") == "alert"]
+    assert all(e["severity"] == "warning" for e in alerts)
+    assert alerts[0]["rta_mode"] == 2.0
+
+
+def test_watchdog_unabsorbed_stays_critical(tmp_path):
+    sink = TelemetrySink(str(tmp_path / "obs"))
+    wd = Watchdog(sink, residual_threshold=1e-2)
+    _beat(sink, 0, certificate_residual=5.0)               # no RTA channel
+    _beat(sink, 1, certificate_residual=5e-3)              # re-arm
+    _beat(sink, 2, certificate_residual=5.0,
+          rta_mode=float("nan"))                           # poisoned gauge
+    wd.stop()
+    sink.close()
+    blows = [a for a in wd.alerts if a.kind == "certificate_blowup"]
+    assert len(blows) == 2
+    assert all(a.severity == "critical" for a in blows)
+    # the NaN gauge rides along for forensics but never downgrades
+    assert blows[1].rta_mode != blows[1].rta_mode
+
+
+def test_watchdog_nan_alert_always_critical(tmp_path):
+    sink = TelemetrySink(str(tmp_path / "obs"))
+    wd = Watchdog(sink)
+    _beat(sink, 0, min_pairwise_distance=float("nan"), rta_mode=3.0)
+    wd.stop()
+    sink.close()
+    (alert,) = [a for a in wd.alerts if a.kind == "nan"]
+    # a non-finite value ON THE STREAM escaped the ladder
+    assert alert.severity == "critical" and alert.rta_mode == 3.0
+
+
+# ------------------------------------------------------ serve rescue ----
+
+def test_serve_rta_rescue_degrades_instead_of_failing():
+    from cbf_tpu.obs.trace import Tracer
+    from cbf_tpu.serve import FaultPolicy, ServeEngine
+
+    def cfg(seed=0, **kw):
+        kw.setdefault("n", 10)
+        kw.setdefault("steps", 8)
+        kw.setdefault("gating", "jnp")
+        return swarm.Config(seed=seed, **kw)
+
+    class Sink:
+        def __init__(self):
+            self.events = []
+
+        def event(self, t, p):
+            self.events.append((t, dict(p)))
+
+    sink = Sink()
+    eng = ServeEngine(max_batch=4, bucket_sizes=(16,), horizon_quantum=8,
+                      telemetry=sink, tracer=Tracer(enabled=False),
+                      fault_policy=FaultPolicy(rta_fallback=True))
+    cfgs = [cfg(seed=i) for i in range(3)]
+    cfgs[1] = faults.poison_config(cfgs[1])
+    results = eng.run(cfgs)                    # nothing raises
+    assert [r.rta_engaged for r in results] == [False, True, False]
+    assert np.all(np.isfinite(np.asarray(results[1].final_state.x)))
+    assert eng.stats["nonfinite"] == 1
+    assert eng.stats["rta_rescued"] == 1
+    assert eng.stats["failed"] == 0
+    retries = [p for t, p in sink.events if t == "serve.retry"]
+    assert any(p.get("action") == "rta_rescue" for p in retries)
+    requests = [p for t, p in sink.events if t == "request"]
+    assert sorted(p["rta_engaged"] for p in requests) == [0, 0, 1]
+
+
+def test_serve_rescue_off_by_default():
+    from cbf_tpu.obs.trace import Tracer
+    from cbf_tpu.serve import NonFiniteResult, ServeEngine
+
+    eng = ServeEngine(max_batch=4, bucket_sizes=(16,), horizon_quantum=8,
+                      tracer=Tracer(enabled=False))
+    bad = faults.poison_config(
+        swarm.Config(n=10, steps=8, gating="jnp"))
+    with pytest.raises(NonFiniteResult):
+        eng.run([bad])
+    assert eng.stats["rta_rescued"] == 0
+
+
+# -------------------------------------------------- verify property ----
+
+def test_rta_soundness_margin_series():
+    class Outs:
+        pass
+
+    o = Outs()
+    o.rta_mode = np.array([0, 0, 2, 2, 0])
+    o.min_pairwise_distance = np.array([0.5, 0.5, 0.20, 0.10, 0.5])
+    th = PropertyThresholds(separation_floor=0.13)
+    s = properties.margin_series_np(th, o, prop="rta_soundness")
+    # engaged steps carry the real margin, nominal steps are vacuous
+    assert np.isinf(s[0]) and np.isinf(s[-1])
+    np.testing.assert_allclose(s[2], 0.20 - 0.13, atol=1e-9)
+    assert s[3] < 0                             # floor broken WHILE engaged
+    # rta_floor overrides the shared separation floor (the CLI's
+    # per-property vacuation lever)
+    th2 = PropertyThresholds(separation_floor=0.13, rta_floor=0.05)
+    s2 = properties.margin_series_np(th2, o, prop="rta_soundness")
+    assert s2[3] > 0
+
+
+def test_rta_soundness_vacuous_and_np_parity():
+    # rta off: the channel is () and the margin is vacuous +inf
+    cfg = swarm.Config(n=12, steps=40, record_trajectory=False)
+    final, outs = _rollout(cfg)
+    th = PropertyThresholds(separation_floor=0.13)
+    m = properties.rollout_margins(th, outs, final.x)
+    i = PROPERTY_NAMES.index("rta_soundness")
+    assert np.isinf(np.asarray(m)[i])
+    # engaged rollout: the compiled margin == the post-hoc NumPy twin
+    cfg = dataclasses.replace(cfg, rta=True, rta_recover_steps=10)
+    final, outs = _rollout(
+        cfg, lambda s: faults.poison_agent_at_step(s, 15, agent=0))
+    m = np.asarray(properties.rollout_margins(th, outs, final.x),
+                   np.float64)
+    m_np = properties.rollout_margins_np(th, outs, np.asarray(final.x))
+    assert np.isfinite(m[i])                    # it engaged
+    np.testing.assert_allclose(m[i], m_np["rta_soundness"], atol=1e-6)
+
+
+def test_hybrid_survives_budget_that_kills_weakened_filter():
+    """The enrollment pin, both directions: the hybrid (default filter +
+    ladder) survives a falsification budget, and arming RTA does NOT
+    hide a genuinely unsafe (dmin-weakened) filter from the falsifier —
+    the ladder absorbs solver failures, not bad safety margins."""
+    from cbf_tpu.core.filter import CBFParams
+
+    base = swarm.Config(n=16, steps=140, k_neighbors=4, gating="jnp",
+                        rta=True, rta_recover_steps=10)
+    a = search.make_adapter("swarm", base)
+    r = search.random_search(a, SearchSettings(budget=8, batch=4, seed=0))
+    assert not r.found, r
+    weak = CBFParams(max_speed=15.0, k=0.0, dmin=0.16)
+    a = search.make_adapter(
+        "swarm", dataclasses.replace(base, steps=250), cbf=weak)
+    r = search.random_search(a, SearchSettings(budget=16, batch=8, seed=0))
+    assert r.found and r.property == "separation", r
+
+
+# ----------------------------------------------------------- AUD005 ----
+
+def test_aud005_parse_durations_sums_phases():
+    text = """
+12.00s call tests/test_a.py::test_x
+ 0.50s setup tests/test_a.py::test_x
+ 3.00s call tests/test_b.py::test_y
+== 2 passed in 15.5s ==
+"""
+    durations = parse_durations(text)
+    assert durations[0] == ("tests/test_a.py::test_x", 12.5)
+    assert durations[1] == ("tests/test_b.py::test_y", 3.0)
+
+
+def test_aud005_suggest_demotions_greedy():
+    durations = [("slowest", 300.0), ("mid", 200.0), ("fast", 1.0)]
+    # under the watermark: nothing to demote
+    assert suggest_demotions(durations, total_s=500.0,
+                             watermark_s=800.0) == []
+    # over: slowest-first until projected <= 0.9 * watermark
+    out = suggest_demotions(durations, total_s=900.0, watermark_s=800.0)
+    assert out == [("slowest", 300.0)]          # 900-300=600 <= 720
+    out = suggest_demotions(durations, total_s=1200.0, watermark_s=800.0)
+    assert [t for t, _ in out] == ["slowest", "mid"]  # 1200-500=700 <= 720
+
+
+@pytest.mark.slow
+def test_aud005_measured_audit_passes():
+    """The measured end-to-end audit: the tier-1 suite fits its wall
+    budget (slow-marked — it re-runs tier 1 as a subprocess)."""
+    from scripts.tier1_budget_audit import run_audit
+
+    verdict = run_audit()
+    assert verdict["ok"], verdict
+
+
+# ---------------------------------------------------------- CLI/docs ----
+
+def test_cli_run_rta_emits_summary(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "cbf_tpu", "run", "swarm", "--rta",
+         "--steps", "20", "--set", "n=8",
+         "--telemetry-dir", str(tmp_path / "t")],
+        cwd=ROOT, capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, out.stderr
+    record = json.loads(out.stdout.splitlines()[-1])
+    assert record["rta"] == {"engagements": 0, "recoveries": 0,
+                             "peak_rung": 0, "engaged_steps": 0}
+
+
+def test_rta_documented():
+    """docs/API.md 'Runtime assurance' stays in lockstep with the code
+    (AUD001 additionally pins the rta.* event tables and heartbeat
+    fields both ways)."""
+    with open(os.path.join(ROOT, "docs", "API.md")) as fh:
+        text = fh.read()
+    assert "## Runtime assurance" in text
+    for needle in ("BIT_INFEASIBLE", "BIT_CERT_RESIDUAL",
+                   "BIT_CARRY_RESET", "BIT_ACTUATION_DEFICIT",
+                   "BIT_STATE_NONFINITE", "BIT_CONTROL_NONFINITE",
+                   "RUNG_RESOLVE", "RUNG_BACKUP", "RUNG_SCRUB",
+                   "rta_recover_steps", "rta_residual_gate",
+                   "rta_deficit_gate", "rta_boost_budget",
+                   "backup_control", "rta_soundness", "rta_floor",
+                   "rta_fallback", "rta_engaged", "rta_rescue",
+                   "`rta.engage`", "`rta.recover`", "`rta_mode`",
+                   "`certificate_carry_resets`", "teleport_clump_at_step",
+                   "residual_blowup_at_step", "poison_agent_at_step",
+                   "BENCH_RTA", "--mode rta", "--rta", "AUD005",
+                   "tier1_budget_audit"):
+        assert needle in text, \
+            f"docs/API.md Runtime assurance: missing {needle!r}"

@@ -125,6 +125,11 @@ def test_binding_pair_radius_tracks_params():
         CertificateParams(barrier_gain=1.0)) > base
 
 
+# slow: ~11 s; dropped-count plumbing stays tier-1 via the partition-
+# parity assertion in test_certificate_ensemble_sp_sharded_matches_dp_only
+# (equal certificate_dropped sums across modes) — this is the semantic
+# soak (small k truncates AND still converges, default k does not).
+@pytest.mark.slow
 def test_certificate_dropped_count_surfaced():
     """A too-small certificate_k at packed density must show up in
     StepOutputs.certificate_dropped_count — the sparse backend's truncation
@@ -401,6 +406,11 @@ def test_certificate_ensemble_partitioned_matches_replicate_hatch():
             == int(np.asarray(mets_r.certificate_dropped).sum()))
 
 
+# slow: ~10 s; jnp/pallas neighbor-backend value agreement stays tier-1
+# in test_sparse_neighbor_backends_agree_with_brute_force — this is the
+# at-scale (N=1024) reverse-mode AD bar, which lives in the slow tier
+# like its training twin test_two_layer_training_descends_at_n512.
+@pytest.mark.slow
 def test_certificate_pallas_backend_gradients_at_n1024():
     """VERDICT r4 item 4's bar: the trainer-facing sparse certificate runs
     neighbor_backend="pallas" at N >= 1024 under reverse-mode AD (the
@@ -582,20 +592,29 @@ def test_certificate_rebuild_skin_rejections():
             make_mesh(1, 1))
 
 
-def test_certificate_budget_knob_guards():
-    """The budget knobs follow the honored-or-rejected contract on every
-    path: rejected without certificate / on the dense backend; honored
-    identically by BOTH ensemble partition modes (the partitioned and
-    replicated solves must never silently run different budgets)."""
-    from cbf_tpu.parallel import make_mesh
-    from cbf_tpu.parallel.ensemble import sharded_swarm_rollout
-
+def test_certificate_budget_knob_rejected_paths():
+    """The budget knobs' rejected half of the honored-or-rejected
+    contract: refused without certificate / on the dense backend."""
     with pytest.raises(ValueError, match="certificate=True"):
         swarm.make(swarm.Config(n=64, certificate_iters=50))
     with pytest.raises(ValueError, match="SPARSE"):
         swarm.make(swarm.Config(n=64, certificate=True,
                                 certificate_backend="dense",
                                 certificate_cg_iters=6))
+
+
+# slow: ~15 s; the rejected-path guards stay tier-1 above, budgets
+# honored under the residual gate stays tier-1 in
+# test_certificate_budget_knobs_converge_under_gate, and partitioned-vs-
+# replicated ensemble parity stays tier-1 in
+# test_certificate_ensemble_sp_sharded_matches_dp_only.
+@pytest.mark.slow
+def test_certificate_budget_knob_guards():
+    """The budget knobs' honored half: honored identically by BOTH
+    ensemble partition modes (the partitioned and replicated solves must
+    never silently run different budgets)."""
+    from cbf_tpu.parallel import make_mesh
+    from cbf_tpu.parallel.ensemble import sharded_swarm_rollout
 
     base = dict(n=256, steps=10, certificate=True,
                 certificate_backend="sparse", certificate_iters=50,
