@@ -83,7 +83,11 @@ Modes / env knobs:
   BENCH_TELEMETRY=<dir> — stream in-flight telemetry (cbf_tpu.obs:
     manifest + JSONL heartbeats, watchdog alerts) into a fresh run
     directory under <dir>; tail it live with
-    `python -m cbf_tpu obs tail <dir> --latest --follow`.
+    `python -m cbf_tpu obs tail <dir> --latest --follow` or watch the
+    metrics surface with `python -m cbf_tpu obs top <dir> --latest
+    --follow` (the run dir also gets metrics.prom/metrics.json at
+    BENCH_METRICS_EVERY (2.0) seconds, and an armed FlightRecorder
+    drops incident capsules under <run>/capsules on watchdog alerts).
     BENCH_TELEMETRY_EVERY (50) sets the sampling interval. The measured
     wall INCLUDES the tap (budgeted <= 3% — docs/BENCH_LOG.md Round 7);
     like profiled runs, telemetry runs are labeled in the record and
@@ -496,8 +500,18 @@ def _telemetry_sink(mode: str, cfg=None):
     sink = obs.TelemetrySink(run_dir, manifest=obs.build_manifest(
         cfg, extra={"bench_mode": mode, "bench_knobs": knobs}))
     watchdog = obs.Watchdog(sink)   # event-driven alerts; stalls are the
-    # reader's job here (obs tail --stall-timeout / tpu_watch.sh) — the
-    # bench child's own clock already enforces the attempt timeout.
+    # reader's job here (obs top/tail --stall-timeout / tpu_watch.sh) —
+    # the bench child's own clock already enforces the attempt timeout.
+    # Live metrics surface + armed incident recorder: `obs top` watches
+    # metrics.json freshness (its stall detector), and any watchdog
+    # alert during the run drops a replayable capsule next to the
+    # stream. Stashed on the sink so _finish_telemetry can close them.
+    sink._bench_exporter = obs.MetricsExporter(
+        sink.registry, run_dir,
+        every_s=_env_float("BENCH_METRICS_EVERY", 2.0)).start()
+    sink._bench_flight = obs.FlightRecorder(
+        os.path.join(run_dir, "capsules"),
+        registry=sink.registry).attach(sink)
     print(f"bench: telemetry -> {run_dir} "
           f"(every {_env_int('BENCH_TELEMETRY_EVERY', 50)} steps)",
           file=sys.stderr)
@@ -514,6 +528,15 @@ def _finish_telemetry(sink, watchdog, result: dict, run_dir) -> None:
     if "value" in result:
         summary["rate"] = result["value"]
     sink.summary(summary)
+    flight = getattr(sink, "_bench_flight", None)
+    if flight is not None:
+        flight.detach()
+        if flight.capsules:
+            result["telemetry_capsules"] = [
+                os.path.basename(p) for p in flight.capsules]
+    exporter = getattr(sink, "_bench_exporter", None)
+    if exporter is not None:
+        exporter.stop()       # final flush: metrics.prom matches the end
     sink.close()
     result["telemetry"] = run_dir
     result["telemetry_heartbeats"] = sink.heartbeat_count
@@ -1303,11 +1326,13 @@ def _child_chaos(steps: int) -> dict:
     counters — the number the fault-tolerance conversation needs is the
     goodput RETENTION ratio under faults, not peak throughput.
 
-    Two hard gates: every request must RESOLVE (completed + errors ==
-    requests — the zero-hang invariant), and no healthy request may be
+    Three hard gates: every request must RESOLVE (completed + errors ==
+    requests — the zero-hang invariant), no healthy request may be
     lost to a neighbor's fault (errors <= poisoned + shed + deadline-
-    expired). Safety-gated over the healthy completions like every
-    serve record."""
+    expired), and the armed FlightRecorder must drop a readable
+    incident capsule for every terminal fault class injected (zero
+    write failures; idle through the fault-free leg). Safety-gated over
+    the healthy completions like every serve record."""
     import jax
     import numpy as np   # noqa: F401  (parity with sibling modes)
 
@@ -1336,8 +1361,18 @@ def _child_chaos(steps: int) -> dict:
 
     spec = LoadSpec(rps=rps, duration_s=duration, seed=seed, n_min=n_min,
                     n_max=n_max, pareto_alpha=alpha)
+    # Armed flight recorder across both legs: the fault-free leg must
+    # trip nothing, and the chaos leg must drop one well-formed capsule
+    # per terminal fault class it injects (zero write failures) — the
+    # incident plumbing is under test here, so it writes to a tempdir.
+    from cbf_tpu import obs
+    flight_root = tempfile.mkdtemp(prefix="bench_chaos_flight_")
+    sink = obs.TelemetrySink(os.path.join(flight_root, "telemetry"))
+    flight = obs.FlightRecorder(os.path.join(flight_root, "capsules"),
+                                registry=sink.registry).attach(sink)
     engine = ServeEngine(max_batch=max_batch, flush_deadline_s=flush,
-                         fault_policy=FaultPolicy())
+                         fault_policy=FaultPolicy(), telemetry=sink,
+                         flight=flight)
     schedule = build_schedule(spec)
     print(f"bench: chaos rps={rps} duration={duration}s "
           f"requests={len(schedule)} poison_every={poison_every} "
@@ -1350,6 +1385,10 @@ def _child_chaos(steps: int) -> dict:
     if base["errors"]:
         return {"error": f"fault-free leg: {base['errors']}/"
                          f"{base['requests']} requests failed",
+                "retryable": False}
+    if flight.capsules:
+        return {"error": f"fault-free leg tripped {len(flight.capsules)} "
+                         f"flight capsules — armed means idle",
                 "retryable": False}
     base_stats = dict(engine.stats)
 
@@ -1387,6 +1426,31 @@ def _child_chaos(steps: int) -> dict:
     if err:
         return {"error": err, "retryable": False}
 
+    # Incident-capsule gate: every injected fault class that produced a
+    # terminal fault must have dropped a capsule (transient exec faults
+    # and latency spikes recover inside the retry budget by design —
+    # recovered is not an incident), and no capsule write may fail.
+    flight.detach()
+    sink.close()
+    capsule_reasons: set = set()
+    for p in flight.capsules:
+        try:
+            capsule_reasons.add(obs.read_capsule(p)["reason"])
+        except (OSError, ValueError, KeyError):
+            capsule_reasons.add("<unreadable>")
+    expected_reasons = set()
+    if delta["nonfinite"] > 0:
+        expected_reasons.add("serve.nonfinite")
+    if delta["quarantined"] > 0:
+        expected_reasons.add("serve.quarantine")
+    missing = expected_reasons - capsule_reasons
+    if missing or "<unreadable>" in capsule_reasons \
+            or flight.write_failures:
+        return {"error": f"flight capsule gate: missing={sorted(missing)} "
+                         f"got={sorted(capsule_reasons)} "
+                         f"write_failures={flight.write_failures}",
+                "retryable": False}
+
     # achieved_rps is already goodput: completed (healthy only) / wall.
     base_goodput = base["achieved_rps"]
     chaos_goodput = chaos["achieved_rps"]
@@ -1414,6 +1478,8 @@ def _child_chaos(steps: int) -> dict:
         "goodput_retention": round(chaos_goodput / base_goodput, 3)
         if base_goodput else 0,
         "fault_counters": delta,
+        "flight_capsules": sorted(capsule_reasons),
+        "flight_write_failures": flight.write_failures,
         "errors_by_type": chaos.get("errors_by_type", {}),
         "buckets": engine.manifest_extra()["serve"]["buckets"],
         "cache_dir": engine.cache_dir,

@@ -371,6 +371,215 @@ def cmd_obs_summary(args) -> int:
     return 0 if summary.get("heartbeats") else 1
 
 
+def _resolve_metrics_dir(path: str, latest: bool) -> str:
+    """``--latest``: treat ``path`` as a root holding metrics directories
+    and pick the one with the newest metrics.json (the directory itself
+    also counts — a root that IS a metrics dir resolves to itself)."""
+    from cbf_tpu.obs import export as obs_export
+
+    if not latest:
+        return path
+    candidates = []
+    if os.path.isdir(path):
+        for d in [os.path.join(path, n) for n in sorted(os.listdir(path))
+                  ] + [path]:
+            m = os.path.join(d, obs_export.JSON_FILENAME)
+            if os.path.isfile(m):
+                candidates.append((os.path.getmtime(m), d))
+    if not candidates:
+        raise FileNotFoundError(
+            f"no {obs_export.JSON_FILENAME} under {path}")
+    return max(candidates)[1]
+
+
+def _render_top(doc: dict) -> str:
+    """One metrics.json snapshot as an aligned terminal table."""
+    from cbf_tpu.obs.export import split_bucket
+
+    lines = []
+    extra = doc.get("extra") or {}
+    for k in sorted(extra):
+        lines.append(f"{k}: {json.dumps(extra[k], sort_keys=True)}")
+    rows = []
+    for name, snap in sorted((doc.get("metrics") or {}).items()):
+        base, bucket = split_bucket(name)
+        kind = snap.get("type", "?")
+        if kind == "counter":
+            val = f"total={snap.get('total')}"
+        elif kind == "gauge":
+            val = (f"last={snap.get('last')} min={snap.get('min')} "
+                   f"max={snap.get('max')}")
+        else:
+            val = (f"p50={snap.get('p50')} p95={snap.get('p95')} "
+                   f"p99={snap.get('p99')} n={snap.get('samples')}")
+        rows.append((base, bucket or "-", kind, val))
+    w = max((len(r[0]) for r in rows), default=1)
+    wb = max((len(r[1]) for r in rows), default=1)
+    for base, bucket, kind, val in rows:
+        lines.append(f"{base:<{w}}  {bucket:<{wb}}  {kind:<9}  {val}")
+    return "\n".join(lines)
+
+
+def cmd_obs_top(args) -> int:
+    """Live terminal view over the metrics surface: renders the
+    metrics.json twin that ``MetricsExporter`` (serve/loadgen
+    ``--metrics-dir``) rewrites atomically. --follow re-renders at
+    --every cadence; --stall-timeout turns a metrics file that stops
+    being rewritten into a synthetic stall alert and exit 3 (the
+    tpu_watch.sh contract, mirroring ``obs tail``)."""
+    import time as _time
+
+    from cbf_tpu.obs import export as obs_export
+
+    try:
+        mdir = _resolve_metrics_dir(args.run_dir, args.latest)
+    except FileNotFoundError as e:
+        print(f"obs top: {e}", file=sys.stderr)
+        return 2
+    path = os.path.join(mdir, obs_export.JSON_FILENAME)
+    t_start = _time.time()
+    while True:
+        if not os.path.isfile(path):
+            if not args.follow:
+                print(f"obs top: no {obs_export.JSON_FILENAME} in {mdir}",
+                      file=sys.stderr)
+                return 2
+            # --follow waits for the exporter's first write; a bounded
+            # wait (--stall-timeout) that expires is the same stall.
+            if args.stall_timeout is not None and \
+                    _time.time() - t_start > args.stall_timeout:
+                print(json.dumps({
+                    "event": "alert", "kind": "stall",
+                    "detail": f"{path} never appeared in "
+                              f"{args.stall_timeout}s"}), flush=True)
+                return 3
+            _time.sleep(min(args.every, 1.0))
+            continue
+        age = _time.time() - os.path.getmtime(path)
+        if args.stall_timeout is not None and age > args.stall_timeout:
+            print(json.dumps({
+                "event": "alert", "kind": "stall",
+                "detail": f"{path} not rewritten for {age:.1f}s "
+                          f"(> {args.stall_timeout}s)"}), flush=True)
+            return 3
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except ValueError:
+            doc = None                     # replaced mid-read: next tick
+        if doc is not None:
+            print(f"== {path}  age={age:.1f}s ==", flush=True)
+            print(_render_top(doc), flush=True)
+        if not args.follow:
+            return 0
+        _time.sleep(args.every)
+
+
+def _resolve_capsule_dir(path: str, latest: bool) -> str:
+    """``--latest``: treat ``path`` as a root (a flight recorder's
+    out_dir) and pick the newest capsule-* directory by manifest
+    mtime."""
+    from cbf_tpu.obs import flight as obs_flight
+
+    if not latest:
+        return path
+    candidates = []
+    if os.path.isdir(path):
+        for d in [os.path.join(path, n) for n in sorted(os.listdir(path))
+                  ] + [path]:
+            m = os.path.join(d, obs_flight.CAPSULE_FILENAME)
+            if os.path.isfile(m):
+                candidates.append((os.path.getmtime(m), d))
+    if not candidates:
+        raise FileNotFoundError(
+            f"no capsule ({obs_flight.CAPSULE_FILENAME}) under {path}")
+    return max(candidates)[1]
+
+
+def _replay_stanza(stanza: dict) -> dict:
+    """Re-run one captured request stanza standalone: rebuild the config
+    via the verify-corpus loader, run its rollout once, and judge the
+    outcome — ``violates`` when the run goes non-finite or agents
+    collide (min pairwise distance <= 0), ``safe`` otherwise."""
+    import importlib
+
+    import numpy as np
+
+    from cbf_tpu.rollout.engine import rollout
+    from cbf_tpu.verify import corpus
+
+    scenario = stanza.get("scenario", "swarm")
+    cfg = corpus.rebuild_config(scenario, stanza.get("overrides", {}))
+    module = importlib.import_module(f"cbf_tpu.scenarios.{scenario}")
+    state0, step = module.make(cfg)
+    steps = getattr(cfg, "steps", None) or getattr(cfg, "iterations")
+    final, outs = rollout(step, state0, int(steps))
+    import jax
+
+    finite = all(bool(np.all(np.isfinite(np.asarray(leaf))))
+                 for leaf in jax.tree.leaves(final))
+    mpd = float(np.min(np.asarray(outs.min_pairwise_distance)))
+    finite = finite and bool(np.isfinite(mpd))
+    violates = (not finite) or mpd <= 0.0
+    return {"scenario": scenario, "steps": int(steps),
+            "finite": finite,
+            "min_pairwise_distance": (round(mpd, 6)
+                                      if np.isfinite(mpd) else None),
+            "outcome": "violates" if violates else "safe"}
+
+
+def cmd_obs_incident(args) -> int:
+    """Summarize one incident capsule directory (``--latest``: the
+    newest capsule under a recorder root). ``--replay`` re-runs the
+    captured offending request through a standalone rollout and exits 0
+    iff the observed outcome matches the stanza's ``expect`` (1 on
+    mismatch, 2 when the capsule carries no request.json)."""
+    from cbf_tpu.obs import flight as obs_flight
+
+    cap_dir = args.capsule_dir
+    try:
+        cap_dir = _resolve_capsule_dir(args.capsule_dir, args.latest)
+        doc = obs_flight.read_capsule(cap_dir)
+    except FileNotFoundError as e:
+        print(f"obs incident: {e}", file=sys.stderr)
+        return 2
+    except ValueError as e:
+        print(f"obs incident: {cap_dir}: corrupt capsule ({e})",
+              file=sys.stderr)
+        return 2
+    summary = {
+        "capsule": os.path.abspath(cap_dir),
+        "flight_schema": doc.get("flight_schema"),
+        "reason": doc.get("reason"),
+        "detail": doc.get("detail"),
+        "t_wall": doc.get("t_wall"),
+        "environment": doc.get("environment"),
+        "ring_events": doc.get("ring_events"),
+        "ring_tail": [e.get("event") for e in doc.get("ring", [])[-8:]],
+        "trigger_event": (doc.get("trigger_event") or {}).get("event"),
+        "recent_requests": len(doc.get("recent_requests") or []),
+        "has_request": doc.get("has_request"),
+    }
+    if args.replay:
+        request = doc.get("request")
+        if request is None:
+            print(f"obs incident: {cap_dir} has no "
+                  f"{obs_flight.REQUEST_FILENAME} to replay",
+                  file=sys.stderr)
+            return 2
+        replay = _replay_stanza(request)
+        replay["expect"] = request.get("expect", "violates")
+        replay["matches_expect"] = replay["outcome"] == replay["expect"]
+        summary["replay"] = replay
+        print(json.dumps(summary, indent=None if args.json else 2))
+        return 0 if replay["matches_expect"] else 1
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(json.dumps(summary, indent=2))
+    return 0
+
+
 def _load_requests(path: str):
     """Parse a serve request file into swarm Configs.
 
@@ -502,15 +711,35 @@ def cmd_serve(args) -> int:
         cfgs = _load_requests(args.requests)
 
     sink = None
-    if args.telemetry_dir:
+    if args.telemetry_dir or args.metrics_dir:
         from cbf_tpu import obs
 
-        sink = obs.TelemetrySink(args.telemetry_dir)
+        # --metrics-dir alone still needs a populated registry: the
+        # sink doubles as the run directory in that case.
+        sink = obs.TelemetrySink(args.telemetry_dir or args.metrics_dir)
+    cost_model = flight = None
+    if sink is not None:
+        from cbf_tpu.obs import flight as obs_flight
+        from cbf_tpu.obs import resource as obs_resource
+
+        cost_model = obs_resource.CostModel(os.path.join(
+            sink.run_dir, obs_resource.COSTMODEL_FILENAME))
+        flight = obs_flight.FlightRecorder(
+            os.path.join(sink.run_dir, "capsules"),
+            cost_model=cost_model).attach(sink)
     engine = ServeEngine(max_batch=args.max_batch,
                          flush_deadline_s=args.flush_deadline,
                          cache_dir=args.cache_dir, telemetry=sink,
                          fault_policy=_fault_policy_from(args),
-                         journal=args.journal)
+                         journal=args.journal, cost_model=cost_model,
+                         flight=flight)
+    exporter = None
+    if args.metrics_dir:
+        from cbf_tpu.obs import export as obs_export
+
+        exporter = obs_export.MetricsExporter(
+            sink.registry, args.metrics_dir, every_s=args.metrics_every,
+            extra_fn=lambda: {"stats": dict(engine.stats)}).start()
     prewarm_s = None
     if args.prewarm or args.prewarm_only:
         prewarm_s = engine.prewarm(cfgs)
@@ -533,6 +762,9 @@ def cmd_serve(args) -> int:
         record["buckets"] = engine.manifest_extra()["serve"]["buckets"]
     if args.prewarm_only:
         record["stats"] = engine.stats
+        if exporter is not None:
+            exporter.stop()
+            record["metrics_dir"] = os.path.abspath(args.metrics_dir)
         print(json.dumps(record))
         if sink is not None:
             sink.close()
@@ -556,6 +788,11 @@ def cmd_serve(args) -> int:
 
             _signal.signal(_signal.SIGTERM, prev_term)
     wall = _time.perf_counter() - t0
+    if cost_model is not None:
+        try:                     # offline run() never stop()s the engine
+            cost_model.save()
+        except OSError:
+            pass
     lat = sorted(r.latency_s for r in results)
     qwait = sorted(r.queue_wait_s for r in results)
     qp_steps = sum(r.n * r.steps for r in results)
@@ -581,6 +818,11 @@ def cmd_serve(args) -> int:
             "infeasible_count": int(np.sum(r.outputs.infeasible_count)),
         } for r in results],
     })
+    if exporter is not None:
+        exporter.stop()
+        record["metrics_dir"] = os.path.abspath(args.metrics_dir)
+    if flight is not None and flight.capsules:
+        record["capsules"] = list(flight.capsules)
     if sink is not None:
         sink.summary({"requests_served": len(results)})
         sink.close()
@@ -618,14 +860,32 @@ def cmd_loadgen(args) -> int:
                     pareto_alpha=args.pareto_alpha,
                     steps_choices=steps_choices, gating=args.gating)
     sink = None
-    if args.telemetry_dir:
+    if args.telemetry_dir or args.metrics_dir:
         from cbf_tpu import obs
 
-        sink = obs.TelemetrySink(args.telemetry_dir)
+        sink = obs.TelemetrySink(args.telemetry_dir or args.metrics_dir)
+    cost_model = flight = None
+    if sink is not None:
+        from cbf_tpu.obs import flight as obs_flight
+        from cbf_tpu.obs import resource as obs_resource
+
+        cost_model = obs_resource.CostModel(os.path.join(
+            sink.run_dir, obs_resource.COSTMODEL_FILENAME))
+        flight = obs_flight.FlightRecorder(
+            os.path.join(sink.run_dir, "capsules"),
+            cost_model=cost_model).attach(sink)
     engine = ServeEngine(max_batch=args.max_batch,
                          flush_deadline_s=args.flush_deadline,
                          cache_dir=args.cache_dir, telemetry=sink,
-                         fault_policy=_fault_policy_from(args))
+                         fault_policy=_fault_policy_from(args),
+                         cost_model=cost_model, flight=flight)
+    exporter = None
+    if args.metrics_dir:
+        from cbf_tpu.obs import export as obs_export
+
+        exporter = obs_export.MetricsExporter(
+            sink.registry, args.metrics_dir, every_s=args.metrics_every,
+            extra_fn=lambda: {"stats": dict(engine.stats)}).start()
     schedule = build_schedule(spec)
     prewarm_s = engine.prewarm([cfg for _, cfg in schedule])
     if sink is not None:
@@ -652,6 +912,11 @@ def cmd_loadgen(args) -> int:
             args.chrome_trace)
     if args.xla_trace:
         record["xla_trace"] = args.xla_trace
+    if exporter is not None:
+        exporter.stop()
+        record["metrics_dir"] = os.path.abspath(args.metrics_dir)
+    if flight is not None and flight.capsules:
+        record["capsules"] = list(flight.capsules)
     if sink is not None:
         sink.summary({"requests_served": report["completed"]})
         sink.close()
@@ -984,6 +1249,14 @@ def main(argv=None) -> int:
                         help="write a serve run directory: manifest with "
                              "bucket/compile attribution + one 'request' "
                              "event per served request")
+    servep.add_argument("--metrics-dir", default=None,
+                        help="atomically rewrite metrics.prom (Prometheus "
+                             "text exposition) + metrics.json here at a "
+                             "fixed cadence while serving; watch with "
+                             "`obs top <dir> --follow`")
+    servep.add_argument("--metrics-every", type=float, default=2.0,
+                        help="metrics rewrite cadence in seconds "
+                             "(default 2)")
     servep.add_argument("--journal", default=None, metavar="PATH",
                         help="write-ahead request journal (docs/API.md "
                              "'Durable execution'): every accepted "
@@ -1036,6 +1309,13 @@ def main(argv=None) -> int:
     loadp.add_argument("--telemetry-dir", default=None,
                        help="write a run directory with serve.span + "
                             "request + loadgen.summary JSONL events")
+    loadp.add_argument("--metrics-dir", default=None,
+                       help="atomically rewrite metrics.prom + "
+                            "metrics.json here at a fixed cadence during "
+                            "the run; watch with `obs top <dir> --follow`")
+    loadp.add_argument("--metrics-every", type=float, default=2.0,
+                       help="metrics rewrite cadence in seconds "
+                            "(default 2)")
     loadp.add_argument("--chrome-trace", default=None,
                        help="export the request-lifecycle spans as "
                             "Chrome trace-event JSON here (load in "
@@ -1117,7 +1397,7 @@ def main(argv=None) -> int:
         .set_defaults(fn=cmd_bench)
 
     obsp = sub.add_parser("obs", help="telemetry run-dir tools (tail, "
-                                      "summary)")
+                                      "summary, top, incident)")
     obs_sub = obsp.add_subparsers(dest="obs_command", required=True)
     tailp = obs_sub.add_parser(
         "tail", help="print a run's JSONL events; -f follows live")
@@ -1138,6 +1418,36 @@ def main(argv=None) -> int:
     sump.add_argument("--latest", action="store_true",
                       help="run_dir is a root; summarize its newest run")
     sump.set_defaults(fn=cmd_obs_summary)
+    topp = obs_sub.add_parser(
+        "top", help="live terminal view over a --metrics-dir surface "
+                    "(reads the metrics.json twin of metrics.prom)")
+    topp.add_argument("run_dir")
+    topp.add_argument("--follow", "-f", action="store_true",
+                      help="keep re-rendering at --every cadence")
+    topp.add_argument("--every", type=float, default=2.0,
+                      help="re-render cadence in seconds (default 2)")
+    topp.add_argument("--stall-timeout", type=float, default=None,
+                      help="emit a synthetic stall alert and exit 3 when "
+                           "metrics.json stops being rewritten for this "
+                           "many seconds")
+    topp.add_argument("--latest", action="store_true",
+                      help="run_dir is a root; watch the directory with "
+                           "the newest metrics.json")
+    topp.set_defaults(fn=cmd_obs_top)
+    incp = obs_sub.add_parser(
+        "incident", help="summarize an incident capsule written by the "
+                         "flight recorder; --replay re-runs the captured "
+                         "request")
+    incp.add_argument("capsule_dir")
+    incp.add_argument("--latest", action="store_true",
+                      help="capsule_dir is a recorder root; pick its "
+                           "newest capsule")
+    incp.add_argument("--replay", action="store_true",
+                      help="re-run the captured request.json standalone; "
+                           "exit 0 iff the outcome matches its 'expect'")
+    incp.add_argument("--json", action="store_true",
+                      help="one-line machine-readable output")
+    incp.set_defaults(fn=cmd_obs_incident)
 
     args = p.parse_args(argv)
     return args.fn(args)

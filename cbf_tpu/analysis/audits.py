@@ -168,6 +168,16 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
             f"{rta_monitor.EMITTED_EVENT_TYPES!r} != "
             f"obs.schema.RTA_EVENT_TYPES {schema.RTA_EVENT_TYPES!r} "
             "— emitter and schema drifted")
+    # Flight-recorder event drift: the incident capsule emitter's
+    # declared emissions must match the schema's flight family exactly.
+    from cbf_tpu.obs import flight as obs_flight
+    if tuple(obs_flight.EMITTED_EVENT_TYPES) != \
+            tuple(schema.FLIGHT_EVENT_TYPES):
+        problems.append(
+            f"obs.flight.EMITTED_EVENT_TYPES "
+            f"{obs_flight.EMITTED_EVENT_TYPES!r} != "
+            f"obs.schema.FLIGHT_EVENT_TYPES {schema.FLIGHT_EVENT_TYPES!r} "
+            "— emitter and schema drifted")
     for table_name, types_name, fields, types in (
             ("SERVE_EVENT_FIELDS", "SERVE_EVENT_TYPES",
              schema.SERVE_EVENT_FIELDS, schema.SERVE_EVENT_TYPES),
@@ -176,7 +186,9 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
             ("LOADGEN_EVENT_FIELDS", "LOADGEN_EVENT_TYPES",
              schema.LOADGEN_EVENT_FIELDS, schema.LOADGEN_EVENT_TYPES),
             ("RTA_EVENT_FIELDS", "RTA_EVENT_TYPES",
-             schema.RTA_EVENT_FIELDS, schema.RTA_EVENT_TYPES)):
+             schema.RTA_EVENT_FIELDS, schema.RTA_EVENT_TYPES),
+            ("FLIGHT_EVENT_FIELDS", "FLIGHT_EVENT_TYPES",
+             schema.FLIGHT_EVENT_FIELDS, schema.FLIGHT_EVENT_TYPES)):
         for etype in fields:
             if etype not in types:
                 problems.append(
@@ -198,7 +210,7 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
     # that way is what makes this check (and grep) possible.
     import inspect
     for mod in (verify_search, serve_engine, obs_trace, serve_loadgen,
-                durable_journal, durable_rollout, rta_monitor):
+                durable_journal, durable_rollout, rta_monitor, obs_flight):
         try:
             mod_tree = ast.parse(inspect.getsource(mod))
         except (OSError, TypeError):
@@ -246,7 +258,8 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
                 ("serve", schema.SERVE_EVENT_FIELDS),
                 ("durable", schema.DURABLE_EVENT_FIELDS),
                 ("loadgen", schema.LOADGEN_EVENT_FIELDS),
-                ("rta", schema.RTA_EVENT_FIELDS)):
+                ("rta", schema.RTA_EVENT_FIELDS),
+                ("flight", schema.FLIGHT_EVENT_FIELDS)):
             for etype, fields in table.items():
                 if f"`{etype}`" not in api_text:
                     problems.append(

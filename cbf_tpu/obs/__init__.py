@@ -19,10 +19,21 @@ autopsy.
     $ python -m cbf_tpu obs tail runs/demo --follow
     $ python -m cbf_tpu obs summary runs/demo
 
+The resource observatory rides the same sink: ``obs.resource`` prices
+every compiled executable (XLA cost/memory attribution + an EWMA
+execute-time cost model, persisted to ``costmodel.json``),
+``obs.flight`` dumps a replayable incident capsule when safety
+machinery fires, and ``obs.export`` rewrites ``metrics.prom`` /
+``metrics.json`` atomically for scrapers and ``cbf_tpu obs top``.
+
 Schema: ``obs.schema`` (versioned; drift against StepOutputs/
 EnsembleMetrics is a tier-1 failure via scripts/obs_schema_audit.py).
 """
 
+from cbf_tpu.obs.export import (MetricsExporter, render_prom, split_bucket,
+                                write_metrics)
+from cbf_tpu.obs.flight import FlightRecorder, read_capsule, request_stanza
+from cbf_tpu.obs.resource import CostModel, analyze_compiled, environment
 from cbf_tpu.obs.schema import SCHEMA_VERSION, HEARTBEAT_FIELDS
 from cbf_tpu.obs.sink import (Histogram, MetricsRegistry, TelemetrySink,
                               build_manifest, read_events, read_manifest,
@@ -40,4 +51,7 @@ __all__ = [
     "LIFECYCLE_PHASES", "Span", "Tracer", "Alert",
     "Watchdog", "ALERT_KINDS", "ALERT_NAN", "ALERT_CERT_BLOWUP",
     "ALERT_INFEASIBLE", "ALERT_STALL",
+    "CostModel", "analyze_compiled", "environment",
+    "FlightRecorder", "read_capsule", "request_stanza",
+    "MetricsExporter", "render_prom", "split_bucket", "write_metrics",
 ]

@@ -1,0 +1,348 @@
+"""Per-executable resource accounting: XLA cost/memory attribution and a
+predicted-vs-measured execute-time cost model.
+
+The serve engine's upcoming continuous-batching scheduler (ROADMAP item
+2) and the spatially-sharded mega-swarm path (item 1) both need numbers
+the stack did not record before this module existed: what does one
+compiled bucket executable COST (flops, bytes accessed, peak buffer
+bytes) and how long does it actually RUN (EWMA of measured execute
+wall)? Following the resource-aware-computation framing of the
+Explicit-CBF paper (PAPERS.md), both are captured at the only honest
+place — the ``lower().compile()`` site — and persisted to a
+schema-versioned ``costmodel.json`` keyed by label + environment
+(backend, jaxlib, git SHA), so a stale model from another machine or
+commit is dropped on load rather than trusted.
+
+Three public pieces:
+
+- :func:`analyze_compiled` — normalize ``Compiled.cost_analysis()`` /
+  ``.memory_analysis()`` across jax versions into one flat dict (older
+  jax returns a LIST of cost dicts; ``CompiledMemoryStats`` has no
+  ``peak_memory_in_bytes`` on CPU jaxlib, so peak is derived as
+  argument + output + temp buffer bytes). Missing backends degrade to
+  zeros, never exceptions — accounting must not take down serving.
+- :class:`CostModel` — the per-label store. ``record_compile`` folds in
+  one compile (static attribution + compile wall), ``observe_execute``
+  returns the pre-update prediction vs the measurement and the relative
+  drift, ``fits`` answers item 1's per-chip admission question ("do n
+  agents fit?") by scaling the worst recorded per-agent peak bytes.
+- :func:`compile_and_record` — the drop-in AOT helper for call sites
+  that today do implicit ``jit`` dispatch: compiles via the AOT path,
+  records, and caches the executable under the model so repeated
+  dispatches pay zero extra compiles.
+
+Everything here is host-side and O(1) per batch; the model never touches
+device values, so accounting on/off is bit-neutral by construction.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any
+
+#: Bump when the costmodel.json layout changes incompatibly.
+RESOURCE_SCHEMA_VERSION = 1
+
+#: File name of the persisted cost model inside a run/cache directory.
+COSTMODEL_FILENAME = "costmodel.json"
+
+#: EWMA smoothing for measured execute time (0 < alpha <= 1; higher =
+#: faster adaptation, noisier prediction).
+EWMA_ALPHA = 0.3
+
+#: Bounded per-label history of recent drift observations.
+DRIFT_WINDOW = 64
+
+
+def _git_sha() -> str:
+    head = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), ".git", "HEAD")
+    try:
+        with open(head) as fh:
+            ref = fh.read().strip()
+        if ref.startswith("ref:"):
+            with open(os.path.join(os.path.dirname(head),
+                                   ref.split(None, 1)[1])) as fh:
+                return fh.read().strip()[:12]
+        return ref[:12]
+    except OSError:
+        return "unknown"
+
+
+def environment() -> dict[str, str]:
+    """The cache key half that is NOT the bucket: backend platform,
+    jaxlib version, git SHA. A loaded model whose environment differs is
+    discarded — cost numbers do not transfer across compilers."""
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform
+    except Exception:  # pragma: no cover - no backend at all
+        platform = "unknown"
+    try:
+        import jaxlib
+
+        jaxlib_version = getattr(jaxlib, "__version__", "unknown")
+    except Exception:  # pragma: no cover
+        jaxlib_version = "unknown"
+    return {"backend": platform, "jaxlib": jaxlib_version,
+            "git_sha": _git_sha()}
+
+
+def analyze_compiled(compiled) -> dict[str, int]:
+    """Flatten one jax ``Compiled``'s cost + memory analysis into
+    integer bytes/flops. Never raises: backends without a cost model
+    (or older jax shapes) degrade field-by-field to 0.
+
+    Keys: ``flops``, ``bytes_accessed``, ``transcendentals``,
+    ``argument_bytes``, ``output_bytes``, ``temp_bytes``,
+    ``alias_bytes``, ``generated_code_bytes``, ``peak_bytes``
+    (argument + output + temp — the resident set one dispatch needs).
+    """
+    out = {"flops": 0, "bytes_accessed": 0, "transcendentals": 0,
+           "argument_bytes": 0, "output_bytes": 0, "temp_bytes": 0,
+           "alias_bytes": 0, "generated_code_bytes": 0, "peak_bytes": 0}
+    try:
+        costs = compiled.cost_analysis()
+    except Exception:
+        costs = None
+    if isinstance(costs, (list, tuple)):   # older jax returns [dict]
+        costs = costs[0] if costs else {}
+    if isinstance(costs, dict):
+        for key, name in (("flops", "flops"),
+                          ("bytes accessed", "bytes_accessed"),
+                          ("transcendentals", "transcendentals")):
+            try:
+                out[name] = int(float(costs.get(key, 0)))
+            except (TypeError, ValueError):
+                pass
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        mem = None
+    if mem is not None:
+        for attr, name in (("argument_size_in_bytes", "argument_bytes"),
+                           ("output_size_in_bytes", "output_bytes"),
+                           ("temp_size_in_bytes", "temp_bytes"),
+                           ("alias_size_in_bytes", "alias_bytes"),
+                           ("generated_code_size_in_bytes",
+                            "generated_code_bytes")):
+            try:
+                out[name] = int(getattr(mem, attr, 0) or 0)
+            except (TypeError, ValueError):
+                pass
+        # jaxlib's CompiledMemoryStats has no peak field on CPU; the
+        # resident set of one dispatch is args + outputs + temps.
+        peak = int(getattr(mem, "peak_memory_in_bytes", 0) or 0)
+        out["peak_bytes"] = peak or (out["argument_bytes"]
+                                     + out["output_bytes"]
+                                     + out["temp_bytes"])
+    return out
+
+
+class CostModel:
+    """Thread-safe per-label cost store with optional JSON persistence.
+
+    One entry per label (the serve bucket label ``n16-t8-...``, a
+    rollout tag, a verify batch signature). Each entry carries the
+    static XLA attribution from :func:`analyze_compiled`, compile
+    count/wall, an EWMA of measured execute wall, and a bounded window
+    of recent prediction drift. ``path=None`` keeps the model purely
+    in-memory (tests, ephemeral engines); with a path every mutation
+    can be flushed via :meth:`save` (atomic tmp + ``os.replace``,
+    same discipline as the telemetry manifest).
+    """
+
+    def __init__(self, path: str | None = None, *,
+                 env: dict[str, str] | None = None):
+        self.path = path
+        self.env = dict(env) if env is not None else environment()
+        self.entries: dict[str, dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        self._execs: dict[Any, Any] = {}
+        if path is not None and os.path.exists(path):
+            self._load(path)
+
+    # -- persistence -------------------------------------------------------
+
+    def _load(self, path: str) -> None:
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            return                         # corrupt/partial: start fresh
+        if doc.get("resource_schema") != RESOURCE_SCHEMA_VERSION:
+            return
+        if doc.get("environment") != self.env:
+            return                         # other compiler/commit: stale
+        entries = doc.get("entries")
+        if isinstance(entries, dict):
+            self.entries = {str(k): dict(v) for k, v in entries.items()
+                            if isinstance(v, dict)}
+
+    def to_doc(self) -> dict[str, Any]:
+        with self._lock:
+            entries = {k: dict(v) for k, v in self.entries.items()}
+        return {"resource_schema": RESOURCE_SCHEMA_VERSION,
+                "environment": dict(self.env), "entries": entries}
+
+    def save(self, path: str | None = None) -> str | None:
+        """Atomically rewrite the model file (no-op without a path)."""
+        path = path or self.path
+        if path is None:
+            return None
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(self.to_doc(), fh, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+
+    # -- recording ---------------------------------------------------------
+
+    def _entry(self, label: str) -> dict[str, Any]:
+        e = self.entries.get(label)
+        if e is None:
+            e = self.entries[label] = {
+                "compiles": 0, "compile_s": 0.0, "cost": {},
+                "execute_ewma_s": None, "executes": 0, "drift_recent": []}
+        return e
+
+    def record_compile(self, label: str, compiled, compile_s: float,
+                       *, save: bool = True) -> dict[str, int]:
+        """Fold one fresh compile into the model; returns the static
+        attribution so call sites can emit it without re-analyzing."""
+        cost = analyze_compiled(compiled)
+        with self._lock:
+            e = self._entry(label)
+            e["compiles"] += 1
+            e["compile_s"] = round(e["compile_s"] + float(compile_s), 6)
+            e["cost"] = cost
+        if save:
+            try:
+                self.save()
+            except OSError:
+                pass                       # accounting never kills serving
+        return cost
+
+    def observe_execute(self, label: str, execute_s: float
+                        ) -> dict[str, Any]:
+        """Record one measured execute wall; returns the PRE-update
+        prediction (None on the label's first observation), the
+        measurement, and the relative drift |pred - meas| / meas."""
+        execute_s = float(execute_s)
+        with self._lock:
+            e = self._entry(label)
+            predicted = e["execute_ewma_s"]
+            drift = None
+            if predicted is not None and execute_s > 0:
+                drift = abs(predicted - execute_s) / execute_s
+                recent = e["drift_recent"]
+                recent.append(round(drift, 6))
+                del recent[:-DRIFT_WINDOW]
+            if predicted is None:
+                e["execute_ewma_s"] = round(execute_s, 6)
+            else:
+                e["execute_ewma_s"] = round(
+                    (1.0 - EWMA_ALPHA) * predicted
+                    + EWMA_ALPHA * execute_s, 6)
+            e["executes"] += 1
+        return {"predicted_s": predicted, "measured_s": execute_s,
+                "drift": drift}
+
+    def predict_execute(self, label: str) -> float | None:
+        with self._lock:
+            e = self.entries.get(label)
+            return None if e is None else e["execute_ewma_s"]
+
+    def cost_of(self, label: str) -> dict[str, int]:
+        with self._lock:
+            e = self.entries.get(label)
+            return dict(e["cost"]) if e else {}
+
+    def drift_summary(self) -> dict[str, float]:
+        """Per-label MEDIAN of the recent drift window — the number the
+        tier-1 warm-path gate holds under 50%."""
+        out: dict[str, float] = {}
+        with self._lock:
+            for label, e in self.entries.items():
+                recent = sorted(e.get("drift_recent") or [])
+                if recent:
+                    mid = len(recent) // 2
+                    med = (recent[mid] if len(recent) % 2
+                           else 0.5 * (recent[mid - 1] + recent[mid]))
+                    out[label] = round(med, 6)
+        return out
+
+    # -- capacity ----------------------------------------------------------
+
+    def fits(self, n: int, mesh=None, *,
+             budget_bytes: int | None = None) -> bool:
+        """Would an ``n``-agent swarm fit one chip's memory? Scales the
+        worst recorded per-agent peak bytes across entries whose label
+        encodes a bucket size (``n<k>-...``). The budget is, in order:
+        the explicit ``budget_bytes``, the first mesh device's
+        ``memory_stats()['bytes_limit']``, or — when neither is known
+        (CPU has no memory_stats) — unbounded (True): an admission
+        helper must fail open, not reject traffic it cannot price."""
+        per_agent = 0.0
+        with self._lock:
+            for label, e in self.entries.items():
+                peak = (e.get("cost") or {}).get("peak_bytes", 0)
+                if not (peak and label.startswith("n")):
+                    continue
+                digits = label[1:].split("-", 1)[0]
+                if digits.isdigit() and int(digits) > 0:
+                    per_agent = max(per_agent, peak / int(digits))
+        if per_agent <= 0:
+            return True                    # nothing priced yet: fail open
+        if budget_bytes is None:
+            devices = None
+            if mesh is not None:
+                devices = list(getattr(mesh, "devices", None).flat
+                               ) if hasattr(getattr(mesh, "devices", None),
+                                            "flat") else None
+            if devices is None:
+                try:
+                    import jax
+
+                    devices = jax.devices()
+                except Exception:
+                    devices = []
+            for dev in devices or []:
+                try:
+                    stats = dev.memory_stats() or {}
+                except Exception:
+                    stats = {}
+                limit = stats.get("bytes_limit")
+                if limit:
+                    budget_bytes = int(limit)
+                    break
+        if budget_bytes is None:
+            return True
+        return per_agent * int(n) <= budget_bytes
+
+    # -- AOT helper --------------------------------------------------------
+
+    def compile_and_record(self, label: str, jitted, args: tuple,
+                           *, cache_key=None):
+        """AOT-compile ``jitted(*args)`` once per ``cache_key`` (default:
+        the label), record the compile, and return the executable. The
+        cache lives on the model — separate from jax's implicit-jit
+        cache, so callers must dispatch the RETURNED executable to avoid
+        compiling twice."""
+        key = cache_key if cache_key is not None else label
+        with self._lock:
+            hit = self._execs.get(key)
+        if hit is not None:
+            return hit
+        t0 = time.perf_counter()
+        compiled = jitted.lower(*args).compile()
+        wall = time.perf_counter() - t0
+        self.record_compile(label, compiled, wall)
+        with self._lock:
+            self._execs[key] = compiled
+        return compiled

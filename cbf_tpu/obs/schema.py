@@ -145,7 +145,8 @@ VERIFY_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
 #: site, and every type and field must be documented in docs/API.md.
 SERVE_EVENT_TYPES: tuple[str, ...] = (
     "request", "serve.span", "serve.retry", "serve.shed",
-    "serve.quarantine", "serve.degrade", "serve.scheduler_crash")
+    "serve.quarantine", "serve.degrade", "serve.scheduler_crash",
+    "serve.cost")
 
 SERVE_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
     "request": ("request_id", "bucket", "n", "steps", "latency_s",
@@ -171,6 +172,15 @@ SERVE_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
     # state: "enter" | "exit"; steps_frac is the horizon cap in effect.
     "serve.degrade": ("state", "queue_depth", "steps_frac"),
     "serve.scheduler_crash": ("error", "resolved"),
+    # One event per successfully executed batch when the engine carries a
+    # CostModel (obs.resource): the model's pre-update execute-time
+    # prediction vs the measured wall, the relative drift between them
+    # (null on a bucket's first observation — no prediction yet), and the
+    # bucket's static XLA cost/memory attribution (flops, bytes accessed,
+    # peak buffer bytes) so a stream reader can rank buckets by cost
+    # without the costmodel.json file.
+    "serve.cost": ("bucket", "batch_fill", "execute_s", "predicted_s",
+                   "drift", "flops", "bytes_accessed", "peak_bytes"),
 }
 
 #: The durable-execution layer's events (PR 9): ``durable.journal`` is
@@ -201,10 +211,14 @@ DURABLE_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
 LOADGEN_EVENT_TYPES: tuple[str, ...] = ("loadgen.summary",)
 
 LOADGEN_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
+    # by_bucket: per-bucket-signature SLO split — {bucket label:
+    # {completed, errors, queue_wait_p50_s/p95_s/p99_s,
+    # execute_p50_s/p95_s/p99_s}} — so a knee-finding sweep can see WHICH
+    # bucket stalls, not just that one did.
     "loadgen.summary": ("seed", "offered_rps", "achieved_rps", "requests",
                         "completed", "errors", "duration_s",
                         "latency_p50_s", "latency_p95_s", "latency_p99_s",
-                        "queue_wait_p99_s", "execute_p99_s"),
+                        "queue_wait_p99_s", "execute_p99_s", "by_bucket"),
 }
 
 #: The runtime-assurance auditor's events (``cbf_tpu.rta.monitor``):
@@ -221,6 +235,23 @@ RTA_EVENT_TYPES: tuple[str, ...] = ("rta.engage", "rta.recover")
 RTA_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
     "rta.engage": ("step", "rung", "prev_rung"),
     "rta.recover": ("step", "peak_rung", "engaged_steps"),
+}
+
+#: The incident flight recorder's event (``cbf_tpu.obs.flight``):
+#: ``flight.capsule`` once per incident capsule written — the trigger
+#: reason (``watchdog.<kind>``, ``serve.nonfinite``,
+#: ``serve.scheduler_crash``, ``serve.quarantine``, ``serve.breaker``,
+#: ``rta.engage``, ``sigterm.drain``, or a caller-chosen manual reason),
+#: a one-line detail, the capsule directory path, and how many ring
+#: events the capsule preserved. Same AUD001 contract as the other
+#: tables: ``obs.flight.EMITTED_EVENT_TYPES`` must equal this tuple,
+#: the type needs a literal emit site, and every type and field must be
+#: documented in docs/API.md.
+FLIGHT_EVENT_TYPES: tuple[str, ...] = ("flight.capsule",)
+
+FLIGHT_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
+    "flight.capsule": ("reason", "detail", "capsule", "events",
+                       "trigger_event"),
 }
 
 

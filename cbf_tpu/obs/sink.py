@@ -296,6 +296,13 @@ class TelemetrySink:
         self._last_step: int | None = None
         self._last_step_wall: float | None = None
         self._manifest_compile_counts: dict = {}
+        self._manifest_doc: dict | None = None
+        # label -> obs.resource.analyze_compiled dict for every executable
+        # compiled during the run (the serve engine and the rollout AOT
+        # path report here); snapshotted into the manifest's
+        # "executables" block so capsules and bench records carry
+        # memory/cost context.
+        self._executables: dict[str, dict] = {}
         self._closed = False
         self._paused = False
         # Tap-wrapper cache: instrumented step functions keyed per
@@ -312,11 +319,41 @@ class TelemetrySink:
         manifest.setdefault("schema", schema.SCHEMA_VERSION)
         self._manifest_compile_counts = dict(
             manifest.get("compile_event_counts") or {})
+        if self._executables:
+            manifest.setdefault("executables", dict(self._executables))
+        self._manifest_doc = manifest
+        self._rewrite_manifest(manifest)
+
+    def _rewrite_manifest(self, manifest: dict) -> None:
         tmp = self.manifest_path + ".tmp"
         with open(tmp, "w") as fh:
             json.dump(manifest, fh, indent=2, default=repr)
             fh.write("\n")
         os.replace(tmp, self.manifest_path)
+
+    def record_executable(self, label: str, info: dict) -> None:
+        """Snapshot one compiled executable's cost/memory analysis
+        (``obs.resource.analyze_compiled`` shape) under ``label``. The
+        manifest on disk is atomically refreshed with the accumulated
+        ``executables`` block — compiles happen after run start, so the
+        write-once manifest would otherwise never see them. Compiles are
+        rare (bounded by the bucket ladder), so the rewrite cost is
+        negligible."""
+        with self._lock:
+            self._executables[label] = dict(info)
+            doc = self._manifest_doc
+            if doc is not None:
+                doc["executables"] = dict(self._executables)
+        if doc is not None:
+            try:
+                self._rewrite_manifest(doc)
+            except OSError:
+                pass   # accounting must never fail the run
+
+    @property
+    def executables(self) -> dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._executables.items()}
 
     def pause(self) -> None:
         """Drop heartbeats until :meth:`resume` — lets a WARMUP run drive

@@ -17,6 +17,7 @@ recorded optionally to bound memory at large N.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -75,8 +76,17 @@ class StepOutputs(NamedTuple):
     rta_mode: Any = ()
 
 
+def _abstract_sig(tree) -> tuple:
+    """Shape/dtype signature of a pytree's leaves — the part of an AOT
+    cache key that changes when the caller hands a different swarm."""
+    return tuple((tuple(getattr(x, "shape", ())),
+                  str(getattr(x, "dtype", type(x).__name__)))
+                 for x in jax.tree.leaves(tree))
+
+
 def rollout(step_fn: Callable, state0, steps: int, *, unroll: int = 1,
-            telemetry=None, telemetry_every: int = 50):
+            telemetry=None, telemetry_every: int = 50,
+            cost_model=None, cost_label: str | None = None):
     """Run ``steps`` iterations of ``step_fn`` under ``lax.scan``.
 
     ``telemetry``: an optional :class:`cbf_tpu.obs.TelemetrySink` — the
@@ -87,14 +97,34 @@ def rollout(step_fn: Callable, state0, steps: int, *, unroll: int = 1,
     compiled executable; streamed values bit-match the returned
     StepOutputs slices by construction.
 
+    ``cost_model``: an optional :class:`cbf_tpu.obs.resource.CostModel`
+    — the rollout is then AOT-compiled through
+    ``CostModel.compile_and_record`` (so XLA cost/memory attribution is
+    captured at the compile site) and the measured execute wall feeds
+    ``observe_execute`` under ``cost_label`` (default
+    ``rollout-s<steps>-u<unroll>``). The model keeps its own executable
+    cache, so repeat calls pay zero extra compiles and the implicit-jit
+    path below is never mixed with the AOT one.
+
     Returns (final_state, StepOutputs stacked over time).
     """
     if telemetry is not None:
         from cbf_tpu.obs.tap import instrument_step
 
         step_fn = instrument_step(step_fn, telemetry, every=telemetry_every)
-    return _rollout_from(step_fn, state0, jnp.zeros((), jnp.int32), steps,
-                         unroll=unroll)
+    t0 = jnp.zeros((), jnp.int32)
+    if cost_model is not None:
+        label = cost_label or f"rollout-s{steps}-u{unroll}"
+        compiled = cost_model.compile_and_record(
+            label, _rollout_from, (step_fn, state0, t0, steps, unroll),
+            cache_key=(label, step_fn, steps, unroll,
+                       _abstract_sig(state0)))
+        t_exec = time.perf_counter()
+        state, outs = compiled(state0, t0)
+        jax.block_until_ready(state)
+        cost_model.observe_execute(label, time.perf_counter() - t_exec)
+        return state, outs
+    return _rollout_from(step_fn, state0, t0, steps, unroll=unroll)
 
 
 def _rollout_body(step_fn: Callable, state, t0, steps: int, unroll: int = 1):
@@ -130,7 +160,8 @@ def rollout_chunked(step_fn: Callable, state0, steps: int, *,
                     resume: bool = True, unroll: int = 1,
                     telemetry=None, telemetry_every: int = 50,
                     donate_carry: bool | None = None,
-                    durable_hook=None):
+                    durable_hook=None,
+                    cost_model=None, cost_label: str | None = None):
     """Run a long rollout in ``chunk``-step compiled segments, checkpointing
     the state pytree at every boundary (SURVEY.md §5 checkpoint/resume —
     absent in the reference).
@@ -160,6 +191,13 @@ def rollout_chunked(step_fn: Callable, state0, steps: int, *,
     donates the buffers — donation's memory win at the cost of the async
     overlap. Pass an explicit bool to pin the choice (bench warmup must
     compile the same executable the measured configuration reuses).
+
+    ``cost_model`` / ``cost_label``: same contract as :func:`rollout` —
+    each chunk size compiles through ``CostModel.compile_and_record``
+    (one AOT executable per (chunk size, donation) pair, cached on the
+    model) and every chunk's measured wall (dispatch + host offload)
+    feeds ``observe_execute`` under ``cost_label`` (default
+    ``rollout-c<chunk>-u<unroll>``).
 
     ``durable_hook``: called after every chunk as
     ``durable_hook(t1, state, outs_host)`` with the post-chunk global
@@ -196,16 +234,28 @@ def rollout_chunked(step_fn: Callable, state0, steps: int, *,
         state = jax.tree.map(jnp.copy, state)
     parts = []
     t0 = start
+    label = cost_label or f"rollout-c{chunk}-u{unroll}"
     try:
         while t0 < steps:
             n = min(chunk, steps - t0)
-            state, outs = run(step_fn, state, jnp.asarray(t0), n,
-                              unroll=unroll)
+            t_exec = time.perf_counter()
+            if cost_model is not None:
+                compiled = cost_model.compile_and_record(
+                    label, run, (step_fn, state, jnp.asarray(t0), n, unroll),
+                    cache_key=(label, step_fn, n, unroll, donate_carry,
+                               _abstract_sig(state)))
+                state, outs = compiled(state, jnp.asarray(t0))
+            else:
+                state, outs = run(step_fn, state, jnp.asarray(t0), n,
+                                  unroll=unroll)
             # Eager host offload each chunk: bounds HBM for recorded
             # trajectories, and (measured on the TPU bench) beats deferring
             # the transfer, which contends with the async checkpoint
             # writer's own device reads.
             outs_host = jax.device_get(outs)
+            if cost_model is not None:
+                cost_model.observe_execute(label,
+                                           time.perf_counter() - t_exec)
             parts.append(outs_host)
             t0 += n
             if durable_hook is not None:

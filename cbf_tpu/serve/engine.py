@@ -63,7 +63,7 @@ from cbf_tpu.utils import profiling
 #: with obs.trace's, must union to obs.schema.SERVE_EVENT_TYPES).
 EMITTED_EVENT_TYPES: tuple[str, ...] = (
     "request", "serve.retry", "serve.shed", "serve.quarantine",
-    "serve.degrade", "serve.scheduler_crash")
+    "serve.degrade", "serve.scheduler_crash", "serve.cost")
 
 
 def configure_compilation_cache(cache_dir: str | None = None) -> str | None:
@@ -216,7 +216,7 @@ class ServeEngine:
                  horizon_quantum: int = _buckets.DEFAULT_HORIZON_QUANTUM,
                  cache_dir: str | None = None, telemetry=None, tracer=None,
                  fault_policy: resilience.FaultPolicy | None = None,
-                 journal=None):
+                 journal=None, cost_model=None, flight=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self.max_batch = max_batch
@@ -245,6 +245,16 @@ class ServeEngine:
 
             journal = RequestJournal(os.fspath(journal), telemetry=telemetry)
         self.journal = journal
+        # Resource accounting (obs.resource.CostModel): every bucket
+        # compile is attributed (flops/bytes/peak memory) and every
+        # successful batch feeds predicted-vs-measured execute drift
+        # (`serve.cost` events + serve.cost_model.drift gauge). None
+        # (default) disables accounting entirely.
+        self.cost_model = cost_model
+        # Incident flight recorder (obs.flight.FlightRecorder): trips a
+        # capsule on NonFiniteResult, quarantine/breaker opens, scheduler
+        # crashes, and SIGTERM drains. None (default) disables.
+        self.flight = flight
         self.prewarm_s: float | None = None
         self.stats = {"requests": 0, "batches": 0, "pad_slots": 0,
                       "compile_hit": 0, "compile_miss": 0, "retries": 0,
@@ -316,6 +326,14 @@ class ServeEngine:
         profiling.add_event_count(f"serve.compile_ms[{key.label()}]",
                                   int(wall * 1000))
         self._execs[key] = compiled
+        label = key.label()
+        if self.cost_model is not None:
+            self.cost_model.record_compile(label, compiled, wall)
+        record_exec = getattr(self.telemetry, "record_executable", None)
+        if record_exec is not None:
+            from cbf_tpu.obs import resource as _resource
+
+            record_exec(label, _resource.analyze_compiled(compiled))
         return compiled
 
     def prewarm(self, configs) -> float:
@@ -353,6 +371,8 @@ class ServeEngine:
                 "quarantined", "failed", "nonfinite", "cancelled",
                 "degraded_requests", "scheduler_crashes",
                 "rta_rescued")},
+            "cost_model_drift": (self.cost_model.drift_summary()
+                                 if self.cost_model is not None else None),
         }}
 
     # -- breakers ----------------------------------------------------------
@@ -375,6 +395,28 @@ class ServeEngine:
             self._emit("serve.quarantine", {
                 "scope": "request", "signature": sig, "state": "open",
                 "failures": failures, "bucket": bucket_label})
+            self._flight_trip(
+                "serve.quarantine",
+                f"signature {sig} quarantined after {failures} failures "
+                f"in bucket {bucket_label}", cfg=cfg)
+
+    def _flight_trip(self, reason: str, detail: str,
+                     cfg: swarm.Config | None = None,
+                     expect: str = "violates") -> None:
+        """Trip the attached flight recorder (no-op without one); the
+        offending config, when known, rides along as a verify-corpus
+        replay stanza."""
+        if self.flight is None:
+            return
+        request = None
+        if cfg is not None:
+            from cbf_tpu.obs import flight as obs_flight
+
+            try:
+                request = obs_flight.request_stanza(cfg, expect=expect)
+            except Exception:
+                request = None
+        self.flight.trip(reason, detail, request=request)
 
     def _record_signature_success(self, cfg: swarm.Config,
                                   bucket_label: str) -> None:
@@ -496,6 +538,22 @@ class ServeEngine:
             outs = jax.device_get(outs)
         self.stats["batches"] += 1
         self.stats["pad_slots"] += self.max_batch - len(entries)
+        if self.cost_model is not None:
+            obs = self.cost_model.observe_execute(label, execute_s)
+            cost = self.cost_model.cost_of(label)
+            if obs["drift"] is not None:
+                reg = getattr(self.telemetry, "registry", None)
+                if reg is not None:
+                    reg.gauge("serve.cost_model.drift").set(obs["drift"])
+            self._emit("serve.cost", {
+                "bucket": label, "batch_fill": len(entries),
+                "execute_s": round(execute_s, 6),
+                "predicted_s": obs["predicted_s"],
+                "drift": (None if obs["drift"] is None
+                          else round(obs["drift"], 6)),
+                "flops": cost.get("flops", 0),
+                "bytes_accessed": cost.get("bytes_accessed", 0),
+                "peak_bytes": cost.get("peak_bytes", 0)})
         steps_np = np.asarray(steps_b) if degraded else None
         for slot, (pending, cfg, _tr, t_enq, _d) in enumerate(entries):
             with tracer.span("resolve", trace_id=pending.request_id,
@@ -515,6 +573,10 @@ class ServeEngine:
                         continue
                     self._count("failed")
                     self._record_offender(cfg, label)
+                    self._flight_trip(
+                        "serve.nonfinite",
+                        f"request {pending.request_id} unpacked non-finite "
+                        f"state/outputs in bucket {label}", cfg=cfg)
                     pending._resolve(error=resilience.NonFiniteResult(
                         f"request {pending.request_id} unpacked non-finite "
                         f"state/outputs in bucket {label}",
@@ -632,6 +694,10 @@ class ServeEngine:
                 self._emit("serve.quarantine", {
                     "scope": "bucket", "signature": label, "state": "open",
                     "failures": failures, "bucket": label})
+                self._flight_trip(
+                    "serve.breaker",
+                    f"bucket {label} breaker opened after {failures} "
+                    f"compile failures ({type(error).__name__})")
             for pending, *_ in entries:
                 self._count("failed")
                 pending._resolve(error=error)
@@ -672,6 +738,8 @@ class ServeEngine:
                     pending._journal = self.journal
                     self.journal.submitted(pending.request_id, cfg)
                 pendings.append(pending)
+                if self.flight is not None:
+                    self.flight.note_request(cfg, pending.request_id)
                 entries_by_key.setdefault(key, []).append(
                     (pending, cfg, traced, self.tracer.now(), None))
         for key, entries in entries_by_key.items():
@@ -791,6 +859,8 @@ class ServeEngine:
                 "under queue pressure", request_id=ev_pending.request_id))
         if fail is not None:
             raise fail
+        if self.flight is not None:
+            self.flight.note_request(cfg, pending.request_id)
         return pending
 
     def stop(self, drain: bool = True) -> None:
@@ -806,6 +876,13 @@ class ServeEngine:
             self._thread = None
         if drain:
             self._drain_leftovers()
+        if self.cost_model is not None:
+            # Flush measured execute EWMAs/drift (record_compile saves at
+            # compile time, but observations accrue between saves).
+            try:
+                self.cost_model.save()
+            except OSError:
+                pass
 
     def _drain_leftovers(self) -> None:
         """The graceful-drain body: stop admissions, pop everything still
@@ -823,6 +900,11 @@ class ServeEngine:
                     leftovers.append((key, entries[:self.max_batch]))
                     del entries[:self.max_batch]
             self._queue.clear()
+        if self._preempt.is_set():
+            self._flight_trip(
+                "sigterm.drain",
+                f"SIGTERM drain: {sum(len(b) for _, b in leftovers)} "
+                "queued requests flushed to resolution")
         for key, batch in leftovers:
             self._execute(key, batch)
 
@@ -977,3 +1059,7 @@ class ServeEngine:
         self._emit("serve.scheduler_crash", {
             "error": f"{type(error).__name__}: {error}",
             "resolved": len(leftovers)})
+        self._flight_trip(
+            "serve.scheduler_crash",
+            f"scheduler thread crashed ({type(error).__name__}: {error}); "
+            f"{len(leftovers)} queued requests resolved SchedulerCrashed")

@@ -162,16 +162,46 @@ def run_loadgen(engine, spec: LoadSpec, *, telemetry=None,
             except resilience.ServeError as e:
                 _tally(e)   # shed/quarantined at admission: typed, counted
         results = []
+        bucket_errors: dict[str, int] = {}
         for p in pendings:
             try:
                 results.append(p.result(timeout=result_timeout_s))
             except Exception as e:
                 _tally(e)
+                key = getattr(p, "_key", None)
+                if key is not None:     # post-submit failure: bucketable
+                    label = key.label()
+                    bucket_errors[label] = bucket_errors.get(label, 0) + 1
         errors = sum(errors_by_type.values())
         drained_s = time.perf_counter() - t_start
     finally:
         if started_here:
             engine.stop(drain=True)
+
+    # Per-bucket SLO split: aggregate percentiles hide which leg of the
+    # ladder is slow — a p99 blowup in one big bucket looks like uniform
+    # degradation in the roll-up. Group by the served bucket label.
+    by_bucket: dict[str, dict] = {}
+    groups: dict[str, list] = {}
+    for r in results:
+        groups.setdefault(r.bucket, []).append(r)
+    for label in sorted(set(groups) | set(bucket_errors)):
+        rs = groups.get(label, [])
+        bq = sorted(r.queue_wait_s for r in rs)
+        bx = sorted(r.execute_s for r in rs)
+        by_bucket[label] = {
+            "completed": len(rs),
+            "errors": bucket_errors.get(label, 0),
+            "queue_wait_p50_s": _quantile(bq, 0.50),
+            "queue_wait_p95_s": _quantile(bq, 0.95),
+            "queue_wait_p99_s": _quantile(bq, 0.99),
+            "execute_p50_s": _quantile(bx, 0.50),
+            "execute_p95_s": _quantile(bx, 0.95),
+            "execute_p99_s": _quantile(bx, 0.99),
+        }
+        for k, v in list(by_bucket[label].items()):
+            if isinstance(v, float):
+                by_bucket[label][k] = round(v, 6)
 
     lat = sorted(r.latency_s for r in results)
     qwait = sorted(r.queue_wait_s for r in results)
@@ -205,6 +235,7 @@ def run_loadgen(engine, spec: LoadSpec, *, telemetry=None,
             if results else None),
         "infeasible_count": (sum(int(np.sum(r.outputs.infeasible_count))
                                  for r in results) if results else None),
+        "by_bucket": by_bucket,
     }
     for k, v in list(report.items()):
         if isinstance(v, float):
@@ -215,5 +246,5 @@ def run_loadgen(engine, spec: LoadSpec, *, telemetry=None,
                 "seed", "offered_rps", "achieved_rps", "requests",
                 "completed", "errors", "duration_s", "latency_p50_s",
                 "latency_p95_s", "latency_p99_s", "queue_wait_p99_s",
-                "execute_p99_s")})
+                "execute_p99_s", "by_bucket")})
     return report

@@ -42,6 +42,7 @@ import dataclasses
 import hashlib
 import json
 import os
+import time
 from typing import Any, Callable, NamedTuple
 
 import numpy as np
@@ -267,14 +268,34 @@ def make_eval_one(adapter: Adapter, settings: SearchSettings) -> Callable:
 
 
 def make_eval_batch(adapter: Adapter, settings: SearchSettings,
-                    mesh=None) -> Callable:
+                    mesh=None, cost_model=None) -> Callable:
     """jit(vmap(eval_one)): ``(B, *delta_shape) -> (B, P)`` margins —
     one compiled program per batch shape. With ``mesh``, the candidate
     axis is sharded over the mesh's ``dp`` axis (B must be a multiple of
-    the dp extent — use :func:`round_batch`)."""
+    the dp extent — use :func:`round_batch`). With ``cost_model`` (a
+    :class:`cbf_tpu.obs.resource.CostModel`; unsharded path only), each
+    batch shape compiles through ``CostModel.compile_and_record`` so
+    XLA cost/memory attribution lands in the model under
+    ``verify-eval-b<B>-s<steps>``, and every dispatch's measured wall
+    feeds ``observe_execute`` — the model caches the AOT executable, so
+    no shape ever compiles twice."""
     eval_b = jax.jit(jax.vmap(make_eval_one(adapter, settings)))
     if mesh is None:
-        return eval_b
+        if cost_model is None:
+            return eval_b
+
+        def eval_recorded(deltas):
+            label = f"verify-eval-b{deltas.shape[0]}-s{adapter.steps}"
+            compiled = cost_model.compile_and_record(
+                label, eval_b, (deltas,),
+                cache_key=(eval_b, deltas.shape, str(deltas.dtype)))
+            t0 = time.perf_counter()
+            out = compiled(deltas)
+            jax.block_until_ready(out)
+            cost_model.observe_execute(label, time.perf_counter() - t0)
+            return out
+
+        return eval_recorded
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     ndim = 1 + len(adapter.delta_shape)

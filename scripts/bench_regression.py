@@ -1,0 +1,189 @@
+"""Bench trajectory regression audit (AUD006): compare, don't drift.
+
+The driver snapshots every bench round into ``BENCH_r<NN>.json`` at the
+repo root, but nothing ever read them back — a 20% throughput slide
+across three rounds would land silently. This audit walks the recorded
+rounds per metric axis (``(metric, unit)`` pairs in each round's
+``parsed`` block), reduces each round to its *effective* measurement,
+and fails when the newest verified number regresses beyond a tolerance
+against the previous verified one.
+
+Effective measurement rules (matching how bench.py records hardware
+flakiness, docs/BENCH_LOG.md):
+
+- a record with ``value > 0`` and no ``error`` is verified as-is;
+- a record with ``value == 0`` + ``error`` falls back to its embedded
+  ``last_verified`` stanza when present (bench.py writes one after the
+  first successful run — Round 5 onward);
+- otherwise the round is *unverified* for that axis and is skipped as a
+  comparison endpoint (a wedged devserver is not a regression).
+
+All bench axes so far are higher-is-better (throughput); the audit
+treats them so. The comparison and parsing logic is pure and
+unit-tested fast; the repo-level audit runs as a slow-tier test
+(tests/test_obs_resource.py) and ``--write-trajectory`` refreshes
+``docs/BENCH_TRAJECTORY.json`` so reviews can see the series without
+re-deriving it.
+
+Usage: python scripts/bench_regression.py [--tolerance 0.15] [--json]
+       [--write-trajectory]
+Exit 1 when any axis's newest verified value regresses beyond
+tolerance; exit 0 otherwise (including "not enough verified rounds").
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+#: Allowed fractional slide between consecutive verified rounds before
+#: the audit fails. Bench numbers on shared hardware are noisy; 15%
+#: is outside run-to-run jitter but inside "someone landed a perf bug".
+TOLERANCE = 0.15
+
+#: Where --write-trajectory persists the per-axis series.
+TRAJECTORY_PATH = os.path.join("docs", "BENCH_TRAJECTORY.json")
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def discover_rounds(repo: str = _REPO) -> list[tuple[int, str]]:
+    """Sorted ``(round_number, path)`` pairs for every BENCH_r*.json."""
+    out = []
+    for path in glob.glob(os.path.join(repo, "BENCH_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def effective(parsed: dict) -> dict | None:
+    """Reduce one round's parsed record for an axis to its effective
+    measurement, or None when the round is unverified (wedged device,
+    no fallback). The returned dict always has ``value`` and a
+    ``source`` of either "measured" or "last_verified"."""
+    if not isinstance(parsed, dict) or "value" not in parsed:
+        return None
+    value = parsed.get("value")
+    if isinstance(value, (int, float)) and value > 0 \
+            and not parsed.get("error"):
+        return {"value": float(value), "source": "measured",
+                "vs_baseline": parsed.get("vs_baseline")}
+    fallback = parsed.get("last_verified")
+    if isinstance(fallback, dict) and \
+            isinstance(fallback.get("value"), (int, float)) and \
+            fallback["value"] > 0:
+        return {"value": float(fallback["value"]),
+                "source": "last_verified",
+                "vs_baseline": fallback.get("vs_baseline")}
+    return None
+
+
+def collect_series(rounds: list[tuple[int, str]]) -> dict[str, list[dict]]:
+    """Per-axis trajectory across rounds. Keyed by ``metric [unit]``;
+    each entry carries the round number and the effective measurement
+    (or ``verified: False`` when the round had nothing usable for that
+    axis). A round's ``parsed`` may be one record or a list of them."""
+    series: dict[str, list[dict]] = {}
+    for rnd, path in rounds:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed")
+        records = parsed if isinstance(parsed, list) else [parsed]
+        for rec in records:
+            if not isinstance(rec, dict) or "metric" not in rec:
+                continue
+            axis = f"{rec['metric']} [{rec.get('unit', '')}]"
+            eff = effective(rec)
+            entry = {"round": rnd, "verified": eff is not None}
+            if eff is not None:
+                entry.update(eff)
+            series.setdefault(axis, []).append(entry)
+    return series
+
+
+def compare(series: dict[str, list[dict]],
+            tolerance: float = TOLERANCE) -> dict:
+    """The audit verdict: for each axis, the newest verified value vs
+    the previous verified one (higher is better). Axes with fewer than
+    two verified rounds are reported but cannot regress."""
+    axes, ok = {}, True
+    for axis, entries in sorted(series.items()):
+        verified = [e for e in entries if e["verified"]]
+        if len(verified) < 2:
+            axes[axis] = {"status": "insufficient",
+                          "verified_rounds": len(verified)}
+            continue
+        prev, latest = verified[-2], verified[-1]
+        change = (latest["value"] - prev["value"]) / prev["value"]
+        regressed = change < -tolerance
+        ok = ok and not regressed
+        axes[axis] = {
+            "status": "regressed" if regressed else "ok",
+            "prev_round": prev["round"], "prev_value": prev["value"],
+            "latest_round": latest["round"],
+            "latest_value": latest["value"],
+            "latest_source": latest["source"],
+            "change_frac": round(change, 4),
+        }
+    return {"rule": "AUD006", "ok": ok, "tolerance": tolerance,
+            "axes": axes}
+
+
+def write_trajectory(series: dict[str, list[dict]],
+                     repo: str = _REPO) -> str:
+    """Persist the per-axis series (atomic rewrite) for review diffs."""
+    path = os.path.join(repo, TRAJECTORY_PATH)
+    doc = {"schema": "bench-trajectory-v1", "axes": series}
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--tolerance", type=float, default=TOLERANCE,
+                   help=f"allowed fractional slide (default {TOLERANCE})")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--write-trajectory", action="store_true",
+                   help=f"rewrite {TRAJECTORY_PATH} from the rounds")
+    args = p.parse_args()
+    rounds = discover_rounds()
+    series = collect_series(rounds)
+    if args.write_trajectory:
+        write_trajectory(series)
+    verdict = compare(series, args.tolerance)
+    if args.json:
+        print(json.dumps(verdict, indent=1, sort_keys=True))
+    else:
+        for axis, v in verdict["axes"].items():
+            if v["status"] == "insufficient":
+                print(f"AUD006 {axis}: insufficient verified rounds "
+                      f"({v['verified_rounds']})")
+            else:
+                print(f"AUD006 {axis}: {v['status']} "
+                      f"r{v['prev_round']:02d} {v['prev_value']:.1f} -> "
+                      f"r{v['latest_round']:02d} {v['latest_value']:.1f} "
+                      f"({v['change_frac']:+.1%}, "
+                      f"source={v['latest_source']})")
+        print(f"bench regression audit "
+              f"{'OK' if verdict['ok'] else 'FAILED'} "
+              f"(tolerance {verdict['tolerance']:.0%})")
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,14 @@ in the host-side guard work. The compiled executable is shared between
 legs, which is also the bit-neutrality argument: an idle policy cannot
 change results it never touches.
 
+``--mode flight`` measures the incident flight recorder's ARMED-idle
+cost under the same <= 3% budget (ISSUE 11): a FlightRecorder attached
+to the engine's sink (ring buffering every event, trigger predicates
+evaluated, nothing ever trips) vs no recorder, same prewarmed mixed
+batch through ServeEngine.run, same interleaved min-of-R discipline.
+All recorder work is host-side (a deque append + a dict probe per
+event), so the budget governs the engine's request wall.
+
 ``--mode rta`` measures the runtime-assurance ladder's IDLE cost under
 the same <= 3% budget (ISSUE 10): a healthy rta=True rollout (health
 word assembled, latch updated, every select taken on the nominal side —
@@ -182,6 +190,60 @@ def measure_faults(b: int, n_base: int, steps: int, reps: int) -> dict:
             "platform": jax.devices()[0].platform}
 
 
+def measure_flight(b: int, n_base: int, steps: int, reps: int) -> dict:
+    """Armed-idle flight-recorder overhead on the serve path: the SAME
+    fixed mixed batch served with a FlightRecorder attached to the
+    engine's sink vs detached. One engine, one executable set, fault-free
+    traffic — nothing ever trips, so the on-leg pays exactly the ring
+    append + trigger probe per event (the 'armed but idle' budget of
+    ISSUE 11's acceptance gate)."""
+    import jax
+
+    from cbf_tpu import obs
+    from cbf_tpu.obs.trace import Tracer
+    from cbf_tpu.scenarios import swarm
+    from cbf_tpu.serve import ServeEngine
+
+    cfgs = [swarm.Config(n=max(4, n_base // (2 ** (i % 3))), steps=steps,
+                         seed=i, gating="jnp",
+                         safety_distance=0.4 + 0.003 * (i % 5))
+            for i in range(b)]
+    sink = obs.TelemetrySink(tempfile.mkdtemp(prefix="obs_flight_"))
+    # Tracer disabled in both legs (spans have their own budget); the
+    # sink itself is in both legs too — only the recorder differs.
+    engine = ServeEngine(max_batch=8, tracer=Tracer(enabled=False),
+                         telemetry=sink)
+    engine.prewarm(cfgs)
+    recorder = obs.FlightRecorder(
+        tempfile.mkdtemp(prefix="obs_capsules_"))
+
+    def one(armed: bool) -> float:
+        if armed:
+            recorder.attach(sink)
+        t0 = time.perf_counter()
+        engine.run(cfgs)
+        wall = time.perf_counter() - t0
+        if armed:
+            recorder.detach()
+        return wall
+
+    one(True), one(False)                 # warm both paths end to end
+    offs, ons = [], []
+    for i in range(reps):
+        legs = ((offs, False), (ons, True))
+        for acc, armed in (legs if i % 2 == 0 else legs[::-1]):
+            acc.append(one(armed))
+    capsules = len(recorder.capsules)
+    sink.close()
+    off_s, on_s = min(offs), min(ons)
+    return {"mode": "flight", "b": b, "n_base": n_base, "steps": steps,
+            "reps": reps, "off_s": round(off_s, 4),
+            "on_s": round(on_s, 4),
+            "overhead": round((on_s - off_s) / off_s, 4),
+            "capsules": capsules,       # must be 0: armed means idle
+            "platform": jax.devices()[0].platform}
+
+
 def measure_rta(n: int, steps: int, reps: int) -> dict:
     """Idle runtime-assurance overhead on the rollout path: a HEALTHY
     rta=True rollout vs the plain program. No fault fires, so the on-leg
@@ -231,20 +293,22 @@ def main() -> int:
     p.add_argument("--steps", type=int, default=300)
     p.add_argument("--every", type=int, default=50)
     p.add_argument("--reps", type=int, default=5)
-    p.add_argument("--mode", choices=("rollout", "spans", "faults", "rta"),
+    p.add_argument("--mode", choices=("rollout", "spans", "faults",
+                                      "flight", "rta"),
                    default="rollout")
     p.add_argument("--b", type=int, default=12,
-                   help="request count for --mode spans/faults")
+                   help="request count for --mode spans/faults/flight")
     args = p.parse_args()
     if args.mode == "rta":
         print(json.dumps(measure_rta(args.n, args.steps, args.reps)))
-    elif args.mode in ("spans", "faults"):
+    elif args.mode in ("spans", "faults", "flight"):
         # Serve-path budgets are per-request wall at serving sizes; the
         # rollout defaults (N=1024) would swamp the signal with device
         # time, so these modes size down and serve a mixed batch instead.
         n_base = args.n if args.n != 1024 else 32
         steps = args.steps if args.steps != 300 else 40
-        fn = measure_spans if args.mode == "spans" else measure_faults
+        fn = {"spans": measure_spans, "faults": measure_faults,
+              "flight": measure_flight}[args.mode]
         print(json.dumps(fn(args.b, n_base, steps, args.reps)))
     else:
         print(json.dumps(measure(args.n, args.steps, args.every,

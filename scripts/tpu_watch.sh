@@ -1,11 +1,13 @@
 #!/usr/bin/env bash
 # Probe the tunneled TPU on a loop; at the FIRST healthy probe launch the
 # whole measurement sweep (scripts/tpu_sweep.sh) with telemetry streaming
-# on, and watch the sweep through its JSONL HEARTBEAT STREAM instead of
-# scraping process liveness: `python -m cbf_tpu obs tail --follow
-# --stall-timeout` follows the newest run directory and exits 3 the moment
-# heartbeats stop flowing — a wedged tunnel mid-run is detected in
-# STALL_S seconds with the exact last-known step on record, not hours
+# on, and watch the sweep through its LIVE METRICS SURFACE instead of
+# tailing raw JSONL: `python -m cbf_tpu obs top --follow --stall-timeout`
+# renders the newest run's metrics.json (counters/gauges/percentiles,
+# rewritten atomically every BENCH_METRICS_EVERY seconds by the bench
+# child's exporter) and exits 3 the moment the surface goes stale — a
+# wedged tunnel mid-run is detected in STALL_S seconds with the last
+# rendered counters on screen, not hours
 # later from a dead process table. Launch once in the background at
 # session start (the round-4 lesson: healthy minutes between manual
 # probes went unused):
@@ -15,9 +17,9 @@
 # Probe interval 15 min (a probe against a wedged tunnel burns a 120 s
 # child timeout; 15 min keeps the duty cycle ~13%). Stops after MAX_HOURS
 # regardless. Exit codes: 0 sweep finished, 2 no healthy probe before the
-# deadline, 3 sweep stalled (heartbeats stopped; see the stall alert at
-# the end of the tail output and the run dir's events.jsonl for the last
-# heartbeat's step/rate).
+# deadline, 3 sweep stalled (metrics surface went stale; see the stall
+# alert at the end of the top output, the run dir's events.jsonl for the
+# last heartbeat's step/rate, and <run>/capsules for incident capsules).
 set -u
 cd "$(dirname "$0")/.."
 INTERVAL="${TPU_WATCH_INTERVAL_S:-900}"
@@ -25,7 +27,8 @@ MAX_HOURS="${TPU_WATCH_MAX_HOURS:-12}"
 SWEEP="${TPU_WATCH_SWEEP:-scripts/tpu_sweep.sh}"
 # Telemetry root the sweep's bench children stream into; the watcher
 # follows the newest run under it. Stall timeout must cover warmup/compile
-# (the first heartbeat waits on it) AND the certificate chunk cadence.
+# (the first metrics.json flush waits on the sink coming up) AND the
+# certificate chunk cadence.
 TELEMETRY_ROOT="${TPU_WATCH_TELEMETRY:-docs/sweeps/telemetry}"
 STALL_S="${TPU_WATCH_STALL_S:-600}"
 deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
@@ -44,14 +47,16 @@ sys.exit(0 if ok else 1)
     mkdir -p "$TELEMETRY_ROOT"
     BENCH_TELEMETRY="$TELEMETRY_ROOT" bash "$SWEEP" &
     sweep_pid=$!
-    # Consume the heartbeat stream: --latest waits for the first bench
-    # child to open its run dir, then follows it; a silent stream for
-    # STALL_S emits one synthetic stall alert and exits 3. Loop: each
-    # bench child is its own run dir, so re-tail the newest one until
-    # the sweep process finishes.
+    # Consume the live metrics surface: --latest waits for the first
+    # bench child to flush its metrics.json, then re-renders it in
+    # place; a surface that stops refreshing for STALL_S emits one
+    # synthetic stall alert and exits 3. Loop: each bench child is its
+    # own run dir, so re-watch the newest one until the sweep process
+    # finishes. (The raw stream is still there: obs tail <run> for the
+    # event-by-event view, <run>/capsules for any incident capsules.)
     watch_rc=0
     while kill -0 "$sweep_pid" 2>/dev/null; do
-      python -m cbf_tpu obs tail "$TELEMETRY_ROOT" --latest --follow \
+      python -m cbf_tpu obs top "$TELEMETRY_ROOT" --latest --follow \
         --stall-timeout "$STALL_S"
       rc=$?
       if [ "$rc" -eq 3 ]; then

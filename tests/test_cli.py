@@ -202,6 +202,120 @@ def test_serve_recover_exit_codes_and_empty_journal(tmp_path, capsys):
                    "journal": path}
 
 
+# ------------------- resource observatory surface (ISSUE 11 satellite) --
+
+def _metrics_dir(tmp_path, name="m"):
+    """A populated metrics surface, as the exporter writes it."""
+    from cbf_tpu.obs import export as obs_export
+    from cbf_tpu.obs.sink import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter("requests").add(3)
+    reg.histogram("execute_s[n16-t8]").observe(0.02)
+    out = str(tmp_path / name)
+    obs_export.write_metrics(out, reg, extra={"queue_depth": 1})
+    return out
+
+
+def test_obs_top_renders_surface_and_resolves_latest(tmp_path, capsys):
+    out = _metrics_dir(tmp_path)
+    assert main(["obs", "top", out]) == 0
+    text = capsys.readouterr().out
+    assert "requests" in text and "queue_depth" in text
+    assert "n16-t8" in text                     # bucket column populated
+    # --latest resolves the newest metrics dir under a root.
+    assert main(["obs", "top", str(tmp_path), "--latest"]) == 0
+    assert "requests" in capsys.readouterr().out
+
+
+def test_obs_top_exit_codes(tmp_path, capsys):
+    import os
+    import time
+
+    missing = str(tmp_path / "nowhere")
+    assert main(["obs", "top", missing]) == 2   # no surface: operator error
+    assert "obs top" in capsys.readouterr().err
+    assert main(["obs", "top", str(tmp_path), "--latest"]) == 2
+    capsys.readouterr()
+    # --follow --stall-timeout: a surface that never appears is a stall.
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    assert main(["obs", "top", empty, "--follow", "--every", "0.05",
+                 "--stall-timeout", "0.2"]) == 3
+    assert json.loads(capsys.readouterr().out)["kind"] == "stall"
+    # ... and so is one that stops being rewritten (tpu_watch contract).
+    out = _metrics_dir(tmp_path)
+    stale = time.time() - 60
+    os.utime(os.path.join(out, "metrics.json"), (stale, stale))
+    assert main(["obs", "top", out, "--follow",
+                 "--stall-timeout", "5"]) == 3
+    assert json.loads(capsys.readouterr().out)["kind"] == "stall"
+
+
+def _capsule(tmp_path, cfg=None, expect="safe"):
+    from cbf_tpu.obs import flight as obs_flight
+
+    rec = obs_flight.FlightRecorder(str(tmp_path / "caps"))
+    request = None
+    if cfg is not None:
+        request = obs_flight.request_stanza(cfg, expect=expect)
+    return rec.trip("manual.test", "cli pin", request=request)
+
+
+def test_obs_incident_summary_and_json(tmp_path, capsys):
+    from cbf_tpu.scenarios import swarm
+
+    path = _capsule(tmp_path, swarm.Config(n=6, steps=4, gating="jnp"))
+    assert main(["obs", "incident", path, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["reason"] == "manual.test" and doc["has_request"] is True
+    # --latest resolves the newest capsule under the recorder root.
+    assert main(["obs", "incident", str(tmp_path / "caps"),
+                 "--latest"]) == 0
+    assert json.loads(capsys.readouterr().out)["reason"] == "manual.test"
+    assert main(["obs", "incident", str(tmp_path / "nowhere")]) == 2
+    assert "obs incident" in capsys.readouterr().err
+
+
+def test_obs_incident_replay_judges_outcome(tmp_path, capsys):
+    """--replay re-runs the captured request: exit 0 iff the observed
+    outcome matches the stanza's expect, 1 on mismatch, 2 with no
+    request.json at all."""
+    from cbf_tpu.scenarios import swarm
+
+    healthy = swarm.Config(n=6, steps=4, gating="jnp")
+    path = _capsule(tmp_path, healthy, expect="safe")
+    assert main(["obs", "incident", path, "--replay", "--json"]) == 0
+    replay = json.loads(capsys.readouterr().out)["replay"]
+    assert replay["outcome"] == "safe" and replay["matches_expect"]
+
+    wrong = _capsule(tmp_path / "b", healthy, expect="violates")
+    assert main(["obs", "incident", wrong, "--replay", "--json"]) == 1
+    assert json.loads(capsys.readouterr().out
+                      )["replay"]["matches_expect"] is False
+
+    bare = _capsule(tmp_path / "c")                 # no request captured
+    assert main(["obs", "incident", bare, "--replay"]) == 2
+    assert "no request.json" in capsys.readouterr().err
+
+
+def test_loadgen_metrics_dir_writes_both_surfaces(tmp_path, capsys):
+    import os
+
+    out = str(tmp_path / "metrics")
+    assert main(["loadgen", "--rps", "20", "--duration", "0.5",
+                 "--n-min", "8", "--n-max", "16", "--steps", "8",
+                 "--flush-deadline", "0.05",
+                 "--metrics-dir", out, "--metrics-every", "0.2"]) == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metrics_dir"] == out
+    assert rec["errors"] == 0 and rec["by_bucket"]  # per-bucket SLO split
+    for fname in ("metrics.prom", "metrics.json"):
+        assert os.path.isfile(os.path.join(out, fname)), fname
+    doc = json.load(open(os.path.join(out, "metrics.json")))
+    assert doc["metrics"]                           # registry made it out
+
+
 def test_verify_state_dir_fingerprint_mismatch_exits_2(tmp_path, capsys):
     d = str(tmp_path / "campaign")
     assert main(["verify", "swarm", "--engine", "random", "--budget", "8",

@@ -1,0 +1,471 @@
+"""Resource observatory (cbf_tpu.obs.resource / .flight / .export) +
+the AUD006 bench-trajectory audit.
+
+The load-bearing pins:
+
+- ATTRIBUTION AT THE COMPILE SITE: every `lower().compile()` goes
+  through `CostModel.compile_and_record`, so `costmodel.json` carries
+  flops / bytes accessed / peak buffer bytes per label, and the AOT
+  path is bit-identical to the implicit-jit dispatch it replaces.
+- WARM-PATH DRIFT GATE (ISSUE 11 acceptance): after a short loadgen
+  sweep the cost model holds an entry for EVERY bucket the report saw,
+  and the warm execute-time prediction's median drift stays under 50%.
+- EXACTLY-ONE CAPSULE: every watchdog alert class and an RTA rung-3
+  engagement each produce one well-formed capsule (per-reason cooldown,
+  rung < 2 never trips), capsule replay round-trips the offending
+  config through the verify-corpus loader, and a write failure is
+  counted, never raised.
+- PARSEABLE SURFACE: `metrics.prom` survives a minimal Prometheus
+  text-format parser — every sample line well-formed, every family
+  TYPE'd exactly once, no duplicate bare sample names even when a gauge
+  and a histogram share a base name.
+"""
+
+import json
+import os
+import re
+import sys
+import time
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from cbf_tpu import obs  # noqa: E402
+from cbf_tpu.obs import export as obs_export  # noqa: E402
+from cbf_tpu.obs import flight as obs_flight  # noqa: E402
+from cbf_tpu.obs import resource as obs_resource  # noqa: E402
+from cbf_tpu.obs.sink import MetricsRegistry  # noqa: E402
+from cbf_tpu.rollout.engine import rollout  # noqa: E402
+from cbf_tpu.rta import monitor  # noqa: E402
+from cbf_tpu.scenarios import swarm  # noqa: E402
+from cbf_tpu.verify import corpus  # noqa: E402
+from scripts.bench_regression import (TOLERANCE, collect_series,  # noqa: E402
+                                      compare, effective)
+
+
+# ------------------------------------------------------ cost analysis --
+
+@pytest.fixture(scope="module")
+def tiny_compiled():
+    jitted = jax.jit(lambda a, b: a @ b + jnp.sin(a))
+    x = jnp.ones((32, 32), jnp.float32)
+    return jitted.lower(x, x).compile()
+
+
+def test_analyze_compiled_reports_flops_and_peak(tiny_compiled):
+    cost = obs_resource.analyze_compiled(tiny_compiled)
+    for key in ("flops", "bytes_accessed", "transcendentals",
+                "argument_bytes", "output_bytes", "temp_bytes",
+                "peak_bytes"):
+        assert key in cost and isinstance(cost[key], int)
+    assert cost["flops"] > 0                 # a matmul has flops
+    # peak covers at least the arguments + outputs one dispatch holds.
+    assert cost["peak_bytes"] >= cost["argument_bytes"]
+
+
+def test_analyze_compiled_degrades_to_zeros_not_exceptions():
+    class Broken:
+        def cost_analysis(self):
+            raise RuntimeError("no cost model on this backend")
+
+        def memory_analysis(self):
+            raise RuntimeError("nope")
+
+    cost = obs_resource.analyze_compiled(Broken())
+    assert cost["flops"] == 0 and cost["peak_bytes"] == 0
+
+
+def test_cost_model_persistence_roundtrip(tiny_compiled, tmp_path):
+    path = str(tmp_path / "costmodel.json")
+    model = obs_resource.CostModel(path)
+    model.record_compile("n16-t8-x", tiny_compiled, 0.5)
+    model.observe_execute("n16-t8-x", 0.01)
+    doc = json.load(open(path))              # record_compile auto-saves
+    assert doc["resource_schema"] == obs_resource.RESOURCE_SCHEMA_VERSION
+    assert doc["environment"] == obs_resource.environment()
+    model.save()
+    reloaded = obs_resource.CostModel(path)
+    assert reloaded.entries["n16-t8-x"]["compiles"] == 1
+    assert reloaded.cost_of("n16-t8-x")["flops"] > 0
+    assert reloaded.predict_execute("n16-t8-x") == 0.01
+
+
+def test_cost_model_drops_snapshot_from_other_environment(tmp_path):
+    path = str(tmp_path / "costmodel.json")
+    stale = obs_resource.CostModel(
+        path, env={"backend": "tpu", "jaxlib": "0.0.1", "git_sha": "dead"})
+    stale.entries["n16-t8-x"] = {"compiles": 3, "compile_s": 1.0,
+                                 "cost": {}, "execute_ewma_s": 0.1,
+                                 "executes": 9, "drift_recent": []}
+    stale.save()
+    fresh = obs_resource.CostModel(path)     # real environment() differs
+    assert fresh.entries == {}
+
+
+def test_cost_model_drift_tracking():
+    model = obs_resource.CostModel()
+    first = model.observe_execute("lbl", 0.10)
+    assert first["predicted_s"] is None and first["drift"] is None
+    second = model.observe_execute("lbl", 0.10)
+    assert second["predicted_s"] == pytest.approx(0.10)
+    assert second["drift"] == pytest.approx(0.0)
+    third = model.observe_execute("lbl", 0.20)  # 2x jump: 50% drift
+    assert third["drift"] == pytest.approx(0.5)
+    assert model.drift_summary()["lbl"] <= 0.5
+
+
+def test_cost_model_fits_scales_per_agent_peak():
+    model = obs_resource.CostModel()
+    assert model.fits(10 ** 9)               # nothing priced: fail open
+    model.entries["n16-t8-x"] = {
+        "compiles": 1, "compile_s": 0.1, "executes": 0,
+        "execute_ewma_s": None, "drift_recent": [],
+        "cost": {"peak_bytes": 16_000}}      # 1000 bytes/agent
+    assert model.fits(100, budget_bytes=200_000)
+    assert not model.fits(300, budget_bytes=200_000)
+    assert model.fits(10 ** 9)               # no budget known: fail open
+
+
+def test_compile_and_record_caches_executable():
+    model = obs_resource.CostModel()
+    jitted = jax.jit(lambda a: a * 2.0)
+    x = jnp.ones((8,), jnp.float32)
+    c1 = model.compile_and_record("lbl", jitted, (x,), cache_key="k")
+    c2 = model.compile_and_record("lbl", jitted, (x,), cache_key="k")
+    assert c1 is c2
+    assert model.entries["lbl"]["compiles"] == 1
+    np.testing.assert_array_equal(np.asarray(c1(x)), np.asarray(x) * 2.0)
+
+
+def test_rollout_with_cost_model_is_bit_identical():
+    """The AOT dispatch the cost model introduces must not change a
+    single byte vs the implicit-jit path it replaces."""
+    cfg = swarm.Config(n=8, steps=6, record_trajectory=False)
+    state0, step = swarm.make(cfg)
+    final_ref, outs_ref = rollout(step, state0, cfg.steps)
+    model = obs_resource.CostModel()
+    final, outs = rollout(step, state0, cfg.steps, cost_model=model)
+    np.testing.assert_array_equal(np.asarray(final.x),
+                                  np.asarray(final_ref.x))
+    np.testing.assert_array_equal(
+        np.asarray(outs.min_pairwise_distance),
+        np.asarray(outs_ref.min_pairwise_distance))
+    (label,) = model.entries
+    e = model.entries[label]
+    assert e["compiles"] == 1 and e["executes"] == 1
+    assert e["cost"]["flops"] > 0
+
+
+def test_warm_path_drift_gate_under_50_percent():
+    """ISSUE 11 acceptance: warm repeated dispatch of one executable
+    keeps the execute-time prediction's median drift under 50%."""
+    cfg = swarm.Config(n=16, steps=32, record_trajectory=False)
+    state0, step = swarm.make(cfg)
+    model = obs_resource.CostModel()
+    for _ in range(8):
+        rollout(step, state0, cfg.steps, cost_model=model,
+                cost_label="warm")
+    e = model.entries["warm"]
+    assert e["compiles"] == 1 and e["executes"] == 8   # one AOT compile
+    assert len(e["drift_recent"]) == 7    # every warm repeat drifted vs
+    assert model.drift_summary()["warm"] < 0.5          # the prediction
+
+
+# --------------------------------------------- loadgen bucket pricing --
+
+def test_loadgen_prices_every_bucket_and_reports_slo_split():
+    """Acceptance: a loadgen sweep leaves a cost-model entry for every
+    bucket its report saw, with the per-bucket SLO split populated."""
+    from cbf_tpu.obs.trace import Tracer
+    from cbf_tpu.serve import LoadSpec, ServeEngine, build_schedule, \
+        run_loadgen
+
+    spec = LoadSpec(rps=24.0, duration_s=0.8, seed=3, n_min=8, n_max=24,
+                    steps_choices=(8,))
+    model = obs_resource.CostModel()
+    engine = ServeEngine(max_batch=8, bucket_sizes=(16, 32),
+                         horizon_quantum=8, flush_deadline_s=0.05,
+                         tracer=Tracer(enabled=False), cost_model=model)
+    engine.prewarm([cfg for _, cfg in build_schedule(spec)])
+    report = run_loadgen(engine, spec)
+    assert report["errors"] == 0 and report["completed"] >= 2
+    assert report["by_bucket"]
+    for label, row in report["by_bucket"].items():
+        assert row["completed"] + row["errors"] >= 1
+        if row["completed"]:
+            assert row["execute_p50_s"] > 0
+            assert row["queue_wait_p99_s"] >= row["queue_wait_p50_s"]
+        entry = model.entries[label]         # the bucket is priced
+        assert entry["cost"]["peak_bytes"] > 0
+        assert entry["executes"] >= 1
+    drift = model.drift_summary()
+    for label, med in drift.items():
+        assert med < 0.5, f"{label}: median drift {med}"
+
+
+# --------------------------------------------------- flight recorder --
+
+def _capsule_reasons(rec):
+    return [obs_flight.read_capsule(p)["reason"] for p in rec.capsules]
+
+
+def test_every_watchdog_alert_class_produces_one_capsule(tmp_path):
+    sink = obs.TelemetrySink(str(tmp_path / "run"))
+    rec = obs_flight.FlightRecorder(str(tmp_path / "caps")).attach(sink)
+    try:
+        for i, kind in enumerate(obs.ALERT_KINDS):
+            sink.alert(kind, step=i, detail=f"injected {kind}")
+            sink.alert(kind, step=i, detail="repeat inside cooldown")
+    finally:
+        rec.detach()
+        sink.close()
+    assert _capsule_reasons(rec) == [
+        f"watchdog.{kind}" for kind in obs.ALERT_KINDS]
+    for path in rec.capsules:
+        doc = obs_flight.read_capsule(path)
+        assert doc["flight_schema"] == obs_flight.FLIGHT_SCHEMA_VERSION
+        assert doc["environment"]["backend"]
+        assert doc["ring_events"] == len(doc["ring"]) > 0
+        assert doc["trigger_event"]["event"] == "alert"
+    assert rec.write_failures == 0
+
+
+def test_rta_rung3_trips_and_rung1_does_not(tmp_path):
+    """The REAL monitor emitter drives the gating: a synthetic rung-3
+    episode (the poison_agent_at_step scrub) trips one capsule; a
+    rung-1 boosted re-solve episode is routine and trips nothing."""
+    sink = obs.TelemetrySink(str(tmp_path / "run"))
+    rec = obs_flight.FlightRecorder(str(tmp_path / "caps")).attach(sink)
+    try:
+        monitor.emit_rta_events(sink, [0, 0, 1, 1, 0])   # rung 1: routine
+        assert rec.capsules == []
+        monitor.emit_rta_events(sink, [0, 0, 3, 3, 0])   # rung 3: scrub
+    finally:
+        rec.detach()
+        sink.close()
+    assert _capsule_reasons(rec) == ["rta.engage"]
+    doc = obs_flight.read_capsule(rec.capsules[0])
+    assert doc["trigger_event"]["rung"] == 3
+
+
+def test_capsule_replay_stanza_roundtrips_config(tmp_path):
+    cfg = swarm.Config(n=6, steps=4, seed=9, gating="jnp",
+                       safety_distance=0.43)
+    rec = obs_flight.FlightRecorder(str(tmp_path / "caps"))
+    rec.note_request(swarm.Config(n=4, steps=4), request_id="r-prev")
+    path = rec.trip("manual.test", "roundtrip",
+                    request=obs_flight.request_stanza(
+                        cfg, request_id="r-bad", expect="safe"))
+    doc = obs_flight.read_capsule(path)
+    stanza = doc["request"]
+    assert stanza["schema"] == corpus.CORPUS_SCHEMA_VERSION
+    assert stanza["request_id"] == "r-bad"
+    rebuilt = corpus.rebuild_config(stanza["scenario"],
+                                    stanza["overrides"])
+    assert rebuilt == cfg                    # bit-exact config round-trip
+    assert doc["recent_requests"][0]["request_id"] == "r-prev"
+
+
+def test_capsule_cooldown_cap_and_disarm(tmp_path):
+    rec = obs_flight.FlightRecorder(str(tmp_path / "caps"),
+                                    cooldown_s=30.0, max_capsules=2)
+    assert rec.trip("r.a", "first") is not None
+    assert rec.trip("r.a", "cooling") is None       # same-reason cooldown
+    assert rec.trip("r.b", "second") is not None
+    assert rec.trip("r.c", "capped") is None        # max_capsules
+    disarmed = obs_flight.FlightRecorder(str(tmp_path / "caps2"),
+                                         armed=False)
+    assert disarmed.trip("r.a", "no-op") is None
+    assert disarmed.capsules == []
+
+
+def test_capsule_write_failure_is_counted_not_raised(tmp_path):
+    blocker = tmp_path / "blocked"
+    blocker.write_text("a file where the out_dir should be")
+    rec = obs_flight.FlightRecorder(str(blocker))
+    assert rec.trip("r.a", "doomed") is None
+    assert rec.write_failures == 1 and rec.capsules == []
+
+
+# ------------------------------------------------------- live surface --
+
+_PROM_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"(,[a-zA-Z_][a-zA-Z0-9_]*='
+    r'"[^"]*")*\})?'
+    r" (NaN|[-+]?[0-9.eE+-]+)$")
+_PROM_TYPE = re.compile(
+    r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) (counter|gauge|summary)$")
+
+
+def _parse_prom(text: str) -> tuple[dict[str, str], dict[str, float]]:
+    """Minimal Prometheus text-format parser: {family: type} and
+    {sample key: value}. Raises on any malformed line or duplicate."""
+    families: dict[str, str] = {}
+    samples: dict[str, float] = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        mt = _PROM_TYPE.match(line)
+        if mt:
+            assert mt.group(1) not in families, f"re-TYPE'd {line!r}"
+            families[mt.group(1)] = mt.group(2)
+            continue
+        ms = _PROM_SAMPLE.match(line)
+        assert ms, f"malformed sample line {line!r}"
+        key = line.rsplit(" ", 1)[0]
+        assert key not in samples, f"duplicate sample {key!r}"
+        samples[key] = (float("nan") if ms.group(4) == "NaN"
+                        else float(ms.group(4)))
+    return families, samples
+
+
+def _loaded_registry() -> MetricsRegistry:
+    reg = MetricsRegistry()
+    reg.counter("requests").add(5)
+    reg.gauge("queue_depth").set(3)
+    for v in (0.01, 0.02, 0.04, 0.08):
+        reg.histogram("latency[n16-t8]").observe(v)
+        reg.histogram("latency[n32-t8]").observe(v * 2)
+    # The heartbeat-tap shape: a gauge and a histogram on one base name.
+    reg.gauge("min_dist").set(0.14)
+    reg.histogram("min_dist").observe(0.14)
+    return reg
+
+
+def test_render_prom_parses_under_minimal_parser():
+    out = obs_export.render_prom(_loaded_registry().snapshot())
+    families, samples = _parse_prom(out)
+    assert families["cbf_requests"] == "counter"
+    assert families["cbf_queue_depth"] == "gauge"
+    assert families["cbf_latency"] == "summary"
+    assert samples["cbf_requests"] == 5.0
+    # Bucket convention lifted into a label, one family for both.
+    assert 'cbf_latency{quantile="0.5",bucket="n16-t8"}' in samples
+    assert 'cbf_latency_count{bucket="n32-t8"}' in samples
+    # Gauge/histogram base-name collision: histogram renamed, no dups.
+    assert families["cbf_min_dist"] == "gauge"
+    assert families["cbf_min_dist_hist"] == "summary"
+
+
+def test_split_bucket():
+    assert obs_export.split_bucket("lat[n16-t8]") == ("lat", "n16-t8")
+    assert obs_export.split_bucket("plain") == ("plain", None)
+
+
+def test_write_metrics_and_exporter_flush(tmp_path):
+    reg = _loaded_registry()
+    out = str(tmp_path / "m")
+    doc = obs_export.write_metrics(out, reg, extra={"queue": 3})
+    assert doc["extra"]["queue"] == 3
+    ondisk = json.load(open(os.path.join(out, obs_export.JSON_FILENAME)))
+    assert ondisk["metrics"]["requests"]["total"] == 5.0
+    _parse_prom(open(os.path.join(out, obs_export.PROM_FILENAME)).read())
+    assert not [p for p in os.listdir(out) if ".tmp" in p]  # atomic
+
+    exporter = obs_export.MetricsExporter(reg, out, every_s=60.0,
+                                          extra_fn=lambda: {"live": 1})
+    exporter.start()
+    exporter.stop()                          # start-write + final flush
+    assert exporter.writes >= 2 and exporter.write_failures == 0
+    ondisk = json.load(open(os.path.join(out, obs_export.JSON_FILENAME)))
+    assert ondisk["extra"]["live"] == 1
+
+
+def test_exporter_survives_throwing_extra_fn(tmp_path):
+    def boom():
+        raise RuntimeError("extra_fn bug")
+
+    exporter = obs_export.MetricsExporter(
+        MetricsRegistry(), str(tmp_path), every_s=60.0, extra_fn=boom)
+    assert exporter.write_once()
+    doc = json.load(open(os.path.join(str(tmp_path),
+                                      obs_export.JSON_FILENAME)))
+    assert doc["extra"] == {}
+
+
+# ----------------------------------------------------- AUD006 (bench) --
+
+def test_bench_regression_effective_rules():
+    assert effective({"value": 5.0})["source"] == "measured"
+    assert effective({"value": 0, "error": "wedged"}) is None
+    fb = effective({"value": 0, "error": "wedged",
+                    "last_verified": {"value": 7.5, "vs_baseline": 2}})
+    assert fb == {"value": 7.5, "source": "last_verified",
+                  "vs_baseline": 2}
+    assert effective({"metric": "x"}) is None
+
+
+def test_bench_regression_compare_detects_slide(tmp_path):
+    rounds = []
+    for i, parsed in enumerate((
+            {"metric": "rate", "unit": "u", "value": 100.0},
+            {"metric": "rate", "unit": "u", "value": 0, "error": "wedged"},
+            {"metric": "rate", "unit": "u", "value": 70.0})):
+        path = tmp_path / f"BENCH_r{i + 1:02d}.json"
+        path.write_text(json.dumps({"n": i + 1, "parsed": parsed}))
+        rounds.append((i + 1, str(path)))
+    series = collect_series(rounds)
+    (entries,) = series.values()
+    assert [e["verified"] for e in entries] == [True, False, True]
+    verdict = compare(series)                # 100 -> 70: -30% < -15%
+    (axis,) = verdict["axes"].values()
+    assert axis["status"] == "regressed" and not verdict["ok"]
+    ok = compare(series, tolerance=0.35)     # inside a looser tolerance
+    assert ok["ok"] and TOLERANCE == 0.15
+
+
+@pytest.mark.slow
+def test_bench_regression_audit_on_repo_rounds():
+    """The repo's own recorded rounds must pass the audit (exit 0)."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts",
+                                      "bench_regression.py"), "--json"],
+        capture_output=True, text=True, cwd=ROOT)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    verdict = json.loads(proc.stdout)
+    assert verdict["rule"] == "AUD006" and verdict["ok"]
+    assert verdict["axes"]                  # at least the headline axis
+
+
+@pytest.mark.slow
+def test_flight_overhead_within_budget():
+    """Armed-idle flight recorder <= 3% serve wall (subprocess: the
+    measurement controls its own backend, same as the other modes)."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "scripts", "telemetry_overhead.py"),
+         "--mode", "flight", "--reps", "3"],
+        capture_output=True, text=True, cwd=ROOT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stderr
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["capsules"] == 0              # armed means idle
+    assert rec["overhead"] <= 0.03, rec
+
+
+# ------------------------------------------------------ docs lockstep --
+
+def test_docs_cover_resource_observatory():
+    """docs/API.md "Resource observability & incident capsules" stays in
+    lockstep with the code surface (AUD001 enforces the event needles;
+    this pins the section itself and the operational names)."""
+    with open(os.path.join(ROOT, "docs", "API.md")) as fh:
+        text = fh.read()
+    assert "## Resource observability & incident capsules" in text
+    for needle in ("costmodel.json", "`serve.cost`", "`flight.capsule`",
+                   "`metrics.prom`", "`obs top", "`read_capsule",
+                   "`serve.cost_model.drift`", "`by_bucket`",
+                   "`compile_and_record", "`fits(", "AUD006",
+                   "`sigterm.drain`", "`watchdog.<kind>`"):
+        assert needle in text, f"docs/API.md: missing {needle!r}"

@@ -458,6 +458,137 @@ def test_fault_overhead_within_budget():
         f"(off {rec['off_s']}s, on {rec['on_s']}s)")
 
 
+# ---------------------------------------------------- incident capsules --
+#
+# ISSUE 11 acceptance: every serve fault class trips EXACTLY ONE
+# well-formed incident capsule on the attached flight recorder. The
+# engine calls flight.trip() at the fault site itself (no event-stream
+# subscription in between), so a bare FlightRecorder on the engine is
+# the whole wiring.
+
+def _flight(tmp_path, eng):
+    from cbf_tpu.obs import flight as obs_flight
+
+    eng.flight = obs_flight.FlightRecorder(str(tmp_path / "caps"))
+    return eng.flight
+
+
+def _one_capsule(rec, reason):
+    from cbf_tpu.obs import flight as obs_flight
+
+    assert rec.write_failures == 0
+    (path,) = rec.capsules
+    doc = obs_flight.read_capsule(path)
+    assert doc["reason"] == reason
+    assert doc["flight_schema"] == obs_flight.FLIGHT_SCHEMA_VERSION
+    return doc
+
+
+def test_nonfinite_capsule_replays_offending_config(engine, tmp_path):
+    """The poison capsule carries a verify-corpus replay stanza that
+    rebuilds the EXACT offending config — the incident is one
+    `obs incident <dir> --replay` away from a local repro."""
+    from cbf_tpu.verify import corpus
+
+    rec = _flight(tmp_path, engine)
+    cfgs = [_cfg(seed=i) for i in range(4)]
+    cfgs[2] = faults.poison_config(cfgs[2])
+    engine.start()
+    try:
+        pendings = [engine.submit(c) for c in cfgs]
+        for i, p in enumerate(pendings):
+            if i == 2:
+                with pytest.raises(NonFiniteResult):
+                    p.result(timeout=120)
+            else:
+                p.result(timeout=120)
+    finally:
+        engine.stop()
+    doc = _one_capsule(rec, "serve.nonfinite")
+    stanza = doc["request"]
+    assert stanza["expect"] == "violates"
+    rebuilt = corpus.rebuild_config(stanza["scenario"], stanza["overrides"])
+    assert rebuilt == cfgs[2]                         # bit-exact repro
+    # Healthy batch-mates are in the recent-request context window.
+    seen = {r["request_id"] for r in doc["recent_requests"]}
+    assert {p.request_id for p in pendings} <= seen
+
+
+def test_quarantine_open_trips_one_capsule(warm_execs, tmp_path):
+    eng = _engine(flush_deadline_s=0.02)
+    eng._execs = warm_execs
+    eng.fault_policy = FaultPolicy(max_retries=0, quarantine_threshold=2,
+                                   quarantine_cooldown_s=30.0)
+    eng.fault_hook = faults.serve_executor_fault(
+        times=2, exc=ValueError("permanent model bug"))
+    rec = _flight(tmp_path, eng)
+    eng.start()
+    try:
+        for _ in range(2):                            # strike, strike, open
+            with pytest.raises(ValueError):
+                eng.submit(_cfg(seed=0)).result(timeout=120)
+    finally:
+        eng.stop()
+    doc = _one_capsule(rec, "serve.quarantine")       # opened once -> one
+    assert doc["request"] is not None                 # offender rides along
+
+
+def test_bucket_breaker_open_trips_one_capsule(warm_execs, tmp_path):
+    """One compile failure merely charges the bucket breaker (no
+    capsule); the failure that OPENS it trips exactly one."""
+    eng = _engine(flush_deadline_s=60.0)
+    eng._execs = warm_execs
+    eng.fault_policy = FaultPolicy(max_retries=0, breaker_threshold=2)
+    eng.fault_hook = faults.serve_compile_failure(times=2)
+    rec = _flight(tmp_path, eng)
+    with pytest.raises(faults.InjectedExecutorFault):
+        eng.run([_cfg(seed=0)])                       # charge: no capsule
+    assert rec.capsules == []
+    with pytest.raises(faults.InjectedExecutorFault):
+        eng.run([_cfg(seed=1)])                       # open: one capsule
+    _one_capsule(rec, "serve.breaker")
+
+
+def test_scheduler_crash_trips_one_capsule(warm_execs, tmp_path,
+                                           monkeypatch):
+    eng = _engine(flush_deadline_s=60.0)
+    eng._execs = warm_execs
+    rec = _flight(tmp_path, eng)
+    eng.start()
+    try:
+        p = eng.submit(_cfg(seed=0))
+        time.sleep(0.05)
+
+        def boom(now):
+            raise RuntimeError("injected scheduler bug")
+
+        monkeypatch.setattr(eng, "_scan_queue", boom)
+        with eng._cond:
+            eng._cond.notify()
+        with pytest.raises(SchedulerCrashed):
+            p.result(timeout=10)
+    finally:
+        eng.stop(drain=False)
+    doc = _one_capsule(rec, "serve.scheduler_crash")
+    assert "RuntimeError" in doc["detail"]
+
+
+def test_sigterm_drain_trips_one_capsule(warm_execs, tmp_path):
+    """A preemption-driven drain is an incident worth a capsule: the
+    queued request still resolves (durable-drain contract) AND the
+    capsule records what was in flight when the node went away."""
+    eng = _engine(flush_deadline_s=60.0)
+    eng._execs = warm_execs
+    rec = _flight(tmp_path, eng)
+    eng.start()
+    p = eng.submit(_cfg(seed=0))
+    eng._preempt.set()                                # as the handler does
+    eng.stop(drain=True)
+    assert p.result(timeout=0).n == 10                # drained, not dropped
+    doc = _one_capsule(rec, "sigterm.drain")
+    assert doc["recent_requests"][0]["request_id"] == p.request_id
+
+
 # ---------------------------------------------------------------- docs --
 
 def test_fault_tolerance_documented():
