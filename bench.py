@@ -98,6 +98,13 @@ Modes / env knobs:
     (steady-state sweep rate) axes. Knobs: BENCH_VERIFY_N (256),
     BENCH_VERIFY_STEPS (200), BENCH_VERIFY_BATCH (16),
     BENCH_VERIFY_ROUNDS (3). See docs/BENCH_LOG.md Round 9.
+  BENCH_SCEN=1 — scenario-platform sweep mode (cbf_tpu.scenarios.platform):
+    generate the seeded procedural scenario batch (spawn x goal x
+    obstacle x dynamics ingredients, mixed single+double heterogeneous
+    swarms included), run every scenario end to end, and gate each
+    against its dynamics family's calibrated safety floor. Reports sweep
+    rate + the per-scenario safety table. Knobs: BENCH_SCEN_SEED (0),
+    BENCH_SCEN_COUNT (20).
   BENCH_SLO=1 — SLO latency mode (cbf_tpu.serve.loadgen): open-loop
     seeded Poisson x bounded-Pareto traffic at a FIXED offered rate
     through the serving engine; reports achieved sustained RPS,
@@ -194,7 +201,12 @@ def _dynamics_floor(dynamics: str) -> float:
     validation choke point: an unknown family must fail loudly (ValueError
     = permanent, no retry) rather than fall through to a floor that was
     never measured for it."""
+    # mixed: heterogeneous single+double swarms bound by the conservative
+    # union of the two families' calibrated floors — the double rows'
+    # inertial transients dominate (tests/test_platform.py pins the
+    # generated-scenario sweep above it).
     floors = {"single": SAFETY_FLOOR, "double": SAFETY_FLOOR_DOUBLE,
+              "mixed": SAFETY_FLOOR_DOUBLE,
               "unicycle": SAFETY_FLOOR_UNICYCLE}
     if dynamics not in floors:
         raise ValueError(
@@ -1312,6 +1324,65 @@ def _child_slo(steps: int) -> dict:
     return result
 
 
+def _child_scen(steps: int) -> dict:
+    """BENCH_SCEN mode: scenario-platform sweep harness
+    (cbf_tpu.scenarios.platform). Generates the seeded procedural batch
+    — BENCH_SCEN_COUNT specs from one BENCH_SCEN_SEED rng stream, spawn
+    x goal x obstacle x dynamics ingredients including mixed
+    single+double heterogeneous swarms — runs every scenario end to end,
+    and gates each against its dynamics family's calibrated safety
+    floor. The metric is sweep rate (scenarios/s), but the point of the
+    record is the per-scenario safety table: the procedural surface the
+    filter is certified over, re-measured on real hardware.
+
+    Knobs: BENCH_SCEN_SEED (0) — generator seed (same seed, same batch,
+    any host); BENCH_SCEN_COUNT (20) — batch size (index 3 is pinned
+    mixed-dynamics)."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from cbf_tpu.scenarios.platform import dsl
+
+    seed = _env_int("BENCH_SCEN_SEED", 0)
+    count = _env_int("BENCH_SCEN_COUNT", 20)
+    specs = dsl.generate(seed, count=count)
+    n_mixed = sum(s.dynamics == "mixed" for s in specs)
+    print(f"bench: scen seed={seed} count={count} mixed={n_mixed} "
+          f"names={[s.name for s in specs[:3]]}...", file=sys.stderr)
+    per = []
+    t0 = _time.perf_counter()
+    for s in specs:
+        _final, outs = dsl.run_spec(s)
+        md = float(jnp.min(outs.min_pairwise_distance))
+        inf = int(jnp.sum(outs.infeasible_count))
+        err = _check_safety(md, inf, floor=_dynamics_floor(s.dynamics))
+        if err:
+            return {"error": f"scenario {s.name}: {err}",
+                    "retryable": False}
+        per.append({"scenario": s.name, "n": s.n, "steps": s.steps,
+                    "dynamics": s.dynamics,
+                    "min_pairwise_distance": round(md, 6),
+                    "infeasible_count": inf})
+    wall = _time.perf_counter() - t0
+    print(f"bench: scen swept {count} scenarios in {wall:.1f}s "
+          f"(all above their floors)", file=sys.stderr)
+    return {
+        "metric": (f"scenario-platform sweep (seed={seed}, {count} "
+                   "generated scenarios, compile included)"),
+        "value": round(count / wall, 3) if wall else 0.0,
+        "unit": "scenarios_per_sec",
+        "vs_baseline": 0,   # a coverage axis, not the headline rate
+        "scen_seed": seed,
+        "scen_count": count,
+        "mixed_count": n_mixed,
+        "wall_s": round(wall, 3),
+        "platform": jax.devices()[0].platform,
+        "scenarios": per,
+    }
+
+
 def _child_chaos(steps: int) -> dict:
     """BENCH_CHAOS mode: fault-tolerance goodput harness
     (cbf_tpu.serve.resilience + cbf_tpu.utils.faults). Drives the SAME
@@ -1965,6 +2036,8 @@ def child_main(result_path: str, ensemble: bool) -> None:
     try:
         if os.environ.get("BENCH_PREEMPT", "0") == "1":
             result = _child_preempt(steps)
+        elif os.environ.get("BENCH_SCEN", "0") == "1":
+            result = _child_scen(steps)
         elif os.environ.get("BENCH_VERIFY", "0") == "1":
             result = _child_verify(steps)
         elif os.environ.get("BENCH_RTA", "0") == "1":
@@ -2083,6 +2156,8 @@ def main() -> None:
 
     if os.environ.get("BENCH_PREEMPT", "0") == "1":
         label = "preempt rounds=%d" % _env_int("BENCH_PREEMPT_ROUNDS", 3)
+    elif os.environ.get("BENCH_SCEN", "0") == "1":
+        label = "scen count=%d" % _env_int("BENCH_SCEN_COUNT", 20)
     elif os.environ.get("BENCH_VERIFY", "0") == "1":
         label = "verify N=%d" % _env_int("BENCH_VERIFY_N", 256)
     elif os.environ.get("BENCH_RTA", "0") == "1":

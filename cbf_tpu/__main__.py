@@ -926,13 +926,13 @@ def cmd_loadgen(args) -> int:
 
 
 def _verify_scenarios():
-    """scenario -> (module, steps_field) for the falsification CLI (no
-    render imports — verify runs headless)."""
-    from cbf_tpu.scenarios import cross_and_rescue, meet_at_center, swarm
+    """scenario -> (make_config, steps_field) for the falsification CLI,
+    driven by the platform registry so registered/generated scenarios
+    enroll without CLI edits (no render imports — verify runs headless)."""
+    from cbf_tpu.scenarios.platform import registry
 
-    return {"swarm": (swarm, "steps"),
-            "meet_at_center": (meet_at_center, "iterations"),
-            "cross_and_rescue": (cross_and_rescue, "iterations")}
+    return {e.name: (e.make_config, e.steps_field)
+            for e in registry.entries()}
 
 
 def _weakened_cbf(scenario: str, cfg, pairs: list[str]):
@@ -945,8 +945,13 @@ def _weakened_cbf(scenario: str, cfg, pairs: list[str]):
     from cbf_tpu.core.filter import CBFParams
     from cbf_tpu.scenarios import swarm
 
-    base = (swarm.default_cbf(cfg) if scenario == "swarm"
-            else CBFParams(max_speed=cfg.max_speed))
+    if scenario == "swarm" or getattr(cfg, "spawn", None) is not None:
+        base = swarm.default_cbf(cfg)   # swarm or a DSL-generated swarm
+    elif scenario == "antipodal":
+        # matches antipodal.make's default: velocity box, no brake term
+        base = CBFParams(max_speed=cfg.max_speed, k=0.0)
+    else:
+        base = CBFParams(max_speed=cfg.max_speed)
     updates = {}
     for pair in pairs:
         key, _, raw = pair.partition("=")
@@ -971,8 +976,8 @@ def cmd_verify(args) -> int:
 
     from cbf_tpu import verify as V
 
-    module, steps_field = _verify_scenarios()[args.scenario]
-    cfg = _apply_overrides(module.Config(), args.set, args.steps,
+    make_config, steps_field = _verify_scenarios()[args.scenario]
+    cfg = _apply_overrides(make_config(), args.set, args.steps,
                            steps_field, need_trajectory=False)
     cbf = _weakened_cbf(args.scenario, cfg, args.weaken)
     settings = V.SearchSettings(
@@ -1074,6 +1079,99 @@ def cmd_verify(args) -> int:
         if "corpus" in record:
             print(f"archived: {record['corpus']}")
     return 3 if found is not None else 0
+
+
+def cmd_scenario(args) -> int:
+    """Scenario-platform commands. ``list`` prints the registry;
+    ``gen`` runs the seeded procedural generator (enrolling the batch
+    for this process, optionally running every scenario); ``run``
+    executes one registered scenario end to end (regenerate a batch in-
+    process with --gen-seed to reach generated names)."""
+    if getattr(args, "platform", None):
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from cbf_tpu.scenarios.platform import dsl, registry
+
+    if args.scenario_command == "list":
+        print(json.dumps({"scenarios": [
+            {"name": e.name, "adapter": e.adapter,
+             "steps_field": e.steps_field, "servable": e.servable,
+             "generated": e.generated} for e in registry.entries()]}))
+        return 0
+
+    if args.scenario_command == "gen":
+        sink = None
+        if args.telemetry_dir:
+            from cbf_tpu import obs
+            from cbf_tpu.scenarios import swarm
+
+            sink = obs.TelemetrySink(
+                args.telemetry_dir,
+                manifest=obs.build_manifest(swarm.Config(), extra={
+                    "scenario": "platform.gen", "gen_seed": args.seed,
+                    "gen_count": args.count}))
+        specs = dsl.generate(args.seed, count=args.count, telemetry=sink)
+        dsl.enroll(specs, replace=True)
+        record = {"seed": args.seed, "count": len(specs),
+                  "scenarios": [dataclasses.asdict(s) for s in specs]}
+        if args.run:
+            import jax.numpy as jnp
+            runs = []
+            for s in specs:
+                _final, outs = dsl.run_spec(s, telemetry=sink)
+                runs.append({
+                    "scenario": s.name,
+                    "min_pairwise_distance": round(float(
+                        jnp.min(outs.min_pairwise_distance)), 6),
+                    "infeasible_count": int(
+                        jnp.sum(outs.infeasible_count))})
+            record["runs"] = runs
+        if sink is not None:
+            sink.summary()
+            sink.close()
+            record["telemetry"] = sink.run_dir
+        print(json.dumps(record))
+        return 0
+
+    # scenario run NAME
+    if args.gen_seed is not None:
+        dsl.enroll(dsl.generate(args.gen_seed, count=args.gen_count),
+                   replace=True)
+    try:
+        entry = registry.get(args.name)
+    except KeyError as e:
+        print(f"scenario run: {e.args[0]}", file=sys.stderr)
+        return 2
+    if not entry.servable:
+        print(f"scenario run: {args.name!r} is not a platform "
+              "(swarm.Config) scenario — use `python -m cbf_tpu run "
+              f"{args.name}`", file=sys.stderr)
+        return 2
+    cfg = _apply_overrides(entry.make_config(), args.set, args.steps,
+                           entry.steps_field, need_trajectory=False)
+    sink = None
+    if args.telemetry_dir:
+        from cbf_tpu import obs
+
+        sink = obs.TelemetrySink(
+            args.telemetry_dir,
+            manifest=obs.build_manifest(cfg, extra={
+                "scenario": args.name, "steps": cfg.steps}))
+    import jax.numpy as jnp
+    _final, outs = dsl.run_config(args.name, cfg, telemetry=sink)
+    record = {"scenario": args.name, "n": cfg.n, "steps": cfg.steps,
+              "dynamics": cfg.dynamics,
+              "min_pairwise_distance": round(float(
+                  jnp.min(outs.min_pairwise_distance)), 6),
+              "infeasible_count": int(jnp.sum(outs.infeasible_count))}
+    if sink is not None:
+        sink.summary()
+        sink.close()
+        record["telemetry"] = sink.run_dir
+    print(json.dumps(record))
+    return 0
 
 
 def cmd_lint(args) -> int:
@@ -1333,8 +1431,7 @@ def main(argv=None) -> int:
                        "(docs/API.md 'Verification'); exit 3 = violation "
                        "found")
     verp.add_argument("scenario", nargs="?", default="swarm",
-                      choices=("swarm", "meet_at_center",
-                               "cross_and_rescue"))
+                      choices=sorted(_verify_scenarios()))
     verp.add_argument("--platform", default=None, choices=("cpu", "tpu"),
                       help="force a JAX backend before first use")
     verp.add_argument("--steps", type=int, default=None,
@@ -1390,6 +1487,51 @@ def main(argv=None) -> int:
     verp.add_argument("--json", action="store_true",
                       help="machine-readable output (one JSON object)")
     verp.set_defaults(fn=cmd_verify)
+
+    scenp = sub.add_parser(
+        "scenario", help="scenario platform: list the registry, generate "
+                         "a seeded procedural batch, or run one "
+                         "(docs/API.md 'Scenario platform')")
+    scen_sub = scenp.add_subparsers(dest="scenario_command", required=True)
+    slistp = scen_sub.add_parser(
+        "list", help="print the scenario registry as JSON")
+    slistp.set_defaults(fn=cmd_scenario)
+    sgenp = scen_sub.add_parser(
+        "gen", help="seeded procedural generation: same seed, same specs")
+    sgenp.add_argument("--platform", default=None, choices=("cpu", "tpu"),
+                       help="force a JAX backend before first use")
+    sgenp.add_argument("--seed", type=int, default=0,
+                       help="generator seed (default 0)")
+    sgenp.add_argument("--count", type=int, default=20,
+                       help="specs to generate (default 20; index 3 is "
+                            "pinned mixed-dynamics)")
+    sgenp.add_argument("--run", action="store_true",
+                       help="also run every generated scenario and report "
+                            "its safety aggregates")
+    sgenp.add_argument("--telemetry-dir", default=None,
+                       help="write scenario.generated (+ scenario.run "
+                            "with --run) events into a run directory")
+    sgenp.set_defaults(fn=cmd_scenario)
+    srunp = scen_sub.add_parser(
+        "run", help="run one registered scenario end to end")
+    srunp.add_argument("name", help="registered scenario name (builtin, "
+                                    "or generated via --gen-seed)")
+    srunp.add_argument("--platform", default=None, choices=("cpu", "tpu"),
+                       help="force a JAX backend before first use")
+    srunp.add_argument("--gen-seed", type=int, default=None,
+                       help="regenerate+enroll this generator batch "
+                            "first, so generated names resolve")
+    srunp.add_argument("--gen-count", type=int, default=20,
+                       help="batch size for --gen-seed (default 20)")
+    srunp.add_argument("--steps", type=int, default=None,
+                       help="override the rollout horizon")
+    srunp.add_argument("--set", action="append", default=[],
+                       metavar="FIELD=VALUE",
+                       help="override any config field")
+    srunp.add_argument("--telemetry-dir", default=None,
+                       help="write a run directory with a scenario.run "
+                            "event")
+    srunp.set_defaults(fn=cmd_scenario)
 
     sub.add_parser("list", help="list scenarios + config knobs") \
         .set_defaults(fn=cmd_list)

@@ -17,7 +17,11 @@ existing tier-1 tests and operator muscle memory keep working.
   iteration's serialized pair-op chain vs its pinned bound);
 * AUD004 — reproducibility: no seedless np.random anywhere a verify
   run's bit-replayability could route through (born in this module,
-  not a former script).
+  not a former script);
+* AUD007 — scenario-platform coverage: every registered scenario is
+  enrolled across the full stack (verify adapter + calibrated
+  thresholds + NumPy-twin parity test + docs/API.md row), and every
+  scenario module on disk is registered.
 """
 
 from __future__ import annotations
@@ -178,6 +182,16 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
             f"{obs_flight.EMITTED_EVENT_TYPES!r} != "
             f"obs.schema.FLIGHT_EVENT_TYPES {schema.FLIGHT_EVENT_TYPES!r} "
             "— emitter and schema drifted")
+    # Scenario-platform event drift: the generator DSL's declared
+    # emissions must match the schema's scenario family exactly.
+    from cbf_tpu.scenarios.platform import dsl as scen_dsl
+    if tuple(scen_dsl.EMITTED_EVENT_TYPES) != \
+            tuple(schema.SCENARIO_EVENT_TYPES):
+        problems.append(
+            f"scenarios.platform.dsl.EMITTED_EVENT_TYPES "
+            f"{scen_dsl.EMITTED_EVENT_TYPES!r} != "
+            f"obs.schema.SCENARIO_EVENT_TYPES "
+            f"{schema.SCENARIO_EVENT_TYPES!r} — emitter and schema drifted")
     for table_name, types_name, fields, types in (
             ("SERVE_EVENT_FIELDS", "SERVE_EVENT_TYPES",
              schema.SERVE_EVENT_FIELDS, schema.SERVE_EVENT_TYPES),
@@ -188,7 +202,9 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
             ("RTA_EVENT_FIELDS", "RTA_EVENT_TYPES",
              schema.RTA_EVENT_FIELDS, schema.RTA_EVENT_TYPES),
             ("FLIGHT_EVENT_FIELDS", "FLIGHT_EVENT_TYPES",
-             schema.FLIGHT_EVENT_FIELDS, schema.FLIGHT_EVENT_TYPES)):
+             schema.FLIGHT_EVENT_FIELDS, schema.FLIGHT_EVENT_TYPES),
+            ("SCENARIO_EVENT_FIELDS", "SCENARIO_EVENT_TYPES",
+             schema.SCENARIO_EVENT_FIELDS, schema.SCENARIO_EVENT_TYPES)):
         for etype in fields:
             if etype not in types:
                 problems.append(
@@ -210,7 +226,8 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
     # that way is what makes this check (and grep) possible.
     import inspect
     for mod in (verify_search, serve_engine, obs_trace, serve_loadgen,
-                durable_journal, durable_rollout, rta_monitor, obs_flight):
+                durable_journal, durable_rollout, rta_monitor, obs_flight,
+                scen_dsl):
         try:
             mod_tree = ast.parse(inspect.getsource(mod))
         except (OSError, TypeError):
@@ -259,7 +276,8 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
                 ("durable", schema.DURABLE_EVENT_FIELDS),
                 ("loadgen", schema.LOADGEN_EVENT_FIELDS),
                 ("rta", schema.RTA_EVENT_FIELDS),
-                ("flight", schema.FLIGHT_EVENT_FIELDS)):
+                ("flight", schema.FLIGHT_EVENT_FIELDS),
+                ("scenario", schema.SCENARIO_EVENT_FIELDS)):
             for etype, fields in table.items():
                 if f"`{etype}`" not in api_text:
                     problems.append(
@@ -606,6 +624,83 @@ def reproducibility_audit(repo_root: str | None = None) -> list[str]:
     return problems
 
 
+# -- AUD007: scenario-platform coverage ------------------------------------
+
+def scenario_coverage_audit(repo_root: str | None = None) -> list[str]:
+    """AUD007: the scenario registry's full-stack enrollment contract.
+
+    Every registered scenario must reach the whole stack, not just the
+    rollout loop: its ``adapter`` key must exist in
+    ``verify.search.ADAPTER_BUILDERS`` and its default config must have
+    calibrated property thresholds (falsification enrolls for free);
+    its ``parity_test`` needle must appear in ``tests/`` (the NumPy
+    margin twin is covered); and — for the hand-written builtins — its
+    name must have a backticked row in docs/API.md. The inverse leg
+    catches staleness: a ``cbf_tpu/scenarios/*.py`` module that never
+    registers is invisible to verify/serve/bench and fails here."""
+    repo = repo_root or _REPO
+    problems: list[str] = []
+    from cbf_tpu.scenarios.platform import registry as scen_registry
+    from cbf_tpu.verify import properties as verify_properties
+    from cbf_tpu.verify import search as verify_search
+
+    test_blobs = []
+    tests_dir = os.path.join(repo, "tests")
+    for dirpath, _dirs, files in os.walk(tests_dir):
+        for name in sorted(files):
+            if name.endswith(".py"):
+                with open(os.path.join(dirpath, name),
+                          encoding="utf-8") as fh:
+                    test_blobs.append(fh.read())
+    test_blob = "\n".join(test_blobs)
+
+    api_path = os.path.join(repo, "docs", "API.md")
+    try:
+        with open(api_path, encoding="utf-8") as fh:
+            api_text = fh.read()
+    except OSError:
+        problems.append(f"docs/API.md unreadable at {api_path}")
+        api_text = ""
+
+    for entry in scen_registry.entries():
+        if entry.adapter not in verify_search.ADAPTER_BUILDERS:
+            problems.append(
+                f"scenario {entry.name!r}: adapter key {entry.adapter!r} "
+                "has no verify.search.ADAPTER_BUILDERS entry — "
+                "falsification cannot enroll it")
+        else:
+            try:
+                verify_properties.thresholds_for(entry.name,
+                                                 entry.make_config())
+            except ValueError as e:
+                problems.append(
+                    f"scenario {entry.name!r}: no calibrated property "
+                    f"thresholds ({e})")
+        if entry.parity_test not in test_blob:
+            problems.append(
+                f"scenario {entry.name!r}: parity-test needle "
+                f"{entry.parity_test!r} not found in tests/ — its NumPy "
+                "twin is uncovered")
+        if not entry.generated and api_text \
+                and f"`{entry.name}`" not in api_text:
+            problems.append(
+                f"scenario {entry.name!r} has no `{entry.name}` row in "
+                "docs/API.md")
+
+    registered_mods = {e.module.rsplit(".", 1)[-1]
+                       for e in scen_registry.entries()}
+    scen_dir = os.path.join(repo, "cbf_tpu", "scenarios")
+    for name in sorted(os.listdir(scen_dir)):
+        if not name.endswith(".py") or name.startswith("_"):
+            continue
+        if name[:-3] not in registered_mods:
+            problems.append(
+                f"cbf_tpu/scenarios/{name} is not registered with "
+                "scenarios.platform.registry — a stale scenario module "
+                "the stack cannot see (register it or remove it)")
+    return problems
+
+
 # -- runner ----------------------------------------------------------------
 
 def run_audits(repo_root: str | None = None) -> list[Finding]:
@@ -623,4 +718,8 @@ def run_audits(repo_root: str | None = None) -> list[Finding]:
     for msg in reproducibility_audit(repo_root):
         findings.append(Finding("AUD004", msg.split(":", 1)[0], 0, 0,
                                 "<reproducibility>", msg))
+    for msg in scenario_coverage_audit(repo_root):
+        findings.append(Finding("AUD007",
+                                "cbf_tpu/scenarios/platform/registry.py",
+                                0, 0, "<scenario>", msg))
     return findings

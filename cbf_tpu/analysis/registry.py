@@ -132,6 +132,11 @@ _RULES = [
          "seed, or any global-generator draw) in cbf_tpu/scripts/"
          "examples/bench — verify runs must be bit-replayable from "
          "their corpus record"),
+    Rule("AUD007", ERROR,
+         "scenario-platform coverage: a registered scenario missing its "
+         "verify adapter, calibrated thresholds, NumPy-twin parity test "
+         "or docs/API.md row — or a scenario module on disk that never "
+         "registers (invisible to verify/serve/bench)"),
 ]
 
 RULES: dict[str, Rule] = {r.id: r for r in _RULES}

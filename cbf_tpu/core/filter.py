@@ -121,7 +121,32 @@ def safe_controls(robot_states, obs_states, obs_mask, f, g, u0,
     non-endangered agents (meet_at_center.py:136) — so for exact parity
     including |u0| > max_speed, callers should select
     ``where(mask.any(-1), u_filtered, u0)``; the rollout engine does.
+
+    Heterogeneous swarms (``swarm.Config(dynamics="mixed")``) pass
+    PER-AGENT dynamics — f: (N, 4, 4), g: (N, 4, 2) — and CBFParams whose
+    leaves may be (N,) arrays (per-row box bound / velocity term). That
+    shape routes through a plain vmap of :func:`safe_control` with the
+    dynamics (and any per-agent params leaf) mapped over axis 0: each row
+    is solved against ITS OWN family's rows and box, branch-free.
     """
+    if f.ndim == 3:
+        p_ax = CBFParams(*(0 if jnp.ndim(l) == 1 else None
+                           for l in params))
+        fn = functools.partial(
+            safe_control, max_relax=max_relax, unroll_relax=unroll_relax,
+            reference_layout=reference_layout, vel_box_rows=vel_box_rows,
+            priority_relax_weight=priority_relax_weight,
+            relax_cap=relax_cap,
+        )
+        if priority_mask is None:
+            return jax.vmap(fn, in_axes=(0, 0, 0, 0, 0, 0, p_ax))(
+                robot_states, obs_states, obs_mask, f, g, u0, params)
+        return jax.vmap(
+            lambda s, o, m, fi, gi, u, p, pri: fn(s, o, m, fi, gi, u, p,
+                                                  priority_mask=pri),
+            in_axes=(0, 0, 0, 0, 0, 0, p_ax, 0),
+        )(robot_states, obs_states, obs_mask, f, g, u0, params,
+          priority_mask)
     if unroll_relax > 0:
         # Differentiable path (unrolled relax rounds) — plain vmap; tiered
         # relaxation is exact per row here (no dedup classes needed).

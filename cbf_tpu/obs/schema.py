@@ -215,10 +215,14 @@ LOADGEN_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
     # {completed, errors, queue_wait_p50_s/p95_s/p99_s,
     # execute_p50_s/p95_s/p99_s}} — so a knee-finding sweep can see WHICH
     # bucket stalls, not just that one did.
+    # by_scenario: per-scenario-name SLO split for mixed scenario feeds
+    # (LoadSpec.scenario_mix) — {scenario: {completed, errors,
+    # latency_p50_s/p95_s/p99_s}}.
     "loadgen.summary": ("seed", "offered_rps", "achieved_rps", "requests",
                         "completed", "errors", "duration_s",
                         "latency_p50_s", "latency_p95_s", "latency_p99_s",
-                        "queue_wait_p99_s", "execute_p99_s", "by_bucket"),
+                        "queue_wait_p99_s", "execute_p99_s", "by_bucket",
+                        "by_scenario"),
 }
 
 #: The runtime-assurance auditor's events (``cbf_tpu.rta.monitor``):
@@ -252,6 +256,25 @@ FLIGHT_EVENT_TYPES: tuple[str, ...] = ("flight.capsule",)
 FLIGHT_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
     "flight.capsule": ("reason", "detail", "capsule", "events",
                        "trigger_event"),
+}
+
+#: The scenario platform's events (``cbf_tpu.scenarios.platform.dsl``):
+#: ``scenario.generated`` once per :func:`generate` call (the seed, how
+#: many specs it produced, and their names — the provenance record that
+#: ties a sweep's trajectory files back to the generator inputs),
+#: ``scenario.run`` once per platform-driven rollout (which scenario, its
+#: size/horizon/dynamics family, and the rollout's safety floor and
+#: infeasibility count). Same AUD001 contract as the other tables:
+#: ``scenarios.platform.dsl.EMITTED_EVENT_TYPES`` must equal this tuple,
+#: every type needs a literal emit site, and every type and field must
+#: be documented in docs/API.md.
+SCENARIO_EVENT_TYPES: tuple[str, ...] = ("scenario.generated",
+                                         "scenario.run")
+
+SCENARIO_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
+    "scenario.generated": ("seed", "count", "names"),
+    "scenario.run": ("scenario", "n", "steps", "dynamics",
+                     "min_pairwise_distance", "infeasible_count"),
 }
 
 

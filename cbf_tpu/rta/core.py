@@ -166,7 +166,7 @@ def latch_update(mode, streak, demanded, recover_steps: int):
 
 
 def backup_control(v, *, dynamics: str, vel_tracking_tau: float = 0.2,
-                   accel_limit: float = 1.0):
+                   accel_limit: float = 1.0, dynamics_mask=None):
     """(N, 2) closed-form provably-safe backup command (rungs 2-3).
 
     single/unicycle (velocity-space commands): a zero command — the
@@ -175,9 +175,21 @@ def backup_control(v, *, dynamics: str, vel_tracking_tau: float = 0.2,
     braking toward zero velocity, the velocity-tracking PD at a zero
     setpoint capped at the actuator limit. No iterative solve on this
     path — it must work precisely when the solvers don't.
+
+    mixed (heterogeneous swarm): ``dynamics_mask`` (N,) bool selects the
+    double rows — they brake, single rows hold — branch-free per row.
+    The mask is required there (a silently-zero backup on a moving
+    double row would NOT be safe: it coasts).
     """
     if dynamics == "double":
         return l2_cap(-v / vel_tracking_tau, accel_limit)
+    if dynamics == "mixed":
+        if dynamics_mask is None:
+            raise ValueError(
+                'backup_control(dynamics="mixed") requires dynamics_mask')
+        return jnp.where(dynamics_mask[:, None],
+                         l2_cap(-v / vel_tracking_tau, accel_limit),
+                         jnp.zeros_like(v))
     return jnp.zeros_like(v)
 
 

@@ -1,1 +1,2 @@
-from cbf_tpu.scenarios import meet_at_center, cross_and_rescue, swarm  # noqa: F401
+from cbf_tpu.scenarios import (  # noqa: F401
+    antipodal, cross_and_rescue, meet_at_center, swarm)

@@ -1,0 +1,15 @@
+"""Scenario platform: registry + composable generator DSL.
+
+The registry (:mod:`.registry`) is the single place the rest of the
+stack learns what a scenario is — verify adapters, serve bucket
+signatures, RTA enrollment, telemetry, and the AUD007 coverage audit
+all key off it. The DSL (:mod:`.dsl`) generates seeded deterministic
+``swarm.Config``-producing specs from composable ingredients (spawn
+distribution x goal structure x obstacle field x dynamics family,
+including mixed single+double heterogeneous swarms).
+"""
+
+from cbf_tpu.scenarios.platform.dsl import (  # noqa: F401
+    ScenarioSpec, enroll, generate, run_config, run_spec)
+from cbf_tpu.scenarios.platform.registry import (  # noqa: F401
+    ScenarioEntry, builtin_entries, entries, get, names, register)

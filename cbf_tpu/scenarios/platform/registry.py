@@ -1,0 +1,122 @@
+"""The scenario registry — the ONE place the rest of the stack learns
+what a scenario is.
+
+Every entry carries the hooks the full stack needs to enroll a scenario
+automatically: a config factory (the runnable spec), the verify
+subsystem's adapter-builder key (``verify.search.ADAPTER_BUILDERS``), the
+steps-field name the CLI override path uses, whether the serving engine
+can take it (it submits ``swarm.Config`` objects only), and the needle
+its NumPy-twin parity test must carry in ``tests/`` (enforced by AUD007,
+``analysis.audits.scenario_coverage_audit`` — a registered scenario with
+no adapter, no parity test, or no docs/API.md row fails tier-1, as does
+a scenario module on disk that never registers).
+
+Builtin entries cover the four hand-written scenario modules; the
+generator DSL (:mod:`cbf_tpu.scenarios.platform.dsl`) registers its
+seeded procedural scenarios through the same :func:`register` door, so
+falsification, serving, RTA, and telemetry see generated scenarios
+exactly the way they see hand-written ones.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+
+class ScenarioEntry(NamedTuple):
+    """One registered scenario.
+
+    ``make_config()`` returns the scenario's default runnable config
+    object (a ``swarm.Config`` for every servable entry). ``adapter`` is
+    the key into ``verify.search.ADAPTER_BUILDERS`` — falsification
+    enrolls through it for free. ``steps_field`` names the horizon field
+    on the config (the CLI/verify override path). ``servable`` marks
+    configs the serve engine accepts (``swarm.Config`` only — the
+    engine's bucket signature is derived from its static fields).
+    ``parity_test`` is the needle AUD007 greps for in ``tests/`` — the
+    scenario's NumPy-twin parity coverage. ``generated`` marks DSL
+    entries (excluded from the stale-module scan: they have no module
+    file of their own).
+    """
+    name: str
+    module: str
+    make_config: Callable[[], Any]
+    adapter: str
+    steps_field: str
+    servable: bool
+    parity_test: str
+    generated: bool = False
+
+
+_REGISTRY: dict[str, ScenarioEntry] = {}
+
+
+def register(entry: ScenarioEntry, *, replace: bool = False) -> None:
+    """Register a scenario. Re-registering an existing name raises
+    unless ``replace=True`` (the generator's idempotent re-enroll) — a
+    silent overwrite would let a generated scenario shadow a builtin."""
+    if entry.name in _REGISTRY and not replace:
+        raise ValueError(f"scenario {entry.name!r} is already registered")
+    _REGISTRY[entry.name] = entry
+
+
+def get(name: str) -> ScenarioEntry:
+    if name not in _REGISTRY:
+        raise KeyError(
+            f"unknown scenario {name!r}; registered: {', '.join(names())}")
+    return _REGISTRY[name]
+
+
+def names() -> tuple[str, ...]:
+    """Registered scenario names, registration order (builtins first)."""
+    return tuple(_REGISTRY)
+
+
+def entries() -> tuple[ScenarioEntry, ...]:
+    return tuple(_REGISTRY.values())
+
+
+def builtin_entries() -> tuple[ScenarioEntry, ...]:
+    """The hand-written (non-generated) entries — AUD007's audit set."""
+    return tuple(e for e in _REGISTRY.values() if not e.generated)
+
+
+def _swarm_config():
+    from cbf_tpu.scenarios import swarm
+    return swarm.Config()
+
+
+def _meet_config():
+    from cbf_tpu.scenarios import meet_at_center
+    return meet_at_center.Config()
+
+
+def _cross_config():
+    from cbf_tpu.scenarios import cross_and_rescue
+    return cross_and_rescue.Config()
+
+
+def _antipodal_config():
+    from cbf_tpu.scenarios import antipodal
+    return antipodal.Config()
+
+
+register(ScenarioEntry(
+    name="swarm", module="cbf_tpu.scenarios.swarm",
+    make_config=_swarm_config, adapter="swarm", steps_field="steps",
+    servable=True, parity_test="test_margin_parity_vs_numpy"))
+register(ScenarioEntry(
+    name="meet_at_center", module="cbf_tpu.scenarios.meet_at_center",
+    make_config=_meet_config, adapter="meet_at_center",
+    steps_field="iterations", servable=False,
+    parity_test="test_meet_at_center_trace_oracle_parity"))
+register(ScenarioEntry(
+    name="cross_and_rescue", module="cbf_tpu.scenarios.cross_and_rescue",
+    make_config=_cross_config, adapter="cross_and_rescue",
+    steps_field="iterations", servable=False,
+    parity_test="test_cross_and_rescue_full_horizon_oracle_parity"))
+register(ScenarioEntry(
+    name="antipodal", module="cbf_tpu.scenarios.antipodal",
+    make_config=_antipodal_config, adapter="antipodal",
+    steps_field="steps", servable=False,
+    parity_test="test_antipodal_margins_numpy_parity"))

@@ -147,6 +147,21 @@ class Config:
     # measured; the projection point is what the filter guarantees — body
     # centers sit within projection_distance of it).
     dynamics: str = "single"
+    # Heterogeneous swarm (the scenario platform's mixed-dynamics axis,
+    # a la Potato's data-oriented heterogeneous swarms): "mixed" runs a
+    # PER-AGENT single/double split in one swarm. Agents [0, n_double)
+    # are honest double integrators (acceleration control, carried
+    # velocity — the "double" physics above); the rest keep the
+    # single-integrator model with the exact DISCRETE barrier rows
+    # (real velocities are in play, so the static-world continuous rows
+    # would erode — same argument as barrier="discrete"). The mask is
+    # branch-free end to end: barrier_dynamics stacks per-agent (N,4,4)
+    # f / (N,4,2) g rows via jnp.where, the QP filter runs its
+    # per-agent vmapped path with PER-ROW box bounds (|a| <= accel_limit
+    # on double rows, |u| <= max_speed on single rows — default_cbf),
+    # and the integrator/backup-controller blend per row. n_double is
+    # static — it is part of the serving layer's bucket signature.
+    n_double: int = 0
     # Double mode only: actuator bound on acceleration (componentwise via
     # the QP box + L2 via the nominal cap), and the time constant of the
     # velocity-tracking PD that turns the nominal velocity field into a
@@ -286,6 +301,31 @@ class Config:
     # parallel.ensemble). Incompatible with gating="banded" and the
     # differentiable trainer path.
     gating_rebuild_skin: float = 0.0
+    # Scenario-platform ingredients (cbf_tpu.scenarios.platform): spawn
+    # distribution, goal structure, obstacle-field layout. All static
+    # (bucket-signature axes); the defaults reproduce the original
+    # swarm scenario BIT-EXACTLY (jittered-grid spawn, packed-disk
+    # rendezvous, orbiting obstacle ring).
+    # spawn: "grid" (jittered grid — the original), "ring" (circle,
+    # arc spacing >= 0.4), "clusters" (four corner sub-grids),
+    # "corridor" (0.4-spaced lane columns at the left arena edge).
+    # Every layout keeps the grid's collision-free guarantee: base
+    # spacing >= 0.4 with jitter <= 0.25*spacing per coordinate.
+    spawn: str = "grid"
+    # goal: "rendezvous" (the original closed-loop packed-disk
+    # consensus), or a fixed per-agent target layout ("coverage" — a
+    # grid over the spawn box; "corridor" — transit to mirrored lanes
+    # at the right arena edge; "formation" — a ring at >= 0.3 arc
+    # spacing). Non-rendezvous nominals are plain go-to-goal fields
+    # capped at speed_limit; the safety layer is untouched.
+    goal: str = "rendezvous"
+    # obstacle_layout: "orbit" (the original orbiting ring), "static"
+    # (the ring frozen at its t=0 pose, zero velocity — obstacle_omega
+    # unused), "scatter" (seed-free golden-angle spiral through the
+    # packing disk, zero velocity; obstacle_orbit_frac still scales the
+    # field radius). Procedural layouts get the same
+    # clear_obstacle_spawn clearance repair as the ring.
+    obstacle_layout: str = "orbit"
     dtype: type = jnp.float32
 
     # Override the spawn box half-width (None = density-safe default).
@@ -392,19 +432,109 @@ class State(NamedTuple):
     rta: tuple = ()
 
 
+def dynamics_mask(cfg: Config) -> jnp.ndarray:
+    """(N,) bool — True rows are the double-integrator agents of a
+    ``dynamics="mixed"`` swarm: agents ``[0, n_double)`` by construction,
+    so the mask is deterministic, static, and part of the serving
+    layer's bucket signature for free (``n_double`` is a static Config
+    field)."""
+    return jnp.arange(cfg.n) < cfg.n_double
+
+
+def spawn_layout(cfg: Config) -> tuple[np.ndarray, float]:
+    """Host-side un-jittered spawn layout for the configured ``spawn``
+    distribution: ``((N, 2) base positions, jitter spacing)``. Pure
+    numpy — the scenario platform's NumPy twin (tests pin
+    :func:`spawn_positions` == layout + seeded float32 jitter), and
+    usable without a live JAX backend.
+
+    Every layout keeps the original grid's collision-free contract:
+    base spacing >= 0.4 m and per-coordinate jitter <= 0.25*spacing, so
+    the worst-case post-jitter gap stays >= 0.5*spacing >= 0.2 m."""
+    n, half = cfg.n, cfg.spawn_half_width
+    if cfg.spawn == "grid":
+        side = int(np.ceil(np.sqrt(n)))
+        lin = np.linspace(-half, half, side)
+        gx, gy = np.meshgrid(lin, lin)
+        grid = np.stack([gx.ravel(), gy.ravel()], axis=1)[:n]
+        return grid, 2 * half / max(side - 1, 1)
+    if cfg.spawn == "ring":
+        # Arc spacing >= 0.4 (the radius grows with N past the point
+        # the configured half-width can hold the ring safely).
+        radius = max(half, 0.4 * n / (2 * np.pi))
+        th = 2 * np.pi * np.arange(n) / n
+        ring = radius * np.stack([np.cos(th), np.sin(th)], axis=1)
+        return ring, 2 * np.pi * radius / n
+    if cfg.spawn == "clusters":
+        # Four corner sub-grids at 0.4 m spacing; cluster centers far
+        # enough apart that sub-grids cannot overlap.
+        m = int(np.ceil(n / 4))
+        side = max(int(np.ceil(np.sqrt(m))), 1)
+        extent = 0.2 * (side - 1)
+        c = max(0.55 * half, extent + 0.4)
+        lin = 0.4 * (np.arange(side) - (side - 1) / 2.0)
+        gx, gy = np.meshgrid(lin, lin)
+        sub = np.stack([gx.ravel(), gy.ravel()], axis=1)
+        centers = np.array([[c, c], [-c, c], [-c, -c], [c, -c]])
+        rows = [sub[i // 4] + centers[i % 4] for i in range(n)]
+        return np.stack(rows, axis=0), 0.4
+    if cfg.spawn == "corridor":
+        # 0.4-spaced lane columns stacked leftward from the left arena
+        # edge — the corridor-transit start line.
+        lanes = max(int(np.ceil(np.sqrt(n))), 1)
+        j = np.arange(n)
+        x = -half - 0.4 * (j // lanes)
+        y = 0.4 * (j % lanes - (lanes - 1) / 2.0)
+        return np.stack([x, y], axis=1), 0.4
+    raise ValueError(
+        f"spawn must be grid|ring|clusters|corridor, got {cfg.spawn!r}")
+
+
+def goal_layout(cfg: Config) -> np.ndarray | None:
+    """Host-side (N, 2) per-agent goal points for the configured
+    ``goal`` structure, or ``None`` for the default rendezvous (whose
+    closed-loop centroid consensus has no fixed target layout). Pure
+    numpy over STATIC config fields only (``n``, spawn geometry) — the
+    serving layer's traced-config path embeds the result as constants,
+    so traced scalars must never enter here."""
+    n, half = cfg.n, cfg.spawn_half_width
+    if cfg.goal == "rendezvous":
+        return None
+    if cfg.goal == "coverage":
+        # n-point grid over the spawn box: spread out, don't converge.
+        side = int(np.ceil(np.sqrt(n)))
+        lin = np.linspace(-half, half, side)
+        gx, gy = np.meshgrid(lin, lin)
+        return np.stack([gx.ravel(), gy.ravel()], axis=1)[:n]
+    if cfg.goal == "formation":
+        # Ring formation at >= 0.3 arc spacing (agents can hold it at
+        # the 0.2 barrier floor with slack).
+        radius = max(1.0, 0.3 * n / (2 * np.pi))
+        th = 2 * np.pi * np.arange(n) / n
+        return radius * np.stack([np.cos(th), np.sin(th)], axis=1)
+    if cfg.goal == "corridor":
+        # Transit: mirrored lane columns at the right arena edge (the
+        # corridor spawn's reflection — every path crosses the middle).
+        lanes = max(int(np.ceil(np.sqrt(n))), 1)
+        j = np.arange(n)
+        x = half + 0.4 * (j // lanes)
+        y = 0.4 * (j % lanes - (lanes - 1) / 2.0)
+        return np.stack([x, y], axis=1)
+    raise ValueError(
+        f"goal must be rendezvous|coverage|corridor|formation, "
+        f"got {cfg.goal!r}")
+
+
 def spawn_positions(cfg: Config, seed) -> jnp.ndarray:
-    """Jittered-grid spawn: collision-free (N, 2) start at any N.
+    """Seeded collision-free (N, 2) start for the configured spawn
+    distribution: the host-side :func:`spawn_layout` plus a seeded
+    float32 jitter of up to 0.25x the layout spacing.
 
     The single source of spawn truth — ensemble/training paths vmap this
     over seeds so sharded runs start from exactly the same distribution as
     the single-device scenario.
     """
-    side = int(np.ceil(np.sqrt(cfg.n)))
-    half = cfg.spawn_half_width
-    lin = np.linspace(-half, half, side)
-    gx, gy = np.meshgrid(lin, lin)
-    grid = np.stack([gx.ravel(), gy.ravel()], axis=1)[: cfg.n]
-    spacing = 2 * half / max(side - 1, 1)
+    grid, spacing = spawn_layout(cfg)
     is_key = hasattr(seed, "dtype") and (
         jax.dtypes.issubdtype(seed.dtype, jax.dtypes.prng_key)
         or (seed.dtype == jnp.uint32 and jnp.ndim(seed) == 1)  # legacy key
@@ -423,15 +553,33 @@ def spawn_positions(cfg: Config, seed) -> jnp.ndarray:
 
 
 def _orbit_ring(cfg: Config, t, xp):
-    """The closed-form obstacle orbit law, single-sourced over an array
-    namespace: ``xp = jax.numpy`` on device (traced t inside the scan) or
+    """The closed-form obstacle field law for the configured
+    ``obstacle_layout``, single-sourced over an array namespace:
+    ``xp = jax.numpy`` on device (traced t inside the scan) or
     ``xp = numpy`` on host (render/spawn/test paths must work without a
     live JAX backend — e.g. when the TPU tunnel is wedged).
 
+    Layouts (all closed-form in t — obstacle positions never carry scan
+    state): "orbit" is the original rotating ring; "static" freezes that
+    ring at its t=0 pose with zero velocity; "scatter" is a seed-free
+    golden-angle spiral through the packing disk, zero velocity (the
+    procedural static field — deterministic by construction, so it needs
+    no RNG and stays bit-identical across hosts).
+
     Returns (pos (M, 2), vel (M, 2))."""
     M = cfg.n_obstacles
+    if cfg.obstacle_layout == "scatter":
+        k = xp.arange(M)
+        r = (cfg.obstacle_orbit_frac * cfg.pack_radius
+             * xp.sqrt((k + 0.5) / M))
+        ang = (k + 0.5) * 2.39996322972865332  # golden angle (rad)
+        pos = xp.stack([r * xp.cos(ang), r * xp.sin(ang)], axis=1)
+        return pos, xp.zeros_like(pos)
     phases = xp.arange(M) * (2 * np.pi / M)
     r = cfg.obstacle_orbit_frac * cfg.pack_radius
+    if cfg.obstacle_layout == "static":
+        pos = r * xp.stack([xp.cos(phases), xp.sin(phases)], axis=1)
+        return pos, xp.zeros_like(pos)
     ang = phases + cfg.obstacle_omega * cfg.dt * t
     pos = r * xp.stack([xp.cos(ang), xp.sin(ang)], axis=1)
     vel = (cfg.obstacle_omega * r
@@ -520,6 +668,25 @@ def barrier_dynamics(cfg: Config, dtype, validate: bool = True):
         g = (jnp.array([[1, 0], [0, 1], [1, 0], [0, 1]], dtype)
              * jnp.stack([dt * dt, dt * dt, dt, dt]).astype(dtype)[:, None])
         return f, g, True
+    if cfg.dynamics == "mixed":
+        # Heterogeneous swarm: PER-AGENT stacked dynamics — f (N, 4, 4),
+        # g (N, 4, 2) — selected branch-free by the static dynamics_mask
+        # (core.filter routes ndim(f) == 3 through its per-agent vmap
+        # path, giving each row its own box bound). Both families use
+        # exact discrete-time rows; the drift term is shared (single
+        # rows carry zero velocity slots, so dt * v_rel vanishes there).
+        dt = cfg.dt
+        m = dynamics_mask(cfg)
+        f1 = dt * jnp.array([[0, 0, 1, 0], [0, 0, 0, 1],
+                             [0, 0, 0, 0], [0, 0, 0, 0]], dtype)
+        f = jnp.broadcast_to(f1[None], (cfg.n, 4, 4))
+        # Row-scale forms (dt may be TRACED on the serving path).
+        g_dbl = (jnp.array([[1, 0], [0, 1], [1, 0], [0, 1]], dtype)
+                 * jnp.stack([dt * dt, dt * dt, dt, dt]).astype(
+                     dtype)[:, None])
+        g_sgl = dt * jnp.array([[1, 0], [0, 1], [0, 0], [0, 0]], dtype)
+        g = jnp.where(m[:, None, None], g_dbl[None], g_sgl[None])
+        return f, g, True
     discrete = (cfg.n_obstacles > 0 if cfg.barrier == "auto"
                 else cfg.barrier == "discrete")
     # Discrete rows are exact discrete-time CBF conditions (see
@@ -538,14 +705,46 @@ def validate_config(cfg: Config) -> None:
     """Raise on invalid/unsupported knob combinations. Requires CONCRETE
     config values (comparisons on floats) — call it on the original
     request config before substituting traced scalars."""
-    if cfg.dynamics not in ("single", "double", "unicycle"):
+    if cfg.dynamics not in ("single", "double", "unicycle", "mixed"):
         raise ValueError(
-            f"dynamics must be single|double|unicycle, got {cfg.dynamics!r}")
-    if cfg.certificate and cfg.dynamics == "double":
+            f"dynamics must be single|double|unicycle|mixed, "
+            f"got {cfg.dynamics!r}")
+    if cfg.n_double and cfg.dynamics != "mixed":
+        # Honored-or-rejected: the split count only reaches the mixed
+        # per-agent path — silently ignoring it elsewhere would make a
+        # heterogeneity sweep measure nothing.
+        raise ValueError(
+            f'n_double={cfg.n_double} needs dynamics="mixed" '
+            f"(got {cfg.dynamics!r})")
+    if cfg.dynamics == "mixed" and not 0 < cfg.n_double <= cfg.n:
+        raise ValueError(
+            f'dynamics="mixed" needs 0 < n_double <= n, got '
+            f"n_double={cfg.n_double} with n={cfg.n} (use "
+            f'dynamics="single" for a homogeneous swarm)')
+    if cfg.spawn not in ("grid", "ring", "clusters", "corridor"):
+        raise ValueError(
+            f"spawn must be grid|ring|clusters|corridor, got {cfg.spawn!r}")
+    if cfg.goal not in ("rendezvous", "coverage", "corridor", "formation"):
+        raise ValueError(
+            f"goal must be rendezvous|coverage|corridor|formation, "
+            f"got {cfg.goal!r}")
+    if cfg.obstacle_layout not in ("orbit", "static", "scatter"):
+        raise ValueError(
+            f"obstacle_layout must be orbit|static|scatter, "
+            f"got {cfg.obstacle_layout!r}")
+    if cfg.obstacle_layout != "orbit" and not cfg.n_obstacles:
+        # Honored-or-rejected: a non-default layout with zero obstacles
+        # is a no-op — raise rather than let a sweep silently measure
+        # the obstacle-free swarm.
+        raise ValueError(
+            f"obstacle_layout={cfg.obstacle_layout!r} needs "
+            "n_obstacles > 0")
+    if cfg.certificate and cfg.dynamics in ("double", "mixed"):
         raise ValueError(
             "certificate=True filters VELOCITY commands (the reference's "
-            "joint certificate, cross_and_rescue.py:162-163); double mode "
-            "outputs accelerations — the combination is not meaningful")
+            "joint certificate, cross_and_rescue.py:162-163); double/mixed "
+            "modes output accelerations — the combination is not "
+            "meaningful")
     if cfg.certificate and cfg.n_obstacles:
         raise ValueError(
             "certificate=True with moving obstacles is rejected: the joint "
@@ -690,17 +889,18 @@ def validate_config(cfg: Config) -> None:
     if cfg.barrier not in ("auto", "continuous", "discrete"):
         raise ValueError(
             f"barrier must be auto|continuous|discrete, got {cfg.barrier!r}")
-    if cfg.dynamics == "double":
+    if cfg.dynamics in ("double", "mixed"):
         # Exact discrete rows for the semi-implicit double integrator (see
         # Config.dynamics). "continuous" has no meaning here — the rows ARE
-        # the discretized update.
+        # the discretized update. Mixed swarms inherit both constraints:
+        # their double rows are honest double integrators.
         if cfg.barrier == "continuous":
             raise ValueError(
-                'dynamics="double" uses exact discrete-time rows; '
+                f"dynamics={cfg.dynamics!r} uses exact discrete-time rows; "
                 'barrier="continuous" is not meaningful for it')
         if not (cfg.accel_limit > 0 and cfg.vel_tracking_tau > 0):
             raise ValueError(
-                "double dynamics needs accel_limit > 0 and "
+                f"{cfg.dynamics} dynamics needs accel_limit > 0 and "
                 f"vel_tracking_tau > 0, got {cfg.accel_limit}, "
                 f"{cfg.vel_tracking_tau}")
 
@@ -847,11 +1047,25 @@ def complete_nominal(cfg: Config, u0, x, v, obs_slab, mask):
     the sharded ensemble path — the ordering constraint must not be
     mirrored by hand (cf. default_cbf / attach_obstacle_rows)."""
     double = cfg.dynamics == "double"
-    if double and cfg.sep_gain:
-        u0 = u0 + separation_bias(cfg, x, obs_slab, mask)
+    mixed = cfg.dynamics == "mixed"
+    # sep_gain is a TRACED per-request scalar on the serving path; the
+    # skip is a static-zero optimization only (the term itself scales by
+    # sep_gain, so computing it under a tracer is always correct).
+    sep_off = isinstance(cfg.sep_gain, (int, float)) and not cfg.sep_gain
+    if (double or mixed) and not sep_off:
+        bias = separation_bias(cfg, x, obs_slab, mask)
+        if mixed:
+            # Only the double rows need the decompression term (their
+            # convergence momentum is real state); masking it keeps the
+            # single rows' nominal bit-identical to a homogeneous swarm.
+            bias = jnp.where(dynamics_mask(cfg)[:, None], bias, 0.0)
+        u0 = u0 + bias
     u0 = l2_cap(u0, cfg.speed_limit)
     if double:
         u0 = nominal_accel(cfg, u0, v)
+    elif mixed:
+        u0 = jnp.where(dynamics_mask(cfg)[:, None],
+                       nominal_accel(cfg, u0, v), u0)
     return u0
 
 
@@ -890,7 +1104,11 @@ def relax_tiers(cfg: Config, mask, priority):
     Single mode: obstacle rows (when present) are the priority tier and
     agent rows carry the per-row relax cap.
     """
-    if cfg.dynamics in ("double", "unicycle"):
+    if cfg.dynamics in ("double", "unicycle", "mixed"):
+        # Mixed swarms take the conservative union: any double row in the
+        # QP batch has acceleration-bounded authority, so the whole batch
+        # shares the uniform eps tier (a per-agent tier split would let a
+        # single-row relax-cap starve a squeezed double neighbor).
         priority = (jnp.ones_like(mask) if priority is None
                     else jnp.ones_like(priority))
         return priority, None
@@ -1066,6 +1284,13 @@ def integrate(cfg: Config, x, v, u):
     if cfg.dynamics == "double":
         v_new = v + cfg.dt * u
         return x + cfg.dt * v_new, v_new
+    if cfg.dynamics == "mixed":
+        # Branch-free per-row blend of the two updates above — double
+        # rows integrate semi-implicitly, single rows first-order.
+        m = dynamics_mask(cfg)[:, None]
+        v_dbl = v + cfg.dt * u
+        return (jnp.where(m, x + cfg.dt * v_dbl, x + cfg.dt * u),
+                jnp.where(m, v_dbl, u))
     return x + cfg.dt * u, u
 
 
@@ -1089,6 +1314,14 @@ def default_cbf(cfg: Config) -> CBFParams:
     """
     if cfg.dynamics == "double":
         return CBFParams(max_speed=cfg.accel_limit, k=1.0)
+    if cfg.dynamics == "mixed":
+        # Per-agent (N,) leaves: each row gets its own family's box bound
+        # and velocity term (core.filter maps per-leaf over them). Single
+        # rows keep the homogeneous defaults bit-exactly.
+        m = dynamics_mask(cfg)
+        return CBFParams(
+            max_speed=jnp.where(m, cfg.accel_limit, cfg.max_speed),
+            k=jnp.where(m, 1.0, 0.0))
     if cfg.dynamics == "unicycle":
         # The QP box bounds the COMMAND at the wheel-realizable speed:
         # with the reference's 15.0 box a fast obstacle elicits evasion
@@ -1229,6 +1462,14 @@ def _build_step(cfg: Config, cbf: CBFParams | None = None, *,
     f, g, discrete = barrier_dynamics(cfg, dt_, validate=validate)
     double = cfg.dynamics == "double"
     unicycle = cfg.dynamics == "unicycle"
+    mixed = cfg.dynamics == "mixed"
+    dmask = dynamics_mask(cfg) if mixed else None
+    # Goal structure (scenario platform): a fixed per-agent target layout
+    # replaces the centroid consensus nominal. Computed on the host from
+    # STATIC geometry only (goal_layout) and embedded as a constant — the
+    # traced-config serving path never sees it change.
+    goals_np = goal_layout(cfg)
+    goals_c = None if goals_np is None else jnp.asarray(goals_np, dt_)
     if cbf is None:
         cbf = default_cbf(cfg)
     K = cfg.k_neighbors
@@ -1299,7 +1540,12 @@ def _build_step(cfg: Config, cbf: CBFParams | None = None, *,
         # "Tracing & SLOs"): consensus, gating, filter, certificate,
         # integrate.
         with profiling.annotate("consensus"):
-            if active is None:
+            if goals_c is not None:
+                # Fixed goal layout (coverage/corridor/formation): plain
+                # proportional pull toward each agent's own target —
+                # capped with the rest of the nominal in complete_nominal.
+                u0 = cfg.consensus_gain * (goals_c - x)
+            elif active is None:
                 centroid = jnp.mean(x, axis=0)
             else:
                 # Padded bucket: the consensus target is the REAL agents'
@@ -1308,11 +1554,14 @@ def _build_step(cfg: Config, cbf: CBFParams | None = None, *,
                 n_act = jnp.maximum(jnp.sum(active.astype(dt_)), 1.0)
                 centroid = jnp.sum(jnp.where(active[:, None], x, 0.0),
                                    axis=0) / n_act
-            to_c = centroid[None] - x                          # (N, 2)
-            d_c = jnp.linalg.norm(to_c, axis=1, keepdims=True)
-            # Pull toward the centroid only while outside the packing disk.
-            pull = jnp.maximum(d_c - cfg.pack_radius, 0.0)
-            u0 = cfg.consensus_gain * pull * to_c / jnp.maximum(d_c, 1e-9)
+            if goals_c is None:
+                to_c = centroid[None] - x                      # (N, 2)
+                d_c = jnp.linalg.norm(to_c, axis=1, keepdims=True)
+                # Pull toward the centroid only while outside the
+                # packing disk.
+                pull = jnp.maximum(d_c - cfg.pack_radius, 0.0)
+                u0 = (cfg.consensus_gain * pull * to_c
+                      / jnp.maximum(d_c, 1e-9))
             if M:
                 obstacles4 = obstacle_states_at(cfg, t, dt_)
                 dodge, d_o = lane_dodge(x, obstacles4, cfg.safety_distance)
@@ -1329,8 +1578,14 @@ def _build_step(cfg: Config, cbf: CBFParams | None = None, *,
         # only obstacle rows carry real velocities into the drift term.
         # Double mode: velocities are real carried state, known at step
         # start — the drift term dt*s.dv needs them.
-        vslots = (state.v if (double or not discrete)
-                  else jnp.zeros_like(state.v))
+        if mixed:
+            # Per-row: double rows carry real state into the drift term,
+            # single rows keep the zero slots (see the comment above).
+            vslots = jnp.where(dmask[:, None], state.v,
+                               jnp.zeros_like(state.v))
+        else:
+            vslots = (state.v if (double or not discrete)
+                      else jnp.zeros_like(state.v))
         states4 = jnp.concatenate([x, vslots], axis=1)         # (N, 4)
 
         overflow_count = ()
@@ -1385,7 +1640,7 @@ def _build_step(cfg: Config, cbf: CBFParams | None = None, *,
             # Actuation-bounded modes get the corrected pure actuator box
             # (the reference's quirky velocity-coupled rows are a parity
             # artifact).
-            plain_box = double or unicycle
+            plain_box = double or unicycle or mixed
             u_safe, info = safe_controls(
                 states4, obs_slab, mask, f, g, u0, cbf,
                 priority_mask=priority, relax_cap=cap,
@@ -1476,7 +1731,8 @@ def _build_step(cfg: Config, cbf: CBFParams | None = None, *,
                           backup_control(
                               state.v, dynamics=cfg.dynamics,
                               vel_tracking_tau=cfg.vel_tracking_tau,
-                              accel_limit=cfg.accel_limit),
+                              accel_limit=cfg.accel_limit,
+                              dynamics_mask=dmask),
                           u)
             # Last-ditch guard: whatever produced it, a non-finite
             # command never reaches the integrator.

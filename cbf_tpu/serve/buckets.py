@@ -51,11 +51,25 @@ class BucketKey(NamedTuple):
         return self.static_cfg.n
 
     def label(self) -> str:
-        """Short stable tag for counters/telemetry/docs."""
+        """Short stable tag for counters/telemetry/docs.
+
+        Scenario-platform axes (mixed-dynamics split, spawn/goal/
+        obstacle-field ingredients) append suffixes ONLY when non-default
+        — every pre-platform label stays byte-stable (dashboards and
+        docs key on them)."""
         c = self.static_cfg
         cert = swarm.certificate_backend(c) if c.certificate else "off"
-        return (f"n{c.n}-t{self.horizon}-{c.dynamics}"
-                f"-cert_{cert}-g{c.gating}")
+        lab = (f"n{c.n}-t{self.horizon}-{c.dynamics}"
+               f"-cert_{cert}-g{c.gating}")
+        if c.dynamics == "mixed":
+            lab += f"-nd{c.n_double}"
+        if c.spawn != "grid":
+            lab += f"-sp_{c.spawn}"
+        if c.goal != "rendezvous":
+            lab += f"-gl_{c.goal}"
+        if c.obstacle_layout != "orbit":
+            lab += f"-ob_{c.obstacle_layout}"
+        return lab
 
 
 def bucket_n(n: int, sizes: tuple[int, ...] = DEFAULT_BUCKET_SIZES) -> int:

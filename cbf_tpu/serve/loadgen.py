@@ -48,6 +48,13 @@ class LoadSpec:
     ``pareto_alpha`` — tail index (smaller = heavier tail; 1.3 gives a
     realistic many-small/few-large mix).
     ``steps_choices`` — horizon mix (uniform over these).
+    ``scenario_mix`` — seeded weights over registered SERVABLE scenarios
+    (``scenarios.platform.registry``): each arrival draws its scenario
+    from this distribution. The default single-entry swarm mix keeps the
+    pre-platform schedule BIT-IDENTICAL (no extra rng draw is consumed);
+    named non-swarm scenarios take their registered config with the
+    schedule's horizon/seed/traced-knob jitter applied on top — the
+    traffic-diversity feed for ROADMAP item 2.
     """
     rps: float = 8.0
     duration_s: float = 5.0
@@ -57,6 +64,7 @@ class LoadSpec:
     pareto_alpha: float = 1.3
     steps_choices: tuple[int, ...] = (20, 40, 60)
     gating: str = "jnp"
+    scenario_mix: tuple[tuple[str, float], ...] = (("swarm", 1.0),)
 
 
 def bounded_pareto(rng: np.random.Generator, alpha: float, lo: float,
@@ -70,19 +78,53 @@ def bounded_pareto(rng: np.random.Generator, alpha: float, lo: float,
     return (-(u * ha - u * la - ha) / (ha * la)) ** (-1.0 / alpha)
 
 
-def build_schedule(spec: LoadSpec) -> list[tuple[float, swarm.Config]]:
+def _validated_mix(spec: LoadSpec):
+    """Resolve the spec's scenario mix against the registry: every name
+    must be a registered SERVABLE scenario (the engine submits
+    ``swarm.Config`` objects only) with a positive weight. Returns
+    ``(names, cumulative_probabilities)``."""
+    from cbf_tpu.scenarios.platform import registry
+
+    if not spec.scenario_mix:
+        raise ValueError("scenario_mix must name at least one scenario")
+    names, weights = [], []
+    for name, w in spec.scenario_mix:
+        entry = registry.get(name)      # raises on unknown
+        if not entry.servable:
+            raise ValueError(
+                f"scenario {name!r} is not servable (the engine takes "
+                "swarm.Config requests only) — it cannot join a loadgen "
+                "scenario mix")
+        if not w > 0:
+            raise ValueError(
+                f"scenario_mix weight for {name!r} must be > 0, got {w}")
+        names.append(name)
+        weights.append(float(w))
+    cum = np.cumsum(weights) / float(np.sum(weights))
+    return names, cum
+
+
+def schedule_with_scenarios(
+        spec: LoadSpec) -> list[tuple[float, str, swarm.Config]]:
     """The full arrival schedule for one run: sorted
-    ``(arrival_offset_s, config)`` pairs. Pure function of the spec —
-    same seed, same schedule — so a run can be replayed or inspected
-    without driving an engine."""
+    ``(arrival_offset_s, scenario_name, config)`` triples. Pure function
+    of the spec — same seed, same schedule — so a run can be replayed or
+    inspected without driving an engine.
+
+    Determinism note: with the default single-scenario mix NO scenario
+    draw is consumed, so pre-platform schedules replay bit-identically;
+    a weighted mix consumes exactly one extra uniform per arrival."""
     if spec.rps <= 0 or spec.duration_s <= 0:
         raise ValueError(f"rps and duration_s must be > 0, got "
                          f"rps={spec.rps}, duration_s={spec.duration_s}")
+    names, cum = _validated_mix(spec)
     rng = np.random.default_rng(spec.seed)
-    out: list[tuple[float, swarm.Config]] = []
+    out: list[tuple[float, str, swarm.Config]] = []
     t = float(rng.exponential(1.0 / spec.rps))
     i = 0
     while t < spec.duration_s:
+        scenario = names[0] if len(names) == 1 else \
+            names[int(np.searchsorted(cum, rng.random(), side="right"))]
         n = int(np.clip(round(float(bounded_pareto(
             rng, spec.pareto_alpha, spec.n_min, spec.n_max))),
             spec.n_min, spec.n_max))
@@ -91,14 +133,31 @@ def build_schedule(spec: LoadSpec) -> list[tuple[float, swarm.Config]]:
         # Same knob mix as bench.serve_workload: small seeded jitter on
         # the traced floats — fresh scalars per request, known-safe
         # ranges (the safety gates hold over them).
-        cfg = swarm.Config(
-            n=n, steps=steps, seed=i, gating=spec.gating,
-            safety_distance=0.4 + 0.003 * int(rng.integers(5)),
-            consensus_gain=1.0 + 0.01 * int(rng.integers(16)))
-        out.append((t, cfg))
+        safety = 0.4 + 0.003 * int(rng.integers(5))
+        gain = 1.0 + 0.01 * int(rng.integers(16))
+        if scenario == "swarm":
+            cfg = swarm.Config(
+                n=n, steps=steps, seed=i, gating=spec.gating,
+                safety_distance=safety, consensus_gain=gain)
+        else:
+            # Registered (e.g. DSL-generated) scenario: its own config
+            # defines the bucket identity (n, ingredients, dynamics);
+            # the schedule varies horizon/seed/traced floats on top.
+            from cbf_tpu.scenarios.platform import registry
+            cfg = dataclasses.replace(
+                registry.get(scenario).make_config(),
+                steps=steps, seed=i, gating=spec.gating,
+                safety_distance=safety, consensus_gain=gain)
+        out.append((t, scenario, cfg))
         t += float(rng.exponential(1.0 / spec.rps))
         i += 1
     return out
+
+
+def build_schedule(spec: LoadSpec) -> list[tuple[float, swarm.Config]]:
+    """Back-compat view of :func:`schedule_with_scenarios` — the sorted
+    ``(arrival_offset_s, config)`` pairs without the scenario names."""
+    return [(t, cfg) for t, _name, cfg in schedule_with_scenarios(spec)]
 
 
 def _quantile(sorted_vals: list[float], q: float) -> float | None:
@@ -135,20 +194,22 @@ def run_loadgen(engine, spec: LoadSpec, *, telemetry=None,
     the measured window, which is a cold-start measurement, not a
     sustained-rate one. Starts (and then stops) the engine's scheduler
     thread if the caller has not already."""
-    schedule = build_schedule(spec)
+    schedule = schedule_with_scenarios(spec)
     started_here = not engine._running
     if started_here:
         engine.start()
     pendings = []
     errors_by_type: dict[str, int] = {}
+    scen_errors: dict[str, int] = {}
 
-    def _tally(exc: BaseException) -> None:
+    def _tally(exc: BaseException, scenario: str) -> None:
         name = type(exc).__name__
         errors_by_type[name] = errors_by_type.get(name, 0) + 1
+        scen_errors[scenario] = scen_errors.get(scenario, 0) + 1
 
     t_start = time.perf_counter()
     try:
-        for i, (arrival_s, cfg) in enumerate(schedule):
+        for i, (arrival_s, scen_name, cfg) in enumerate(schedule):
             # Open-loop: sleep to the scheduled arrival, never await
             # results — lateness here (the generator falling behind)
             # is reported, not silently absorbed.
@@ -158,16 +219,20 @@ def run_loadgen(engine, spec: LoadSpec, *, telemetry=None,
             if mutate is not None:
                 cfg = mutate(i, cfg)
             try:
-                pendings.append(engine.submit(cfg))
+                pendings.append((scen_name, engine.submit(cfg)))
             except resilience.ServeError as e:
-                _tally(e)   # shed/quarantined at admission: typed, counted
+                # shed/quarantined at admission: typed, counted
+                _tally(e, scen_name)
         results = []
+        scen_of: dict[int, str] = {}
         bucket_errors: dict[str, int] = {}
-        for p in pendings:
+        for scen_name, p in pendings:
             try:
-                results.append(p.result(timeout=result_timeout_s))
+                r = p.result(timeout=result_timeout_s)
+                scen_of[id(r)] = scen_name
+                results.append(r)
             except Exception as e:
-                _tally(e)
+                _tally(e, scen_name)
                 key = getattr(p, "_key", None)
                 if key is not None:     # post-submit failure: bucketable
                     label = key.label()
@@ -203,6 +268,29 @@ def run_loadgen(engine, spec: LoadSpec, *, telemetry=None,
             if isinstance(v, float):
                 by_bucket[label][k] = round(v, 6)
 
+    # Per-scenario SLO split: with a mixed scenario feed the bucket axis
+    # alone can't show which SCENARIO family is slow or being shed — a
+    # generated mixed-dynamics scenario and plain swarm traffic can land
+    # in different buckets but degrade together. Group on the schedule's
+    # scenario names.
+    by_scenario: dict[str, dict] = {}
+    scen_groups: dict[str, list] = {}
+    for r in results:
+        scen_groups.setdefault(scen_of[id(r)], []).append(r)
+    for scen_name in sorted(set(scen_groups) | set(scen_errors)):
+        rs = scen_groups.get(scen_name, [])
+        sl = sorted(r.latency_s for r in rs)
+        by_scenario[scen_name] = {
+            "completed": len(rs),
+            "errors": scen_errors.get(scen_name, 0),
+            "latency_p50_s": _quantile(sl, 0.50),
+            "latency_p95_s": _quantile(sl, 0.95),
+            "latency_p99_s": _quantile(sl, 0.99),
+        }
+        for k, v in list(by_scenario[scen_name].items()):
+            if isinstance(v, float):
+                by_scenario[scen_name][k] = round(v, 6)
+
     lat = sorted(r.latency_s for r in results)
     qwait = sorted(r.queue_wait_s for r in results)
     execu = sorted(r.execute_s for r in results)
@@ -236,6 +324,7 @@ def run_loadgen(engine, spec: LoadSpec, *, telemetry=None,
         "infeasible_count": (sum(int(np.sum(r.outputs.infeasible_count))
                                  for r in results) if results else None),
         "by_bucket": by_bucket,
+        "by_scenario": by_scenario,
     }
     for k, v in list(report.items()):
         if isinstance(v, float):
@@ -246,5 +335,5 @@ def run_loadgen(engine, spec: LoadSpec, *, telemetry=None,
                 "seed", "offered_rps", "achieved_rps", "requests",
                 "completed", "errors", "duration_s", "latency_p50_s",
                 "latency_p95_s", "latency_p99_s", "queue_wait_p99_s",
-                "execute_p99_s", "by_bucket")})
+                "execute_p99_s", "by_bucket", "by_scenario")})
     return report

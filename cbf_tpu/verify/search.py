@@ -123,28 +123,40 @@ def make_adapter(scenario: str, cfg=None, *, cbf=None, steps=None,
                  unroll_relax: int = 2) -> Adapter:
     """Bind a scenario config for falsification.
 
-    ``differentiable=True`` (swarm only): builds the step with the
-    unrolled-relax QP and jnp gating so engines can reverse-differentiate
-    the rollout w.r.t. the initial state; rejected for configs whose step
-    has non-differentiable structure (Verlet caches, the dense
-    certificate's fori_loop solver)."""
-    if scenario == "swarm":
-        return _swarm_adapter(cfg, cbf, steps, thresholds, differentiable,
-                              unroll_relax)
+    Registry-driven (``scenarios.platform.registry``): the scenario name
+    resolves to its registered entry, whose ``adapter`` key selects the
+    builder from :data:`ADAPTER_BUILDERS` and whose ``make_config``
+    supplies the default config — so registering a scenario (including
+    DSL-generated ones) enrolls it for falsification with no edit here.
+
+    ``differentiable=True`` (swarm-built steps only): builds the step
+    with the unrolled-relax QP and jnp gating so engines can
+    reverse-differentiate the rollout w.r.t. the initial state; rejected
+    for configs whose step has non-differentiable structure (Verlet
+    caches, the dense certificate's fori_loop solver)."""
+    from cbf_tpu.scenarios.platform import registry as scen_registry
+
+    try:
+        entry = scen_registry.get(scenario)
+    except KeyError:
+        raise ValueError(
+            f"unknown scenario {scenario!r}; have "
+            f"{', '.join(scen_registry.names())}") from None
+    if cfg is None:
+        cfg = entry.make_config()
+    builder = ADAPTER_BUILDERS[entry.adapter]
+    if entry.adapter == "swarm":
+        return builder(scenario, cfg, cbf, steps, thresholds,
+                       differentiable, unroll_relax)
     if differentiable:
         raise ValueError(
-            f"the differentiable (gradient-engine) path exists for the "
-            f"swarm scenario only — {scenario!r} steps run the "
+            f"the differentiable (gradient-engine) path exists for "
+            f"swarm-built steps only — {scenario!r} steps run the "
             "scalar-guarded relax loop; use the random/cem engines")
-    if scenario == "meet_at_center":
-        return _meet_adapter(cfg, cbf, steps, thresholds)
-    if scenario == "cross_and_rescue":
-        return _cross_adapter(cfg, cbf, steps, thresholds)
-    raise ValueError(f"unknown scenario {scenario!r}; have swarm, "
-                     "meet_at_center, cross_and_rescue")
+    return builder(scenario, cfg, cbf, steps, thresholds)
 
 
-def _swarm_adapter(cfg, cbf, steps, thresholds, differentiable,
+def _swarm_adapter(scenario, cfg, cbf, steps, thresholds, differentiable,
                    unroll_relax) -> Adapter:
     from cbf_tpu.scenarios import swarm
 
@@ -165,7 +177,7 @@ def _swarm_adapter(cfg, cbf, steps, thresholds, differentiable,
         cfg = dataclasses.replace(cfg, gating="jnp")
     state0, step = swarm.make(
         cfg, cbf, unroll_relax=unroll_relax if differentiable else 0)
-    th = thresholds or thresholds_for("swarm", cfg)
+    th = thresholds or thresholds_for(scenario, cfg)
     obstacle_fn = obstacle_fn_np = None
     if cfg.n_obstacles:
         obstacle_fn = (lambda t:
@@ -174,7 +186,7 @@ def _swarm_adapter(cfg, cbf, steps, thresholds, differentiable,
     traj_extract = ((lambda outs: outs.trajectory)
                     if cfg.record_trajectory else (lambda outs: None))
     return Adapter(
-        scenario="swarm", cfg=cfg, state0=state0, step=step,
+        scenario=scenario, cfg=cfg, state0=state0, step=step,
         steps=int(cfg.steps), thresholds=th,
         delta_shape=(cfg.n, 2),
         perturb=lambda s0, d: s0._replace(x=s0.x + d.astype(s0.x.dtype)),
@@ -184,7 +196,7 @@ def _swarm_adapter(cfg, cbf, steps, thresholds, differentiable,
         differentiable=differentiable)
 
 
-def _meet_adapter(cfg, cbf, steps, thresholds) -> Adapter:
+def _meet_adapter(scenario, cfg, cbf, steps, thresholds) -> Adapter:
     from cbf_tpu.scenarios import meet_at_center as meet
 
     cfg = cfg or meet.Config()
@@ -212,7 +224,7 @@ def _meet_adapter(cfg, cbf, steps, thresholds) -> Adapter:
         obstacle_fn=None, obstacle_fn_np=None, differentiable=False)
 
 
-def _cross_adapter(cfg, cbf, steps, thresholds) -> Adapter:
+def _cross_adapter(scenario, cfg, cbf, steps, thresholds) -> Adapter:
     from cbf_tpu.scenarios import cross_and_rescue as cross
 
     cfg = cfg or cross.Config()
@@ -238,6 +250,39 @@ def _cross_adapter(cfg, cbf, steps, thresholds) -> Adapter:
         positions=lambda final: final.poses[:2].T,
         traj_extract=traj_extract,
         obstacle_fn=None, obstacle_fn_np=None, differentiable=False)
+
+
+def _antipodal_adapter(scenario, cfg, cbf, steps, thresholds) -> Adapter:
+    from cbf_tpu.scenarios import antipodal
+
+    cfg = cfg or antipodal.Config()
+    if steps is not None:
+        cfg = dataclasses.replace(cfg, steps=int(steps))
+    state0, step = (antipodal.make(cfg, cbf=cbf) if cbf is not None
+                    else antipodal.make(cfg))
+    th = thresholds or thresholds_for("antipodal", cfg)
+    traj_extract = ((lambda outs: outs.trajectory)
+                    if cfg.record_trajectory else (lambda outs: None))
+    return Adapter(
+        scenario="antipodal", cfg=cfg, state0=state0, step=step,
+        steps=int(cfg.steps), thresholds=th,
+        delta_shape=(cfg.n, 2),
+        perturb=lambda s0, d: s0._replace(x=s0.x + d.astype(s0.x.dtype)),
+        positions=lambda final: final.x,
+        traj_extract=traj_extract,
+        obstacle_fn=None, obstacle_fn_np=None, differentiable=False)
+
+
+#: Adapter-builder dispatch — keyed by ``ScenarioEntry.adapter``. The
+#: swarm builder carries the extra (differentiable, unroll_relax) tail;
+#: :func:`make_adapter` routes accordingly. Generated scenarios reuse
+#: the "swarm" key (their Configs ARE swarm Configs).
+ADAPTER_BUILDERS: dict[str, Callable] = {
+    "swarm": _swarm_adapter,
+    "meet_at_center": _meet_adapter,
+    "cross_and_rescue": _cross_adapter,
+    "antipodal": _antipodal_adapter,
+}
 
 
 # ----------------------------------------------------------- evaluation --

@@ -301,6 +301,10 @@ def test_corpus_replay_gate():
     assert not problems, "\n".join(problems)
     assert any(e.get("expect") == "violates" for e, _r, _p in results)
     assert any(e.get("expect") == "safe" for e, _r, _p in results)
+    # Covered set: the archive must span every scenario the gate is
+    # contracted to watch (antipodal joined in PR 12).
+    covered = {e.get("scenario") for e, _r, _p in results}
+    assert {"swarm", "antipodal"} <= covered, covered
 
 
 # ----------------------------------------------------- telemetry + audits
