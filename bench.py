@@ -120,8 +120,10 @@ Modes / env knobs:
     executor faults, periodic latency spikes). Reports goodput and p99
     for both legs, the goodput retention ratio, the typed-error census
     and the engine's retry/shed/quarantine counters; fails the round if
-    any request hangs (completed + errors != requests) or a healthy
-    request is lost to a fault. Knobs: BENCH_CHAOS_RPS (8.0),
+    any request hangs (completed + errors != requests), a healthy
+    request is lost to a fault, or the armed lock-order witness
+    observes an acquisition-order inversion or an edge the static
+    concurrency analyzer cannot explain. Knobs: BENCH_CHAOS_RPS (8.0),
     BENCH_CHAOS_DURATION (10.0), BENCH_CHAOS_SEED (0),
     BENCH_CHAOS_POISON (7), BENCH_CHAOS_EXEC_FAULTS (2),
     BENCH_CHAOS_SPIKE_S (0.1), BENCH_CHAOS_SPIKE_EVERY (10), plus the
@@ -1397,13 +1399,16 @@ def _child_chaos(steps: int) -> dict:
     counters — the number the fault-tolerance conversation needs is the
     goodput RETENTION ratio under faults, not peak throughput.
 
-    Three hard gates: every request must RESOLVE (completed + errors ==
+    Four hard gates: every request must RESOLVE (completed + errors ==
     requests — the zero-hang invariant), no healthy request may be
     lost to a neighbor's fault (errors <= poisoned + shed + deadline-
-    expired), and the armed FlightRecorder must drop a readable
-    incident capsule for every terminal fault class injected (zero
-    write failures; idle through the fault-free leg). Safety-gated over
-    the healthy completions like every serve record."""
+    expired), the armed FlightRecorder must drop a readable incident
+    capsule for every terminal fault class injected (zero write
+    failures; idle through the fault-free leg), and the armed
+    lock-order witness must observe zero acquisition-order inversions
+    with every observed edge inside the static analyzer's lock-order
+    graph. Safety-gated over the healthy completions like every serve
+    record."""
     import jax
     import numpy as np   # noqa: F401  (parity with sibling modes)
 
@@ -1432,6 +1437,13 @@ def _child_chaos(steps: int) -> dict:
 
     spec = LoadSpec(rps=rps, duration_s=duration, seed=seed, n_min=n_min,
                     n_max=n_max, pareto_alpha=alpha)
+    # Armed lock-order witness across both legs: the engine's locks are
+    # wrapped from construction, so the whole chaos run doubles as a
+    # runtime lock-order check — zero inversions, observed graph inside
+    # the static analyzer's.
+    from cbf_tpu.analysis import concurrency, lockwitness
+    lockwitness.arm()
+    lockwitness.reset()
     # Armed flight recorder across both legs: the fault-free leg must
     # trip nothing, and the chaos leg must drop one well-formed capsule
     # per terminal fault class it injects (zero write failures) — the
@@ -1522,6 +1534,21 @@ def _child_chaos(steps: int) -> dict:
                          f"write_failures={flight.write_failures}",
                 "retryable": False}
 
+    # Lock-witness gate: the observed acquisition order over BOTH legs
+    # must be cycle-free, and every observed edge must be explained by
+    # the statically derived lock-order graph (transitive closure).
+    lockwitness.disarm()
+    witness_snap = lockwitness.snapshot()
+    witness_inversions = lockwitness.inversions()
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    static_edges = concurrency.static_edge_set(concurrency.analyze_paths(
+        [os.path.join(repo_root, "cbf_tpu")], repo_root=repo_root))
+    unexplained = lockwitness.check_subgraph(static_edges)
+    if witness_inversions or unexplained:
+        return {"error": f"lock witness gate: inversions="
+                         f"{witness_inversions} unexplained={unexplained}",
+                "retryable": False}
+
     # achieved_rps is already goodput: completed (healthy only) / wall.
     base_goodput = base["achieved_rps"]
     chaos_goodput = chaos["achieved_rps"]
@@ -1551,6 +1578,11 @@ def _child_chaos(steps: int) -> dict:
         "fault_counters": delta,
         "flight_capsules": sorted(capsule_reasons),
         "flight_write_failures": flight.write_failures,
+        "lock_witness": {
+            "acquisitions": witness_snap["acquisitions"],
+            "edges": len(witness_snap["edges"]),
+            "inversions": len(witness_inversions),
+        },
         "errors_by_type": chaos.get("errors_by_type", {}),
         "buckets": engine.manifest_extra()["serve"]["buckets"],
         "cache_dir": engine.cache_dir,

@@ -1184,11 +1184,17 @@ def cmd_lint(args) -> int:
     from cbf_tpu.analysis.baseline import BaselineError
 
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    paths = args.paths or [os.path.join(repo_root, "cbf_tpu")]
+    # Default to the same path set the tier-1 gate lints, so "what the
+    # gate enforces" and "what the terminal shows" cannot drift apart.
+    paths = args.paths or [
+        p for p in (os.path.join(repo_root, d)
+                    for d in ("cbf_tpu", "scripts", "examples", "bench.py"))
+        if os.path.exists(p)]
     try:
         result = report.run_lint(
             paths, repo_root=repo_root, baseline_path=args.baseline,
             jaxpr=args.all or args.jaxpr, audits=args.all,
+            concurrency=args.all or args.concurrency,
             entrypoints=args.entrypoint or None)
     except BaselineError as e:
         print(f"lint: {e}", file=sys.stderr)
@@ -1295,12 +1301,16 @@ def main(argv=None) -> int:
                             "cbf_tpu package)")
     lintp.add_argument("--all", action="store_true",
                        help="also run the jaxpr entry-point invariants "
-                            "(JX0xx) and the consolidated repo audits "
+                            "(JX0xx), the consolidated repo audits "
                             "(AUD0xx: obs schema, tier-1 markers, chain "
-                            "depth)")
+                            "depth) and the concurrency analyzer (CC0xx)")
     lintp.add_argument("--jaxpr", action="store_true",
                        help="also run just the jaxpr entry-point "
                             "invariants (JX0xx)")
+    lintp.add_argument("--concurrency", action="store_true",
+                       help="also run just the concurrency analyzer "
+                            "(CC0xx: lock discipline, lock-order graph; "
+                            "docs/API.md 'Concurrency analysis')")
     lintp.add_argument("--entrypoint", action="append", default=[],
                        metavar="NAME",
                        help="restrict the jaxpr checks to these entry "

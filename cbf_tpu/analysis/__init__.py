@@ -16,16 +16,23 @@ a standing gate:
   dtype discipline, carry aval stability);
 * :mod:`cbf_tpu.analysis.audits` — the former standalone audit scripts
   (obs schema, tier-1 markers, chain depth) as rules;
+* :mod:`cbf_tpu.analysis.concurrency` — lock-discipline linter for the
+  threaded serve/durable/obs stack (unlocked shared writes, lock-order
+  cycles, blocking calls under locks, signal-handler hygiene) plus the
+  global acquisition-order graph;
+* :mod:`cbf_tpu.analysis.lockwitness` — opt-in runtime lock-order
+  witness (``CBF_TPU_LOCK_WITNESS=1``) cross-validating the static
+  graph against observed acquisitions;
 * :mod:`cbf_tpu.analysis.baseline` — suppression file with mandatory
   reasons (``baseline.toml``): pre-existing findings visible, new ones
   fatal;
 * :mod:`cbf_tpu.analysis.registry` / :mod:`~cbf_tpu.analysis.report` —
   the rule table and the text/JSON reporters.
 
-CLI: ``python -m cbf_tpu lint [paths] [--all] [--json]
-[--show-suppressed]`` — docs/API.md "Static analysis" documents the
-rule IDs and the suppression format; tests/test_analysis.py enforces
-repo-cleanliness as tier-1.
+CLI: ``python -m cbf_tpu lint [paths] [--all | --jaxpr | --concurrency]
+[--json] [--show-suppressed]`` — docs/API.md "Static analysis" and
+"Concurrency analysis" document the rule IDs and the suppression
+format; tests/test_analysis.py enforces repo-cleanliness as tier-1.
 """
 
 from cbf_tpu.analysis.registry import RULES, Finding, Rule, rule_ids

@@ -22,6 +22,10 @@ existing tier-1 tests and operator muscle memory keep working.
   enrolled across the full stack (verify adapter + calibrated
   thresholds + NumPy-twin parity test + docs/API.md row), and every
   scenario module on disk is registered.
+* AUD008 — concurrency-map drift: the concurrency analyzer's
+  discovered lock/thread inventory vs the docs/API.md concurrency-map
+  table (a new thread or lock without a doc row fails tier-1, and a
+  map row for a primitive that no longer exists is stale).
 """
 
 from __future__ import annotations
@@ -701,6 +705,80 @@ def scenario_coverage_audit(repo_root: str | None = None) -> list[str]:
     return problems
 
 
+# -- AUD008: concurrency-map drift ----------------------------------------
+
+
+def concurrency_map_audit(repo_root: str | None = None) -> list[str]:
+    """AUD008: the threading inventory vs the docs/API.md concurrency map.
+
+    The concurrency analyzer's discovered inventory (every lock/
+    condition/event attribute, thread entry point and signal/atexit
+    handler in ``cbf_tpu/``) must have a backticked row in the
+    docs/API.md concurrency-map table — a new thread or lock without a
+    doc row fails tier-1. The inverse leg catches staleness: a
+    backticked ``Class.attr`` token between the map's markers that the
+    analyzer no longer discovers means the map describes threads that
+    no longer exist."""
+    repo = repo_root or _REPO
+    problems: list[str] = []
+    from cbf_tpu.analysis import concurrency
+
+    inv = concurrency.analyze_paths(
+        [os.path.join(repo, "cbf_tpu")], repo_root=repo).inventory
+
+    api_path = os.path.join(repo, "docs", "API.md")
+    try:
+        with open(api_path, encoding="utf-8") as fh:
+            api_text = fh.read()
+    except OSError:
+        return [f"docs/API.md unreadable at {api_path}"]
+
+    start = api_text.find("<!-- concurrency-map:start -->")
+    end = api_text.find("<!-- concurrency-map:end -->")
+    if start < 0 or end < 0 or end < start:
+        return ["docs/API.md has no concurrency-map markers "
+                "(<!-- concurrency-map:start/end -->) — the map table "
+                "is missing"]
+    map_text = api_text[start:end]
+
+    expected: set[str] = set()
+    for cls_name, rec in inv.items():
+        for attr in rec["locks"]:
+            expected.add(f"{cls_name}.{attr}")
+        for attr in rec["conditions"]:
+            expected.add(f"{cls_name}.{attr}")
+        for attr in rec["events"]:
+            expected.add(f"{cls_name}.{attr}")
+        for t in rec["threads"]:
+            if t["entry"]:
+                expected.add(f"{cls_name}.{t['entry']}")
+        for qual in rec["handlers"]:
+            # `Cls.method.nested` documents as the enclosing method row.
+            parts = qual.split(".")
+            expected.add(".".join(parts[:2]))
+    for needle in sorted(expected):
+        if f"`{needle}`" not in map_text:
+            problems.append(
+                f"discovered threading primitive `{needle}` has no row "
+                "in the docs/API.md concurrency map — document the new "
+                "lock/thread (who holds it, who runs it) or remove it")
+
+    # Inverse: every backticked Class.attr-shaped token in the map must
+    # still be discovered (skip lowercase-first tokens like
+    # `threading.Lock` and env-var style names).
+    import re
+    for token in set(re.findall(r"`([A-Za-z_][\w.]*)`", map_text)):
+        parts = token.split(".")
+        if len(parts) != 2 or not parts[0][0].isupper():
+            continue
+        if token not in expected:
+            problems.append(
+                f"concurrency-map row `{token}` matches no discovered "
+                "primitive — the map describes a lock/thread that no "
+                "longer exists (delete the row)")
+    return problems
+
+
 # -- runner ----------------------------------------------------------------
 
 def run_audits(repo_root: str | None = None) -> list[Finding]:
@@ -722,4 +800,8 @@ def run_audits(repo_root: str | None = None) -> list[Finding]:
         findings.append(Finding("AUD007",
                                 "cbf_tpu/scenarios/platform/registry.py",
                                 0, 0, "<scenario>", msg))
+    for msg in concurrency_map_audit(repo_root):
+        findings.append(Finding("AUD008",
+                                "cbf_tpu/analysis/concurrency.py",
+                                0, 0, "<concurrency>", msg))
     return findings

@@ -137,6 +137,39 @@ _RULES = [
          "verify adapter, calibrated thresholds, NumPy-twin parity test "
          "or docs/API.md row — or a scenario module on disk that never "
          "registers (invisible to verify/serve/bench)"),
+    # -- concurrency (lock discipline) ------------------------------------
+    Rule("CC001", ERROR,
+         "shared mutable attribute of a threaded class written from "
+         "multiple thread scopes (or multiple methods) with no common "
+         "lock held across the write sites"),
+    Rule("CC002", ERROR,
+         "lock-order inversion: cycle in the global acquisition-order "
+         "graph — two threads taking the locks in opposite orders "
+         "deadlock"),
+    Rule("CC003", WARNING,
+         "blocking call (fsync/sleep/join/device wait/file I/O) inside "
+         "a held-lock region: every contending thread stalls behind "
+         "the I/O"),
+    Rule("CC004", ERROR,
+         "signal handler does more than Event.set/flag writes: a "
+         "handler interrupting the thread that holds the lock it "
+         "touches deadlocks"),
+    Rule("CC005", ERROR,
+         "Condition.wait outside a predicate loop: spurious wakeups "
+         "and missed rechecks proceed on a false predicate"),
+    Rule("CC006", WARNING,
+         "daemon thread doing file I/O with no join path: interpreter "
+         "teardown kills daemons mid-write (torn file, lost record)"),
+    Rule("CC007", ERROR,
+         "lock acquired in a __del__/atexit finalizer path: finalizers "
+         "run at arbitrary points, possibly while the lock is held"),
+    Rule("CC008", WARNING,
+         "thread start() without a matching join/stop contract: the "
+         "thread outlives every owner"),
+    Rule("AUD008", ERROR,
+         "concurrency-map drift: a discovered lock/condition/event/"
+         "thread/handler has no row in the docs/API.md concurrency map "
+         "(or the map names a primitive that no longer exists)"),
 ]
 
 RULES: dict[str, Rule] = {r.id: r for r in _RULES}

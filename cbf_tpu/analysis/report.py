@@ -26,18 +26,22 @@ from cbf_tpu.analysis.registry import RULES, Finding
 
 
 class LintResult:
-    def __init__(self, active, suppressed, stale):
+    def __init__(self, active, suppressed, stale, lock_graph=None):
         self.active: list[Finding] = active
         self.suppressed: list[tuple[Finding,
                                     baseline_mod.Suppression]] = suppressed
         self.stale: list[baseline_mod.Suppression] = stale
+        # Acquisition-order edges from the concurrency analyzer; None
+        # when the concurrency pass did not run (keeps the JSON contract
+        # for plain lint runs byte-identical to before).
+        self.lock_graph: list[dict] | None = lock_graph
 
     @property
     def exit_code(self) -> int:
         return 1 if (self.active or self.stale) else 0
 
     def as_dict(self) -> dict:
-        return {
+        d = {
             "findings": [f.as_dict() for f in self.active],
             "suppressed": [
                 dict(f.as_dict(), reason=s.reason)
@@ -48,15 +52,19 @@ class LintResult:
                       if any(f.rule == rid for f in self.active)},
             "exit_code": self.exit_code,
         }
+        if self.lock_graph is not None:
+            d["lock_order_graph"] = self.lock_graph
+        return d
 
 
 def run_lint(paths: Iterable[str], *, repo_root: str | None = None,
              baseline_path: str | None = None,
              jaxpr: bool = False, audits: bool = False,
+             concurrency: bool = False,
              entrypoints: Iterable[str] | None = None) -> LintResult:
     """Lint ``paths`` (AST rules), optionally adding the jaxpr
-    entry-point checks and the consolidated repo audits, and fold the
-    result through the baseline."""
+    entry-point checks, the consolidated repo audits and the
+    concurrency analyzer, and fold the result through the baseline."""
     findings = ast_rules.lint_paths(paths, repo_root=repo_root)
     if jaxpr:
         from cbf_tpu.analysis import jaxpr_rules
@@ -66,9 +74,27 @@ def run_lint(paths: Iterable[str], *, repo_root: str | None = None,
         from cbf_tpu.analysis import audits as audits_mod
 
         findings.extend(audits_mod.run_audits(repo_root=repo_root))
+    lock_graph = None
+    if concurrency:
+        from cbf_tpu.analysis import concurrency as conc_mod
+
+        conc = conc_mod.analyze_paths(paths, repo_root=repo_root)
+        findings.extend(conc.findings)
+        lock_graph = [e._asdict() for e in conc.edges]
     sups = baseline_mod.load(baseline_path)
     active, suppressed, stale = baseline_mod.split(findings, sups)
-    return LintResult(active, suppressed, stale)
+    # A suppression is only judged stale by a run that could have
+    # produced its finding: a plain lint run must not flag the CC/JX/AUD
+    # entries of the optional passes it skipped.
+    ran = ("TS", "RC")
+    if jaxpr:
+        ran += ("JX",)
+    if audits:
+        ran += ("AUD",)
+    if concurrency:
+        ran += ("CC",)
+    stale = [s for s in stale if s.rule.startswith(ran)]
+    return LintResult(active, suppressed, stale, lock_graph=lock_graph)
 
 
 def _fmt(f: Finding, suffix: str = "") -> str:

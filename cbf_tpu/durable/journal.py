@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Any
 
+from cbf_tpu.analysis import lockwitness
 from cbf_tpu.durable.rollout import config_from_json, config_to_json
 from cbf_tpu.serve.resilience import RecoveryError, ServeError
 
@@ -48,7 +49,7 @@ class RequestJournal:
 
     def __init__(self, path: str, *, telemetry=None):
         self.path = os.path.abspath(path)
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("RequestJournal._lock")
         d = os.path.dirname(self.path)
         if d:
             os.makedirs(d, exist_ok=True)

@@ -35,6 +35,8 @@ import threading
 import time
 from typing import Any, Callable
 
+from cbf_tpu.analysis import lockwitness
+
 PROM_FILENAME = "metrics.prom"
 JSON_FILENAME = "metrics.json"
 
@@ -162,7 +164,11 @@ class MetricsExporter:
         self.extra_fn = extra_fn
         self.writes = 0
         self.write_failures = 0
-        self._stop = threading.Event()
+        # Guards the write counters (bumped by the exporter thread AND
+        # any caller invoking write_once directly) and the start/stop
+        # thread-handle transition.
+        self._lock = lockwitness.make_lock("MetricsExporter._lock")
+        self._stop = lockwitness.make_event("MetricsExporter._stop")
         self._thread: threading.Thread | None = None
 
     def write_once(self) -> bool:
@@ -175,23 +181,31 @@ class MetricsExporter:
         try:
             write_metrics(self.out_dir, self.registry, extra=extra)
         except OSError:
-            self.write_failures += 1
+            with self._lock:
+                self.write_failures += 1
             return False
-        self.writes += 1
+        with self._lock:
+            self.writes += 1
         return True
 
     def start(self) -> "MetricsExporter":
-        if self._thread is None:
+        t = threading.Thread(target=self._loop, daemon=True)
+        with self._lock:
+            if self._thread is not None:
+                return self
             self._stop.clear()
-            self._thread = threading.Thread(target=self._loop, daemon=True)
-            self._thread.start()
+            self._thread = t
+        t.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2.0)
+        with self._lock:
+            t = self._thread
             self._thread = None
+        if t is not None:
+            # Join OUTSIDE the lock: the loop thread must keep running.
+            t.join(timeout=2.0)
         self.write_once()                  # final flush: surface run end
 
     def __enter__(self):

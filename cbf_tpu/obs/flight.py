@@ -47,6 +47,7 @@ import threading
 import time
 from typing import Any
 
+from cbf_tpu.analysis import lockwitness
 from cbf_tpu.obs import schema
 
 #: Event types this module emits — cross-checked against
@@ -112,7 +113,7 @@ class FlightRecorder:
         self.capsules: list[str] = []
         self.write_failures = 0
         self._last_trip: dict[str, float] = {}
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("FlightRecorder._lock")
         self._sink = None
         self._seq = 0
 
@@ -121,19 +122,22 @@ class FlightRecorder:
     def attach(self, sink) -> "FlightRecorder":
         """Subscribe to ``sink``'s event stream (and adopt its registry
         when none was given). Returns self for chaining."""
-        self._sink = sink
-        if self.registry is None:
-            self.registry = getattr(sink, "registry", None)
+        with self._lock:
+            self._sink = sink
+            if self.registry is None:
+                self.registry = getattr(sink, "registry", None)
+        # Subscribe OUTSIDE the lock: the sink takes its own lock.
         sink.subscribe(self._on_event)
         return self
 
     def detach(self) -> None:
-        if self._sink is not None:
+        with self._lock:
+            sink, self._sink = self._sink, None
+        if sink is not None:
             try:
-                self._sink.unsubscribe(self._on_event)
+                sink.unsubscribe(self._on_event)
             except Exception:
                 pass
-            self._sink = None
 
     def note_request(self, cfg, request_id: str | None = None) -> None:
         """Remember one admitted request (bounded ring) so a later trip

@@ -43,6 +43,8 @@ import threading
 import time
 from typing import Any
 
+from cbf_tpu.analysis import lockwitness
+
 #: Bump when the costmodel.json layout changes incompatibly.
 RESOURCE_SCHEMA_VERSION = 1
 
@@ -161,7 +163,7 @@ class CostModel:
         self.path = path
         self.env = dict(env) if env is not None else environment()
         self.entries: dict[str, dict[str, Any]] = {}
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("CostModel._lock")
         self._execs: dict[Any, Any] = {}
         if path is not None and os.path.exists(path):
             self._load(path)

@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Any, Callable
 
+from cbf_tpu.analysis import lockwitness
 from cbf_tpu.obs import schema
 
 
@@ -287,7 +288,7 @@ class TelemetrySink:
         self.manifest_path = os.path.join(self.run_dir,
                                           schema.MANIFEST_FILENAME)
         self._fh = open(self.events_path, "a")
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("TelemetrySink._lock")
         self._subscribers: list[Callable[[dict], None]] = []
         self.registry = MetricsRegistry()
         self.heartbeat_count = 0
@@ -387,11 +388,15 @@ class TelemetrySink:
     # -- events ------------------------------------------------------------
 
     def subscribe(self, fn: Callable[[dict], None]) -> None:
-        self._subscribers.append(fn)
+        # Under _lock: _emit snapshots the subscriber list under the
+        # same lock, and subscribe can race it from another thread.
+        with self._lock:
+            self._subscribers.append(fn)
 
     def unsubscribe(self, fn: Callable[[dict], None]) -> None:
-        if fn in self._subscribers:
-            self._subscribers.remove(fn)
+        with self._lock:
+            if fn in self._subscribers:
+                self._subscribers.remove(fn)
 
     def _emit(self, event: dict) -> None:
         """Serialize + append + fan out one event (caller holds no lock)."""

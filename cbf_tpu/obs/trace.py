@@ -38,6 +38,8 @@ import os
 import threading
 import time
 
+from cbf_tpu.analysis import lockwitness
+
 #: The event types this module emits (AUD001: together with
 #: serve.engine's, must union to obs.schema.SERVE_EVENT_TYPES).
 EMITTED_EVENT_TYPES: tuple[str, ...] = ("serve.span",)
@@ -136,7 +138,7 @@ class Tracer:
         self.dropped = 0
         self._epoch_perf = time.perf_counter()
         self._epoch_wall = time.time()
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("Tracer._lock")
         self._span_ids = itertools.count(1)
         self._local = threading.local()
         self._trace_seq = 0

@@ -42,6 +42,7 @@ import threading
 import time
 from typing import Callable, NamedTuple
 
+from cbf_tpu.analysis import lockwitness
 from cbf_tpu.obs import schema
 from cbf_tpu.obs.sink import TelemetrySink
 
@@ -86,11 +87,11 @@ class Watchdog:
         self.stall_timeout = stall_timeout
         self.on_alert = on_alert
         self.alerts: list[Alert] = []
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("Watchdog._lock")
         self._infeasible_streak = 0
         self._armed = {ALERT_NAN: True, ALERT_CERT_BLOWUP: True,
                        ALERT_INFEASIBLE: True}
-        self._stop = threading.Event()
+        self._stop = lockwitness.make_event("Watchdog._stop")
         self._started = time.time()
         self._thread = None
         sink.subscribe(self._on_event)

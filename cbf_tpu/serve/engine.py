@@ -51,6 +51,7 @@ from typing import Any
 import numpy as np
 import jax
 
+from cbf_tpu.analysis import lockwitness
 from cbf_tpu.obs import trace as obs_trace
 from cbf_tpu.parallel.ensemble import lockstep_traced_rollout
 from cbf_tpu.scenarios import swarm
@@ -127,7 +128,7 @@ class PendingRequest:
 
     def __init__(self, request_id: str):
         self.request_id = request_id
-        self._event = threading.Event()
+        self._event = lockwitness.make_event("PendingRequest._event")
         self._result: RequestResult | None = None
         self._error: BaseException | None = None
         self._engine: "ServeEngine | None" = None
@@ -265,8 +266,14 @@ class ServeEngine:
         self._execs: dict[_buckets.BucketKey, Any] = {}
         self._ids = itertools.count()
         self._batch_ids = itertools.count()
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
+        self._lock = lockwitness.make_lock("ServeEngine._lock")
+        self._cond = lockwitness.make_condition("ServeEngine._cond",
+                                                self._lock)
+        # Leaf lock for the stats dict: `_count` is reached both from
+        # caller paths that already hold `_cond` (cancel, submit-shed)
+        # and from the bare scheduler thread, so the stats guard must be
+        # a SEPARATE lock — reusing `_lock` would deadlock the former.
+        self._stats_lock = lockwitness.make_lock("ServeEngine._stats_lock")
         # bucket key -> list of (PendingRequest, cfg, traced, enqueue_t,
         # deadline_t); times are on the tracer's monotonic clock
         # (tracer.now()); deadline_t is None when the request has none.
@@ -278,7 +285,7 @@ class ServeEngine:
         # scheduler thread, or stop()). _preempt_poll_s bounds the
         # scheduler's condition wait once a handler is installed, so the
         # notice is observed without the handler touching any lock.
-        self._preempt = threading.Event()
+        self._preempt = lockwitness.make_event("ServeEngine._preempt")
         self._preempt_poll_s: float | None = None
         # Jitter rng (seeded — AUD004) + breaker state, all host-side.
         self._rng = np.random.default_rng(self.fault_policy.seed)
@@ -290,10 +297,20 @@ class ServeEngine:
 
     # -- telemetry helpers -------------------------------------------------
 
+    def _bump(self, name: str, v: int = 1) -> None:
+        """Bump a stats-dict entry under the stats leaf lock. The stats
+        dict is written from the scheduler thread, caller threads and
+        the cancel path concurrently (CC001)."""
+        with self._stats_lock:
+            self.stats[name] = self.stats.get(name, 0) + v
+
     def _count(self, name: str, v: int = 1) -> None:
         """Bump a resilience stat and its registry counter (when the
-        telemetry sink carries one)."""
-        self.stats[name] = self.stats.get(name, 0) + v
+        telemetry sink carries one). The registry counter is bumped
+        OUTSIDE the stats lock: MetricsRegistry is caller-serialized and
+        holding `_stats_lock` across it would put foreign code inside
+        the leaf region."""
+        self._bump(name, v)
         reg = getattr(self.telemetry, "registry", None)
         if reg is not None:
             reg.counter(f"serve.{name}").add(v)
@@ -314,10 +331,10 @@ class ServeEngine:
         hits/misses into the shared profiling event registry."""
         compiled = self._execs.get(key)
         if compiled is not None:
-            self.stats["compile_hit"] += 1
+            self._bump("compile_hit")
             profiling.add_event_count(f"serve.executable_hit[{key.label()}]")
             return compiled
-        self.stats["compile_miss"] += 1
+        self._bump("compile_miss")
         profiling.add_event_count(f"serve.executable_miss[{key.label()}]")
         t0 = time.perf_counter()
         fn = lockstep_traced_rollout(key.static_cfg, key.horizon)
@@ -536,8 +553,8 @@ class ServeEngine:
         with tracer.span("unpack", trace_id=batch_id, bucket=label):
             final_states = jax.device_get(final_states)
             outs = jax.device_get(outs)
-        self.stats["batches"] += 1
-        self.stats["pad_slots"] += self.max_batch - len(entries)
+        self._bump("batches")
+        self._bump("pad_slots", self.max_batch - len(entries))
         if self.cost_model is not None:
             obs = self.cost_model.observe_execute(label, execute_s)
             cost = self.cost_model.cost_of(label)
@@ -594,7 +611,7 @@ class ServeEngine:
                     queue_wait_s=round(t_exec_start - t_enq, 6),
                     execute_s=round(execute_s, 6), batch_fill=len(entries),
                     degraded=degraded, rta_engaged=rta_engaged)
-                self.stats["requests"] += 1
+                self._bump("requests")
                 if degraded:
                     self._count("degraded_requests")
                 if self.telemetry is not None:
@@ -750,13 +767,16 @@ class ServeEngine:
     # -- queue mode --------------------------------------------------------
 
     def start(self) -> None:
+        t = threading.Thread(target=self._scheduler_loop,
+                             name="serve-scheduler", daemon=True)
         with self._lock:
             if self._running:
                 return
             self._running = True
-        self._thread = threading.Thread(target=self._scheduler_loop,
-                                        name="serve-scheduler", daemon=True)
-        self._thread.start()
+            # Publish the handle under the lock: a concurrent stop()
+            # must never observe _running=True with _thread still None.
+            self._thread = t
+        t.start()
 
     def _queue_depth(self) -> int:
         with self._lock:
@@ -871,9 +891,11 @@ class ServeEngine:
         with self._cond:
             self._running = False
             self._cond.notify()
-        if self._thread is not None:
-            self._thread.join()
+            t = self._thread
             self._thread = None
+        if t is not None:
+            # Join OUTSIDE the lock — the scheduler needs it to exit.
+            t.join()
         if drain:
             self._drain_leftovers()
         if self.cost_model is not None:

@@ -36,6 +36,17 @@ batch through ServeEngine.run, same interleaved min-of-R discipline.
 All recorder work is host-side (a deque append + a dict probe per
 event), so the budget governs the engine's request wall.
 
+``--mode lockwitness`` measures the lock-order witness's ARMED cost
+under the same <= 3% budget (ISSUE 13): two engines sharing one
+prewarmed executable set, one constructed with the witness armed (every
+lock/condition/event wrapped, every acquisition booked into the global
+edge map) and one with the plain ``threading`` primitives, same mixed
+batch through ServeEngine.run, same interleaved min-of-R discipline.
+All witness work is host-side dict bookkeeping, so the budget governs
+the engine's request wall; the record also carries the observed
+acquisition count and inversion count (which must be zero — the
+measurement doubles as a deadlock-order check on fault-free traffic).
+
 ``--mode rta`` measures the runtime-assurance ladder's IDLE cost under
 the same <= 3% budget (ISSUE 10): a healthy rta=True rollout (health
 word assembled, latch updated, every select taken on the nominal side —
@@ -244,6 +255,71 @@ def measure_flight(b: int, n_base: int, steps: int, reps: int) -> dict:
             "platform": jax.devices()[0].platform}
 
 
+def measure_lockwitness(b: int, n_base: int, steps: int,
+                        reps: int) -> dict:
+    """Armed lock-witness overhead on the serve path: the SAME fixed
+    mixed batch served by an engine whose locks are witness-wrapped vs
+    an engine with plain threading primitives. Arming is a factory-time
+    decision, so the legs need two engines — but they share one
+    prewarmed executable set, so they differ only in the host-side
+    acquisition bookkeeping. Fault-free traffic; the observed graph
+    must be inversion-free, making the measurement double as a runtime
+    lock-order check."""
+    import jax
+
+    from cbf_tpu.analysis import lockwitness
+    from cbf_tpu.obs.trace import Tracer
+    from cbf_tpu.scenarios import swarm
+    from cbf_tpu.serve import ServeEngine
+
+    cfgs = [swarm.Config(n=max(4, n_base // (2 ** (i % 3))), steps=steps,
+                         seed=i, gating="jnp",
+                         safety_distance=0.4 + 0.003 * (i % 5))
+            for i in range(b)]
+    # Tracer disabled in both legs (spans have their own budget).
+    lockwitness.disarm()
+    engine_off = ServeEngine(max_batch=8, tracer=Tracer(enabled=False))
+    engine_off.prewarm(cfgs)
+    lockwitness.arm()
+    lockwitness.reset()
+    engine_on = ServeEngine(max_batch=8, tracer=Tracer(enabled=False))
+    lockwitness.disarm()
+    engine_on._execs = engine_off._execs  # one compiled set, two engines
+
+    def one(engine, armed: bool) -> float:
+        # Per-request events are made at submit time, so the arm flag
+        # must track the leg (the long-lived engine locks were fixed at
+        # construction either way).
+        if armed:
+            lockwitness.arm()
+        else:
+            lockwitness.disarm()
+        t0 = time.perf_counter()
+        engine.run(cfgs)
+        wall = time.perf_counter() - t0
+        lockwitness.disarm()
+        return wall
+
+    one(engine_on, True), one(engine_off, False)   # warm both paths
+    offs, ons = [], []
+    for i in range(reps):
+        legs = ((offs, engine_off, False), (ons, engine_on, True))
+        for acc, eng, armed in (legs if i % 2 == 0 else legs[::-1]):
+            acc.append(one(eng, armed))
+    snap = lockwitness.snapshot()
+    inversions = lockwitness.inversions()
+    lockwitness.reset()
+    off_s, on_s = min(offs), min(ons)
+    return {"mode": "lockwitness", "b": b, "n_base": n_base,
+            "steps": steps, "reps": reps, "off_s": round(off_s, 4),
+            "on_s": round(on_s, 4),
+            "overhead": round((on_s - off_s) / off_s, 4),
+            "acquisitions": snap["acquisitions"],
+            "edges": len(snap["edges"]),
+            "inversions": len(inversions),   # must be 0
+            "platform": jax.devices()[0].platform}
+
+
 def measure_rta(n: int, steps: int, reps: int) -> dict:
     """Idle runtime-assurance overhead on the rollout path: a HEALTHY
     rta=True rollout vs the plain program. No fault fires, so the on-leg
@@ -294,21 +370,23 @@ def main() -> int:
     p.add_argument("--every", type=int, default=50)
     p.add_argument("--reps", type=int, default=5)
     p.add_argument("--mode", choices=("rollout", "spans", "faults",
-                                      "flight", "rta"),
+                                      "flight", "lockwitness", "rta"),
                    default="rollout")
     p.add_argument("--b", type=int, default=12,
-                   help="request count for --mode spans/faults/flight")
+                   help="request count for --mode "
+                        "spans/faults/flight/lockwitness")
     args = p.parse_args()
     if args.mode == "rta":
         print(json.dumps(measure_rta(args.n, args.steps, args.reps)))
-    elif args.mode in ("spans", "faults", "flight"):
+    elif args.mode in ("spans", "faults", "flight", "lockwitness"):
         # Serve-path budgets are per-request wall at serving sizes; the
         # rollout defaults (N=1024) would swamp the signal with device
         # time, so these modes size down and serve a mixed batch instead.
         n_base = args.n if args.n != 1024 else 32
         steps = args.steps if args.steps != 300 else 40
         fn = {"spans": measure_spans, "faults": measure_faults,
-              "flight": measure_flight}[args.mode]
+              "flight": measure_flight,
+              "lockwitness": measure_lockwitness}[args.mode]
         print(json.dumps(fn(args.b, n_base, steps, args.reps)))
     else:
         print(json.dumps(measure(args.n, args.steps, args.every,

@@ -1,0 +1,20 @@
+"""CC001 bad: shared counter written from the worker thread and the
+caller with no common lock held."""
+import threading
+
+
+class Collector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        self.count += 1                  # CC001: worker scope, no lock
+
+    def add(self, n):
+        self.count += n                  # CC001: caller scope, no lock
+
+    def stop(self):
+        self._thread.join()

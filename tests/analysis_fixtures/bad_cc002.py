@@ -1,0 +1,18 @@
+"""CC002 bad: two locks taken in opposite orders by two public paths."""
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:                # edge a -> b
+                pass
+
+    def backward(self):
+        with self._b:
+            with self._a:                # edge b -> a: CC002 cycle
+                pass

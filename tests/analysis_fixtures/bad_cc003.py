@@ -1,0 +1,15 @@
+"""CC003 bad: write+flush+fsync performed while holding the lock."""
+import os
+import threading
+
+
+class Journal:
+    def __init__(self, path):
+        self._lock = threading.Lock()
+        self._fh = open(path, "a")
+
+    def append(self, line):
+        with self._lock:
+            self._fh.write(line)         # CC003: file I/O under lock
+            self._fh.flush()
+            os.fsync(self._fh.fileno())

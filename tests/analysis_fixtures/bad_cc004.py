@@ -1,0 +1,20 @@
+"""CC004 bad: SIGTERM handler takes a lock and mutates state."""
+import signal
+import threading
+
+
+class Server:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+
+    def install(self):
+        def _handler(signum, frame):
+            with self._lock:             # CC004: lock in a signal handler
+                self.drain()
+
+        signal.signal(signal.SIGTERM, _handler)
+
+    def drain(self):
+        with self._lock:
+            pass

@@ -1,0 +1,20 @@
+"""CC005 bad: Condition.wait guarded by `if`, not a predicate loop."""
+import threading
+
+
+class WorkQueue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.items = []
+
+    def put(self, item):
+        with self._cond:
+            self.items.append(item)
+            self._cond.notify()
+
+    def get(self):
+        with self._cond:
+            if not self.items:
+                self._cond.wait()        # CC005: spurious wakeup pops empty
+            return self.items.pop(0)

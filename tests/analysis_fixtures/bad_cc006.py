@@ -1,0 +1,14 @@
+"""CC006 bad: daemon thread appends to a file and nothing ever joins
+it — interpreter teardown kills it mid-write."""
+import threading
+
+
+class Spooler:
+    def __init__(self, path):
+        self._fh = open(path, "a")
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _drain(self):
+        self._fh.write("tick\n")         # CC006: torn on teardown
+        self._fh.flush()

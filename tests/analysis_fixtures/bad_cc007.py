@@ -1,0 +1,13 @@
+"""CC007 bad: __del__ acquires a lock — finalizers run at arbitrary
+points, possibly while the same lock is held."""
+import threading
+
+
+class Holder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.closed = False
+
+    def __del__(self):
+        with self._lock:                 # CC007
+            self.closed = True

@@ -1,0 +1,16 @@
+"""CC008 bad: thread handle is started but nothing in the class ever
+joins it — no stop contract."""
+import threading
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._loop)
+
+    def start(self):
+        self._thread.start()             # CC008: never joined
+
+    def _loop(self):
+        with self._lock:
+            pass

@@ -1,0 +1,21 @@
+"""CC001 clean: every cross-thread write holds the one shared lock."""
+import threading
+
+
+class Collector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        with self._lock:
+            self.count += 1
+
+    def add(self, n):
+        with self._lock:
+            self.count += n
+
+    def stop(self):
+        self._thread.join()

@@ -1,0 +1,18 @@
+"""CC002 clean: both paths take the locks in the same global order."""
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def backward(self):
+        with self._a:
+            with self._b:
+                pass

@@ -1,0 +1,21 @@
+"""CC003 clean: the lock covers only the list mutation; I/O happens
+after release."""
+import threading
+
+
+class Journal:
+    def __init__(self, path):
+        self._lock = threading.Lock()
+        self._fh = open(path, "a")
+        self._pending = []
+
+    def append(self, line):
+        with self._lock:
+            self._pending.append(line)
+
+    def flush(self):
+        with self._lock:
+            batch = list(self._pending)
+            self._pending.clear()
+        for line in batch:
+            self._fh.write(line)

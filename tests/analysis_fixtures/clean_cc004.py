@@ -1,0 +1,20 @@
+"""CC004 clean: the handler only sets an Event; the drain runs in
+normal control flow."""
+import signal
+import threading
+
+
+class Server:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+
+    def install(self):
+        def _handler(signum, frame):
+            self._stop.set()
+
+        signal.signal(signal.SIGTERM, _handler)
+
+    def drain(self):
+        with self._lock:
+            pass

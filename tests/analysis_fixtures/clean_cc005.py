@@ -1,0 +1,20 @@
+"""CC005 clean: wait() re-checks its predicate in a while loop."""
+import threading
+
+
+class WorkQueue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.items = []
+
+    def put(self, item):
+        with self._cond:
+            self.items.append(item)
+            self._cond.notify()
+
+    def get(self):
+        with self._cond:
+            while not self.items:
+                self._cond.wait()
+            return self.items.pop(0)

@@ -1,0 +1,16 @@
+"""CC006 clean: same daemon writer, but stop() joins it before exit."""
+import threading
+
+
+class Spooler:
+    def __init__(self, path):
+        self._fh = open(path, "a")
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _drain(self):
+        self._fh.write("tick\n")
+        self._fh.flush()
+
+    def stop(self):
+        self._thread.join()

@@ -1,0 +1,16 @@
+"""CC007 clean: explicit close() takes the lock; __del__ touches no
+lock (a plain flag write cannot deadlock)."""
+import threading
+
+
+class Holder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.closed = False
+
+    def close(self):
+        with self._lock:
+            self.closed = True
+
+    def __del__(self):
+        self.closed = True

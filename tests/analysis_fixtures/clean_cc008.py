@@ -1,0 +1,18 @@
+"""CC008 clean: start() has a matching stop() that joins the handle."""
+import threading
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._loop)
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._thread.join()
+
+    def _loop(self):
+        with self._lock:
+            pass

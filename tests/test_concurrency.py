@@ -1,0 +1,327 @@
+"""Concurrency analyzer + lock-order witness.
+
+Mirrors test_analysis.py's three-layer shape for the CC rule family:
+
+* fixture snippets per CC rule (tests/analysis_fixtures/: one
+  known-bad, one known-clean each) pin true-positive AND
+  false-positive behavior of the lock-discipline rules;
+* graph/inventory assertions pin the analyzer's structural outputs
+  (acquisition-order edges, per-class lock inventory) against both a
+  fixture and the live repo;
+* the runtime witness is unit-tested here (arm/disarm factories, edge
+  recording, inversion detection, observed-within-static closure) and
+  exercised against the real threaded stack by the armed legs of
+  test_serve_faults.py / test_durable.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from cbf_tpu.analysis import baseline, concurrency, lockwitness
+from cbf_tpu.analysis.report import render_json, render_text, run_lint
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "analysis_fixtures")
+
+_CC_RULES = ["CC001", "CC002", "CC003", "CC004",
+             "CC005", "CC006", "CC007", "CC008"]
+
+
+def _analyze_fixture(name: str):
+    path = os.path.join(_FIXTURES, name)
+    with open(path) as fh:
+        return concurrency.analyze_source(fh.read(), name)
+
+
+# -- CC rules: one bad + one clean fixture each ---------------------------
+
+@pytest.mark.parametrize("rule", _CC_RULES)
+def test_cc_rule_fires_on_bad_fixture(rule):
+    res = _analyze_fixture(f"bad_{rule.lower()}.py")
+    assert rule in {f.rule for f in res.findings}, (
+        f"{rule} did not fire on its known-bad fixture: {res.findings}")
+
+
+@pytest.mark.parametrize("rule", _CC_RULES)
+def test_cc_rule_silent_on_clean_fixture(rule):
+    res = _analyze_fixture(f"clean_{rule.lower()}.py")
+    assert res.findings == [], (
+        f"clean fixture for {rule} produced findings: {res.findings}")
+
+
+# -- graph + inventory ------------------------------------------------------
+
+def test_bad_cc002_books_both_edge_directions():
+    res = _analyze_fixture("bad_cc002.py")
+    got = {(e.src, e.dst) for e in res.edges}
+    assert got == {("Pair._a", "Pair._b"), ("Pair._b", "Pair._a")}
+    assert concurrency.static_edge_set(res) == got
+
+
+def test_clean_cc002_books_one_edge_direction():
+    res = _analyze_fixture("clean_cc002.py")
+    assert {(e.src, e.dst) for e in res.edges} == {("Pair._a", "Pair._b")}
+
+
+def test_repo_inventory_names_the_threaded_stack():
+    res = concurrency.analyze_paths(
+        [os.path.join(_ROOT, "cbf_tpu")], repo_root=_ROOT)
+    inv = res.inventory
+    eng = inv["ServeEngine"]
+    assert "_lock" in eng["locks"]
+    assert eng["conditions"].get("_cond") == "_lock"
+    assert any(t["entry"] == "_scheduler_loop" for t in eng["threads"])
+    assert "_lock" in inv["RequestJournal"]["locks"]
+    assert "_lock" in inv["TelemetrySink"]["locks"]
+
+
+def test_repo_lock_graph_is_acyclic_with_expected_edges():
+    res = concurrency.analyze_paths(
+        [os.path.join(_ROOT, "cbf_tpu")], repo_root=_ROOT)
+    edges = concurrency.static_edge_set(res)
+    assert ("ServeEngine._lock", "RequestJournal._lock") in edges
+    assert not any(f.rule == "CC002" for f in res.findings), (
+        "lock-order cycle in the repo's own graph")
+
+
+# -- baseline round-trip ----------------------------------------------------
+
+def test_cc_baseline_roundtrip(tmp_path):
+    target = os.path.join(_FIXTURES, "bad_cc001.py")
+    res = run_lint([target], repo_root=_ROOT, concurrency=True)
+    assert any(f.rule == "CC001" for f in res.active)
+    sups = [baseline.Suppression(f.rule, f.path, f.symbol,
+                                 "fixture: known-bad by construction")
+            for f in res.active]
+    bpath = str(tmp_path / "baseline.toml")
+    baseline.write(bpath, sups)
+    res = run_lint([target], repo_root=_ROOT, baseline_path=bpath,
+                   concurrency=True)
+    assert res.exit_code == 0
+    assert res.active == []
+    text = render_text(res, show_suppressed=True)
+    assert "CC001" in text
+
+
+def test_cc_suppression_not_stale_when_pass_skipped(tmp_path):
+    """A plain lint run (no --concurrency) must not flag CC baseline
+    entries as stale — only a pass that could have produced the finding
+    may retire its suppression."""
+    bpath = str(tmp_path / "baseline.toml")
+    baseline.write(bpath, [baseline.Suppression(
+        "CC003", "cbf_tpu/durable/journal.py", "RequestJournal._append",
+        "WAL contract")])
+    target = os.path.join(_FIXTURES, "clean_ts001.py")
+    res = run_lint([target], repo_root=_ROOT, baseline_path=bpath)
+    assert res.exit_code == 0
+    assert res.stale == []
+    # ... but the concurrency pass itself DOES judge it.
+    res = run_lint([target], repo_root=_ROOT, baseline_path=bpath,
+                   concurrency=True)
+    assert res.exit_code == 1
+    assert len(res.stale) == 1
+
+
+def test_lock_order_graph_in_json_only_with_concurrency():
+    target = os.path.join(_FIXTURES, "clean_cc002.py")
+    import json as _json
+    plain = _json.loads(render_json(run_lint([target], repo_root=_ROOT)))
+    assert "lock_order_graph" not in plain
+    conc = _json.loads(render_json(
+        run_lint([target], repo_root=_ROOT, concurrency=True)))
+    graph = conc["lock_order_graph"]
+    assert {(e["src"], e["dst"]) for e in graph} == {
+        ("Pair._a", "Pair._b")}
+
+
+# -- docs needles -----------------------------------------------------------
+
+def test_concurrency_docs_section_present():
+    """docs/API.md's 'Concurrency analysis' section must keep its
+    load-bearing needles: every CC rule ID (also enforced repo-wide by
+    test_rules_documented), the witness env knob, and the concurrency-
+    map markers AUD008 audits between."""
+    with open(os.path.join(_ROOT, "docs", "API.md")) as fh:
+        api = fh.read()
+    assert "## Concurrency analysis" in api
+    for needle in ("`CC001`", "`CC008`", "CBF_TPU_LOCK_WITNESS",
+                   "lock_order_graph", "<!-- concurrency-map:start -->",
+                   "<!-- concurrency-map:end -->"):
+        assert needle in api, f"docs/API.md lost needle: {needle}"
+
+
+# -- runtime witness --------------------------------------------------------
+
+@pytest.fixture
+def armed():
+    lockwitness.arm()
+    lockwitness.reset()
+    try:
+        yield
+    finally:
+        lockwitness.disarm()
+        lockwitness.reset()
+
+
+def test_factories_return_plain_primitives_when_disarmed():
+    assert not lockwitness.is_armed()
+    assert type(lockwitness.make_lock("X._lock")) is type(threading.Lock())
+    assert isinstance(lockwitness.make_event("X._ev"), threading.Event)
+    assert isinstance(lockwitness.make_condition("X._cond"),
+                      threading.Condition)
+
+
+def test_factories_return_witness_wrappers_when_armed(armed):
+    lk = lockwitness.make_lock("X._lock")
+    assert isinstance(lk, lockwitness.WitnessLock)
+    assert isinstance(lockwitness.make_event("X._ev"),
+                      lockwitness.WitnessEvent)
+    cond = lockwitness.make_condition("X._cond", lk)
+    assert isinstance(cond, lockwitness.WitnessCondition)
+    # A condition shares its lock's witness identity.
+    assert cond.name == "X._lock"
+
+
+def test_nested_acquire_books_edge_and_reset_clears(armed):
+    a = lockwitness.make_lock("A._lock")
+    b = lockwitness.make_lock("B._lock")
+    with a:
+        with b:
+            pass
+    assert lockwitness.observed_edges() == {("A._lock", "B._lock")}
+    snap = lockwitness.snapshot()
+    assert snap["armed"] and snap["acquisitions"] == 2
+    lockwitness.reset()
+    assert lockwitness.observed_edges() == set()
+    assert lockwitness.snapshot()["acquisitions"] == 0
+
+
+def test_inversions_detects_opposite_orders(armed):
+    a = lockwitness.make_lock("A._lock")
+    b = lockwitness.make_lock("B._lock")
+    with a:
+        with b:
+            pass
+    assert lockwitness.inversions() == []
+    with b:
+        with a:
+            pass
+    assert lockwitness.inversions() == [("A._lock", "B._lock")]
+
+
+def test_check_subgraph_accepts_transitive_closure(armed):
+    a = lockwitness.make_lock("A._lock")
+    c = lockwitness.make_lock("C._lock")
+    with a:
+        with c:             # observed A->C directly
+            pass
+    static = {("A._lock", "B._lock"), ("B._lock", "C._lock")}
+    assert lockwitness.check_subgraph(static) == []
+
+
+def test_check_subgraph_flags_unexplained_edge(armed):
+    a = lockwitness.make_lock("A._lock")
+    d = lockwitness.make_lock("D._lock")
+    with a:
+        with d:
+            pass
+    problems = lockwitness.check_subgraph({("A._lock", "B._lock")})
+    assert len(problems) == 1
+    assert "A._lock -> D._lock" in problems[0]
+
+
+def test_witness_condition_wait_notify_across_threads(armed):
+    lk = lockwitness.make_lock("Q._lock")
+    cond = lockwitness.make_condition("Q._cond", lk)
+    items = []
+
+    def consumer():
+        with cond:
+            while not items:
+                cond.wait(timeout=5.0)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.02)
+    with cond:
+        items.append(1)
+        cond.notify()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+
+
+def test_witness_condition_wait_for(armed):
+    lk = lockwitness.make_lock("Q._lock")
+    cond = lockwitness.make_condition("Q._cond", lk)
+    flag = []
+
+    def setter():
+        time.sleep(0.02)
+        with cond:
+            flag.append(1)
+            cond.notify()
+
+    t = threading.Thread(target=setter)
+    t.start()
+    with cond:
+        assert cond.wait_for(lambda: bool(flag), timeout=5.0)
+    t.join(timeout=5.0)
+    # Timed-out wait_for returns the (falsy) predicate value.
+    with cond:
+        assert not cond.wait_for(lambda: False, timeout=0.01)
+
+
+def test_wait_with_other_lock_held_books_blocking_event(armed):
+    outer = lockwitness.make_lock("Outer._lock")
+    lk = lockwitness.make_lock("Inner._lock")
+    cond = lockwitness.make_condition("Inner._cond", lk)
+    with outer:
+        with cond:
+            cond.wait(timeout=0.01)
+    snap = lockwitness.snapshot()
+    assert any(b["kind"] == "cond_wait" and "Outer._lock" in b["held"]
+               for b in snap["blocking"])
+    # The post-wait reacquisition books the (outer -> inner) edge.
+    assert ("Outer._lock", "Inner._lock") in lockwitness.observed_edges()
+
+
+@pytest.mark.slow
+def test_lockwitness_overhead_within_budget():
+    """Armed witness costs <= 3% of the engine's request wall — same
+    budget and interleaved min-of-R methodology as the heartbeat tap,
+    span tracing, and idle fault machinery (subprocess for a clean
+    single-device backend). The same record must show zero observed
+    lock-order inversions: the measurement doubles as a runtime check."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "scripts",
+                                      "telemetry_overhead.py"),
+         "--mode", "lockwitness", "--reps", "5"],
+        capture_output=True, text=True, env=env, cwd=_ROOT, timeout=560)
+    assert out.returncode == 0, out.stderr[-800:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["acquisitions"] > 0                    # witness really on
+    assert rec["inversions"] == 0
+    assert rec["overhead"] <= 0.03, (
+        f"armed lock-witness overhead {rec['overhead']:.1%} > 3% budget "
+        f"(off {rec['off_s']}s, on {rec['on_s']}s)")
+
+
+def test_witness_event_wait_books_blocking_when_lock_held(armed):
+    lk = lockwitness.make_lock("E._lock")
+    ev = lockwitness.make_event("E._ev")
+    with lk:
+        ev.wait(timeout=0.01)
+    snap = lockwitness.snapshot()
+    assert any(b["kind"] == "event_wait" and b["name"] == "E._ev"
+               for b in snap["blocking"])
+    ev.set()
+    assert ev.is_set() and ev.wait(timeout=0.01)

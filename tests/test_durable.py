@@ -265,21 +265,39 @@ def test_sigterm_drains_from_scheduler_not_the_handler(tmp_path):
     (normal) control flow, so every acknowledged request resolves and
     journals its terminal record — no batch execution, thread join, or
     journal fsync ever runs inside the signal handler."""
+    from cbf_tpu.analysis import concurrency, lockwitness
+
     path = str(tmp_path / "j.jsonl")
-    engine = ServeEngine(max_batch=2, flush_deadline_s=60.0, journal=path)
-    engine.start()
-    prev = engine.install_sigterm_handler()
+    # Arm the lock-order witness BEFORE the engine/journal exist (locks
+    # are wrapped at construction): the drain path must show a
+    # cycle-free acquisition order fully explained by the static graph.
+    lockwitness.arm()
+    lockwitness.reset()
     try:
-        # flush_deadline far out: only the preempt drain can flush these.
-        handles = [engine.submit(_mk_cfg(seed=i)) for i in range(3)]
-        os.kill(os.getpid(), signal.SIGTERM)
-        for h in handles:
-            r = h.result(timeout=120)
-            assert r.request_id == h.request_id
+        engine = ServeEngine(max_batch=2, flush_deadline_s=60.0,
+                             journal=path)
+        engine.start()
+        prev = engine.install_sigterm_handler()
+        try:
+            # flush_deadline far out: only the preempt drain can flush
+            # these.
+            handles = [engine.submit(_mk_cfg(seed=i)) for i in range(3)]
+            os.kill(os.getpid(), signal.SIGTERM)
+            for h in handles:
+                r = h.result(timeout=120)
+                assert r.request_id == h.request_id
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+            engine.stop(drain=True)
+        assert dj.replay_journal(path).unresolved == []
+        assert lockwitness.snapshot()["acquisitions"] > 0
+        assert lockwitness.inversions() == []
+        static = concurrency.static_edge_set(concurrency.analyze_paths(
+            [os.path.join(ROOT, "cbf_tpu")], repo_root=ROOT))
+        assert lockwitness.check_subgraph(static) == []
     finally:
-        signal.signal(signal.SIGTERM, prev)
-        engine.stop(drain=True)
-    assert dj.replay_journal(path).unresolved == []
+        lockwitness.disarm()
+        lockwitness.reset()
 
 
 def test_recover_reruns_only_unresolved_under_original_ids(tmp_path):

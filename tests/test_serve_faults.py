@@ -406,35 +406,55 @@ def test_chaos_soak_resolves_every_request(warm_execs, sink):
     spikes, a bounded queue with deadlines — and EVERY request resolves:
     completed + errors == requests, every error is a typed ServeError,
     zero hangs (no TimeoutError)."""
+    from cbf_tpu.analysis import concurrency, lockwitness
+
     spec = LoadSpec(rps=40.0, duration_s=1.5, seed=0, n_min=8, n_max=12,
                     steps_choices=(8,))
-    eng = _engine(sink=sink, flush_deadline_s=0.05)
-    eng._execs = warm_execs
-    eng.fault_policy = FaultPolicy(queue_limit=32, deadline_s=5.0,
-                                   quarantine_threshold=3,
-                                   quarantine_cooldown_s=0.5)
-    # times=2 == the default max_retries: a transient burst the retry
-    # budget is provisioned for always recovers, so the only expected
-    # casualties are the typed shed/deadline/quarantine/poison verdicts.
-    eng.fault_hook = faults.serve_chaos_hook(
-        faults.serve_executor_fault(times=2),
-        faults.serve_latency_spike(0.05, every=4))
+    # Arm the lock-order witness BEFORE the engine exists: arming is a
+    # factory-time decision, so only locks constructed now are recorded.
+    lockwitness.arm()
+    lockwitness.reset()
+    try:
+        eng = _engine(sink=sink, flush_deadline_s=0.05)
+        eng._execs = warm_execs
+        eng.fault_policy = FaultPolicy(queue_limit=32, deadline_s=5.0,
+                                       quarantine_threshold=3,
+                                       quarantine_cooldown_s=0.5)
+        # times=2 == the default max_retries: a transient burst the retry
+        # budget is provisioned for always recovers, so the only expected
+        # casualties are the typed shed/deadline/quarantine/poison
+        # verdicts.
+        eng.fault_hook = faults.serve_chaos_hook(
+            faults.serve_executor_fault(times=2),
+            faults.serve_latency_spike(0.05, every=4))
 
-    def mutate(i, cfg):
-        return faults.poison_config(cfg) if i % 5 == 4 else cfg
+        def mutate(i, cfg):
+            return faults.poison_config(cfg) if i % 5 == 4 else cfg
 
-    report = run_loadgen(eng, spec, mutate=mutate, result_timeout_s=60.0)
-    assert report["requests"] > 20
-    assert report["completed"] + report["errors"] == report["requests"]
-    assert report["completed"] > 0
-    assert report["errors"] > 0                       # faults really fired
-    allowed = {"NonFiniteResult", "ShedError", "DeadlineExceeded",
-               "QuarantinedError"}
-    assert set(report["errors_by_type"]) <= allowed, report["errors_by_type"]
-    assert report["errors_by_type"].get("NonFiniteResult", 0) > 0
-    assert eng.stats["retries"] >= 1                  # transients recovered
-    # Healthy completions stayed safe under chaos.
-    assert report["min_pairwise_distance"] > 0.1
+        report = run_loadgen(eng, spec, mutate=mutate, result_timeout_s=60.0)
+        assert report["requests"] > 20
+        assert report["completed"] + report["errors"] == report["requests"]
+        assert report["completed"] > 0
+        assert report["errors"] > 0                   # faults really fired
+        allowed = {"NonFiniteResult", "ShedError", "DeadlineExceeded",
+                   "QuarantinedError"}
+        assert set(report["errors_by_type"]) <= allowed, (
+            report["errors_by_type"])
+        assert report["errors_by_type"].get("NonFiniteResult", 0) > 0
+        assert eng.stats["retries"] >= 1              # transients recovered
+        # Healthy completions stayed safe under chaos.
+        assert report["min_pairwise_distance"] > 0.1
+        # The witness corroborates the static analyzer under chaos: the
+        # observed acquisition order is cycle-free and every observed
+        # edge is explained by the statically derived lock-order graph.
+        assert lockwitness.snapshot()["acquisitions"] > 0
+        assert lockwitness.inversions() == []
+        static = concurrency.static_edge_set(concurrency.analyze_paths(
+            [os.path.join(ROOT, "cbf_tpu")], repo_root=ROOT))
+        assert lockwitness.check_subgraph(static) == []
+    finally:
+        lockwitness.disarm()
+        lockwitness.reset()
 
 
 @pytest.mark.slow
