@@ -153,6 +153,22 @@ Modes / env knobs:
     BENCH_PREEMPT_STEPS (4000), BENCH_PREEMPT_CHUNK (400),
     BENCH_PREEMPT_MTTR_BOUND (60 s). Subprocesses run on CPU (the axis
     is durability, not rate). See docs/BENCH_LOG.md Round 12.
+  BENCH_FAILOVER=1 — hot-standby failover mode (cbf_tpu.serve.ha +
+    utils.faults): BENCH_FAILOVER_ROUNDS primary/standby CLI pairs on
+    one lease + fenced journal, the primary SIGKILLed mid-stream at a
+    seeded point in each round, plus one SIGSTOP'd-zombie round (the
+    paused primary must come back FENCED — exit 4 — while the new
+    epoch's log stays intact). Gates: every round's standby takes
+    over, the journal census shows zero acknowledged requests lost
+    (no unresolved) and zero duplicate executions (no request id with
+    more than one resolved record), takeover MTTR (the reported
+    value) under BENCH_FAILOVER_MTTR_BOUND, and the zombie fenced
+    with the typed exit code. Knobs: BENCH_FAILOVER_ROUNDS (3),
+    BENCH_FAILOVER_SEED (0), BENCH_FAILOVER_REQUESTS (16),
+    BENCH_FAILOVER_PACE_S (0.3), BENCH_FAILOVER_TTL_S (1.0),
+    BENCH_FAILOVER_KILL_TMIN (0.5) / _TMAX (2.5),
+    BENCH_FAILOVER_MTTR_BOUND (5 s). Subprocesses run on CPU (the
+    axis is availability, not rate).
   BENCH_ENSEMBLE=1 (or --ensemble) — dp-sharded ensemble of independent
     swarms over all available devices (the multi-chip measurement path for
     the v4-8 ladder rung); adds "chips" + "scaling_efficiency" fields.
@@ -2033,6 +2049,233 @@ def _child_preempt(steps: int) -> dict:
     return result
 
 
+def _child_failover(steps: int) -> dict:
+    """BENCH_FAILOVER mode: supervised hot-standby failover harness
+    (cbf_tpu.serve.ha + utils.faults), driven through the real CLI in
+    subprocesses so the kills hit whole processes.
+
+    Each round: a hot standby (`serve --ha-standby`, prewarmed and
+    watching the lease) plus a primary (`serve --lease --journal
+    --pace-s`, paced queue-mode traffic) sharing one lease file and one
+    fenced journal; the primary is SIGKILLed a seeded delay after its
+    first acknowledged request lands in the journal; the standby must
+    take over (bumped epoch) and finish every acknowledged-but-
+    unresolved request. Gate per round: the journal folds to ZERO
+    unresolved entries and the resolved-record census shows NO request
+    id above 1 (zero lost acknowledged requests, zero duplicate
+    executions), and the takeover MTTR stays under
+    BENCH_FAILOVER_MTTR_BOUND.
+
+    The final round is the ZOMBIE leg: the primary is SIGSTOP'd (not
+    killed) mid-stream, the standby takes over while it is paused, and
+    on SIGCONT the zombie's next journal append must be rejected by the
+    epoch fence — the primary exits EXIT_FENCED (4), the new epoch's
+    log replays clean, and not a single zombie byte lands in it."""
+    import shutil
+    import signal as _signal
+    import subprocess
+    import tempfile as _tempfile
+    import time as _time
+
+    from cbf_tpu.durable.journal import replay_journal
+    from cbf_tpu.serve.ha import EXIT_FENCED
+    from cbf_tpu.utils import faults
+
+    rounds = _env_int("BENCH_FAILOVER_ROUNDS", 3)
+    seed = _env_int("BENCH_FAILOVER_SEED", 0)
+    requests = _env_int("BENCH_FAILOVER_REQUESTS", 16)
+    pace_s = _env_float("BENCH_FAILOVER_PACE_S", 0.3)
+    ttl_s = _env_float("BENCH_FAILOVER_TTL_S", 1.0)
+    t_min = _env_float("BENCH_FAILOVER_KILL_TMIN", 0.5)
+    t_max = _env_float("BENCH_FAILOVER_KILL_TMAX", 2.5)
+    mttr_bound = _env_float("BENCH_FAILOVER_MTTR_BOUND", 5.0)
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    work = _tempfile.mkdtemp(prefix="bench_failover_")
+    # One shared compilation cache: after round 0 every prewarm/compile
+    # in both roles is a deserialization hit, so kills land in the
+    # serving stream, not inside XLA.
+    env["CBF_TPU_CACHE_DIR"] = os.path.join(work, "cache")
+    reqs_path = os.path.join(work, "requests.json")
+    with open(reqs_path, "w") as fh:
+        json.dump([{"steps": 6, "seed": 1,
+                    "overrides": {"n": 8, "gating": "jnp"},
+                    "repeat": requests}], fh)
+
+    def standby_argv(lease, journal, ready):
+        return [sys.executable, "-m", "cbf_tpu", "serve", "--ha-standby",
+                "--lease", lease, "--journal", journal,
+                "--lease-ttl-s", str(ttl_s), "--ready-file", ready,
+                "--standby-max-wait-s", "120", "--platform", "cpu"]
+
+    def primary_argv(lease, journal):
+        return [sys.executable, "-m", "cbf_tpu", "serve", reqs_path,
+                "--lease", lease, "--journal", journal,
+                "--pace-s", str(pace_s), "--heartbeat-s", "0.1",
+                "--platform", "cpu"]
+
+    def journal_acks(journal):
+        try:
+            with open(journal) as fh:
+                return sum(1 for ln in fh if '"submitted"' in ln)
+        except OSError:
+            return 0
+
+    def census(journal, round_label):
+        replay = replay_journal(journal)
+        dups = {r: c for r, c in replay.resolved_counts.items() if c > 1}
+        if replay.unresolved:
+            return None, (f"{round_label}: {len(replay.unresolved)} "
+                          "acknowledged requests lost (unresolved after "
+                          "takeover)")
+        if dups:
+            return None, (f"{round_label}: duplicate executions {dups} "
+                          "(request ids with >1 resolved record)")
+        return replay, None
+
+    delays = faults.kill_schedule(seed, rounds, t_min, t_max)
+    mttrs, kills, acked_total = [], 0, 0
+    for r, delay in enumerate(delays):
+        lease = os.path.join(work, f"lease{r}.json")
+        journal = os.path.join(work, f"wal{r}.jsonl")
+        ready = os.path.join(work, f"ready{r}")
+        standby = subprocess.Popen(standby_argv(lease, journal, ready),
+                                   env=env, stdout=subprocess.PIPE,
+                                   stderr=subprocess.DEVNULL, text=True)
+        try:
+            if not faults.wait_for_file(ready, 120):
+                standby.kill()
+                return {"error": f"round {r}: standby never became ready",
+                        "retryable": True}
+
+            def should_kill(elapsed, armed=[None], journal=journal,
+                            delay=delay):
+                if armed[0] is None:
+                    # Arm on the first ACKNOWLEDGED request: a kill
+                    # before any fsync'd `submitted` record proves
+                    # nothing about acknowledged-request durability.
+                    if journal_acks(journal):
+                        armed[0] = elapsed
+                    return False
+                return elapsed - armed[0] >= delay
+
+            rc, killed, elapsed = faults.run_process_until(
+                primary_argv(lease, journal), should_kill, poll_s=0.02,
+                timeout_s=180, env=env)
+            if not killed:
+                standby.kill()
+                return {"error": f"round {r}: primary finished (rc={rc}) "
+                                 "before the kill — enlarge the request "
+                                 "stream", "retryable": True}
+            kills += 1
+            out, _ = standby.communicate(timeout=180)
+        except BaseException:
+            standby.kill()
+            raise
+        if standby.returncode != 0:
+            return {"error": f"round {r}: standby exited "
+                             f"rc={standby.returncode}", "retryable": True}
+        rec = json.loads(out.strip().splitlines()[-1])
+        if not rec.get("takeover"):
+            return {"error": f"round {r}: standby never took over: {rec}",
+                    "retryable": True}
+        replay, err = census(journal, f"round {r}")
+        if err:
+            return {"error": err, "retryable": False}
+        acked_total += len(replay.submitted)
+        mttrs.append(rec["mttr_s"])
+        print(f"bench: failover round {r} SIGKILL at {elapsed:.1f}s "
+              f"(+{delay:.2f}s after first ack), epoch "
+              f"{rec['epoch']}, {rec['reenqueued']} re-enqueued, "
+              f"mttr {rec['mttr_s']:.3f}s", file=sys.stderr)
+
+    # ---- zombie leg: SIGSTOP, takeover, SIGCONT -> fenced ----------------
+    lease = os.path.join(work, "leasez.json")
+    journal = os.path.join(work, "walz.jsonl")
+    ready = os.path.join(work, "readyz")
+    standby = subprocess.Popen(standby_argv(lease, journal, ready),
+                               env=env, stdout=subprocess.PIPE,
+                               stderr=subprocess.DEVNULL, text=True)
+    prim = None
+    try:
+        if not faults.wait_for_file(ready, 120):
+            return {"error": "zombie round: standby never became ready",
+                    "retryable": True}
+        prim = subprocess.Popen(primary_argv(lease, journal), env=env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        t0 = _time.monotonic()
+        while journal_acks(journal) < 2 and prim.poll() is None \
+                and _time.monotonic() - t0 < 120:
+            _time.sleep(0.02)
+        if prim.poll() is not None:
+            return {"error": "zombie round: primary exited before the "
+                             "pause", "retryable": True}
+        prim.send_signal(_signal.SIGSTOP)   # zombie: stalled, not dead
+        out, _ = standby.communicate(timeout=180)
+        if standby.returncode != 0:
+            return {"error": f"zombie round: standby exited "
+                             f"rc={standby.returncode}", "retryable": True}
+        rec = json.loads(out.strip().splitlines()[-1])
+        if not rec.get("takeover"):
+            return {"error": f"zombie round: no takeover: {rec}",
+                    "retryable": True}
+        post_takeover = replay_journal(journal).records
+        faults.resume(prim)                 # wake the zombie
+        prim_rc = prim.wait(timeout=180)
+    except BaseException:
+        standby.kill()
+        if prim is not None:
+            faults.resume(prim)
+            prim.kill()
+        raise
+    if prim_rc != EXIT_FENCED:
+        return {"error": f"zombie primary exited rc={prim_rc}, expected "
+                         f"EXIT_FENCED ({EXIT_FENCED}) — the fence did "
+                         "not reject the late appender", "retryable": False}
+    replay, err = census(journal, "zombie round")
+    if err:
+        return {"error": err, "retryable": False}
+    if replay.records != post_takeover:
+        return {"error": f"zombie wrote {replay.records - post_takeover} "
+                         "journal records AFTER the takeover — the fence "
+                         "leaked bytes into the new epoch's log",
+                "retryable": False}
+    acked_total += len(replay.submitted)
+    mttrs.append(rec["mttr_s"])
+    print(f"bench: failover zombie round fenced (rc={prim_rc}), epoch "
+          f"{rec['epoch']}, mttr {rec['mttr_s']:.3f}s", file=sys.stderr)
+
+    mttr = max(mttrs)
+    if mttr > mttr_bound:
+        return {"error": f"takeover MTTR {mttr:.2f}s exceeds the "
+                         f"{mttr_bound:.0f}s bound", "retryable": False}
+    shutil.rmtree(work, ignore_errors=True)
+    return {
+        "metric": (f"hot-standby takeover MTTR under {kills} seeded "
+                   "SIGKILLs + 1 SIGSTOP zombie (zero acknowledged "
+                   "requests lost, zero duplicate executions)"),
+        "value": round(mttr, 4),
+        "unit": "seconds",
+        "vs_baseline": 0,   # an availability axis, not the headline rate
+        "failover": True,
+        "rounds": rounds,
+        "kills": kills,
+        "seed": seed,
+        "acknowledged_requests": acked_total,
+        "lost": 0,
+        "duplicate_executions": 0,
+        "mttr_s": [round(m, 4) for m in mttrs],
+        "mttr_bound_s": mttr_bound,
+        "zombie_fenced": True,
+        "zombie_exit_code": prim_rc,
+        "platform": "cpu",
+    }
+
+
 def _is_permanent_error(e: BaseException) -> bool:
     """Transient device/tunnel deaths raise (XlaRuntimeError: connection
     reset / DEADLINE_EXCEEDED / UNAVAILABLE) rather than hang — those must
@@ -2066,7 +2309,9 @@ def child_main(result_path: str, ensemble: bool) -> None:
     # the r02 rate; the 420 s attempt timeout has ample slack).
     steps = _env_int("BENCH_STEPS", 10_000)
     try:
-        if os.environ.get("BENCH_PREEMPT", "0") == "1":
+        if os.environ.get("BENCH_FAILOVER", "0") == "1":
+            result = _child_failover(steps)
+        elif os.environ.get("BENCH_PREEMPT", "0") == "1":
             result = _child_preempt(steps)
         elif os.environ.get("BENCH_SCEN", "0") == "1":
             result = _child_scen(steps)
@@ -2186,7 +2431,9 @@ def main() -> None:
             time.sleep(backoff)
             backoff *= 2
 
-    if os.environ.get("BENCH_PREEMPT", "0") == "1":
+    if os.environ.get("BENCH_FAILOVER", "0") == "1":
+        label = "failover rounds=%d" % _env_int("BENCH_FAILOVER_ROUNDS", 3)
+    elif os.environ.get("BENCH_PREEMPT", "0") == "1":
         label = "preempt rounds=%d" % _env_int("BENCH_PREEMPT_ROUNDS", 3)
     elif os.environ.get("BENCH_SCEN", "0") == "1":
         label = "scen count=%d" % _env_int("BENCH_SCEN_COUNT", 20)

@@ -652,15 +652,159 @@ def _fault_policy_from(args):
                        rta_fallback=getattr(args, "rta_fallback", False))
 
 
+def _serve_supervised(args) -> int:
+    """Run the serve command under the HA supervisor: re-exec this
+    process's own argv (minus ``--supervised``) as a child, restart it
+    on crashes with exponential backoff, trip the crash-loop breaker on
+    a restart storm (exit 3), and pass a FENCED child's exit 4 through
+    WITHOUT restarting — a newer epoch owns the journal, and a restart
+    would only fence again (docs/API.md 'High availability')."""
+    from cbf_tpu.serve import ha as serve_ha
+
+    sink = flight = None
+    if args.telemetry_dir:
+        from cbf_tpu import obs
+        from cbf_tpu.obs import flight as obs_flight
+
+        sink = obs.TelemetrySink(args.telemetry_dir)
+        flight = obs_flight.FlightRecorder(
+            os.path.join(sink.run_dir, "capsules")).attach(sink)
+    child = [sys.executable, "-m", "cbf_tpu"] + \
+        [a for a in sys.argv[1:] if a != "--supervised"]
+    sup = serve_ha.Supervisor(
+        child, backoff_base_s=args.backoff_base_s,
+        backoff_max_s=args.backoff_max_s, max_restarts=args.max_restarts,
+        crash_window_s=args.crash_window_s, telemetry=sink, flight=flight)
+    rc = sup.run()
+    if sink is not None:
+        sink.close()
+    return rc
+
+
+def _serve_standby(args) -> int:
+    """Run the hot-standby side of an HA pair: prewarm the journal's
+    acknowledged buckets, watch the lease, and on expiry take over —
+    bump the epoch (fencing the old primary), replay acknowledged-but-
+    unresolved requests with request-id dedupe, serve them to
+    completion under the new epoch, and print one JSON takeover record
+    (docs/API.md 'High availability')."""
+    import time as _time
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from cbf_tpu.serve import FencedError, ServeEngine
+    from cbf_tpu.serve import ha as serve_ha
+
+    if not args.lease or not args.journal:
+        print("serve: --ha-standby requires --lease and --journal",
+              file=sys.stderr)
+        return 2
+    sink = None
+    if args.telemetry_dir or args.metrics_dir:
+        from cbf_tpu import obs
+
+        sink = obs.TelemetrySink(args.telemetry_dir or args.metrics_dir)
+    flight = None
+    if sink is not None:
+        from cbf_tpu.obs import flight as obs_flight
+
+        flight = obs_flight.FlightRecorder(
+            os.path.join(sink.run_dir, "capsules")).attach(sink)
+    health_dir = args.metrics_dir or (sink.run_dir if sink else None)
+
+    def _health(role: str, epoch) -> None:
+        if health_dir is None:
+            return
+        from cbf_tpu.obs import export as obs_export
+
+        obs_export.write_health(health_dir, {
+            "role": role, "epoch": epoch,
+            "lease": os.path.abspath(args.lease),
+            "journal": os.path.abspath(args.journal)})
+
+    def _engine_factory():
+        return ServeEngine(max_batch=args.max_batch,
+                           flush_deadline_s=args.flush_deadline,
+                           cache_dir=args.cache_dir, telemetry=sink,
+                           fault_policy=_fault_policy_from(args),
+                           flight=flight)
+
+    standby = serve_ha.Standby(
+        lease_path=args.lease, journal_path=args.journal,
+        engine_factory=_engine_factory, ttl_s=args.lease_ttl_s,
+        rotate_bytes=args.rotate_bytes, telemetry=sink, flight=flight)
+
+    def _on_ready() -> None:
+        _health("standby", None)
+        if args.ready_file:
+            with open(args.ready_file, "w") as fh:
+                fh.write("ready\n")
+
+    report = standby.run(max_wait_s=args.standby_max_wait_s,
+                         on_ready=_on_ready)
+    if report is None:
+        print(json.dumps({"takeover": False,
+                          "waited_s": args.standby_max_wait_s}))
+        if sink is not None:
+            sink.close()
+        return 0
+    _health("primary", report.epoch)
+    heartbeater = serve_ha.Heartbeater(
+        standby.lease, interval_s=args.heartbeat_s).start()
+    served, errors = [], {}
+    fenced_err = None
+    for p in report.pendings:
+        try:
+            r = p.result(timeout=300.0)
+            served.append({"request_id": r.request_id, "bucket": r.bucket,
+                           "latency_s": r.latency_s})
+        except FencedError as fe:
+            fenced_err = fenced_err or fe
+        except Exception as e:
+            errors[p.request_id] = type(e).__name__
+    standby.engine.stop(drain=True)
+    heartbeater.stop()
+    if fenced_err is None:
+        fenced_err = heartbeater.fenced or standby.engine.fenced
+    if sink is not None:
+        sink.summary({"takeover_epoch": report.epoch,
+                      "reenqueued": report.reenqueued})
+        sink.close()
+    if fenced_err is not None:
+        serve_ha.note_fenced(fenced_err, telemetry=sink, flight=flight)
+        print(json.dumps({"fenced": True, "epoch": fenced_err.epoch,
+                          "fence_epoch": fenced_err.fence_epoch}))
+        return serve_ha.EXIT_FENCED
+    print(json.dumps({
+        "takeover": True, "epoch": report.epoch,
+        "prev_epoch": report.prev_epoch, "records": report.records,
+        "reenqueued": report.reenqueued, "deduped": report.deduped,
+        "mttr_s": report.mttr_s, "served": served, "errors": errors,
+        "journal": os.path.abspath(args.journal)}))
+    return 0
+
+
 def cmd_serve(args) -> int:
     """Batch-serve a request file through the serving engine (offline
     drain mode): bucket by static signature, pack same-bucket requests
     into one lockstep executable, optionally AOT-prewarm every bucket
     first. Prints one JSON record (per-request summaries + aggregate
-    throughput/latency + compile counters)."""
+    throughput/latency + compile counters). With ``--lease`` the
+    process serves as an HA PRIMARY: it acquires the lease (bumping the
+    epoch), heartbeats it on a daemon thread, and opens the journal
+    fenced by the lease — a takeover by a standby turns every further
+    append into a typed rejection and this process exits 4
+    (docs/API.md 'High availability')."""
     import statistics
     import time as _time
 
+    if args.supervised:
+        return _serve_supervised(args)
+    if args.ha_standby:
+        return _serve_standby(args)
     if args.platform:
         import jax
 
@@ -673,6 +817,14 @@ def cmd_serve(args) -> int:
 
     if args.recover and not args.journal:
         print("serve: --recover requires --journal", file=sys.stderr)
+        return 2
+    if args.lease and not args.journal:
+        print("serve: --lease requires --journal (the lease fences the "
+              "journal)", file=sys.stderr)
+        return 2
+    if args.pace_s is not None and args.pace_s < 0:
+        print(f"serve: --pace-s must be >= 0, got {args.pace_s}",
+              file=sys.stderr)
         return 2
     if args.requests is None and not args.recover:
         print("serve: a requests file is required (or --journal PATH "
@@ -727,11 +879,40 @@ def cmd_serve(args) -> int:
         flight = obs_flight.FlightRecorder(
             os.path.join(sink.run_dir, "capsules"),
             cost_model=cost_model).attach(sink)
+    # HA primary: acquire the lease FIRST (bumping the epoch), then open
+    # the journal stamped with that epoch and fenced by the lease file —
+    # from here, a standby's takeover turns every append this process
+    # attempts into a typed FencedError.
+    lease = heartbeater = None
+    journal_obj = args.journal
+    if args.lease or (args.journal and args.rotate_bytes):
+        from cbf_tpu.durable.journal import RequestJournal
+        from cbf_tpu.serve import ha as serve_ha
+
+        epoch, fence = 0, None
+        if args.lease:
+            lease = serve_ha.Lease(args.lease, telemetry=sink)
+            epoch, fence = lease.acquire(), lease.path
+        journal_obj = RequestJournal(args.journal, telemetry=sink,
+                                     epoch=epoch, fence_path=fence,
+                                     rotate_bytes=args.rotate_bytes)
+        if lease is not None:
+            heartbeater = serve_ha.Heartbeater(
+                lease, interval_s=args.heartbeat_s).start()
+            health_dir = args.metrics_dir or (sink.run_dir if sink
+                                              else None)
+            if health_dir:
+                from cbf_tpu.obs import export as obs_export
+
+                obs_export.write_health(health_dir, {
+                    "role": "primary", "epoch": epoch,
+                    "lease": lease.path,
+                    "journal": os.path.abspath(args.journal)})
     engine = ServeEngine(max_batch=args.max_batch,
                          flush_deadline_s=args.flush_deadline,
                          cache_dir=args.cache_dir, telemetry=sink,
                          fault_policy=_fault_policy_from(args),
-                         journal=args.journal, cost_model=cost_model,
+                         journal=journal_obj, cost_model=cost_model,
                          flight=flight)
     exporter = None
     if args.metrics_dir:
@@ -779,15 +960,64 @@ def cmd_serve(args) -> int:
         prev_term = engine.install_sigterm_handler()
     except ValueError:
         pass
+    from cbf_tpu.serve import FencedError
+    fenced_err = None
+    req_errors: dict[str, str] = {}
     t0 = _time.perf_counter()
     try:
-        results = engine.run(cfgs, request_ids=request_ids)
+        if args.pace_s is not None:
+            # Paced queue-mode submits: one request at a time with a
+            # fixed inter-arrival gap — the HA harness's traffic shape,
+            # where a kill must be able to land BETWEEN acknowledged
+            # requests, not after an all-at-once offline drain.
+            engine.start()
+            pendings = []
+            try:
+                for i, cfg in enumerate(cfgs):
+                    rid = (request_ids[i] if request_ids is not None
+                           else None)
+                    pendings.append(engine.submit(cfg, request_id=rid))
+                    if args.pace_s > 0:
+                        _time.sleep(args.pace_s)
+            except FencedError as fe:
+                fenced_err = fe
+            results = []
+            for p in pendings:
+                try:
+                    results.append(p.result(timeout=300.0))
+                except FencedError as fe:
+                    fenced_err = fenced_err if fenced_err is not None \
+                        else fe
+                except Exception as e:
+                    req_errors[p.request_id] = type(e).__name__
+            engine.stop(drain=True)
+        else:
+            results = engine.run(cfgs, request_ids=request_ids)
+    except FencedError as fe:
+        fenced_err = fe
+        results = []
     finally:
         if prev_term is not None:
             import signal as _signal
 
             _signal.signal(_signal.SIGTERM, prev_term)
     wall = _time.perf_counter() - t0
+    if heartbeater is not None:
+        heartbeater.stop()
+        if fenced_err is None:
+            fenced_err = heartbeater.fenced
+    if fenced_err is None:
+        fenced_err = engine.fenced
+    if fenced_err is not None:
+        from cbf_tpu.serve import ha as serve_ha
+
+        serve_ha.note_fenced(fenced_err, telemetry=sink, flight=flight)
+        if sink is not None:
+            sink.close()
+        print(json.dumps({"fenced": True, "epoch": fenced_err.epoch,
+                          "fence_epoch": fenced_err.fence_epoch,
+                          "served": len(results)}))
+        return serve_ha.EXIT_FENCED
     if cost_model is not None:
         try:                     # offline run() never stop()s the engine
             cost_model.save()
@@ -796,15 +1026,21 @@ def cmd_serve(args) -> int:
     lat = sorted(r.latency_s for r in results)
     qwait = sorted(r.queue_wait_s for r in results)
     qp_steps = sum(r.n * r.steps for r in results)
+    if req_errors:
+        record["request_errors"] = req_errors
+    if lat:
+        record.update({
+            "agent_qp_steps_per_sec": round(qp_steps / wall, 1),
+            "latency_p50_s": round(statistics.median(lat), 4),
+            "latency_p99_s": round(lat[min(len(lat) - 1,
+                                           int(0.99 * len(lat)))], 4),
+            "queue_wait_p50_s": round(statistics.median(qwait), 4),
+            "queue_wait_p99_s": round(qwait[min(len(qwait) - 1,
+                                                int(0.99 * len(qwait)))],
+                                      4),
+        })
     record.update({
         "wall_s": round(wall, 3),
-        "agent_qp_steps_per_sec": round(qp_steps / wall, 1),
-        "latency_p50_s": round(statistics.median(lat), 4),
-        "latency_p99_s": round(lat[min(len(lat) - 1,
-                                       int(0.99 * len(lat)))], 4),
-        "queue_wait_p50_s": round(statistics.median(qwait), 4),
-        "queue_wait_p99_s": round(qwait[min(len(qwait) - 1,
-                                            int(0.99 * len(qwait)))], 4),
         "stats": engine.stats,
         "compile_counters": {k: v for k, v in
                              profiling.compile_event_counts().items()
@@ -1377,6 +1613,64 @@ def main(argv=None) -> int:
                              "process's journal instead of (or before) a "
                              "requests file; exit 2 when the journal is "
                              "missing or unreadable")
+    servep.add_argument("--rotate-bytes", type=int, default=None,
+                        metavar="N",
+                        help="with --journal: rotate the active journal "
+                             "file to an immutable .segNNNNNN segment "
+                             "once it crosses N bytes (fully-resolved "
+                             "segments are compacted away)")
+    servep.add_argument("--lease", default=None, metavar="PATH",
+                        help="serve as an HA PRIMARY: acquire this lease "
+                             "file (bumping its epoch), heartbeat it, and "
+                             "fence the journal with it — a standby "
+                             "takeover makes this process exit 4 "
+                             "(docs/API.md 'High availability'; requires "
+                             "--journal)")
+    servep.add_argument("--heartbeat-s", type=float, default=0.2,
+                        help="lease heartbeat interval in seconds "
+                             "(default 0.2)")
+    servep.add_argument("--pace-s", type=float, default=None,
+                        metavar="S",
+                        help="queue-mode paced submits: one request every "
+                             "S seconds instead of an all-at-once offline "
+                             "drain (the HA chaos harness's traffic "
+                             "shape)")
+    servep.add_argument("--supervised", action="store_true",
+                        help="run this serve command under the HA "
+                             "supervisor: restart on crash with "
+                             "exponential backoff, exit 3 on a crash "
+                             "loop, pass a fenced child's exit 4 through "
+                             "without restarting")
+    servep.add_argument("--max-restarts", type=int, default=5,
+                        help="supervisor crash-loop breaker: more than "
+                             "this many crashes inside --crash-window-s "
+                             "exits 3 (default 5)")
+    servep.add_argument("--crash-window-s", type=float, default=30.0,
+                        help="supervisor crash-loop rolling window in "
+                             "seconds (default 30)")
+    servep.add_argument("--backoff-base-s", type=float, default=0.2,
+                        help="supervisor restart backoff base in seconds "
+                             "(doubles per consecutive crash; default "
+                             "0.2)")
+    servep.add_argument("--backoff-max-s", type=float, default=5.0,
+                        help="supervisor restart backoff ceiling in "
+                             "seconds (default 5)")
+    servep.add_argument("--ha-standby", action="store_true",
+                        help="serve as an HA HOT STANDBY: prewarm the "
+                             "journal's buckets, watch the lease, and on "
+                             "expiry take over under a bumped epoch "
+                             "(requires --lease and --journal)")
+    servep.add_argument("--lease-ttl-s", type=float, default=2.0,
+                        help="standby: declare the lease expired after "
+                             "this many seconds without a heartbeat "
+                             "change (default 2)")
+    servep.add_argument("--ready-file", default=None, metavar="PATH",
+                        help="standby: touch this file once hot "
+                             "(prewarmed + watching) — the harness "
+                             "handshake")
+    servep.add_argument("--standby-max-wait-s", type=float, default=600.0,
+                        help="standby: give up waiting for a takeover "
+                             "after this many seconds (default 600)")
     _add_fault_policy_args(servep)
     servep.set_defaults(fn=cmd_serve)
 

@@ -196,6 +196,16 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
             f"{scen_dsl.EMITTED_EVENT_TYPES!r} != "
             f"obs.schema.SCENARIO_EVENT_TYPES "
             f"{schema.SCENARIO_EVENT_TYPES!r} — emitter and schema drifted")
+    # High-availability event drift: the lease/failover layer's declared
+    # emissions must match the schema's ha family exactly.
+    from cbf_tpu.serve import ha as serve_ha
+    if tuple(serve_ha.EMITTED_EVENT_TYPES) != \
+            tuple(schema.HA_EVENT_TYPES):
+        problems.append(
+            f"serve.ha.EMITTED_EVENT_TYPES "
+            f"{serve_ha.EMITTED_EVENT_TYPES!r} != "
+            f"obs.schema.HA_EVENT_TYPES {schema.HA_EVENT_TYPES!r} "
+            "— emitter and schema drifted")
     for table_name, types_name, fields, types in (
             ("SERVE_EVENT_FIELDS", "SERVE_EVENT_TYPES",
              schema.SERVE_EVENT_FIELDS, schema.SERVE_EVENT_TYPES),
@@ -208,7 +218,9 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
             ("FLIGHT_EVENT_FIELDS", "FLIGHT_EVENT_TYPES",
              schema.FLIGHT_EVENT_FIELDS, schema.FLIGHT_EVENT_TYPES),
             ("SCENARIO_EVENT_FIELDS", "SCENARIO_EVENT_TYPES",
-             schema.SCENARIO_EVENT_FIELDS, schema.SCENARIO_EVENT_TYPES)):
+             schema.SCENARIO_EVENT_FIELDS, schema.SCENARIO_EVENT_TYPES),
+            ("HA_EVENT_FIELDS", "HA_EVENT_TYPES",
+             schema.HA_EVENT_FIELDS, schema.HA_EVENT_TYPES)):
         for etype in fields:
             if etype not in types:
                 problems.append(
@@ -231,7 +243,7 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
     import inspect
     for mod in (verify_search, serve_engine, obs_trace, serve_loadgen,
                 durable_journal, durable_rollout, rta_monitor, obs_flight,
-                scen_dsl):
+                scen_dsl, serve_ha):
         try:
             mod_tree = ast.parse(inspect.getsource(mod))
         except (OSError, TypeError):
@@ -281,7 +293,8 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
                 ("loadgen", schema.LOADGEN_EVENT_FIELDS),
                 ("rta", schema.RTA_EVENT_FIELDS),
                 ("flight", schema.FLIGHT_EVENT_FIELDS),
-                ("scenario", schema.SCENARIO_EVENT_FIELDS)):
+                ("scenario", schema.SCENARIO_EVENT_FIELDS),
+                ("ha", schema.HA_EVENT_FIELDS)):
             for etype, fields in table.items():
                 if f"`{etype}`" not in api_text:
                     problems.append(

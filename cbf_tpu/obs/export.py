@@ -129,6 +129,26 @@ def _atomic_write(path: str, text: str) -> None:
     os.replace(tmp, path)
 
 
+#: Name of the liveness/role surface file inside an export directory.
+HEALTH_FILENAME = "health.json"
+
+
+def write_health(out_dir: str, payload: dict[str, Any]) -> str:
+    """Atomically (re)write the ``health.json`` surface: a small JSON
+    document describing the process's serving role right now — the HA
+    layer (`cbf_tpu.serve.ha`) publishes ``role`` ("primary" |
+    "standby"), ``epoch``, and lease/journal coordinates here on every
+    role transition, so an external prober can tell WHO is serving
+    without parsing the event stream. Stamped with ``t_wall``; returns
+    the file path."""
+    os.makedirs(out_dir, exist_ok=True)
+    doc = dict(payload)
+    doc.setdefault("t_wall", round(time.time(), 6))
+    path = os.path.join(out_dir, HEALTH_FILENAME)
+    _atomic_write(path, json.dumps(doc, indent=1, sort_keys=True))
+    return path
+
+
 def write_metrics(out_dir: str, registry, *,
                   extra: dict[str, Any] | None = None) -> dict[str, Any]:
     """One synchronous rewrite of both surfaces; returns the JSON doc."""

@@ -199,7 +199,8 @@ DURABLE_EVENT_TYPES: tuple[str, ...] = (
     "durable.journal", "durable.recover", "durable.resume")
 
 DURABLE_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
-    "durable.journal": ("path", "records", "unresolved", "repaired_bytes"),
+    "durable.journal": ("path", "records", "unresolved", "repaired_bytes",
+                        "epoch", "segments"),
     "durable.recover": ("path", "records", "reenqueued", "refused"),
     "durable.resume": ("directory", "resumed_from_step", "chunks_loaded",
                        "steps"),
@@ -275,6 +276,33 @@ SCENARIO_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
     "scenario.generated": ("seed", "count", "names"),
     "scenario.run": ("scenario", "n", "steps", "dynamics",
                      "min_pairwise_distance", "infeasible_count"),
+}
+
+#: The high-availability layer's events (``cbf_tpu.serve.ha``):
+#: ``ha.lease`` once per lease acquisition (the epoch bumped to, the
+#: owner string, the lease path), ``ha.takeover`` once per standby
+#: promotion (new vs fenced epoch, journal records folded, how many
+#: acknowledged-but-unresolved requests were re-enqueued, how many
+#: already-resolved ids the replay deduped, and the measured MTTR from
+#: expiry detection to serving resumed), ``ha.fenced`` once when a
+#: zombie's journal append/heartbeat is rejected by a newer epoch,
+#: ``ha.restart`` once per supervisor restart of a crashed primary
+#: (attempt number, the crash's exit code, uptime, backoff applied),
+#: and ``ha.crash_loop`` once when the supervisor's crash-loop breaker
+#: trips. Same AUD001 contract as the other tables:
+#: ``serve.ha.EMITTED_EVENT_TYPES`` must equal this tuple, every type
+#: needs a literal emit site, and every type and field must be
+#: documented in docs/API.md.
+HA_EVENT_TYPES: tuple[str, ...] = (
+    "ha.lease", "ha.takeover", "ha.fenced", "ha.restart", "ha.crash_loop")
+
+HA_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
+    "ha.lease": ("path", "epoch", "owner", "action"),
+    "ha.takeover": ("epoch", "prev_epoch", "records", "reenqueued",
+                    "deduped", "mttr_s"),
+    "ha.fenced": ("epoch", "fence_epoch", "path"),
+    "ha.restart": ("attempt", "exit_code", "backoff_s", "uptime_s"),
+    "ha.crash_loop": ("restarts", "window_s"),
 }
 
 

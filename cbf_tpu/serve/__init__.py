@@ -16,18 +16,19 @@ from cbf_tpu.serve.engine import (PendingRequest, RequestResult, ServeEngine,
                                   configure_compilation_cache)
 from cbf_tpu.serve.loadgen import LoadSpec, build_schedule, run_loadgen
 from cbf_tpu.serve.resilience import (CircuitBreaker, DeadlineExceeded,
-                                      FaultPolicy, NonFiniteResult,
-                                      QuarantinedError, RecoveryError,
-                                      RequestCancelled, SchedulerCrashed,
-                                      ServeError, ShedError, is_retryable,
-                                      request_signature)
+                                      FaultPolicy, FencedError,
+                                      NonFiniteResult, QuarantinedError,
+                                      RecoveryError, RequestCancelled,
+                                      SchedulerCrashed, ServeError, ShedError,
+                                      is_retryable, request_signature)
 
 __all__ = [
     "BucketKey", "CircuitBreaker", "DEFAULT_BUCKET_SIZES",
     "DEFAULT_HORIZON_QUANTUM", "DeadlineExceeded", "FaultPolicy",
-    "LoadSpec", "NonFiniteResult", "PendingRequest", "QuarantinedError",
-    "RecoveryError", "RequestCancelled", "RequestResult", "SchedulerCrashed",
-    "ServeEngine", "ServeError", "ShedError", "bucket_horizon", "bucket_key",
-    "bucket_n", "build_schedule", "configure_compilation_cache",
-    "is_retryable", "request_signature", "run_loadgen",
+    "FencedError", "LoadSpec", "NonFiniteResult", "PendingRequest",
+    "QuarantinedError", "RecoveryError", "RequestCancelled", "RequestResult",
+    "SchedulerCrashed", "ServeEngine", "ServeError", "ShedError",
+    "bucket_horizon", "bucket_key", "bucket_n", "build_schedule",
+    "configure_compilation_cache", "is_retryable", "request_signature",
+    "run_loadgen",
 ]

@@ -143,6 +143,16 @@ class PendingRequest:
         if self._journal is not None:
             try:
                 self._journal.resolved(self.request_id, error)
+            except resilience.FencedError as fe:
+                # A newer epoch owns the log (we are the zombie): the
+                # terminal record did NOT land, the new owner will re-run
+                # this request, and handing the caller a result it would
+                # treat as acknowledged makes a duplicate delivery. The
+                # handle resolves with the typed fencing error instead,
+                # and the engine remembers it so the CLI can exit fenced.
+                self._result, self._error = None, fe
+                if self._engine is not None:
+                    self._engine._note_fenced(fe)
             except (OSError, ValueError):
                 pass   # journal gone/closed: resolving beats stranding
         self._event.set()
@@ -294,6 +304,23 @@ class ServeEngine:
             _buckets.BucketKey, resilience.CircuitBreaker] = {}
         self._degraded = False
         self._overload_since: float | None = None
+        # First fencing rejection observed on this engine's journal (a
+        # newer epoch took over — we are the zombie); the CLI exits
+        # EXIT_FENCED on it instead of being restarted.
+        self.fenced: resilience.FencedError | None = None
+        # Persisted resilience state (quarantine table + circuit-breaker
+        # state) lives beside the journal and survives restarts: a
+        # poison signature must not re-burn its full quarantine
+        # threshold after every crash. Saved atomically on every breaker
+        # change; restored here when the journal has an on-disk path.
+        # Bucket breakers persist keyed by LABEL (BucketKey is not
+        # serializable) and are adopted lazily by `_bucket_breaker`.
+        self._restored_bucket_breakers: dict[
+            str, resilience.CircuitBreaker] = {}
+        jpath = getattr(self.journal, "path", None)
+        self._resilience_path = f"{jpath}.resilience" if jpath else None
+        if self._resilience_path and os.path.exists(self._resilience_path):
+            self._load_resilience()
 
     # -- telemetry helpers -------------------------------------------------
 
@@ -394,6 +421,89 @@ class ServeEngine:
 
     # -- breakers ----------------------------------------------------------
 
+    def _note_fenced(self, err: resilience.FencedError) -> None:
+        """Remember the first fencing rejection. First-wins under the
+        stats leaf lock (callers arrive from the scheduler thread and
+        from resolving foreground threads); any fence observation means
+        the same thing — a newer epoch owns the journal and this
+        process must stand down."""
+        with self._stats_lock:
+            if self.fenced is None:
+                self.fenced = err
+
+    def _bucket_breaker(self, key: _buckets.BucketKey, create: bool = False):
+        """Bucket-breaker lookup with lazy adoption of restored state:
+        persisted bucket breakers are keyed by label (a BucketKey does
+        not serialize), so a key's first lookup adopts its label's
+        restored breaker. Caller holds ``self._lock``."""
+        br = self._bucket_breakers.get(key)
+        if br is None and self._restored_bucket_breakers:
+            br = self._restored_bucket_breakers.pop(key.label(), None)
+            if br is not None:
+                self._bucket_breakers[key] = br
+        if br is None and create:
+            br = resilience.CircuitBreaker(
+                self.fault_policy.breaker_threshold,
+                self.fault_policy.quarantine_cooldown_s)
+            self._bucket_breakers[key] = br
+        return br
+
+    def _load_resilience(self) -> None:
+        """Restore the quarantine table + breaker state persisted by a
+        previous process (clock-rebased: `CircuitBreaker.from_state`
+        maps remaining cooldowns onto THIS process's tracer clock, and a
+        persisted half-open breaker restores ready to admit exactly one
+        fresh probe). An unreadable state file starts cold — restoring
+        fault memory is never worth refusing to serve."""
+        import json
+
+        try:
+            with open(self._resilience_path) as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            return
+        now = self.tracer.now()
+        try:
+            for sig, st in data.get("signatures", {}).items():
+                self._sig_breakers[sig] = \
+                    resilience.CircuitBreaker.from_state(st, now)
+            for label, st in data.get("buckets", {}).items():
+                self._restored_bucket_breakers[label] = \
+                    resilience.CircuitBreaker.from_state(st, now)
+        except (KeyError, TypeError, ValueError):
+            self._sig_breakers.clear()
+            self._restored_bucket_breakers.clear()
+
+    def _save_resilience(self) -> None:
+        """Persist quarantine + breaker state atomically (write-temp +
+        rename) beside the journal. Called on every breaker CHANGE —
+        strike, open, close — so the on-disk failure counts never lag a
+        crash. Best-effort: a full disk must not take down serving."""
+        path = self._resilience_path
+        if path is None:
+            return
+        import json
+
+        now = self.tracer.now()
+        with self._lock:
+            buckets = {k.label(): b.to_state(now)
+                       for k, b in self._bucket_breakers.items()}
+            for label, b in self._restored_bucket_breakers.items():
+                buckets.setdefault(label, b.to_state(now))
+            data = {"schema": 1,
+                    "signatures": {s: b.to_state(now)
+                                   for s, b in self._sig_breakers.items()},
+                    "buckets": buckets}
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as fh:
+                json.dump(data, fh, sort_keys=True)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
     def _record_offender(self, cfg: swarm.Config, bucket_label: str) -> None:
         """One execution failure attributed to THIS request's signature
         (poison/repeat-offender accounting); opens the signature's
@@ -408,6 +518,7 @@ class ServeEngine:
                     policy.quarantine_cooldown_s))
             opened = br.record_failure(now)
             failures = br.failures
+        self._save_resilience()   # every strike counts across restarts
         if opened:
             self._emit("serve.quarantine", {
                 "scope": "request", "signature": sig, "state": "open",
@@ -445,7 +556,11 @@ class ServeEngine:
         sig = resilience.request_signature(cfg)
         with self._lock:
             br = self._sig_breakers.get(sig)
+            changed = br is not None and (br.failures != 0
+                                          or br.state != "closed")
             recovered = br.record_success() if br is not None else False
+        if changed:
+            self._save_resilience()
         if recovered:
             self._emit("serve.quarantine", {
                 "scope": "request", "signature": sig, "state": "closed",
@@ -482,9 +597,22 @@ class ServeEngine:
         if not alive:
             return
         if self.journal is not None:
-            # Breadcrumb, not a commit point: batch formation is
-            # re-derivable at recovery, so no fsync.
-            self.journal.packed(label, [e[0].request_id for e in alive])
+            try:
+                # Breadcrumb, not a commit point: batch formation is
+                # re-derivable at recovery, so no fsync.
+                self.journal.packed(label, [e[0].request_id for e in alive])
+            except resilience.FencedError as fe:
+                # A takeover fenced this epoch while the batch was in
+                # flight. These entries already left the queue, so the
+                # scheduler's crash guard would never resolve them —
+                # resolve each with the typed fence error here (the new
+                # owner replays them from its own journal epoch) instead
+                # of executing a batch whose terminal records could
+                # never land.
+                self._note_fenced(fe)
+                for pending, *_rest in alive:
+                    pending._resolve(error=fe)
+                return
         t_exec_start = tracer.now()
         for pending, _cfg, _tr, t_enq, _d in alive:
             tracer.record("queue_wait", t0_s=t_enq,
@@ -542,10 +670,14 @@ class ServeEngine:
                                    phase, e)
             return
         recovered = False
+        bchanged = False
         with self._lock:
-            bbr = self._bucket_breakers.get(key)
+            bbr = self._bucket_breaker(key)
             if bbr is not None:
+                bchanged = bbr.failures != 0 or bbr.state != "closed"
                 recovered = bbr.record_success()
+        if bchanged:
+            self._save_resilience()
         if recovered:
             self._emit("serve.quarantine", {
                 "scope": "bucket", "signature": label, "state": "closed",
@@ -701,12 +833,10 @@ class ServeEngine:
         if phase == "compile":
             now = self.tracer.now()
             with self._lock:
-                bbr = self._bucket_breakers.setdefault(
-                    key, resilience.CircuitBreaker(
-                        policy.breaker_threshold,
-                        policy.quarantine_cooldown_s))
+                bbr = self._bucket_breaker(key, create=True)
                 opened = bbr.record_failure(now)
                 failures = bbr.failures
+            self._save_resilience()
             if opened:
                 self._emit("serve.quarantine", {
                     "scope": "bucket", "signature": label, "state": "open",
@@ -749,6 +879,7 @@ class ServeEngine:
             rid = request_ids[i] if request_ids is not None \
                 else f"r{next(self._ids)}"
             pending = PendingRequest(rid)
+            pending._engine = self
             with self.tracer.span("enqueue", trace_id=pending.request_id):
                 key, traced = self.bucket_of(cfg)
                 if self.journal is not None:
@@ -821,7 +952,7 @@ class ServeEngine:
                             f"({br.failures} failures; state {br.state})",
                             request_id=pending.request_id, bucket=label)
                 if fail is None:
-                    bbr = self._bucket_breakers.get(key)
+                    bbr = self._bucket_breaker(key)
                     if bbr is not None and not bbr.allow(now):
                         self._count("quarantined")
                         fail = resilience.QuarantinedError(

@@ -171,10 +171,18 @@ def _quantile(sorted_vals: list[float], q: float) -> float | None:
 
 
 def run_loadgen(engine, spec: LoadSpec, *, telemetry=None,
-                result_timeout_s: float = 300.0, mutate=None) -> dict:
+                result_timeout_s: float = 300.0, mutate=None,
+                request_id_prefix: str | None = None) -> dict:
     """Drive ``engine`` with the spec's open-loop schedule and return
     the SLO report: sustained RPS + end-to-end latency percentiles +
     queue-wait/execute breakdown + a typed-error census.
+
+    ``request_id_prefix`` (optional) stamps every submitted request id
+    as ``<prefix><i>`` over the schedule index — the census seam for
+    the HA failover harness, where ids must be attributable to the
+    epoch/process that submitted them and collision-free across
+    processes sharing one journal (engine-default ids restart at ``r0``
+    in every process).
 
     Every scheduled request is accounted for exactly once: completed,
     or counted under ``errors`` with its exception type tallied in
@@ -219,7 +227,10 @@ def run_loadgen(engine, spec: LoadSpec, *, telemetry=None,
             if mutate is not None:
                 cfg = mutate(i, cfg)
             try:
-                pendings.append((scen_name, engine.submit(cfg)))
+                rid = (f"{request_id_prefix}{i}"
+                       if request_id_prefix is not None else None)
+                pendings.append((scen_name,
+                                 engine.submit(cfg, request_id=rid)))
             except resilience.ServeError as e:
                 # shed/quarantined at admission: typed, counted
                 _tally(e, scen_name)

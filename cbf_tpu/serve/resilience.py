@@ -99,6 +99,25 @@ class RecoveryError(ServeError):
     journal must fail loudly, not silently drop acknowledged requests."""
 
 
+class FencedError(ServeError):
+    """A journal append was rejected because a NEWER epoch owns the log:
+    the appender's epoch is below the fence (the lease file's epoch
+    counter), which means a standby has taken over since this process
+    last held the lease. Raised by
+    :meth:`cbf_tpu.durable.journal.RequestJournal._append` BEFORE any
+    byte is written — a paused/zombie primary that wakes after takeover
+    is fenced at the log, so the new epoch's records can never interleave
+    with stale ones. Carries ``epoch`` (the appender's), ``fence_epoch``
+    (the current owner's) and ``path`` (the fence file consulted)."""
+
+    def __init__(self, message: str, *, epoch: int, fence_epoch: int,
+                 path: str | None = None, request_id: str | None = None):
+        super().__init__(message, request_id=request_id)
+        self.epoch = epoch
+        self.fence_epoch = fence_epoch
+        self.path = path
+
+
 #: Exception types retrying cannot fix: bad inputs and code bugs, the
 #: same classification bench.py's ``_is_permanent_error`` uses. The
 #: typed taxonomy above is also permanent — a shed or quarantine verdict
@@ -283,3 +302,36 @@ class CircuitBreaker:
             self._probing = False
             return not already_open
         return False
+
+    def to_state(self, now: float) -> dict:
+        """JSON-able snapshot for cross-restart persistence. Time is
+        stored as REMAINING cooldown, not an absolute stamp: breaker
+        clocks are per-process monotonic (`obs.trace.Tracer.now()`
+        style) and rebase to ~0 in the next process, so an absolute
+        ``_opened_at`` would be meaningless after a restart."""
+        remaining = 0.0
+        if self.state == "open" and self._opened_at is not None:
+            remaining = max(0.0, self.cooldown_s - (now - self._opened_at))
+        return {"state": self.state, "failures": self.failures,
+                "threshold": self.threshold, "cooldown_s": self.cooldown_s,
+                "remaining_s": round(remaining, 6)}
+
+    @classmethod
+    def from_state(cls, state: dict, now: float) -> "CircuitBreaker":
+        """Rebuild a breaker on the NEW process's clock (inverse of
+        :meth:`to_state`). A breaker persisted HALF-OPEN restores as
+        OPEN with its cooldown already elapsed: the in-flight probe died
+        with the old process, and this mapping makes the next ``allow``
+        admit exactly one fresh probe — half-open semantics survive the
+        restart instead of deadlocking on a probe that will never
+        report."""
+        br = cls(int(state["threshold"]), float(state["cooldown_s"]))
+        br.failures = int(state["failures"])
+        persisted = state["state"]
+        if persisted == "closed":
+            return br
+        br.state = "open"
+        remaining = 0.0 if persisted == "half_open" \
+            else max(0.0, float(state["remaining_s"]))
+        br._opened_at = now - (br.cooldown_s - remaining)
+        return br

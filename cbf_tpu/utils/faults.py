@@ -402,6 +402,56 @@ def run_until_killed(argv, kill_after_s: float, **kw) -> tuple:
     return run_process_until(argv, lambda t: t >= kill_after_s, **kw)
 
 
+def pause_after(argv, pause_after_s: float, *, poll_s: float = 0.05,
+                env=None, stdout=None, stderr=None):
+    """Start ``argv`` and SIGSTOP it after ``pause_after_s`` seconds —
+    the ZOMBIE model: the process is not dead, merely stalled (GC pause,
+    scheduler stall, VM migration), and will resume exactly where it
+    was on SIGCONT. Returns the stopped ``Popen`` handle (or the exited
+    handle, if the process finished first — check ``returncode``).
+    Unlike :func:`run_process_until` this never waits on the process:
+    the caller resumes it with :func:`resume` and harvests the exit
+    code itself — the whole point is what the zombie does AFTER the
+    world moved on without it."""
+    import signal
+    import subprocess
+    import time
+
+    t0 = time.monotonic()
+    proc = subprocess.Popen(argv, env=env, stdout=stdout, stderr=stderr)
+    while proc.poll() is None \
+            and time.monotonic() - t0 < pause_after_s:
+        time.sleep(poll_s)
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGSTOP)
+    return proc
+
+
+def resume(proc) -> None:
+    """SIGCONT a process stopped by :func:`pause_after` (no-op when it
+    already exited)."""
+    import signal
+
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGCONT)
+
+
+def wait_for_file(path: str, timeout_s: float = 60.0,
+                  poll_s: float = 0.05) -> bool:
+    """Poll until ``path`` exists (the ready-file handshake the HA
+    harness uses to know a standby is hot before starting the chaos).
+    Returns True when the file appeared, False on timeout."""
+    import os
+    import time
+
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if os.path.exists(path):
+            return True
+        time.sleep(poll_s)
+    return os.path.exists(path)
+
+
 def poison_config(cfg):
     """A data-plane poisoned request: same bucket as ``cfg`` (only a
     TRACED scalar changes), passes `scenarios.swarm.validate_config`

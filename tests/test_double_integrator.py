@@ -241,6 +241,11 @@ def test_double_fast_obstacles_recover_and_surface_infeasibility():
     assert int(np.asarray(outs.infeasible_count).sum()) > 0   # surfaced
 
 
+# slow: ~12 s; sharded train-step descent stays tier-1 in
+# test_two_layer_training_descends, the mode-aware actuator box in
+# test_double_accel_is_actuator_bounded, and double sharding parity in
+# test_double_sharded_matches_single_device.
+@pytest.mark.slow
 def test_double_training_descends_through_sharded_qp():
     """The differentiable (unrolled-relax) path composes with the double
     rows: a few sharded train steps produce finite losses and move the

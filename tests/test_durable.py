@@ -98,6 +98,11 @@ def test_run_durable_refuses_mixed_runs(tmp_path):
         dr.resume(str(tmp_path / "nowhere"))
 
 
+# slow: ~11 s subprocess run; in-process resume bit-exactness stays
+# tier-1 in test_run_durable_matches_plain_and_resumes_complete and
+# test_durable_resume_skips_corrupt_newest_bit_exact (test_checkpoint),
+# and the end-to-end SIGKILL leg stays gated under BENCH_PREEMPT.
+@pytest.mark.slow
 def test_sigkill_midrun_resume_bit_exact(tmp_path):
     """The tentpole acceptance: SIGKILL the CLI mid-run, resume from the
     directory alone, require byte-identical outputs vs an uninterrupted

@@ -95,6 +95,11 @@ def test_post_training_safety_floor_holds():
     assert int(np.asarray(outs.infeasible_count).sum()) == 0
 
 
+# slow: ~5 s; per-family floors stay tier-1 in
+# test_family_floors_across_seeds (test_scenarios) and each family's own
+# floor tests; the example-runner machinery stays tier-1 via the other
+# example tests in this file.
+@pytest.mark.slow
 def test_dynamics_families_example(tmp_path):
     """The three-family comparison demo runs end-to-end and writes its
     artifacts; every family's floor holds in the short demo horizon."""

@@ -316,6 +316,10 @@ def test_solver_state_empty_tuple_is_absent():
 
 # ------------------------------------------------ ensemble wiring ----
 
+# slow: ~10 s; lockstep-batched solver parity stays tier-1 in
+# test_batched_matches_single_member_solves and the dp-ensemble
+# certificate numerics in test_ensemble_lockstep_fused_warm_adaptive.
+@pytest.mark.slow
 def test_ensemble_lockstep_batched_matches_per_member():
     """The dp-axis ensemble path with several whole swarms per device
     routes the joint layer through the lockstep batched solver — member

@@ -1,0 +1,578 @@
+"""High availability (cbf_tpu.serve.ha, ISSUE 14): supervised
+hot-standby failover with fenced journal shipping.
+
+The load-bearing pins:
+
+- LEASE ARITHMETIC: epochs are strictly monotonic across acquirers;
+  heartbeats bump ONLY the ``.beat`` sidecar (the epoch-authority file
+  that fences the journal is written by ``acquire()`` alone, under an
+  flock) — so a SIGSTOP-zombie's late renewal can never roll the fence
+  back; expiry is judged by (epoch, beat) CHANGE on the observer's own
+  monotonic clock and survives a clock rebase.
+- TYPED FENCING: a stale-epoch appender gets :class:`FencedError` from
+  the lease renewal, from the journal open, and from every append —
+  BEFORE a single byte lands in a log a newer epoch owns.
+- EXACTLY-ONCE-BY-LOG: an id carrying a durable ``resolved`` record is
+  never re-enqueued at takeover (even when the client never saw the
+  result — the kill-between-fsync-and-unblock case); a TORN resolved
+  record does not count, degrading to at-least-once exactly as the WAL
+  contract promises.
+- SEGMENT ROTATION + COMPACTION: rotated segments replay as one
+  logical log, compaction drops only fully-redundant segments
+  (identical unresolved fold), and torn-tail repair still applies to
+  the ACTIVE file only.
+- RESILIENCE ACROSS RESTARTS: breaker/quarantine state persisted
+  beside the journal is restored by the next engine — a poison
+  signature fails fast immediately after restart and still gets its
+  half-open probe after the REMAINING cooldown.
+- SUPERVISOR CONTRACT: clean exit ends supervision, a FENCED child is
+  passed through without restart, a crash storm trips the crash-loop
+  breaker (exit 3).
+- WITNESS-ARMED TAKEOVER: a full in-process takeover under the armed
+  lock witness books zero inversions and every observed edge lies
+  inside the static lock-order graph.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from cbf_tpu.analysis import concurrency, lockwitness  # noqa: E402
+from cbf_tpu.durable import journal as dj  # noqa: E402
+from cbf_tpu.obs.trace import Tracer  # noqa: E402
+from cbf_tpu.scenarios import swarm  # noqa: E402
+from cbf_tpu.serve import (FaultPolicy, FencedError,  # noqa: E402
+                           QuarantinedError, ServeEngine)
+from cbf_tpu.serve import ha  # noqa: E402
+from cbf_tpu.utils import faults  # noqa: E402
+
+
+def _cfg(seed=0, **kw):
+    kw.setdefault("n", 10)
+    kw.setdefault("steps", 8)
+    kw.setdefault("gating", "jnp")
+    return swarm.Config(seed=seed, **kw)
+
+
+class _Sink:
+    """Minimal telemetry stub: records (event_type, payload) pairs."""
+
+    def __init__(self):
+        self.events = []
+
+    def event(self, event_type, payload):
+        self.events.append((event_type, dict(payload)))
+
+    def of(self, event_type):
+        return [p for t, p in self.events if t == event_type]
+
+
+def _engine(sink=None, **kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("bucket_sizes", (16,))
+    kw.setdefault("horizon_quantum", 8)
+    kw.setdefault("flush_deadline_s", 0.15)
+    return ServeEngine(telemetry=sink, tracer=Tracer(enabled=False), **kw)
+
+
+@pytest.fixture(scope="module")
+def warm_execs():
+    """Compile the one (n16, t8) bucket executable once; every engine in
+    this module reuses it."""
+    eng = _engine()
+    eng.prewarm([_cfg()])
+    return eng._execs
+
+
+# ------------------------------------------------------ lease epochs ----
+
+def test_acquire_epochs_strictly_monotonic(tmp_path):
+    path = str(tmp_path / "lease.json")
+    assert ha.read_lease(path) is None
+    a = ha.Lease(path, owner="a")
+    b = ha.Lease(path, owner="b")
+    assert a.acquire() == 1
+    assert b.acquire() == 2
+    assert a.acquire() == 3          # re-acquire keeps climbing
+    state = ha.read_lease(path)
+    assert state.epoch == 3 and state.owner == "a" and state.beat == 0
+
+
+def test_heartbeat_bumps_sidecar_only(tmp_path):
+    """Renewals never rewrite the epoch-authority file: the journal
+    fence cannot be rolled back by a heartbeat, by construction."""
+    path = str(tmp_path / "lease.json")
+    lease = ha.Lease(path, owner="a")
+    lease.acquire()
+    with open(path) as fh:
+        authority_before = fh.read()
+    for _ in range(3):
+        lease.heartbeat()
+    assert ha.read_lease(path).beat == 3
+    with open(path) as fh:
+        assert fh.read() == authority_before   # byte-identical
+    assert "beat" not in json.loads(authority_before)
+    assert dj.read_fence_epoch(path) == 1
+
+
+def test_heartbeat_over_newer_epoch_fenced_without_write(tmp_path):
+    path = str(tmp_path / "lease.json")
+    a = ha.Lease(path, owner="a")
+    a.acquire()
+    a.heartbeat()
+    b = ha.Lease(path, owner="b")
+    assert b.acquire() == 2
+    with pytest.raises(FencedError) as exc:
+        a.heartbeat()
+    assert exc.value.epoch == 1
+    assert exc.value.fence_epoch == 2
+    assert exc.value.path == os.path.abspath(path)
+    state = ha.read_lease(path)
+    assert state.epoch == 2 and state.owner == "b" and state.beat == 0
+
+
+def test_stale_beat_sidecar_is_not_liveness(tmp_path):
+    """The SIGSTOP-zombie race distilled: a renewal whose fence check
+    passed BEFORE a takeover may still land its write after — stamped
+    with the old epoch. Readers must discard it: it is neither liveness
+    for the new epoch nor a fence rollback."""
+    path = str(tmp_path / "lease.json")
+    a = ha.Lease(path, owner="a")
+    a.acquire()
+    b = ha.Lease(path, owner="b")
+    b.acquire()
+    b.heartbeat()
+    # The zombie's late sidecar write (epoch 1), stomping b's (epoch 2).
+    with open(ha.beat_path(path), "w") as fh:
+        json.dump({"epoch": 1, "beat": 99, "t_wall": 0.0}, fh)
+    state = ha.read_lease(path)
+    assert state.epoch == 2
+    assert state.beat == 0                     # stale beat discarded
+    assert dj.read_fence_epoch(path) == 2      # fence untouched
+
+
+def test_lease_edge_cases(tmp_path):
+    path = str(tmp_path / "lease.json")
+    with pytest.raises(RuntimeError, match="before acquire"):
+        ha.Lease(path).heartbeat()
+    with open(path, "w") as fh:
+        fh.write("{not json")
+    with pytest.raises(ValueError, match="unreadable lease"):
+        ha.read_lease(path)
+
+
+# -------------------------------------------------- expiry arithmetic ----
+
+def test_monitor_expiry_is_change_based(tmp_path):
+    path = str(tmp_path / "lease.json")
+    lease = ha.Lease(path, owner="a")
+    lease.acquire()
+    now = {"t": 0.0}
+    mon = ha.LeaseMonitor(path, ttl_s=1.0, clock=lambda: now["t"])
+    assert not mon.expired()          # never observed -> cannot expire
+    mon.poll()
+    now["t"] = 0.9
+    mon.poll()
+    assert not mon.expired()
+    now["t"] = 1.0                    # ttl with no change -> expired
+    assert mon.expired()
+    lease.heartbeat()                 # beat change re-stamps
+    mon.poll()
+    assert not mon.expired()
+    now["t"] = 1.9
+    assert not mon.expired()
+    now["t"] = 2.0
+    assert mon.expired()
+
+
+def test_monitor_clock_rebase_restamps_instead_of_misfiring(tmp_path):
+    path = str(tmp_path / "lease.json")
+    ha.Lease(path, owner="a").acquire()
+    now = {"t": 100.0}
+    mon = ha.LeaseMonitor(path, ttl_s=1.0, clock=lambda: now["t"])
+    mon.poll()
+    now["t"] = 0.5                    # observer clock rebased to ~0
+    assert not mon.expired()          # negative elapsed: re-stamp
+    now["t"] = 1.4
+    assert not mon.expired()          # measured from the re-stamp
+    now["t"] = 1.5
+    assert mon.expired()
+
+
+# -------------------------------------------------------- journal fence --
+
+def test_journal_append_fenced_before_any_byte(tmp_path):
+    lease_path = str(tmp_path / "lease.json")
+    jpath = str(tmp_path / "wal.jsonl")
+    a = ha.Lease(lease_path, owner="a")
+    j = dj.RequestJournal(jpath, epoch=a.acquire(), fence_path=lease_path)
+    j.submitted("r0", _cfg())
+    ha.Lease(lease_path, owner="b").acquire()        # the fence moves
+    size = os.path.getsize(jpath)
+    with pytest.raises(FencedError) as exc:
+        j.submitted("r1", _cfg())
+    assert exc.value.epoch == 1 and exc.value.fence_epoch == 2
+    assert os.path.getsize(jpath) == size            # not a single byte
+    with pytest.raises(FencedError):
+        j.resolved("r0")
+    j.close()
+    # The new epoch's appender is unaffected.
+    j2 = dj.RequestJournal(jpath, epoch=2, fence_path=lease_path)
+    j2.resolved("r0")
+    j2.close()
+    replay = dj.replay_journal(jpath)
+    assert replay.unresolved == []
+
+
+def test_journal_open_is_fenced_too(tmp_path):
+    lease_path = str(tmp_path / "lease.json")
+    ha.Lease(lease_path, owner="b").acquire()
+    ha.Lease(lease_path, owner="b").acquire()        # epoch 2 on disk
+    with pytest.raises(FencedError):
+        dj.RequestJournal(str(tmp_path / "wal.jsonl"), epoch=1,
+                          fence_path=lease_path)
+
+
+def test_fenced_midflight_request_resolves_typed(tmp_path, warm_execs):
+    """Fix for the stranded-batch hang: a request acknowledged at the
+    old epoch whose batch forms AFTER a takeover resolves with the
+    typed FencedError (the new owner replays it) instead of hanging
+    forever on a dead scheduler — and the engine remembers the fencing
+    for the CLI's exit-4 path."""
+    lease_path = str(tmp_path / "lease.json")
+    jpath = str(tmp_path / "wal.jsonl")
+    a = ha.Lease(lease_path, owner="a")
+    j = dj.RequestJournal(jpath, epoch=a.acquire(), fence_path=lease_path)
+    eng = _engine(flush_deadline_s=0.4, journal=j)
+    eng._execs = warm_execs
+    eng.start()
+    try:
+        p = eng.submit(_cfg())                  # acknowledged at epoch 1
+        ha.Lease(lease_path, owner="b").acquire()   # fence moves, queued
+        with pytest.raises(FencedError):
+            p.result(timeout=30)
+        assert isinstance(eng.fenced, FencedError)
+    finally:
+        eng.stop(drain=True)
+    # The fenced primary wrote nothing after the takeover: the epoch-1
+    # ack is the log's only record — never executed, never resolved.
+    replay = dj.replay_journal(jpath)
+    assert replay.max_epoch == 1 and replay.records == 1
+    assert len(replay.unresolved) == 1
+
+
+# ------------------------------------------- rotation and compaction ----
+
+def test_rotation_spills_segments_and_replays_whole(tmp_path):
+    jpath = str(tmp_path / "wal.jsonl")
+    j = dj.RequestJournal(jpath, rotate_bytes=400)
+    for i in range(6):
+        j.submitted(f"r{i}", _cfg(seed=i))
+    j.close()
+    segs = dj.journal_segments(jpath)
+    assert segs, "rotate_bytes=400 must have rotated at least once"
+    replay = dj.replay_journal(jpath)
+    assert sorted(replay.submitted) == [f"r{i}" for i in range(6)]
+    assert len(replay.unresolved) == 6
+    # Reopen mid-rotation: the appender continues the segment sequence.
+    j2 = dj.RequestJournal(jpath, rotate_bytes=400)
+    for i in range(6):
+        j2.resolved(f"r{i}")
+    j2.close()
+    replay = dj.replay_journal(jpath)
+    assert replay.unresolved == []
+    assert max(replay.resolved_counts.values()) == 1
+
+
+def test_compaction_drops_only_fully_redundant_segments(tmp_path):
+    """The compaction invariant: a segment may vanish ONLY when the
+    unresolved fold without it is identical — an id resolved in a later
+    file lets its segment go; an open id pins its segment forever."""
+    jpath = str(tmp_path / "wal.jsonl")
+    j = dj.RequestJournal(jpath, rotate_bytes=250)
+    j.submitted("open", _cfg(seed=0))      # never resolved: pins its seg
+    for i in range(5):
+        j.submitted(f"r{i}", _cfg(seed=i))
+        j.resolved(f"r{i}")
+    before = dj.replay_journal(jpath)
+    removed = dj.compact_segments(jpath)
+    after = dj.replay_journal(jpath)
+    assert [rid for rid, _ in after.unresolved] == ["open"]
+    assert [rid for rid, _ in before.unresolved] == ["open"]
+    assert "open" in after.submitted
+    j.close()
+    assert removed, "fully-redundant segments should have been dropped"
+    assert not set(removed) & set(dj.journal_segments(jpath))
+
+
+def test_torn_tail_forgiven_in_active_file_only(tmp_path):
+    jpath = str(tmp_path / "wal.jsonl")
+    j = dj.RequestJournal(jpath, rotate_bytes=250)
+    for i in range(4):
+        j.submitted(f"r{i}", _cfg(seed=i))
+    j.close()
+    segs = dj.journal_segments(jpath)
+    assert segs
+    # Tear the ACTIVE file's tail: forgiven, then repaired on reopen.
+    with open(jpath, "a") as fh:
+        fh.write('{"type": "resolved", "request_id": "r3", "ou')
+    replay = dj.replay_journal(jpath)
+    assert len(replay.unresolved) == 4       # torn record doesn't count
+    j2 = dj.RequestJournal(jpath)            # reopen repairs the tear
+    j2.resolved("r0")
+    j2.close()
+    assert len(dj.replay_journal(jpath).unresolved) == 3
+    # A tear inside a rotated segment is real damage, not a crash scar.
+    with open(segs[0], "a") as fh:
+        fh.write('{"type": "submitted"')
+    with pytest.raises(dj.RecoveryError):
+        dj.replay_journal(jpath)
+
+
+# ----------------------------------------------- replay dedupe (pin) ----
+
+def test_resolved_id_never_reenqueued_at_recovery(tmp_path, warm_execs):
+    """Exactly-once from the client's view: a durable ``resolved``
+    record excludes its id from recovery even when the client never saw
+    the result (killed between the resolved fsync and the handle
+    unblock). Only the genuinely unresolved id re-runs."""
+    jpath = str(tmp_path / "wal.jsonl")
+    j = dj.RequestJournal(jpath)
+    j.submitted("r1", _cfg(seed=1))
+    j.resolved("r1")                  # fsync'd; client may never know
+    j.submitted("r2", _cfg(seed=2))
+    j.close()
+    eng = _engine(journal=dj.RequestJournal(jpath))
+    eng._execs = warm_execs
+    eng.start()
+    try:
+        pendings = eng.recover(jpath)
+        assert [p.request_id for p in pendings] == ["r2"]
+        pendings[0].result(timeout=120)
+    finally:
+        eng.stop(drain=True)
+    counts = dj.replay_journal(jpath).resolved_counts
+    assert counts["r1"] == 1          # never re-executed
+    assert counts["r2"] == 1
+    assert dj.replay_journal(jpath).unresolved == []
+
+
+def test_torn_resolved_record_degrades_to_at_least_once(tmp_path):
+    jpath = str(tmp_path / "wal.jsonl")
+    j = dj.RequestJournal(jpath)
+    j.submitted("r1", _cfg(seed=1))
+    j.close()
+    with open(jpath, "a") as fh:      # the fsync never completed
+        fh.write('{"type": "resolved", "request_id": "r1", "outco')
+    replay = dj.replay_journal(jpath)
+    assert [rid for rid, _ in replay.unresolved] == ["r1"]
+
+
+# ------------------------------------- resilience state across restart --
+
+def test_breaker_state_survives_engine_restart(tmp_path, warm_execs):
+    """Two strikes open the signature breaker in engine 1; engine 2 on
+    the same journal restores it — the same signature fails fast
+    IMMEDIATELY (no fresh strike budget after a supervisor restart) and
+    the half-open probe is still admitted after the REMAINING
+    cooldown."""
+    jpath = str(tmp_path / "wal.jsonl")
+    e1 = _engine(journal=dj.RequestJournal(jpath), flush_deadline_s=0.02)
+    e1._execs = warm_execs
+    e1.fault_policy = FaultPolicy(max_retries=0, quarantine_threshold=2,
+                                  quarantine_cooldown_s=1.0)
+    e1.fault_hook = faults.serve_executor_fault(times=2, exc=ValueError(
+        "permanent model bug"))
+    cfg = _cfg(seed=0)
+    e1.start()
+    try:
+        for _ in range(2):
+            with pytest.raises(ValueError):
+                e1.submit(cfg).result(timeout=120)
+    finally:
+        e1.stop(drain=True)
+    assert os.path.exists(f"{jpath}.resilience")
+
+    e2 = _engine(journal=dj.RequestJournal(jpath), flush_deadline_s=0.02)
+    e2._execs = warm_execs
+    e2.fault_policy = FaultPolicy(max_retries=0, quarantine_threshold=2,
+                                  quarantine_cooldown_s=1.0)
+    e2.start()
+    try:
+        with pytest.raises(QuarantinedError):    # restored: fail-fast
+            e2.submit(dataclasses.replace(cfg, seed=7))
+        time.sleep(1.05)                         # past remaining cooldown
+        probe = e2.submit(cfg)                   # half-open: admitted
+        assert probe.result(timeout=120).n == 10
+    finally:
+        e2.stop(drain=True)
+
+
+# ------------------------------------------- takeover, witness-armed ----
+
+def test_takeover_dedupes_and_books_no_lock_inversions(tmp_path,
+                                                       warm_execs):
+    """The acceptance leg: a full in-process takeover — lease bump,
+    fenced journal reopen, replay with request-id dedupe, re-enqueue,
+    drain — under the ARMED lock witness. Zero observed inversions, and
+    every observed edge lies inside the static lock-order graph."""
+    lease_path = str(tmp_path / "lease.json")
+    jpath = str(tmp_path / "wal.jsonl")
+    primary = ha.Lease(lease_path, owner="primary")
+    j = dj.RequestJournal(jpath, epoch=primary.acquire(),
+                          fence_path=lease_path)
+    j.submitted("r0", _cfg(seed=0))
+    j.resolved("r0")                      # done: must be deduped
+    j.submitted("r1", _cfg(seed=1))       # acknowledged, unresolved
+    j.close()
+
+    lockwitness.arm()
+    lockwitness.reset()
+    try:
+        sink = _Sink()
+        eng = _engine(sink=sink)
+        eng._execs = warm_execs
+        standby = ha.Lease(lease_path, owner="standby", telemetry=sink)
+        report = ha.take_over(lease=standby, journal_path=jpath,
+                              engine=eng, telemetry=sink)
+        try:
+            assert report.epoch == 2 and report.prev_epoch == 1
+            assert report.deduped == 1 and report.reenqueued == 1
+            assert [p.request_id for p in report.pendings] == ["r1"]
+            report.pendings[0].result(timeout=120)
+        finally:
+            eng.stop(drain=True)
+        assert lockwitness.inversions() == []
+        static = concurrency.static_edge_set(concurrency.analyze_paths(
+            [os.path.join(ROOT, "cbf_tpu")], repo_root=ROOT))
+        assert lockwitness.check_subgraph(static) == []
+    finally:
+        lockwitness.disarm()
+        lockwitness.reset()
+
+    counts = dj.replay_journal(jpath).resolved_counts
+    assert counts == {"r0": 1, "r1": 1}   # exactly-once census
+    assert [e["action"] for e in sink.of("ha.lease")] == ["acquire"]
+    (takeover,) = sink.of("ha.takeover")
+    assert takeover["epoch"] == 2 and takeover["deduped"] == 1
+
+
+# ------------------------------------------------- supervisor contract --
+
+def _child_argv(code):
+    return [sys.executable, "-c", code]
+
+
+def test_supervisor_clean_exit_ends_supervision():
+    sup = ha.Supervisor(_child_argv("raise SystemExit(0)"),
+                        backoff_base_s=0.01)
+    assert sup.run() == 0
+    assert sup.restarts == 0
+
+
+def test_supervisor_never_restarts_a_fenced_child():
+    sink = _Sink()
+    sup = ha.Supervisor(_child_argv(f"raise SystemExit({ha.EXIT_FENCED})"),
+                        backoff_base_s=0.01, telemetry=sink)
+    assert sup.run() == ha.EXIT_FENCED
+    assert sup.restarts == 0
+    assert sink.of("ha.restart") == []
+
+
+def test_supervisor_crash_loop_breaker_trips():
+    sink = _Sink()
+    sup = ha.Supervisor(_child_argv("raise SystemExit(1)"),
+                        backoff_base_s=0.01, backoff_max_s=0.05,
+                        max_restarts=2, crash_window_s=30.0,
+                        telemetry=sink)
+    assert sup.run() == ha.EXIT_CRASH_LOOP
+    assert sup.restarts == 2
+    restarts = sink.of("ha.restart")
+    assert [e["exit_code"] for e in restarts] == [1, 1]
+    assert [e["attempt"] for e in restarts] == [1, 2]
+    # Exponential backoff is visible in the emitted schedule.
+    assert restarts[1]["backoff_s"] > restarts[0]["backoff_s"]
+    (loop,) = sink.of("ha.crash_loop")
+    assert loop["restarts"] == 2 and loop["window_s"] == 30.0
+
+
+# ------------------------------------------------------ docs lockstep ----
+
+def test_docs_cover_high_availability():
+    with open(os.path.join(ROOT, "docs", "API.md"), encoding="utf-8") as fh:
+        text = fh.read()
+    assert "## High availability" in text
+    for needle in ("`ha.lease`", "`ha.takeover`", "`ha.fenced`",
+                   "`ha.restart`", "`ha.crash_loop`", "--supervised",
+                   "--ha-standby", "--lease", "--heartbeat-s",
+                   "--rotate-bytes", "BENCH_FAILOVER", "`.beat`",
+                   "exit code 4", "`<journal>.resilience`"):
+        assert needle in text, f"docs/API.md missing {needle!r}"
+    with open(os.path.join(ROOT, "README.md"), encoding="utf-8") as fh:
+        readme = fh.read()
+    assert "serve/ha" in readme
+
+
+# ------------------------------------------------ full CLI round-trip ----
+
+@pytest.mark.slow
+def test_cli_failover_sigkill_roundtrip(tmp_path):
+    """One bench-shaped round through the real CLI: a hot standby takes
+    over from a SIGKILLed paced primary with zero lost acknowledged
+    requests and zero duplicate executions (exact request-id census)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["CBF_TPU_CACHE_DIR"] = str(tmp_path / "cache")
+    lease = str(tmp_path / "lease.json")
+    jpath = str(tmp_path / "wal.jsonl")
+    ready = str(tmp_path / "ready")
+    reqs = str(tmp_path / "reqs.json")
+    with open(reqs, "w") as fh:
+        json.dump([{"steps": 6, "seed": 1,
+                    "overrides": {"n": 8, "gating": "jnp"},
+                    "repeat": 8}], fh)
+    standby = subprocess.Popen(
+        [sys.executable, "-m", "cbf_tpu", "serve", "--ha-standby",
+         "--lease", lease, "--journal", jpath, "--lease-ttl-s", "1.0",
+         "--ready-file", ready, "--standby-max-wait-s", "120",
+         "--platform", "cpu"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True)
+    try:
+        assert faults.wait_for_file(ready, 120), "standby never ready"
+
+        def should_kill(elapsed, armed=[None]):
+            if armed[0] is None:
+                try:
+                    with open(jpath) as fh:
+                        if any('"submitted"' in ln for ln in fh):
+                            armed[0] = elapsed
+                except OSError:
+                    pass
+                return False
+            return elapsed - armed[0] >= 0.8
+        rc, killed, _ = faults.run_process_until(
+            [sys.executable, "-m", "cbf_tpu", "serve", reqs,
+             "--lease", lease, "--journal", jpath, "--pace-s", "0.3",
+             "--heartbeat-s", "0.1", "--platform", "cpu"],
+            should_kill, poll_s=0.02, timeout_s=180, env=env)
+        assert killed, f"primary finished (rc={rc}) before the kill"
+        out, _ = standby.communicate(timeout=180)
+    except BaseException:
+        standby.kill()
+        raise
+    assert standby.returncode == 0
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["takeover"] and rec["epoch"] == 2
+    replay = dj.replay_journal(jpath)
+    assert replay.unresolved == []                      # zero lost
+    assert max(replay.resolved_counts.values()) == 1    # zero dups

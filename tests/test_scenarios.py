@@ -143,6 +143,11 @@ def test_antipodal_swap_completes_safely(x64):
     assert int(np.asarray(outs.filter_active_count).sum()) > 100 * cfg.n
 
 
+# slow: ~7 s; joint-certificate residual convergence and the widened
+# spacing stay tier-1 in test_swarm_certificate_composes_with_unicycle
+# (this file) and the sparse-certificate parity tests — this is the
+# N=64, 120-step single-swarm soak.
+@pytest.mark.slow
 def test_swarm_two_layer_certificate_stack():
     """The reference's two-layer stack (per-agent CBF then the joint
     certificate — cross_and_rescue.py:162-163) at swarm scale: the joint
@@ -164,6 +169,10 @@ def test_swarm_two_layer_certificate_stack():
     assert int(np.asarray(outs.infeasible_count).sum()) == 0
 
 
+# slow: ~8 s; dp-sharded certificate ensembles stay tier-1 in
+# test_certificate_ensemble_sp_sharded_matches_dp_only, which runs the
+# dp-only configuration as its reference side.
+@pytest.mark.slow
 def test_certificate_ensemble_dp_only():
     """dp-only sharded certificate ensembles run the second layer per
     member (whole swarm on each device): residuals converge, the

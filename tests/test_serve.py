@@ -153,6 +153,11 @@ def test_pads_stay_parked():
     assert not np.any(np.asarray(final.v)[0, cfg.n:])
 
 
+# slow: ~12 s; pad-neutral bucket padding stays tier-1 in
+# test_padded_bucket_parity_mixed_batch and test_pads_stay_parked, and
+# the certificate residual gate at scale in test_sparse_certificate's
+# tier-1 parity tests — this is the padded joint-QP parity soak.
+@pytest.mark.slow
 def test_padded_certificate_parity():
     """Certificate bucket: the padded joint QP (decoupled pad variables,
     parking-containing arena) reproduces the unpadded solve run under

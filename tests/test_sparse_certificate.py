@@ -181,6 +181,11 @@ def test_sparse_neighbor_backends_agree_with_brute_force():
         assert int(info_p.dropped_count) == brute, k
 
 
+# slow: ~9 s; certificate+unicycle composition stays tier-1 in
+# test_swarm_certificate_composes_with_unicycle (test_scenarios), and
+# the sparse backend past the dense cutoff in the crossover-agreement
+# test and test_sparse_neighbor_backends_agree_with_brute_force.
+@pytest.mark.slow
 def test_sparse_certificate_composes_with_unicycle():
     """The sparse backend composes with the unicycle family beyond the
     dense cutoff (commands are si velocities at the projection points)."""

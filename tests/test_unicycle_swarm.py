@@ -139,6 +139,11 @@ def test_unicycle_validation():
         swarm.make(swarm.Config(n=8, dynamics="unicycle", speed_limit=0.5))
 
 
+# slow: ~10 s; sharded train-step descent stays tier-1 in
+# test_two_layer_training_descends, and the si<->uni trig maps plus
+# wheel-saturation scaling in test_unicycle_wheel_saturation_bounds_motion
+# and test_unicycle_initial_state_laws_match.
+@pytest.mark.slow
 def test_unicycle_training_descends_through_pose_state():
     """The trainer carries the heading as a third sharded state array and
     differentiates through the si<->uni trig maps and the wheel-saturation
