@@ -98,6 +98,16 @@ Modes / env knobs:
     (steady-state sweep rate) axes. Knobs: BENCH_VERIFY_N (256),
     BENCH_VERIFY_STEPS (200), BENCH_VERIFY_BATCH (16),
     BENCH_VERIFY_ROUNDS (3). See docs/BENCH_LOG.md Round 9.
+  BENCH_FLEET=1 — falsification-fleet mode (cbf_tpu.verify.fleet):
+    standalone campaign rate (candidates/hour, warm) plus the tenancy
+    gate — the same seeded loadgen schedule with and without the fleet
+    attached as the serve engine's background tenant; fleet-on
+    foreground p99 must stay within BENCH_FLEET_P99_BUDGET (1.10) of
+    fleet-off plus BENCH_FLEET_P99_SLACK (0.005 s), with zero
+    foreground errors/degrades and background_batches > 0. Knobs:
+    BENCH_FLEET_N (64), BENCH_FLEET_STEPS (64), BENCH_FLEET_BATCH (16),
+    BENCH_FLEET_BATCHES (4), BENCH_FLEET_ROUNDS (3) + the BENCH_SLO_*
+    sizing knobs.
   BENCH_SCEN=1 — scenario-platform sweep mode (cbf_tpu.scenarios.platform):
     generate the seeded procedural scenario batch (spawn x goal x
     obstacle x dynamics ingredients, mixed single+double heterogeneous
@@ -1608,6 +1618,150 @@ def _child_chaos(steps: int) -> dict:
     return result
 
 
+def _child_fleet(steps: int) -> dict:
+    """BENCH_FLEET mode: falsification-fleet throughput + the tenancy
+    gate (cbf_tpu.verify.fleet as a serve-engine background tenant).
+
+    Three legs. Leg 0 runs a standalone campaign against one swarm
+    target and reports ``candidates_per_hour`` (warm: the first
+    dispatch's compile is paid before the clock starts). Legs 1 and 2
+    drive the SAME seeded open-loop loadgen schedule through one
+    prewarmed engine — first with no tenant (baseline foreground p99),
+    then with a fleet attached as the ``priority="background"`` tenant
+    soaking every idle gap. The tenancy gate: the fleet-on foreground
+    p99 must stay within BENCH_FLEET_P99_BUDGET (default 1.10 = +10%)
+    of fleet-off plus BENCH_FLEET_P99_SLACK absolute seconds (default
+    0.005 — open-loop p99 at ~80 samples is noisy at the millisecond
+    scale), with zero foreground errors, zero degrade transitions, and
+    the tenant actually having run (background_batches > 0 — a gate
+    that passes because the fleet never got a slot proves nothing).
+
+    Knobs: BENCH_FLEET_N (64), BENCH_FLEET_STEPS (min(BENCH_STEPS, 64)),
+    BENCH_FLEET_BATCH (16), BENCH_FLEET_BATCHES (4, per round),
+    BENCH_FLEET_ROUNDS (3, the standalone leg), plus the BENCH_SLO_*
+    sizing knobs for the loadgen legs."""
+    import jax
+    import numpy as np   # noqa: F401  (parity with sibling modes)
+
+    from cbf_tpu.scenarios import swarm
+    from cbf_tpu.serve import LoadSpec, ServeEngine, build_schedule, \
+        run_loadgen
+    from cbf_tpu.verify import fleet as vfleet
+    from cbf_tpu.verify import search as vsearch
+
+    n = _env_int("BENCH_FLEET_N", 64)
+    fsteps = _env_int("BENCH_FLEET_STEPS", min(steps, 64))
+    batch = _env_int("BENCH_FLEET_BATCH", 16)
+    batches = _env_int("BENCH_FLEET_BATCHES", 4)
+    rounds = _env_int("BENCH_FLEET_ROUNDS", 3)
+    p99_budget = _env_float("BENCH_FLEET_P99_BUDGET", 1.10)
+    p99_slack = _env_float("BENCH_FLEET_P99_SLACK", 0.005)
+    rps = _env_float("BENCH_SLO_RPS", 8.0)
+    duration = _env_float("BENCH_SLO_DURATION", 10.0)
+    seed = _env_int("BENCH_SLO_SEED", 0)
+    n_min = _env_int("BENCH_SLO_NMIN", 8)
+    n_max = _env_int("BENCH_SLO_NMAX", 96)
+    alpha = _env_float("BENCH_SLO_ALPHA", 1.3)
+    max_batch = _env_int("BENCH_SLO_MAX_BATCH", 8)
+    flush = _env_float("BENCH_SLO_FLUSH", 0.05)
+
+    fs = vfleet.FleetSettings(batch=batch, batches_per_round=batches)
+    cfg = swarm.Config(n=n, steps=fsteps,
+                       gating=os.environ.get("BENCH_GATING", "auto"))
+    ss = vfleet._search_settings(fs)
+    adapter = vsearch.make_adapter("swarm", cfg)
+
+    def mk_targets():
+        return [vfleet.FleetTarget(
+            "swarm-bench", "swarm", "swarm", adapter.cfg, None, adapter,
+            vsearch.make_eval_batch(adapter, ss))]
+
+    print(f"bench: fleet N={n} steps={fsteps} batch={batch} "
+          f"batches/round={batches} rounds={rounds} loadgen rps={rps} "
+          f"duration={duration}s", file=sys.stderr)
+
+    # Leg 0: standalone campaign rate. One unit first to pay the
+    # compile outside the measured window (time-to-first-candidate is
+    # the serve prewarm story, not the soak-rate story).
+    fleet0 = vfleet.FalsificationFleet(fs, budget_rounds=rounds,
+                                       targets=mk_targets())
+    warm_unit = fleet0.next_unit()
+    if warm_unit is not None:
+        warm_unit()
+    t0 = time.time()
+    res0 = fleet0.run()
+    solo_wall = time.time() - t0
+    cand_per_hour = (res0.evaluated / solo_wall * 3600.0) if solo_wall \
+        else 0.0
+
+    # Legs 1+2: same seeded schedule, fleet off then on.
+    spec = LoadSpec(rps=rps, duration_s=duration, seed=seed, n_min=n_min,
+                    n_max=n_max, pareto_alpha=alpha)
+    engine = ServeEngine(max_batch=max_batch, flush_deadline_s=flush)
+    schedule = build_schedule(spec)
+    prewarm_s = engine.prewarm([c for _, c in schedule])
+    base = run_loadgen(engine, spec)
+    if base["errors"]:
+        return {"error": f"fleet-off leg: {base['errors']}/"
+                         f"{base['requests']} requests failed",
+                "retryable": False}
+    base_stats = dict(engine.stats)
+
+    # Effectively-unbounded budget: the tenant must keep offering units
+    # for the whole leg; whatever campaign is left is discarded.
+    fleet1 = vfleet.FalsificationFleet(fs, budget_rounds=10 ** 6,
+                                       targets=mk_targets())
+    engine.attach_background(fleet1)
+    try:
+        on = run_loadgen(engine, spec)
+    finally:
+        engine.attach_background(None)
+    delta = {k: engine.stats[k] - base_stats[k]
+             for k in ("background_batches", "background_yields",
+                       "background_shed", "degraded_requests", "shed")}
+
+    if on["errors"]:
+        return {"error": f"fleet-on leg: {on['errors']}/{on['requests']} "
+                         f"foreground requests failed", "retryable": False}
+    if delta["background_batches"] == 0:
+        return {"error": "tenancy gate vacuous: the fleet never ran a "
+                         "single background unit during the loadgen leg",
+                "retryable": False}
+    if delta["degraded_requests"] or delta["shed"]:
+        return {"error": f"tenancy gate: background tenant triggered "
+                         f"foreground degrade/shed (degraded="
+                         f"{delta['degraded_requests']} shed="
+                         f"{delta['shed']})", "retryable": False}
+    p99_off, p99_on = base["latency_p99_s"], on["latency_p99_s"]
+    if p99_on > p99_budget * p99_off + p99_slack:
+        return {"error": f"tenancy gate: fleet-on foreground p99 "
+                         f"{p99_on:.4f}s > {p99_budget:.2f}x fleet-off "
+                         f"{p99_off:.4f}s + {p99_slack:.3f}s slack",
+                "retryable": False}
+
+    print(f"bench: fleet {cand_per_hour:.0f} candidates/hour solo; p99 "
+          f"on={p99_on}s off={p99_off}s; tenant={delta}", file=sys.stderr)
+    return {
+        "metric": (f"fleet candidates/hour (swarm N={n}, steps={fsteps}, "
+                   f"batch={batch})"),
+        "value": round(cand_per_hour, 1),
+        "unit": "candidates_per_hour",
+        "vs_baseline": 0,   # a robustness axis, not the headline rate
+        "solo_rounds": res0.rounds,
+        "solo_evaluated": res0.evaluated,
+        "solo_wall_s": round(solo_wall, 3),
+        "prewarm_s": round(prewarm_s, 3),
+        "p99_off_s": p99_off,
+        "p99_on_s": p99_on,
+        "p99_budget": p99_budget,
+        "p99_ratio": round(p99_on / p99_off, 3) if p99_off else 0,
+        "background_batches": delta["background_batches"],
+        "background_yields": delta["background_yields"],
+        "foreground_requests": on["requests"],
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def _child_rta(steps: int) -> dict:
     """BENCH_RTA mode: runtime-assurance chaos harness (cbf_tpu.rta +
     the utils.faults in-compiled-code injectors). Two legs because
@@ -2315,6 +2469,8 @@ def child_main(result_path: str, ensemble: bool) -> None:
             result = _child_preempt(steps)
         elif os.environ.get("BENCH_SCEN", "0") == "1":
             result = _child_scen(steps)
+        elif os.environ.get("BENCH_FLEET", "0") == "1":
+            result = _child_fleet(steps)
         elif os.environ.get("BENCH_VERIFY", "0") == "1":
             result = _child_verify(steps)
         elif os.environ.get("BENCH_RTA", "0") == "1":
@@ -2437,6 +2593,8 @@ def main() -> None:
         label = "preempt rounds=%d" % _env_int("BENCH_PREEMPT_ROUNDS", 3)
     elif os.environ.get("BENCH_SCEN", "0") == "1":
         label = "scen count=%d" % _env_int("BENCH_SCEN_COUNT", 20)
+    elif os.environ.get("BENCH_FLEET", "0") == "1":
+        label = "fleet N=%d" % _env_int("BENCH_FLEET_N", 64)
     elif os.environ.get("BENCH_VERIFY", "0") == "1":
         label = "verify N=%d" % _env_int("BENCH_VERIFY_N", 256)
     elif os.environ.get("BENCH_RTA", "0") == "1":

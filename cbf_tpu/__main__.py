@@ -1198,6 +1198,129 @@ def _weakened_cbf(scenario: str, cfg, pairs: list[str]):
     return base._replace(**updates)
 
 
+def _fleet_settings_from_args(args):
+    """Build FleetSettings from the `verify fleet` arg namespace: --weaken
+    pairs become cbf_overrides, --set pairs target FleetSettings fields
+    (type-coerced from the field default), and dedicated flags
+    (--seed/--batch/--perturb-*) act as defaults that a --set of the same
+    field may override."""
+    import dataclasses as _dc
+
+    from cbf_tpu import verify as V
+    from cbf_tpu.core.filter import CBFParams
+
+    overrides = []
+    for pair in args.weaken or []:
+        key, _, raw = pair.partition("=")
+        if key not in CBFParams._fields:
+            raise SystemExit(f"--weaken: unknown CBFParams field {key!r}; "
+                             f"have {sorted(CBFParams._fields)}")
+        overrides.append((key, float(raw)))
+    # --set targets FleetSettings fields here (there is no single
+    # scenario config to override — the fleet enrolls them all).
+    sfields = {f.name: f for f in _dc.fields(V.FleetSettings)}
+    skw = {}
+    for pair in args.set:
+        key, _, raw = pair.partition("=")
+        if key not in sfields or key == "cbf_overrides":
+            raise SystemExit(
+                f"--set: unknown FleetSettings field {key!r}; have "
+                f"{sorted(k for k in sfields if k != 'cbf_overrides')}")
+        proto = sfields[key].default
+        if isinstance(proto, bool):
+            skw[key] = raw.lower() in ("1", "true", "yes")
+        elif isinstance(proto, int):
+            skw[key] = int(raw)
+        else:
+            skw[key] = float(raw)
+    if args.perturb_scale is not None:
+        skw["perturb_scale"] = args.perturb_scale
+    if args.perturb_norm is not None:
+        skw["perturb_norm"] = args.perturb_norm
+    # Dedicated flags are defaults; a --set of the same field wins
+    # (so `--set batch=8` is legal, not a duplicate-kwarg crash).
+    skw.setdefault("seed", args.seed)
+    skw.setdefault("batch", args.batch)
+    return V.FleetSettings(cbf_overrides=tuple(overrides), **skw)
+
+
+def _cmd_verify_fleet(args) -> int:
+    """The falsification fleet: corpus-driven continuous fuzzing over
+    every registered scenario (see verify.fleet). Exit 0 = every target
+    survived the round budget, 2 = operator error (stale --state-dir
+    fingerprint), 3 = new confirmed violation archived."""
+    from cbf_tpu import verify as V
+
+    settings = _fleet_settings_from_args(args)
+    mesh = None
+    if args.mesh_dp:
+        from cbf_tpu.parallel import make_mesh
+
+        mesh = make_mesh(n_dp=args.mesh_dp, n_sp=1)
+    sink = flight = None
+    if args.telemetry_dir:
+        from cbf_tpu import obs
+        from cbf_tpu.obs import flight as obs_flight
+
+        sink = obs.TelemetrySink(args.telemetry_dir, manifest=obs.build_manifest(
+            None, extra={"fleet": {"seed": settings.seed,
+                                   "batch": settings.batch,
+                                   "budget_rounds": args.budget_rounds}}))
+        flight = obs_flight.FlightRecorder(
+            os.path.join(sink.run_dir, "capsules")).attach(sink)
+    if args.state_dir and args.reset_state:
+        removed = V.reset_campaign_state(args.state_dir)
+        if removed and not args.json:
+            print(f"reset: removed {len(removed)} persisted campaign "
+                  f"state file(s) from {args.state_dir}")
+    engine = None
+    if args.serve_idle:
+        from cbf_tpu.serve.engine import ServeEngine
+
+        engine = ServeEngine(telemetry=sink, flight=flight)
+        engine.start()
+    try:
+        res = V.run_fleet(settings, budget_rounds=args.budget_rounds,
+                          corpus_dir=args.corpus_dir,
+                          state_dir=args.state_dir, resume=args.resume,
+                          telemetry=sink, mesh=mesh, flight=flight,
+                          engine=engine)
+    except ValueError as e:
+        # Fingerprint mismatch: --state-dir holds a campaign run under
+        # different settings. Operator error, not a traceback.
+        print(f"verify: {e}", file=sys.stderr)
+        return 2
+    finally:
+        if engine is not None:
+            engine.stop()
+    record = {"targets": res.targets, "rounds": res.rounds,
+              "evaluated": res.evaluated, "best_margin": res.best_margin,
+              "violations": res.violations, "near_misses": res.near_misses,
+              "cells_visited": res.cells_visited,
+              "cells_total": res.cells_total, "done": res.done,
+              "state_path": res.state_path}
+    if sink is not None:
+        sink.summary({"violations_found": len(res.violations)})
+        sink.close()
+        record["telemetry"] = sink.run_dir
+    if args.json:
+        from cbf_tpu.obs.schema import json_scalar
+
+        record["best_margin"] = json_scalar(record["best_margin"])
+        print(json.dumps(record))
+    else:
+        print(f"fleet: {res.rounds} rounds, {res.evaluated} candidates "
+              f"over {len(res.targets)} targets, best margin "
+              f"{res.best_margin:.6f}, coverage "
+              f"{res.cells_visited}/{res.cells_total} cells, "
+              f"{res.near_misses} near-miss cells")
+        for v in res.violations:
+            print(f"VIOLATION {v['target']}/{v['property']}: "
+                  f"margin_x64 {v['margin_x64']:.6f} "
+                  f"(round {v['round']}, archived: {v['corpus']})")
+    return 3 if res.violations else 0
+
+
 def cmd_verify(args) -> int:
     """Falsification sweep: search for initial-condition perturbations
     that violate a safety property, shrink what is found, optionally
@@ -1207,6 +1330,9 @@ def cmd_verify(args) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+
+    if args.scenario == "fleet":
+        return _cmd_verify_fleet(args)
 
     import dataclasses as _dc
 
@@ -1218,7 +1344,10 @@ def cmd_verify(args) -> int:
     cbf = _weakened_cbf(args.scenario, cfg, args.weaken)
     settings = V.SearchSettings(
         budget=args.budget, batch=args.batch, seed=args.seed,
-        perturb_scale=args.perturb_scale, perturb_norm=args.perturb_norm)
+        perturb_scale=(0.04 if args.perturb_scale is None
+                       else args.perturb_scale),
+        perturb_norm=(0.1 if args.perturb_norm is None
+                      else args.perturb_norm))
     thresholds = V.thresholds_for(args.scenario, cfg)
     if args.properties:
         selected = args.properties.split(",")
@@ -1256,6 +1385,11 @@ def cmd_verify(args) -> int:
                     "engines": args.engine, "seed": settings.seed}}))
 
     engines = tuple(args.engine) if args.engine else ("random", "cem")
+    if args.state_dir and args.reset_state:
+        removed = V.reset_campaign_state(args.state_dir)
+        if removed and not args.json:
+            print(f"reset: removed {len(removed)} persisted campaign "
+                  f"state file(s) from {args.state_dir}")
     try:
         results = V.falsify(
             args.scenario, cfg, settings=settings, engines=engines, cbf=cbf,
@@ -1735,7 +1869,11 @@ def main(argv=None) -> int:
                        "(docs/API.md 'Verification'); exit 3 = violation "
                        "found")
     verp.add_argument("scenario", nargs="?", default="swarm",
-                      choices=sorted(_verify_scenarios()))
+                      choices=sorted([*_verify_scenarios(), "fleet"]),
+                      help="one scenario to falsify, or 'fleet' for the "
+                           "continuous fuzzing campaign over every "
+                           "registered scenario (docs/API.md "
+                           "'Falsification fleet')")
     verp.add_argument("--platform", default=None, choices=("cpu", "tpu"),
                       help="force a JAX backend before first use")
     verp.add_argument("--steps", type=int, default=None,
@@ -1760,11 +1898,14 @@ def main(argv=None) -> int:
                       help="comma-separated property subset that may "
                            "trigger a violation (default: all)")
     verp.add_argument("--seed", type=int, default=0)
-    verp.add_argument("--perturb-scale", type=float, default=0.04,
-                      help="proposal std in meters (default 0.04)")
-    verp.add_argument("--perturb-norm", type=float, default=0.1,
+    verp.add_argument("--perturb-scale", type=float, default=None,
+                      help="proposal std in meters (default 0.04; "
+                           "fleet default 0.02)")
+    verp.add_argument("--perturb-norm", type=float, default=None,
                       help="per-agent L2 cap on perturbations "
-                           "(default 0.1 m)")
+                           "(default 0.1 m; fleet default 0.05 — the "
+                           "fleet probes the DEFAULT filters, whose "
+                           "calibrated floors leave less slack)")
     verp.add_argument("--no-shrink", action="store_true",
                       help="skip minimizing a found counterexample")
     verp.add_argument("--corpus-dir", default=None,
@@ -1785,9 +1926,21 @@ def main(argv=None) -> int:
     verp.add_argument("--no-resume", dest="resume", action="store_false",
                       help="ignore persisted --state-dir state and "
                            "restart from round 0")
+    verp.add_argument("--reset-state", action="store_true",
+                      help="delete persisted --state-dir campaign state "
+                           "before running (the recovery lever when a "
+                           "fingerprint mismatch names a drifted field)")
     verp.add_argument("--telemetry-dir", default=None,
                       help="stream verify.round/verify.margin events "
                            "into this run directory")
+    verp.add_argument("--budget-rounds", type=int, default=8,
+                      help="fleet only: fuzzing rounds before the "
+                           "campaign rests (default 8; re-running with a "
+                           "larger value extends a persisted campaign)")
+    verp.add_argument("--serve-idle", action="store_true",
+                      help="fleet only: run the campaign as a background "
+                           "tenant of a local serve engine (preempted by "
+                           "any foreground traffic) instead of inline")
     verp.add_argument("--json", action="store_true",
                       help="machine-readable output (one JSON object)")
     verp.set_defaults(fn=cmd_verify)

@@ -206,6 +206,16 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
             f"{serve_ha.EMITTED_EVENT_TYPES!r} != "
             f"obs.schema.HA_EVENT_TYPES {schema.HA_EVENT_TYPES!r} "
             "— emitter and schema drifted")
+    # Falsification-fleet event drift: the fleet's declared emissions
+    # must match the schema's fleet family exactly.
+    from cbf_tpu.verify import fleet as verify_fleet
+    if tuple(verify_fleet.EMITTED_EVENT_TYPES) != \
+            tuple(schema.FLEET_EVENT_TYPES):
+        problems.append(
+            f"verify.fleet.EMITTED_EVENT_TYPES "
+            f"{verify_fleet.EMITTED_EVENT_TYPES!r} != "
+            f"obs.schema.FLEET_EVENT_TYPES {schema.FLEET_EVENT_TYPES!r} "
+            "— emitter and schema drifted")
     for table_name, types_name, fields, types in (
             ("SERVE_EVENT_FIELDS", "SERVE_EVENT_TYPES",
              schema.SERVE_EVENT_FIELDS, schema.SERVE_EVENT_TYPES),
@@ -220,7 +230,9 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
             ("SCENARIO_EVENT_FIELDS", "SCENARIO_EVENT_TYPES",
              schema.SCENARIO_EVENT_FIELDS, schema.SCENARIO_EVENT_TYPES),
             ("HA_EVENT_FIELDS", "HA_EVENT_TYPES",
-             schema.HA_EVENT_FIELDS, schema.HA_EVENT_TYPES)):
+             schema.HA_EVENT_FIELDS, schema.HA_EVENT_TYPES),
+            ("FLEET_EVENT_FIELDS", "FLEET_EVENT_TYPES",
+             schema.FLEET_EVENT_FIELDS, schema.FLEET_EVENT_TYPES)):
         for etype in fields:
             if etype not in types:
                 problems.append(
@@ -243,7 +255,7 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
     import inspect
     for mod in (verify_search, serve_engine, obs_trace, serve_loadgen,
                 durable_journal, durable_rollout, rta_monitor, obs_flight,
-                scen_dsl, serve_ha):
+                scen_dsl, serve_ha, verify_fleet):
         try:
             mod_tree = ast.parse(inspect.getsource(mod))
         except (OSError, TypeError):
@@ -294,7 +306,8 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
                 ("rta", schema.RTA_EVENT_FIELDS),
                 ("flight", schema.FLIGHT_EVENT_FIELDS),
                 ("scenario", schema.SCENARIO_EVENT_FIELDS),
-                ("ha", schema.HA_EVENT_FIELDS)):
+                ("ha", schema.HA_EVENT_FIELDS),
+                ("fleet", schema.FLEET_EVENT_FIELDS)):
             for etype, fields in table.items():
                 if f"`{etype}`" not in api_text:
                     problems.append(

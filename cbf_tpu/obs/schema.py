@@ -305,6 +305,22 @@ HA_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
     "ha.crash_loop": ("restarts", "window_s"),
 }
 
+#: Falsification-fleet event contract (verify.fleet): the AUD001 audit
+#: verifies ``verify.fleet.EMITTED_EVENT_TYPES`` equals this tuple,
+#: every type has a literal emit site, and every type and field is
+#: documented in docs/API.md.
+FLEET_EVENT_TYPES: tuple[str, ...] = (
+    "fleet.round", "fleet.violation", "fleet.preempt")
+
+FLEET_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
+    "fleet.round": ("round", "candidates", "evaluated", "best_margin",
+                    "violations", "near_misses", "cells_visited",
+                    "cells_total"),
+    "fleet.violation": ("target", "scenario", "property", "margin",
+                        "margin_x64", "confirmed_x64", "round", "corpus"),
+    "fleet.preempt": ("round", "queue_depth", "dispatched"),
+}
+
 
 def step_output_channels() -> dict[str, HeartbeatField]:
     """StepOutputs field name -> HeartbeatField for every streamed field."""

@@ -133,6 +133,7 @@ class PendingRequest:
         self._error: BaseException | None = None
         self._engine: "ServeEngine | None" = None
         self._key = None
+        self._priority = "foreground"   # which queue dict holds the entry
         self._journal = None   # set at admission when the engine journals
 
     def _resolve(self, result=None, error=None):
@@ -179,7 +180,9 @@ class PendingRequest:
         if engine is None or self.done():
             return False
         with engine._cond:
-            entries = engine._queue.get(self._key)
+            qmap = engine._bg_queue if self._priority == "background" \
+                else engine._queue
+            entries = qmap.get(self._key)
             if not entries:
                 return False
             for i, entry in enumerate(entries):
@@ -272,7 +275,9 @@ class ServeEngine:
                       "bisects": 0, "shed": 0, "deadline_expired": 0,
                       "quarantined": 0, "failed": 0, "nonfinite": 0,
                       "cancelled": 0, "degraded_requests": 0,
-                      "scheduler_crashes": 0, "rta_rescued": 0}
+                      "scheduler_crashes": 0, "rta_rescued": 0,
+                      "background_requests": 0, "background_batches": 0,
+                      "background_shed": 0, "background_yields": 0}
         self._execs: dict[_buckets.BucketKey, Any] = {}
         self._ids = itertools.count()
         self._batch_ids = itertools.count()
@@ -288,6 +293,15 @@ class ServeEngine:
         # deadline_t); times are on the tracer's monotonic clock
         # (tracer.now()); deadline_t is None when the request has none.
         self._queue: dict[_buckets.BucketKey, list] = {}
+        # The background tier's queue (same entry tuples), kept as a
+        # SEPARATE dict so every foreground-depth consumer — degrade
+        # watermarks, shed depth checks, queue_depth telemetry — excludes
+        # background work by construction rather than by filtering.
+        self._bg_queue: dict[_buckets.BucketKey, list] = {}
+        # Optional cooperative background tenant (attach_background):
+        # pulled for one unit of work per scheduler pass while the
+        # foreground tier is fully idle.
+        self._bg_tenant = None
         self._thread: threading.Thread | None = None
         self._running = False
         # Preemption notice (SIGTERM): the signal handler ONLY sets this
@@ -414,7 +428,9 @@ class ServeEngine:
                 "retries", "bisects", "shed", "deadline_expired",
                 "quarantined", "failed", "nonfinite", "cancelled",
                 "degraded_requests", "scheduler_crashes",
-                "rta_rescued")},
+                "rta_rescued", "background_requests",
+                "background_batches", "background_shed",
+                "background_yields")},
             "cost_model_drift": (self.cost_model.drift_summary()
                                  if self.cost_model is not None else None),
         }}
@@ -914,7 +930,8 @@ class ServeEngine:
             return sum(len(v) for v in self._queue.values())
 
     def submit(self, cfg: swarm.Config, request_id: str | None = None,
-               deadline_s: float | None = None) -> PendingRequest:
+               deadline_s: float | None = None,
+               priority: str = "foreground") -> PendingRequest:
         """Enqueue one request (queue mode; call `start()` first). The
         bucket flushes when max_batch requests accumulate or after
         flush_deadline_s, whichever comes first.
@@ -926,9 +943,23 @@ class ServeEngine:
         the globally oldest queued request (ITS handle resolves with
         `ShedError`) to admit this one. ``deadline_s`` (default: the
         policy's) stamps a deadline after which the request fails fast
-        with `DeadlineExceeded` instead of occupying an executor slot."""
+        with `DeadlineExceeded` instead of occupying an executor slot.
+
+        ``priority`` selects the admission tier (`resilience.PRIORITIES`).
+        Background requests queue separately: they never count toward
+        foreground depth (shed checks, degrade watermarks), are shed
+        FIRST when a foreground submit hits the queue limit, always
+        reject-newest when their own tier is full (they never evict
+        foreground work), and dispatch only while no foreground work is
+        runnable — at most one background batch per scheduler pass."""
         policy = self.fault_policy
+        if priority not in resilience.PRIORITIES:
+            raise ValueError(
+                f"priority must be one of {resilience.PRIORITIES}, got "
+                f"{priority!r}")
+        background = priority == "background"
         pending = PendingRequest(request_id or f"r{next(self._ids)}")
+        pending._priority = priority
         post_events: list[tuple[str, dict]] = []
         evicted = None
         with self.tracer.span("enqueue", trace_id=pending.request_id):
@@ -961,8 +992,46 @@ class ServeEngine:
                             f"{bbr.state})",
                             request_id=pending.request_id, bucket=label)
                 if fail is None and policy.queue_limit is not None:
-                    depth = sum(len(v) for v in self._queue.values())
-                    if depth >= policy.queue_limit:
+                    # queue_limit bounds the engine's TOTAL occupancy
+                    # (both tiers). Over the limit, background pays
+                    # first: a background submit is refused outright (it
+                    # never evicts anyone — soak work is re-offered from
+                    # persistent fleet state, so a shed costs only
+                    # time), and a foreground submit evicts the oldest
+                    # background entry before the shed policy can touch
+                    # any foreground request.
+                    depth = sum(len(v) for v in self._queue.values()) \
+                        + sum(len(v) for v in self._bg_queue.values())
+                    if depth >= policy.queue_limit and background:
+                        self._count("shed")
+                        self._count("background_shed")
+                        post_events.append(("serve.shed", {
+                            "request_id": pending.request_id,
+                            "bucket": label,
+                            "reason": "background_queue_full",
+                            "queue_depth": depth}))
+                        fail = resilience.ShedError(
+                            f"queue full ({depth}/{policy.queue_limit}) "
+                            f"— background request {pending.request_id} "
+                            "shed", request_id=pending.request_id,
+                            bucket=label)
+                    elif depth >= policy.queue_limit and self._bg_queue:
+                        bg_key = min(
+                            (k for k, es in self._bg_queue.items() if es),
+                            key=lambda k: self._bg_queue[k][0][3],
+                            default=None)
+                        if bg_key is not None:
+                            evicted = self._bg_queue[bg_key].pop(0)
+                            if not self._bg_queue[bg_key]:
+                                del self._bg_queue[bg_key]
+                            self._count("shed")
+                            self._count("background_shed")
+                            post_events.append(("serve.shed", {
+                                "request_id": evicted[0].request_id,
+                                "bucket": bg_key.label(),
+                                "reason": "background_evicted",
+                                "queue_depth": depth}))
+                    elif depth >= policy.queue_limit:
                         if policy.shed_policy == "reject-newest":
                             self._count("shed")
                             post_events.append(("serve.shed", {
@@ -998,16 +1067,22 @@ class ServeEngine:
                         # never journaled — it was never acknowledged.
                         pending._journal = self.journal
                         self.journal.submitted(pending.request_id, cfg)
-                    self._queue.setdefault(key, []).append(
+                    qmap = self._bg_queue if background else self._queue
+                    qmap.setdefault(key, []).append(
                         (pending, cfg, traced, now, deadline_t))
+                    if background:
+                        self._count("background_requests")
                     self._cond.notify()
         for etype, payload in post_events:
             self._emit(etype, payload)
         if evicted is not None:
             ev_pending = evicted[0]
+            how = ("shed first as background"
+                   if ev_pending._priority == "background"
+                   else "evicted by reject-oldest")
             ev_pending._resolve(error=resilience.ShedError(
-                f"request {ev_pending.request_id} evicted by reject-oldest "
-                "under queue pressure", request_id=ev_pending.request_id))
+                f"request {ev_pending.request_id} {how} under queue "
+                "pressure", request_id=ev_pending.request_id))
         if fail is not None:
             raise fail
         if self.flight is not None:
@@ -1047,12 +1122,16 @@ class ServeEngine:
         leftovers = []
         with self._lock:
             self._running = False
-            for key in sorted(self._queue, key=lambda k: k.label()):
-                entries = self._queue[key]
-                while entries:
-                    leftovers.append((key, entries[:self.max_batch]))
-                    del entries[:self.max_batch]
-            self._queue.clear()
+            # Foreground drains before background — same precedence as
+            # live scheduling, so a drain cannot delay an acknowledged
+            # foreground request behind soak work.
+            for qmap in (self._queue, self._bg_queue):
+                for key in sorted(qmap, key=lambda k: k.label()):
+                    entries = qmap[key]
+                    while entries:
+                        leftovers.append((key, entries[:self.max_batch]))
+                        del entries[:self.max_batch]
+                qmap.clear()
         if self._preempt.is_set():
             self._flight_trip(
                 "sigterm.drain",
@@ -1107,6 +1186,52 @@ class ServeEngine:
                     self._cond.release()
 
         return signal.signal(signal.SIGTERM, _notice)
+
+    # -- background tenancy ------------------------------------------------
+
+    def attach_background(self, tenant) -> None:
+        """Attach a cooperative background tenant (the falsification
+        fleet's serve-idle mode). Protocol:
+
+        - ``tenant.next_unit() -> callable | None`` — one unit of
+          background work (roughly one candidate batch), or None when
+          the tenant is idle. Called only while the foreground tier is
+          fully idle (no runnable batch, empty queue) and no queued
+          background batch is ready.
+        - ``tenant.on_preempt(queue_depth) -> None`` — a pulled unit
+          was DROPPED un-run because foreground work arrived between
+          the pull and the dispatch.
+
+        Units must be idempotent offers: the scheduler may drop one
+        without running it (the tenant re-derives the same work next
+        pull). A tenant whose ``next_unit``/unit raises is detached —
+        a broken tenant must not crash the scheduler and strand
+        foreground requests. Pass None to detach explicitly."""
+        with self._cond:
+            self._bg_tenant = tenant
+            self._cond.notify()
+
+    def _scan_bg_queue(self, now: float):
+        """Under ``self._lock``: pop at most ONE flush-ready background
+        batch (full, or oldest member past ``flush_deadline_s``) —
+        one-per-pass is the yield guarantee: between any two background
+        dispatches the scheduler re-scans the foreground tier. Returns
+        ``(batch_or_None, next_deadline)``."""
+        next_deadline = None
+        for key, entries in self._bg_queue.items():
+            if len(entries) >= self.max_batch:
+                batch = entries[:self.max_batch]
+                del entries[:self.max_batch]
+                return (key, batch), None
+            if entries:
+                deadline = entries[0][3] + self.flush_deadline_s
+                if deadline <= now:
+                    batch = entries[:]
+                    entries.clear()
+                    return (key, batch), None
+                if next_deadline is None or deadline < next_deadline:
+                    next_deadline = deadline
+        return None, next_deadline
 
     # -- scheduler ---------------------------------------------------------
 
@@ -1167,6 +1292,8 @@ class ServeEngine:
         while True:
             transition = None
             preempted = False
+            bg_batch = None
+            want_tenant = False
             with self._cond:
                 if not self._running:
                     return
@@ -1175,7 +1302,21 @@ class ServeEngine:
                     now = self.tracer.now()  # same clock as enqueue
                     transition = self._update_degrade(now)
                     to_run, next_deadline = self._scan_queue(now)
-                    if not to_run and transition is None:
+                    # Background dispatches only from a fully idle
+                    # foreground tier: no runnable batch AND an empty
+                    # queue (a partial foreground batch waiting on its
+                    # flush deadline still outranks soak work).
+                    fg_idle = not to_run and not any(self._queue.values())
+                    if fg_idle and transition is None:
+                        bg_batch, bg_deadline = self._scan_bg_queue(now)
+                        if bg_batch is None and bg_deadline is not None \
+                                and (next_deadline is None
+                                     or bg_deadline < next_deadline):
+                            next_deadline = bg_deadline
+                        want_tenant = bg_batch is None \
+                            and self._bg_tenant is not None
+                    if not to_run and transition is None \
+                            and bg_batch is None and not want_tenant:
                         timeout = None if next_deadline is None \
                             else max(next_deadline - now, 1e-3)
                         poll = self._preempt_poll_s
@@ -1197,13 +1338,60 @@ class ServeEngine:
                     "steps_frac": self.fault_policy.degrade_steps_frac})
             for key, batch in to_run:
                 self._execute(key, batch)
+            if bg_batch is not None:
+                key, batch = bg_batch
+                self._count("background_batches")
+                self._execute(key, batch)
+            elif want_tenant:
+                self._run_tenant_unit()
+
+    def _run_tenant_unit(self) -> None:
+        """Pull and run ONE unit of tenant work (scheduler thread,
+        outside every engine lock — tenant code is foreign). The pull
+        and the dispatch re-check the foreground queue in between: a
+        unit pulled just before a foreground arrival is dropped un-run
+        (``on_preempt``), which is the tenant-side half of the yield
+        guarantee. A raising tenant is detached, never re-raised — the
+        crash guard above this loop resolves QUEUED requests, and a
+        broken soak tenant is not worth that blast radius."""
+        tenant = self._bg_tenant
+        if tenant is None:
+            return
+        try:
+            unit = tenant.next_unit()
+        except Exception:
+            self.attach_background(None)
+            return
+        if unit is None:
+            # Tenant idle: park briefly instead of spinning the pull.
+            with self._cond:
+                if self._running:
+                    self._cond.wait(self.flush_deadline_s)
+            return
+        with self._lock:
+            fg_depth = sum(len(v) for v in self._queue.values())
+        if fg_depth > 0:
+            self._count("background_yields")
+            try:
+                tenant.on_preempt(fg_depth)
+            except Exception:
+                self.attach_background(None)
+            return
+        self._count("background_batches")
+        try:
+            unit()
+        except Exception:
+            self.attach_background(None)
 
     def _on_scheduler_crash(self, error: BaseException) -> None:
         with self._cond:
             self._running = False
             leftovers = [entry for entries in self._queue.values()
                          for entry in entries]
+            leftovers += [entry for entries in self._bg_queue.values()
+                          for entry in entries]
             self._queue.clear()
+            self._bg_queue.clear()
         for pending, *_ in leftovers:
             pending._resolve(error=resilience.SchedulerCrashed(
                 f"scheduler thread crashed: {type(error).__name__}: {error}",

@@ -149,6 +149,16 @@ def request_signature(cfg) -> str:
 
 SHED_POLICIES = ("reject-newest", "reject-oldest")
 
+#: Two-class admission tier. ``foreground`` is the SLO class: it owns
+#: the queue watermarks (degrade triggers count foreground depth only)
+#: and the batch scheduler's attention. ``background`` is the soak
+#: class (the falsification fleet): admitted only into its own queue,
+#: shed FIRST under foreground queue pressure, dispatched at most one
+#: batch per scheduler pass and only while no foreground work is
+#: runnable — so a foreground arrival packs within one flush deadline
+#: regardless of how saturated the background queue is.
+PRIORITIES = ("foreground", "background")
+
 
 @dataclasses.dataclass(frozen=True)
 class FaultPolicy:

@@ -19,7 +19,10 @@ CLI: ``python -m cbf_tpu verify`` (exit 3 = violation found). Bench:
 """
 
 from cbf_tpu.verify.corpus import (append_entry, check_replay, entry_from,
-                                   load_entries, replay_corpus, replay_entry)
+                                   load_entries, near_miss_entry,
+                                   replay_corpus, replay_entry)
+from cbf_tpu.verify.fleet import (FleetResult, FleetSettings,
+                                  FalsificationFleet, run_fleet)
 from cbf_tpu.verify.properties import (DIFFERENTIABLE_PROPERTIES,
                                        PROPERTY_NAMES, Margins,
                                        PropertyThresholds, rollout_margins,
@@ -28,15 +31,19 @@ from cbf_tpu.verify.search import (ENGINES, Adapter, SearchResult,
                                    SearchSettings, cem_search, falsify,
                                    gradient_search, make_adapter,
                                    make_eval_batch, make_eval_one,
-                                   random_search)
-from cbf_tpu.verify.shrink import ShrinkResult, enable_x64_ctx, shrink
+                                   random_search, reset_campaign_state)
+from cbf_tpu.verify.shrink import (ShrinkResult, enable_x64_ctx,
+                                   measure_margin_x64, shrink)
 
 __all__ = [
-    "Adapter", "DIFFERENTIABLE_PROPERTIES", "ENGINES", "Margins",
+    "Adapter", "DIFFERENTIABLE_PROPERTIES", "ENGINES",
+    "FalsificationFleet", "FleetResult", "FleetSettings", "Margins",
     "PROPERTY_NAMES", "PropertyThresholds", "SearchResult",
     "SearchSettings", "ShrinkResult", "append_entry", "cem_search",
     "check_replay", "enable_x64_ctx", "entry_from", "falsify",
     "gradient_search", "load_entries", "make_adapter", "make_eval_batch",
-    "make_eval_one", "random_search", "replay_corpus", "replay_entry",
-    "rollout_margins", "rollout_margins_np", "shrink", "thresholds_for",
+    "make_eval_one", "measure_margin_x64", "near_miss_entry",
+    "random_search", "replay_corpus", "replay_entry",
+    "reset_campaign_state", "rollout_margins", "rollout_margins_np",
+    "run_fleet", "shrink", "thresholds_for",
 ]

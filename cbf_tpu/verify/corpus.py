@@ -131,6 +131,49 @@ def entry_from(scenario: str, cfg, result: ShrinkResult, *, engine: str,
     return entry
 
 
+def near_miss_entry(scenario: str, cfg, delta, *, engine: str,
+                    settings: SearchSettings, property: str,
+                    margin: float, margin_x64: float, steps: int,
+                    cbf=None,
+                    thresholds: PropertyThresholds | None = None) -> dict:
+    """Build one archive entry from a low-margin SURVIVOR — a candidate
+    that came close to a property floor without crossing it. Archived
+    with ``expect="safe"`` and its measured margins, so (a) the replay
+    gate pins that the default config keeps surviving this perturbation
+    (``check_replay`` is unchanged: safe entries must stay
+    non-violating), and (b) the fleet can use it as a mutation seed —
+    the thin edges of the safe set are where violations live."""
+    if not np.isfinite(margin_x64) or margin_x64 < 0:
+        raise ValueError(
+            f"near_miss_entry is for survivors: margin_x64 "
+            f"{margin_x64!r} must be finite and >= 0 (a violator "
+            "belongs in entry_from via shrink)")
+    delta = np.asarray(delta, np.float64)
+    return {
+        "schema": CORPUS_SCHEMA_VERSION,
+        "scenario": scenario,
+        "overrides": config_overrides(cfg),
+        "cbf": None if cbf is None else {k: float(v) for k, v in
+                                         cbf._asdict().items()},
+        "thresholds": (_thresholds_dict(thresholds)
+                       if thresholds is not None else {}),
+        "seed": int(settings.seed),
+        "perturb_norm": float(settings.perturb_norm),
+        "engine": engine,
+        "property": property,
+        "delta": delta.tolist(),
+        "scale": 1.0,
+        "steps": int(steps),
+        "earliest_step": None,
+        "margin": float(margin),
+        "margin_x64": float(margin_x64),
+        "confirmed_x64": False,
+        "expect": "safe",
+        "git_sha": _git_sha(),
+        "created": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+    }
+
+
 def corpus_path(dir_or_file: str) -> str:
     if os.path.isdir(dir_or_file) or not dir_or_file.endswith(".jsonl"):
         return os.path.join(dir_or_file, CORPUS_FILENAME)

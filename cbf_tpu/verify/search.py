@@ -439,17 +439,44 @@ def _worst_per_candidate(margins) -> np.ndarray:
 SEARCH_STATE_SCHEMA_VERSION = 1
 
 
+def _campaign_fields(engine: str, adapter: Adapter,
+                     settings: SearchSettings) -> dict:
+    """The fingerprint's components, JSON-normalized so a dict persisted
+    in one process compares equal to one rebuilt in another."""
+    return json.loads(json.dumps({
+        "engine": engine, "scenario": adapter.scenario,
+        "delta_shape": list(adapter.delta_shape), "steps": adapter.steps,
+        "settings": dataclasses.asdict(settings)},
+        sort_keys=True, default=str))
+
+
+def _fingerprint_of(fields: dict) -> str:
+    blob = json.dumps(fields, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
 def _campaign_fingerprint(engine: str, adapter: Adapter,
                           settings: SearchSettings) -> str:
     """What a persisted campaign is a campaign OF. Resuming under a
     different budget/proposal/scenario would splice incompatible round
     streams, so the fingerprint pins everything that shapes them."""
-    blob = json.dumps({
-        "engine": engine, "scenario": adapter.scenario,
-        "delta_shape": list(adapter.delta_shape), "steps": adapter.steps,
-        "settings": dataclasses.asdict(settings)},
-        sort_keys=True, default=str)
-    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+    return _fingerprint_of(_campaign_fields(engine, adapter, settings))
+
+
+def _diff_fields(persisted: dict, expected: dict, prefix: str = "") -> list:
+    """Dotted paths of fingerprint fields that differ, with both values,
+    so a mismatch error can say WHICH knob moved instead of just that
+    the hash did."""
+    diffs = []
+    for k in sorted(set(persisted) | set(expected)):
+        old, new = persisted.get(k), expected.get(k)
+        if old == new:
+            continue
+        if isinstance(old, dict) and isinstance(new, dict):
+            diffs.extend(_diff_fields(old, new, f"{prefix}{k}."))
+        else:
+            diffs.append(f"{prefix}{k} (persisted {old!r} != {new!r})")
+    return diffs
 
 
 #: npz member carrying the JSON counters blob; everything else in the
@@ -461,8 +488,26 @@ def _state_path(state_dir: str, engine: str) -> str:
     return os.path.join(os.path.abspath(state_dir), f"{engine}_state.npz")
 
 
+def reset_campaign_state(state_dir: str) -> list:
+    """Delete every persisted ``*_state.npz`` campaign file under
+    ``state_dir`` (the --reset-state lever: start over deliberately
+    instead of editing settings back to match a stale fingerprint).
+    Returns the removed paths."""
+    removed = []
+    root = os.path.abspath(state_dir)
+    if not os.path.isdir(root):
+        return removed
+    for name in sorted(os.listdir(root)):
+        if name.endswith("_state.npz"):
+            path = os.path.join(root, name)
+            os.remove(path)
+            removed.append(path)
+    return removed
+
+
 def _save_round_state(state_dir, engine, fingerprint, *, next_round,
-                      evaluated, best, done, extra_arrays=None) -> None:
+                      evaluated, best, done, extra_arrays=None,
+                      fields=None) -> None:
     """Persist one completed round as a SINGLE atomically-replaced npz:
     the counters ride inside the archive (a uint8-encoded JSON member)
     next to the arrays they describe, so a kill can never pair round-r
@@ -473,20 +518,27 @@ def _save_round_state(state_dir, engine, fingerprint, *, next_round,
     if best[1] is not None:
         arrays["best_delta"] = np.asarray(best[1])
         arrays["best_margins"] = np.asarray(best[2])
-    arrays[_COUNTERS_KEY] = np.frombuffer(json.dumps({
+    counters = {
         "schema": SEARCH_STATE_SCHEMA_VERSION, "engine": engine,
         "fingerprint": fingerprint, "next_round": int(next_round),
         "evaluated": int(evaluated),
         "best_margin": None if best[1] is None else float(best[0]),
-        "done": bool(done)}, sort_keys=True).encode(), np.uint8)
+        "done": bool(done)}
+    if fields is not None:
+        counters["fields"] = fields
+    arrays[_COUNTERS_KEY] = np.frombuffer(
+        json.dumps(counters, sort_keys=True).encode(), np.uint8)
     write_npz_atomic(_state_path(state_dir, engine), arrays)
 
 
-def _load_round_state(state_dir: str, engine: str, fingerprint: str):
+def _load_round_state(state_dir: str, engine: str, fingerprint: str,
+                      fields: dict | None = None):
     """(counters, arrays) of a resumable campaign, or None when nothing
     is persisted yet. A fingerprint mismatch raises: silently continuing
     a campaign under different settings would fabricate a round stream
-    no single-run invocation could produce."""
+    no single-run invocation could produce. With ``fields`` (the
+    expected `_campaign_fields`) the error names WHICH field drifted
+    when the persisted state recorded its own."""
     npath = _state_path(state_dir, engine)
     if not os.path.exists(npath):
         return None
@@ -498,22 +550,29 @@ def _load_round_state(state_dir: str, engine: str, fingerprint: str):
             f"search state schema {counters.get('schema')!r} at {npath} "
             f"!= {SEARCH_STATE_SCHEMA_VERSION}")
     if counters.get("fingerprint") != fingerprint:
+        detail = ""
+        persisted = counters.get("fields")
+        if persisted is not None and fields is not None:
+            diffs = _diff_fields(persisted, fields)
+            if diffs:
+                detail = ": " + "; ".join(diffs)
         raise ValueError(
             f"persisted {engine} campaign in {state_dir} was run under "
-            "different settings/scenario (fingerprint mismatch) — refusing "
-            "to splice; use a fresh state dir or the original settings")
+            f"different settings/scenario (fingerprint mismatch{detail}) "
+            "— refusing to splice; use a fresh state dir, the original "
+            "settings, or --reset-state")
     return counters, arrays
 
 
 def _resume_engine_state(state_dir, engine, fingerprint, resume, rounds,
-                         best, evaluated):
+                         best, evaluated, fields=None):
     """Shared resume preamble: returns (first_round, evaluated, best,
     finished, arrays) with ``finished`` True when the persisted campaign
     already completed (violation found or budget exhausted); ``arrays``
     carries engine-specific extras (the CEM proposal mean/std)."""
     if state_dir is None or not resume:
         return 0, evaluated, best, False, {}
-    st = _load_round_state(state_dir, engine, fingerprint)
+    st = _load_round_state(state_dir, engine, fingerprint, fields)
     if st is None:
         return 0, evaluated, best, False, {}
     counters, arrays = st
@@ -544,10 +603,11 @@ def random_search(adapter: Adapter, settings: SearchSettings = SearchSettings(),
     B = settings.batch
     rounds = max(1, -(-settings.budget // B))
     best = (np.inf, None, None)          # (worst margin, delta, margins row)
-    fp = _campaign_fingerprint("random", adapter, settings) \
+    ffields = _campaign_fields("random", adapter, settings) \
         if state_dir is not None else None
+    fp = None if ffields is None else _fingerprint_of(ffields)
     r0, evaluated, best, finished, _ = _resume_engine_state(
-        state_dir, "random", fp, resume, rounds, best, 0)
+        state_dir, "random", fp, resume, rounds, best, 0, ffields)
     if finished:
         result = _result("random", adapter, settings, best[1], best[2],
                          evaluated, r0)
@@ -571,7 +631,7 @@ def random_search(adapter: Adapter, settings: SearchSettings = SearchSettings(),
         if state_dir is not None:
             _save_round_state(state_dir, "random", fp, next_round=r + 1,
                               evaluated=evaluated, best=best,
-                              done=bool(best[0] < 0))
+                              done=bool(best[0] < 0), fields=ffields)
         if best[0] < 0:
             break
     result = _result("random", adapter, settings, best[1], best[2],
@@ -663,10 +723,11 @@ def cem_search(adapter: Adapter, settings: SearchSettings = SearchSettings(),
     key = jax.random.fold_in(jax.random.PRNGKey(settings.seed),
                              _ENGINE_TAG["cem"])
     best = (np.inf, None, None)
-    fp = _campaign_fingerprint("cem", adapter, settings) \
+    ffields = _campaign_fields("cem", adapter, settings) \
         if state_dir is not None else None
+    fp = None if ffields is None else _fingerprint_of(ffields)
     r0, evaluated, best, finished, arrays = _resume_engine_state(
-        state_dir, "cem", fp, resume, rounds, best, 0)
+        state_dir, "cem", fp, resume, rounds, best, 0, ffields)
     if "mean" in arrays:
         mean = jnp.asarray(arrays["mean"], dt_)
         std = jnp.asarray(arrays["std"], dt_)
@@ -702,7 +763,8 @@ def cem_search(adapter: Adapter, settings: SearchSettings = SearchSettings(),
             _save_round_state(state_dir, "cem", fp, next_round=r + 1,
                               evaluated=evaluated, best=best, done=done,
                               extra_arrays={"mean": np.asarray(mean),
-                                            "std": np.asarray(std)})
+                                            "std": np.asarray(std)},
+                              fields=ffields)
         if done:
             break
     result = _result("cem", adapter, settings, best[1], best[2],

@@ -91,6 +91,30 @@ def _rebuild(scenario, cfg, cbf, thresholds, steps, dtype=None) -> Adapter:
                         steps=steps)
 
 
+def measure_margin_x64(scenario: str, cfg, delta, *, cbf=None,
+                       thresholds=None,
+                       settings: SearchSettings = SearchSettings(),
+                       property: str | None = None, steps=None):
+    """(property, margin_f32, margin_x64) of one candidate — the
+    near-miss twin of :func:`shrink`. A low-margin SURVIVOR has nothing
+    to minimize (no violation to bisect toward), but archiving it still
+    wants the double-precision replay so the corpus records a margin
+    that is not a float32 artifact. ``property`` pins which margin to
+    report (default: the thinnest one); ``steps`` overrides the
+    horizon (default: the config's)."""
+    adapter = make_adapter(scenario, cfg, cbf=cbf, thresholds=thresholds,
+                           steps=steps)
+    delta = np.asarray(delta)
+    margins = _margins_at(adapter, settings, delta)
+    pi = (int(np.argmin(margins)) if property is None
+          else PROPERTY_NAMES.index(property))
+    with enable_x64_ctx():
+        a64 = _rebuild(scenario, adapter.cfg, cbf, adapter.thresholds,
+                       adapter.steps, dtype=jnp.float64)
+        m64 = _margins_at(a64, settings, delta.astype(np.float64))
+    return PROPERTY_NAMES[pi], float(margins[pi]), float(m64[pi])
+
+
 def shrink(scenario: str, cfg, delta, *, cbf=None, thresholds=None,
            settings: SearchSettings = SearchSettings(),
            property: str | None = None, bisect_iters: int = 12,

@@ -226,6 +226,10 @@ def test_bench_end_to_end_single_mode_cpu():
     assert "knn_dropped=" in stderr       # truncation diagnostic surfaced
 
 
+# slow: ~8 s subprocess bench; the one-JSON-line output contract stays
+# tier-1 in test_bench_end_to_end_single_mode_cpu — this only adds the
+# BENCH_PROFILE trace-dir capture on top.
+@pytest.mark.slow
 def test_bench_end_to_end_profile_capture_cpu(tmp_path):
     """BENCH_PROFILE must produce a trace directory without disturbing the
     one-JSON-line output contract."""
@@ -454,9 +458,9 @@ def test_bench_gating_skin_in_ensemble_mode():
 
 
 # slow: ~20 s subprocess bench; tier-1 keeps certificate labeling/gating
-# via test_bench_end_to_end_certificate_cpu, ensemble mode via
-# test_bench_end_to_end_ensemble_mode_cpu, and the lever labels via
-# test_bench_certificate_levers_label_record.
+# via test_bench_end_to_end_certificate_cpu and ensemble mode via
+# test_bench_end_to_end_ensemble_mode_cpu; the lever labels share this
+# slow tier in test_bench_certificate_levers_label_record.
 @pytest.mark.slow
 def test_bench_end_to_end_ensemble_certificate_cpu():
     """BENCH_ENSEMBLE=1 + BENCH_CERTIFICATE=1 (advisor r4: the combo was
@@ -470,6 +474,10 @@ def test_bench_end_to_end_ensemble_certificate_cpu():
     assert "certificate max_residual=" in stderr
 
 
+# slow: ~9 s subprocess bench; certificate labeling and the residual
+# gate stay tier-1 in test_bench_end_to_end_certificate_cpu — this is
+# the round-5 lever-label + rejection soak.
+@pytest.mark.slow
 def test_bench_certificate_levers_label_record():
     """BENCH_CERT_SKIN + BENCH_CERT_ITERS/CG (the round-5 certificate
     levers) must reach the config and label the record; they reject

@@ -322,8 +322,15 @@ def test_verify_state_dir_fingerprint_mismatch_exits_2(tmp_path, capsys):
                  "--batch", "4", "--set", "n=9", "--steps", "20",
                  "--state-dir", d]) == 0
     capsys.readouterr()
-    # Same campaign dir, different budget: fail closed.
-    assert main(["verify", "swarm", "--engine", "random", "--budget", "16",
-                 "--batch", "4", "--set", "n=9", "--steps", "20",
-                 "--state-dir", d]) == 2
-    assert "fingerprint" in capsys.readouterr().err
+    # Same campaign dir, different budget: fail closed, and the error
+    # NAMES the drifted field — the operator should not have to diff
+    # two settings dumps by hand.
+    drifted = ["verify", "swarm", "--engine", "random", "--budget", "16",
+               "--batch", "4", "--set", "n=9", "--steps", "20",
+               "--state-dir", d]
+    assert main(drifted) == 2
+    err = capsys.readouterr().err
+    assert "fingerprint" in err and "settings.budget" in err
+    # --reset-state is the sanctioned recovery: wipe and start fresh.
+    assert main([*drifted, "--reset-state"]) == 0
+    assert "reset" in capsys.readouterr().out

@@ -242,7 +242,8 @@ def test_double_fast_obstacles_recover_and_surface_infeasibility():
 
 
 # slow: ~12 s; sharded train-step descent stays tier-1 in
-# test_two_layer_training_descends, the mode-aware actuator box in
+# test_parallel's test_train_step_runs_and_descends, the mode-aware
+# actuator box in
 # test_double_accel_is_actuator_bounded, and double sharding parity in
 # test_double_sharded_matches_single_device.
 @pytest.mark.slow

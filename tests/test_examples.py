@@ -33,9 +33,9 @@ def test_cross_and_rescue_compat_runs(tmp_path):
     assert (tmp_path / "v.gif").exists()
 
 
-# slow: ~16 s; test_post_training_safety_floor_holds trains through the
-# same 100-step remat horizon in tier-1 (and asserts the stronger
-# post-training floor), and test_parallel keeps train-step descent.
+# slow: ~16 s; test_parallel's test_train_step_runs_and_descends keeps
+# sharded train-step descent tier-1; the stronger post-training floor
+# shares this slow tier in test_post_training_safety_floor_holds.
 @pytest.mark.slow
 def test_train_safety_params_example_moves_params(tmp_path):
     """The differentiable-training demo gets real gradient signal through
@@ -51,6 +51,11 @@ def test_train_safety_params_example_moves_params(tmp_path):
     assert (tmp_path / "training_loss.csv").exists()
 
 
+# slow: ~15 s; sharded train-step descent stays tier-1 in test_parallel's
+# test_train_step_runs_and_descends, and the certified separation floor
+# under the default params is asserted by every tier-1 certificate
+# rollout — this is the trained-params floor soak (VERDICT r2 #7).
+@pytest.mark.slow
 def test_post_training_safety_floor_holds():
     """Parameters trained over the 100-step remat horizon still produce a
     safe swarm: roll out a fresh scenario under the trained CBF and assert

@@ -1,0 +1,493 @@
+"""Falsification fleet tests (cbf_tpu.verify.fleet + serve tenancy).
+
+The load-bearing pins:
+
+- DETERMINISM: the mutation stream is a pure function of (fleet seed,
+  round, target, dispatch) — same key, same candidates, bit-exact; an
+  offered-but-dropped tenant unit costs nothing, so a preempt-riddled
+  campaign ends bit-identical to an uninterrupted one.
+- COVERAGE ALLOCATION: unvisited cells first, then inverse-margin
+  weighting — the thinnest cell gets the largest share, reproducibly.
+- RESUME: a campaign split across two processes (or killed mid-round)
+  equals the one-shot campaign bit-exactly; a fingerprint mismatch
+  names the offending field instead of silently restarting.
+- TENANCY: the fleet runs as a background tenant of the serve engine —
+  background work is shed first at admission, never outranks a
+  foreground arrival (pull-then-recheck drops the unit un-run), and
+  never triggers degrade.
+
+The expensive ends (SIGKILL subprocess resume, weakened-dmin
+end-to-end detection) are @slow; tier-1 drives everything through one
+tiny shared evaluator.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import jax  # noqa: E402
+
+from cbf_tpu.core.filter import CBFParams  # noqa: E402
+from cbf_tpu.obs import schema  # noqa: E402
+from cbf_tpu.obs.trace import Tracer  # noqa: E402
+from cbf_tpu.scenarios import swarm  # noqa: E402
+from cbf_tpu.serve import ServeEngine, FaultPolicy, ShedError  # noqa: E402
+from cbf_tpu.verify import corpus, fleet as vfleet, search  # noqa: E402
+from cbf_tpu.verify.properties import PROPERTY_NAMES  # noqa: E402
+from cbf_tpu.utils import faults  # noqa: E402
+
+#: Same deliberately weakened filter as test_verify: certified radius
+#: 0.2 -> 0.16 drops the packed-equilibrium floor below the 0.13
+#: separation threshold.
+WEAK_CBF = CBFParams(max_speed=15.0, k=0.0, dmin=0.16)
+#: Horizon just short of the weakened filter's unperturbed violation
+#: onset (~step 148): delta = 0 is safe, only a found perturbation
+#: violates.
+MARGINAL_CFG = swarm.Config(n=16, steps=140, k_neighbors=4, gating="jnp")
+
+
+def _settings(**kw):
+    kw.setdefault("seed", 0)
+    kw.setdefault("batch", 2)
+    kw.setdefault("batches_per_round", 2)
+    kw.setdefault("max_steps", 6)
+    kw.setdefault("generated_count", 0)
+    kw.setdefault("include_rta", False)
+    return vfleet.FleetSettings(**kw)
+
+
+@pytest.fixture(scope="module")
+def tiny_target():
+    """One shared (n=4, t=6, batch=2) evaluator — every tier-1 campaign
+    in this module reuses the same compiled target."""
+    st = _settings()
+    cfg = swarm.Config(n=4, steps=6, k_neighbors=3, gating="jnp")
+    a = search.make_adapter("swarm", cfg)
+    eval_b = search.make_eval_batch(a, vfleet._search_settings(st))
+    return vfleet.FleetTarget("tiny", "swarm", "swarm", a.cfg, None, a,
+                              eval_b)
+
+
+class _Sink:
+    def __init__(self):
+        self.events = []
+
+    def event(self, event_type, payload):
+        self.events.append((event_type, dict(payload)))
+
+    def of(self, event_type):
+        return [p for t, p in self.events if t == event_type]
+
+
+class _Flight:
+    def __init__(self):
+        self.trips = []
+
+    def trip(self, kind, message):
+        self.trips.append((kind, message))
+
+
+def _cli_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+# ------------------------------------------------------------- mutation
+
+def test_mutate_batch_deterministic_and_seedless_bootstrap():
+    key = jax.random.fold_in(jax.random.PRNGKey(0), 7)
+    seeds = [np.full((4, 2), 0.01), np.full((4, 2), -0.02)]
+    a = vfleet.mutate_batch(key, 8, (4, 2), np.float32, 0.02, seeds)
+    b = vfleet.mutate_batch(key, 8, (4, 2), np.float32, 0.02, seeds)
+    assert a.shape == (8, 4, 2) and a.dtype == np.float32
+    assert a.tobytes() == b.tobytes()
+    c = vfleet.mutate_batch(jax.random.fold_in(key, 1), 8, (4, 2),
+                            np.float32, 0.02, seeds)
+    assert a.tobytes() != c.tobytes()
+    # No seeds yet: the bootstrap stream is plain scaled noise from the
+    # first fold_in subkey — exactly reproducible by hand.
+    d = vfleet.mutate_batch(key, 8, (4, 2), np.float32, 0.02, [])
+    noise = np.asarray(jax.random.normal(jax.random.fold_in(key, 0),
+                                         (8, 4, 2), np.float32))
+    np.testing.assert_array_equal(d, 0.02 * noise)
+
+
+def test_mutate_batch_draws_from_seed_pool():
+    """With seeds present, non-fresh operators produce candidates
+    correlated with the pool (flip/scale/jitter of a constant seed stay
+    far from a pure noise draw at this scale)."""
+    key = jax.random.PRNGKey(3)
+    seed = np.full((4, 2), 0.5)
+    out = vfleet.mutate_batch(key, 32, (4, 2), np.float32, 0.001, [seed])
+    # At perturb_scale 1e-3, any candidate with magnitude ~0.5 must have
+    # come through a seeded operator, and a 32-draw with 6 ops hits one.
+    assert np.abs(out).max() > 0.1
+
+
+# ------------------------------------------------------------ allocation
+
+def test_allocate_budget_unvisited_first_then_thinnest():
+    alloc = vfleet.allocate_budget(8, [0, 1, 1], [np.inf, 0.5, 0.01])
+    assert alloc.tolist() == [1, 0, 7]
+    alloc = vfleet.allocate_budget(3, [0, 5, 0], [0.5, 0.001, np.inf])
+    assert alloc.tolist() == [1, 1, 1]
+    alloc = vfleet.allocate_budget(8, [1, 1], [1.0, 0.1])
+    assert alloc.tolist() == [1, 7]
+
+
+def test_allocate_budget_preserves_total_and_is_deterministic():
+    visits = [0, 3, 1, 0, 7]
+    worst = [np.inf, 0.2, -0.01, np.inf, 0.05]
+    a = vfleet.allocate_budget(11, visits, worst)
+    b = vfleet.allocate_budget(11, visits, worst)
+    assert a.sum() == 11 and a.tolist() == b.tolist()
+    # Every unvisited target got its coverage dispatch.
+    assert a[0] >= 1 and a[3] >= 1
+
+
+# ------------------------------------------------------------ validation
+
+def test_settings_and_fleet_validation(tiny_target):
+    with pytest.raises(ValueError, match="batch"):
+        vfleet.FleetSettings(batch=0)
+    with pytest.raises(ValueError, match="near_miss_margin"):
+        vfleet.FleetSettings(near_miss_margin=-0.1)
+    with pytest.raises(ValueError, match="budget_rounds"):
+        vfleet.FalsificationFleet(_settings(), budget_rounds=0,
+                                  targets=[tiny_target])
+    with pytest.raises(ValueError, match="target"):
+        vfleet.FalsificationFleet(_settings(), targets=[])
+
+
+def test_near_miss_entry_rejects_non_survivors():
+    ss = search.SearchSettings(budget=2, batch=2)
+    for bad in (-0.01, float("inf"), float("nan")):
+        with pytest.raises(ValueError, match="survivor"):
+            corpus.near_miss_entry(
+                "swarm", swarm.Config(n=4), np.zeros((4, 2)),
+                engine="fleet", settings=ss, property="separation",
+                margin=0.01, margin_x64=bad, steps=6)
+
+
+# --------------------------------------------------- campaign + resume
+
+def test_campaign_resume_bit_exact_and_fingerprint_names_field(
+        tiny_target, tmp_path):
+    st = _settings()
+    ref = vfleet.run_fleet(st, budget_rounds=4, targets=[tiny_target])
+    assert ref.evaluated == 4 * st.batches_per_round * st.batch
+    assert ref.cells_visited == len(PROPERTY_NAMES)
+
+    sdir = str(tmp_path / "state")
+    part = vfleet.run_fleet(st, budget_rounds=2, targets=[tiny_target],
+                            state_dir=sdir)
+    assert part.rounds == 2 and os.path.exists(part.state_path)
+    full = vfleet.run_fleet(st, budget_rounds=4, targets=[tiny_target],
+                            state_dir=sdir)
+    assert full.rounds == ref.rounds
+    assert full.evaluated == ref.evaluated
+    # Bit-exact across the process split: same float64, not just close.
+    assert full.best_margin == ref.best_margin
+
+    # A drifted setting refuses to resume and NAMES the field.
+    with pytest.raises(ValueError, match=r"settings\.batch"):
+        vfleet.FalsificationFleet(_settings(batch=4),
+                                  targets=[tiny_target], state_dir=sdir)
+
+
+def test_dropped_units_cost_nothing(tiny_target):
+    """The tenant-protocol half of determinism: pull units but run only
+    every other offer (simulating foreground preempts) — the campaign
+    must end bit-identical to the straight run, because a dropped unit
+    never advances campaign state."""
+    st = _settings()
+    ref = vfleet.run_fleet(st, budget_rounds=2, targets=[tiny_target])
+
+    sink = _Sink()
+    f = vfleet.FalsificationFleet(st, budget_rounds=2,
+                                  targets=[tiny_target], telemetry=sink)
+    drop = True
+    while True:
+        unit = f.next_unit()
+        if unit is None:
+            break
+        drop = not drop
+        if drop:
+            f.on_preempt(queue_depth=3)   # offered, dropped un-run
+            continue
+        unit()
+    res = f.result()
+    assert res.evaluated == ref.evaluated
+    assert res.best_margin == ref.best_margin
+    pre = sink.of("fleet.preempt")
+    assert pre and all(p["queue_depth"] == 3 for p in pre)
+    assert set(pre[0]) == set(schema.FLEET_EVENT_FIELDS["fleet.preempt"])
+
+
+def test_fleet_round_events_match_schema(tiny_target):
+    sink = _Sink()
+    res = vfleet.run_fleet(_settings(), budget_rounds=2,
+                           targets=[tiny_target], telemetry=sink)
+    rounds = sink.of("fleet.round")
+    assert len(rounds) == 2
+    for p in rounds:
+        assert set(p) == set(schema.FLEET_EVENT_FIELDS["fleet.round"])
+        json.dumps(p)                     # every value JSON-serializable
+    assert rounds[-1]["evaluated"] == res.evaluated
+    assert rounds[-1]["cells_total"] == res.cells_total
+
+
+# ------------------------------------------------------------- tenancy
+
+def test_background_priority_is_shed_first():
+    """Admission control: over the queue limit, background pays first —
+    a background submit is refused outright, and a foreground submit
+    evicts a queued background entry before the shed policy runs."""
+    sink = _Sink()
+    # A huge flush deadline + partial batches keeps everything queued:
+    # this test exercises ADMISSION only, no executables ever compile.
+    eng = ServeEngine(max_batch=4, bucket_sizes=(16,), horizon_quantum=8,
+                      flush_deadline_s=60.0, telemetry=sink,
+                      tracer=Tracer(enabled=False))
+    eng.fault_policy = FaultPolicy(queue_limit=1)
+    cfg = swarm.Config(n=4, steps=8, gating="jnp")
+    eng.start()
+    try:
+        eng.submit(cfg)                   # foreground fills the limit
+        with pytest.raises(ShedError):
+            eng.submit(cfg, priority="background")
+    finally:
+        eng.stop(drain=False)
+    assert eng.stats["background_shed"] == 1
+    (shed,) = sink.of("serve.shed")
+    assert shed["reason"] == "background_queue_full"
+
+    eng2 = ServeEngine(max_batch=4, bucket_sizes=(16,), horizon_quantum=8,
+                       flush_deadline_s=60.0, telemetry=sink,
+                       tracer=Tracer(enabled=False))
+    eng2.fault_policy = FaultPolicy(queue_limit=1)
+    eng2.start()
+    try:
+        bg = eng2.submit(cfg, priority="background")
+        eng2.submit(cfg)                  # evicts the background entry
+        with pytest.raises(ShedError):
+            bg.result(timeout=1)
+    finally:
+        eng2.stop(drain=False)
+    assert eng2.stats["background_shed"] == 1
+    assert any(p["reason"] == "background_evicted"
+               for p in sink.of("serve.shed"))
+
+
+def test_tenant_yields_to_foreground_arrival():
+    """The yield guarantee end-to-end: a unit pulled just before a
+    foreground arrival is dropped un-run (on_preempt fires with the
+    queue depth), the foreground request completes, and the tenant's
+    work resumes afterwards — without ever tripping degrade."""
+    sink = _Sink()
+    eng = ServeEngine(max_batch=4, bucket_sizes=(4,), horizon_quantum=8,
+                      flush_deadline_s=0.02, telemetry=sink,
+                      tracer=Tracer(enabled=False))
+    cfg = swarm.Config(n=4, steps=8, gating="jnp")
+    eng.prewarm([cfg])
+
+    ran, preempts = [], []
+
+    class Tenant:
+        def __init__(self):
+            self.pend = None
+
+        def next_unit(self):
+            if self.pend is None:
+                # Foreground arrives between the pull and the dispatch:
+                # the engine must drop this unit un-run.
+                self.pend = eng.submit(cfg)
+            return lambda: ran.append(time.monotonic())
+
+        def on_preempt(self, queue_depth):
+            preempts.append(queue_depth)
+
+    tenant = Tenant()
+    eng.start()
+    try:
+        eng.attach_background(tenant)
+        deadline = time.monotonic() + 10
+        while len(ran) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        eng.stop()
+    assert preempts == [1], "first pulled unit must be dropped un-run"
+    assert len(ran) >= 3, "tenant work must resume once foreground drains"
+    assert tenant.pend.result(timeout=0).n == 4
+    assert eng.stats["background_yields"] == 1
+    assert eng.stats["background_batches"] >= 3
+    assert eng.stats["degraded_requests"] == 0
+    assert not sink.of("serve.degrade")
+
+
+def test_fleet_campaign_as_background_tenant(tiny_target):
+    """A real (tiny) campaign driven entirely by the engine's idle
+    capacity ends in the same state as the standalone run."""
+    st = _settings()
+    ref = vfleet.run_fleet(st, budget_rounds=2, targets=[tiny_target])
+    eng = ServeEngine(max_batch=4, bucket_sizes=(16,), horizon_quantum=8,
+                      flush_deadline_s=0.02, tracer=Tracer(enabled=False))
+    eng.start()
+    try:
+        res = vfleet.run_fleet(st, budget_rounds=2, targets=[tiny_target],
+                               engine=eng)
+    finally:
+        eng.stop()
+    assert res.evaluated == ref.evaluated
+    assert res.best_margin == ref.best_margin
+    assert eng.stats["background_batches"] >= 2
+    assert eng._bg_tenant is None, "campaign end must detach the tenant"
+
+
+# -------------------------------------------------------- bench + docs
+
+def test_fleet_bench_axis_flows_through_regression_audit(tmp_path):
+    from scripts.bench_regression import collect_series, compare
+
+    metric = "fleet candidates/hour (swarm N=64, steps=64, batch=16)"
+
+    def round_file(rnd, value):
+        p = tmp_path / f"BENCH_r{rnd}.json"
+        p.write_text(json.dumps({"parsed": {
+            "metric": metric, "unit": "candidates_per_hour",
+            "value": value}}))
+        return (rnd, str(p))
+
+    axis = f"{metric} [candidates_per_hour]"
+    series = collect_series([round_file(1, 1000.0), round_file(2, 990.0)])
+    assert [e["value"] for e in series[axis]] == [1000.0, 990.0]
+    assert compare(series)["axes"][axis]["status"] == "ok"
+    slid = collect_series([round_file(1, 1000.0), round_file(3, 500.0)])
+    verdict = compare(slid)
+    assert verdict["axes"][axis]["status"] == "regressed"
+    assert not verdict["ok"]
+
+
+def test_cli_fleet_settings_set_overrides_dedicated_flags():
+    """`--set <field>=` of a field that also has a dedicated flag
+    (--batch/--seed) must override the flag, not crash FleetSettings
+    with a duplicate kwarg."""
+    from types import SimpleNamespace
+
+    from cbf_tpu.__main__ import _fleet_settings_from_args
+
+    def ns(**kw):
+        base = dict(weaken=[], set=[], perturb_scale=None,
+                    perturb_norm=None, seed=0, batch=16)
+        base.update(kw)
+        return SimpleNamespace(**base)
+
+    s = _fleet_settings_from_args(ns(set=["batch=8", "include_rta=false"],
+                                     batch=4, weaken=["dmin=0.1"]))
+    assert s.batch == 8 and s.include_rta is False
+    assert s.cbf_overrides == (("dmin", 0.1),)
+    assert _fleet_settings_from_args(ns(batch=4)).batch == 4
+    with pytest.raises(SystemExit, match="unknown FleetSettings"):
+        _fleet_settings_from_args(ns(set=["bogus=1"]))
+
+
+def test_docs_cover_fleet_surface():
+    api = open(os.path.join(ROOT, "docs", "API.md")).read()
+    for needle in ("Falsification fleet", "`fleet.round`",
+                   "`fleet.violation`", "`fleet.preempt`", "BENCH_FLEET",
+                   "--budget-rounds", "--serve-idle", "near-miss"):
+        assert needle in api, f"docs/API.md missing {needle!r}"
+    readme = open(os.path.join(ROOT, "README.md")).read()
+    assert "verify fleet" in readme
+
+
+def test_schema_audit_has_no_fleet_gaps():
+    from cbf_tpu.analysis import audits
+
+    findings = [f for f in audits.obs_schema_audit()
+                if "fleet" in f.message.lower()]
+    assert findings == [], [f.message for f in findings]
+
+
+# ------------------------------------------------------------ slow end
+
+@pytest.mark.slow
+def test_fleet_cli_sigkill_resume_bit_exact(tmp_path):
+    """SIGKILL durability, subprocess-for-real: kill the CLI campaign
+    after its first round-state save, resume, and the final record must
+    equal an uninterrupted reference run bit-exactly."""
+    shrink_flags = ["--batch", "4", "--set", "batches_per_round=2",
+                    "--set", "generated_count=0",
+                    "--set", "include_rta=false", "--set", "max_steps=8"]
+
+    def argv(state_dir):
+        return [sys.executable, "-m", "cbf_tpu", "verify", "fleet",
+                "--budget-rounds", "3", "--state-dir", state_dir,
+                "--json", *shrink_flags]
+
+    def record_of(proc_stdout):
+        return json.loads(proc_stdout.strip().splitlines()[-1])
+
+    ref_dir, kill_dir = str(tmp_path / "ref"), str(tmp_path / "kill")
+    ref = subprocess.run(argv(ref_dir), capture_output=True, text=True,
+                         env=_cli_env(), timeout=600)
+    assert ref.returncode == 0, ref.stderr
+    ref_rec = record_of(ref.stdout)
+    assert ref_rec["rounds"] == 3
+
+    state_npz = os.path.join(kill_dir, "fleet_state.npz")
+    rc, killed, _ = faults.run_process_until(
+        argv(kill_dir), lambda _t: os.path.exists(state_npz),
+        poll_s=0.05, timeout_s=300.0, env=_cli_env())
+    assert killed, f"campaign finished (rc={rc}) before the kill armed"
+
+    res = subprocess.run(argv(kill_dir), capture_output=True, text=True,
+                         env=_cli_env(), timeout=600)
+    assert res.returncode == 0, res.stderr
+    rec = record_of(res.stdout)
+    for key in ("rounds", "evaluated", "best_margin", "cells_visited",
+                "near_misses", "violations", "targets"):
+        assert rec[key] == ref_rec[key], key
+
+
+@pytest.mark.slow
+def test_fleet_detects_weakened_dmin_end_to_end(tmp_path):
+    """THE detection pin: the weakened-dmin filter is found by the
+    fleet within a small fixed budget, shrunk, x64-confirmed, archived,
+    and the capsule trips — and the archived entry replays clean."""
+    st = vfleet.FleetSettings(seed=0, batch=8, batches_per_round=2,
+                              perturb_scale=0.04, perturb_norm=0.1,
+                              max_steps=MARGINAL_CFG.steps,
+                              generated_count=0, include_rta=False)
+    a = search.make_adapter("swarm", MARGINAL_CFG, cbf=WEAK_CBF)
+    target = vfleet.FleetTarget(
+        "swarm-weak", "swarm", "swarm", a.cfg, WEAK_CBF, a,
+        search.make_eval_batch(a, vfleet._search_settings(st)))
+    sink, flight = _Sink(), _Flight()
+    res = vfleet.run_fleet(st, budget_rounds=6, targets=[target],
+                           corpus_dir=str(tmp_path), telemetry=sink,
+                           flight=flight)
+    assert res.done and res.violations, "weakened dmin must be found"
+    v = res.violations[0]
+    assert v["confirmed_x64"] and v["margin_x64"] < 0
+    assert v["property"] == "separation"
+    assert v["corpus"] and os.path.exists(v["corpus"])
+    assert flight.trips and flight.trips[0][0] == "fleet.violation"
+    events = sink.of("fleet.violation")
+    assert len(events) == len(res.violations)
+    assert set(events[0]) == set(
+        schema.FLEET_EVENT_FIELDS["fleet.violation"])
+    # The archive is a regression gate, not a log: it must replay.
+    for entry, _, problems in corpus.replay_corpus(str(tmp_path)):
+        assert problems == [], problems

@@ -50,6 +50,10 @@ def _cluster_states(n, rng):
 
 # ------------------------------------------------------------- parity ----
 
+# slow: ~8 s; fused-vs-default certificate parity stays tier-1 at the
+# production shape in test_fused_matches_default_at_n256 — this is the
+# x64 all-pairs SLSQP-oracle bar.
+@pytest.mark.slow
 def test_fused_three_way_parity_n64(x64):
     """3-way parity at N=64: the fused+Chebyshev solve == the existing CG
     solve == the independent SLSQP oracle, on the all-pairs constraint set
@@ -368,6 +372,10 @@ def test_ensemble_lockstep_fused_warm_adaptive():
     assert it.min() < 100
 
 
+# slow: ~8 s; warm-carry bit-exact resume stays tier-1 in
+# test_checkpoint's test_resume_preserves_certificate_warm_state, and
+# the carry-free legality half stays tier-1 below.
+@pytest.mark.slow
 def test_ensemble_warm_resume_round_trip():
     """ADVICE r5 #2: ensemble resume must carry the solver warm-start
     state. A run split at step s (carry returned via with_solver_state and
@@ -409,6 +417,11 @@ def test_ensemble_warm_resume_without_carry_still_sound():
     assert float(np.asarray(mets.certificate_residual).max()) < 1e-4
 
 
+# slow: ~9 s; chunked==monolithic trajectory parity stays tier-1 in
+# test_checkpoint's test_chunked_matches_monolithic, and the per-chunk
+# ensemble host-offload values in test_telemetry's
+# test_heartbeats_bitmatch_ensemble_path.
+@pytest.mark.slow
 def test_ensemble_chunked_metrics_match_unchunked():
     """Tentpole part 3 (ensemble-tax removal): the chunked host-offload
     rollout computes the same trajectory and metrics as the unchunked one

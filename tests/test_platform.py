@@ -105,6 +105,12 @@ def test_goal_layout_twins():
             assert np.all(np.isfinite(out))
 
 
+# slow: ~9 s; the ingredient-layout NumPy twins stay tier-1 above
+# (spawn/goal layout twins), builtin-scenario margin parity in
+# test_antipodal_margins_numpy_parity and test_verify's
+# test_margin_parity_vs_numpy — this is the cross-ingredient margin
+# sweep over three generated specs.
+@pytest.mark.slow
 def test_generated_ingredient_parity():
     """NumPy-twin margin parity across the ingredient axes: for each
     non-default spawn×goal (plus a mixed-dynamics spec), the compiled

@@ -140,7 +140,8 @@ def test_unicycle_validation():
 
 
 # slow: ~10 s; sharded train-step descent stays tier-1 in
-# test_two_layer_training_descends, and the si<->uni trig maps plus
+# test_parallel's test_train_step_runs_and_descends, and the si<->uni
+# trig maps plus
 # wheel-saturation scaling in test_unicycle_wheel_saturation_bounds_motion
 # and test_unicycle_initial_state_laws_match.
 @pytest.mark.slow
