@@ -614,6 +614,18 @@ def _load_requests(path: str):
     return cfgs
 
 
+def _add_continuous_args(parser) -> None:
+    parser.add_argument("--continuous", action="store_true",
+                        help="continuous batching: advance packed "
+                             "batches one chunk at a time so arrivals "
+                             "JOIN free lanes and finished requests "
+                             "LEAVE at chunk boundaries (docs/API.md "
+                             "'Continuous batching')")
+    parser.add_argument("--chunk", type=int, default=16,
+                        help="steps per scheduling chunk in continuous "
+                             "mode (default 16)")
+
+
 def _add_fault_policy_args(parser) -> None:
     """The serving fault-tolerance knobs shared by `serve` and `loadgen`
     (docs/API.md "Fault tolerance"). Defaults mirror
@@ -630,6 +642,12 @@ def _add_fault_policy_args(parser) -> None:
                         choices=("reject-newest", "reject-oldest"),
                         help="what to shed when the bounded queue is "
                              "full (default reject-newest)")
+    parser.add_argument("--queue-bytes-budget", type=int, default=None,
+                        help="bound the predicted device bytes of queued "
+                             "work via the profiled cost model; beyond "
+                             "it, submits shed with reason bytes_budget "
+                             "(fail-open for unpriced shapes; default: "
+                             "unbounded)")
     parser.add_argument("--deadline", type=float, default=None,
                         help="per-request deadline in seconds; expired "
                              "requests fail fast with DeadlineExceeded "
@@ -647,6 +665,8 @@ def _fault_policy_from(args):
 
     return FaultPolicy(max_retries=args.max_retries,
                        queue_limit=args.queue_limit,
+                       queue_bytes_budget=getattr(args, "queue_bytes_budget",
+                                                  None),
                        shed_policy=args.shed_policy,
                        deadline_s=args.deadline,
                        rta_fallback=getattr(args, "rta_fallback", False))
@@ -913,7 +933,8 @@ def cmd_serve(args) -> int:
                          cache_dir=args.cache_dir, telemetry=sink,
                          fault_policy=_fault_policy_from(args),
                          journal=journal_obj, cost_model=cost_model,
-                         flight=flight)
+                         flight=flight, continuous=args.continuous,
+                         chunk_steps=args.chunk)
     exporter = None
     if args.metrics_dir:
         from cbf_tpu.obs import export as obs_export
@@ -965,11 +986,13 @@ def cmd_serve(args) -> int:
     req_errors: dict[str, str] = {}
     t0 = _time.perf_counter()
     try:
-        if args.pace_s is not None:
-            # Paced queue-mode submits: one request at a time with a
+        if args.pace_s is not None or args.continuous:
+            # Queue-mode submits: paced (one request at a time with a
             # fixed inter-arrival gap — the HA harness's traffic shape,
             # where a kill must be able to land BETWEEN acknowledged
-            # requests, not after an all-at-once offline drain.
+            # requests) or continuous (the chunked lane-table scheduler
+            # only exists on the scheduler thread; the offline run()
+            # path would silently drain instead).
             engine.start()
             pendings = []
             try:
@@ -977,7 +1000,7 @@ def cmd_serve(args) -> int:
                     rid = (request_ids[i] if request_ids is not None
                            else None)
                     pendings.append(engine.submit(cfg, request_id=rid))
-                    if args.pace_s > 0:
+                    if args.pace_s:
                         _time.sleep(args.pace_s)
             except FencedError as fe:
                 fenced_err = fe
@@ -1083,7 +1106,7 @@ def cmd_loadgen(args) -> int:
         jax.config.update("jax_platforms", args.platform)
 
     from cbf_tpu.serve import ServeEngine, LoadSpec, build_schedule, \
-        run_loadgen
+        parse_sweep, run_loadgen, sweep_rps
     from cbf_tpu.utils import profiling
 
     try:
@@ -1114,7 +1137,9 @@ def cmd_loadgen(args) -> int:
                          flush_deadline_s=args.flush_deadline,
                          cache_dir=args.cache_dir, telemetry=sink,
                          fault_policy=_fault_policy_from(args),
-                         cost_model=cost_model, flight=flight)
+                         cost_model=cost_model, flight=flight,
+                         continuous=args.continuous,
+                         chunk_steps=args.chunk)
     exporter = None
     if args.metrics_dir:
         from cbf_tpu.obs import export as obs_export
@@ -1132,8 +1157,19 @@ def cmd_loadgen(args) -> int:
     trace_ctx = (profiling.trace(args.xla_trace) if args.xla_trace
                  else contextlib.nullcontext())
     with trace_ctx:
-        report = run_loadgen(engine, spec, telemetry=sink)
-    record = dict(report)
+        if args.sweep_rps:
+            try:
+                grid = parse_sweep(args.sweep_rps)
+            except ValueError as exc:
+                raise SystemExit(f"--sweep-rps: {exc}")
+            sweep = sweep_rps(engine, spec, grid,
+                              slo_p99_s=args.slo_p99, telemetry=sink)
+            report = {"completed": sum(l["completed"]
+                                       for l in sweep["legs"])}
+            record = {"sweep": sweep}
+        else:
+            report = run_loadgen(engine, spec, telemetry=sink)
+            record = dict(report)
     record.update({
         "rps_target": args.rps, "max_batch": args.max_batch,
         "flush_deadline_s": args.flush_deadline,
@@ -1805,6 +1841,7 @@ def main(argv=None) -> int:
     servep.add_argument("--standby-max-wait-s", type=float, default=600.0,
                         help="standby: give up waiting for a takeover "
                              "after this many seconds (default 600)")
+    _add_continuous_args(servep)
     _add_fault_policy_args(servep)
     servep.set_defaults(fn=cmd_serve)
 
@@ -1860,6 +1897,17 @@ def main(argv=None) -> int:
                        help="also write a jax.profiler device trace "
                             "here — device time attributes to the same "
                             "phase names as the host spans")
+    loadp.add_argument("--sweep-rps", default=None, metavar="LO:HI:STEP",
+                       help="sweep offered rps over an inclusive grid "
+                            "(one loadgen leg per point, same seed) and "
+                            "report the knee: the highest swept rps whose "
+                            "latency p99 stays within --slo-p99 "
+                            "(docs/API.md 'Continuous batching')")
+    loadp.add_argument("--slo-p99", type=float, default=1.0,
+                       help="end-to-end latency p99 bound in seconds "
+                            "used by --sweep-rps knee detection "
+                            "(default 1.0)")
+    _add_continuous_args(loadp)
     _add_fault_policy_args(loadp)
     loadp.set_defaults(fn=cmd_loadgen)
 

@@ -281,15 +281,12 @@ class CostModel:
 
     # -- capacity ----------------------------------------------------------
 
-    def fits(self, n: int, mesh=None, *,
-             budget_bytes: int | None = None) -> bool:
-        """Would an ``n``-agent swarm fit one chip's memory? Scales the
-        worst recorded per-agent peak bytes across entries whose label
-        encodes a bucket size (``n<k>-...``). The budget is, in order:
-        the explicit ``budget_bytes``, the first mesh device's
-        ``memory_stats()['bytes_limit']``, or — when neither is known
-        (CPU has no memory_stats) — unbounded (True): an admission
-        helper must fail open, not reject traffic it cannot price."""
+    def predict_peak_bytes(self, n: int) -> int:
+        """Predicted device peak bytes for an ``n``-agent swarm: the
+        worst recorded per-agent peak across entries whose label encodes
+        a bucket size (``n<k>-...``), scaled to ``n``. Returns 0 when
+        nothing is priced yet — callers (the serving engine's
+        bytes-budget admission) treat 0 as unpriced and fail open."""
         per_agent = 0.0
         with self._lock:
             for label, e in self.entries.items():
@@ -299,7 +296,20 @@ class CostModel:
                 digits = label[1:].split("-", 1)[0]
                 if digits.isdigit() and int(digits) > 0:
                     per_agent = max(per_agent, peak / int(digits))
-        if per_agent <= 0:
+        return int(per_agent * int(n))
+
+    def fits(self, n: int, mesh=None, *,
+             budget_bytes: int | None = None) -> bool:
+        """Would an ``n``-agent swarm fit one chip's memory? Scales the
+        worst recorded per-agent peak bytes across entries whose label
+        encodes a bucket size (``n<k>-...``) —
+        :meth:`predict_peak_bytes`. The budget is, in order: the
+        explicit ``budget_bytes``, the first mesh device's
+        ``memory_stats()['bytes_limit']``, or — when neither is known
+        (CPU has no memory_stats) — unbounded (True): an admission
+        helper must fail open, not reject traffic it cannot price."""
+        predicted = self.predict_peak_bytes(n)
+        if predicted <= 0:
             return True                    # nothing priced yet: fail open
         if budget_bytes is None:
             devices = None
@@ -325,7 +335,7 @@ class CostModel:
                     break
         if budget_bytes is None:
             return True
-        return per_agent * int(n) <= budget_bytes
+        return predicted <= budget_bytes
 
     # -- AOT helper --------------------------------------------------------
 

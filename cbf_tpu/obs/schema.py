@@ -144,26 +144,46 @@ VERIFY_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
 #: union to this tuple, every declared type must have a literal emit
 #: site, and every type and field must be documented in docs/API.md.
 SERVE_EVENT_TYPES: tuple[str, ...] = (
-    "request", "serve.span", "serve.retry", "serve.shed",
+    "request", "serve.span", "serve.partial", "serve.retry", "serve.shed",
     "serve.quarantine", "serve.degrade", "serve.scheduler_crash",
     "serve.cost")
 
 SERVE_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
+    # ttfp_s: time from enqueue to the request's FIRST streamed
+    # serve.partial chunk (null in drain mode / when the request
+    # completed within its first chunk advance without a partial).
     "request": ("request_id", "bucket", "n", "steps", "latency_s",
                 "queue_wait_s", "execute_s", "batch_fill", "degraded",
-                "rta_engaged", "min_pairwise_distance", "infeasible_count"),
+                "rta_engaged", "min_pairwise_distance", "infeasible_count",
+                "ttfp_s"),
     "serve.span": ("trace_id", "span_id", "parent_id", "name", "bucket",
                    "t0_s", "dur_s"),
-    # action: "retry" (backoff re-run of the whole batch) | "bisect"
-    # (split to isolate the offender) | "rta_rescue" (single-request
-    # re-run under rta=True after a non-finite unpack); attempt is
-    # 1-based for retries.
+    # Continuous batching: one event per in-flight lane per chunk
+    # boundary — the request's progress (steps done of steps total) and
+    # the StepOutputs-slice aggregates of JUST this chunk's rows
+    # (reduced per the heartbeat laws: min over min_pairwise_distance,
+    # sum over infeasible_count). The slices these aggregates reduce are
+    # byte-identical to the corresponding rows of the resolved result's
+    # StepOutputs (a tier-1 test pins it).
+    "serve.partial": ("request_id", "bucket", "steps_done", "steps_total",
+                      "chunk", "min_pairwise_distance", "infeasible_count"),
+    # action: "retry" (backoff re-run of the whole batch or chunk) |
+    # "bisect" (split to isolate the offender) | "demote" (continuous
+    # mode: a chunk failure exhausted retries, live lanes re-run solo
+    # through the drain path from step 0) | "rta_rescue"
+    # (single-request re-run under rta=True after a non-finite unpack);
+    # attempt is 1-based for retries.
     "serve.retry": ("bucket", "action", "attempt", "batch_size",
                     "backoff_s", "error"),
     # reason: "queue_full" (reject-newest refused the submit) |
     # "oldest_evicted" (reject-oldest made room) | "deadline" (expired
-    # before execute).
-    "serve.shed": ("request_id", "bucket", "reason", "queue_depth"),
+    # before execute) | "bytes_budget" (cost-model admission: the
+    # request's predicted device peak bytes would push the queued total
+    # over FaultPolicy.queue_bytes_budget). predicted_bytes is the cost
+    # model's peak-bytes prediction for the shed request (null when no
+    # cost model is attached or the shape is unpriced).
+    "serve.shed": ("request_id", "bucket", "reason", "queue_depth",
+                   "predicted_bytes"),
     # scope: "request" (signature breaker) | "bucket" (compile breaker);
     # state: "open" on trip, "closed" on recovery; signature is the
     # request signature or the bucket label per scope.
@@ -219,11 +239,15 @@ LOADGEN_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
     # by_scenario: per-scenario-name SLO split for mixed scenario feeds
     # (LoadSpec.scenario_mix) — {scenario: {completed, errors,
     # latency_p50_s/p95_s/p99_s}}.
+    # ttfp_p50_s / ttfp_p95_s / ttfp_p99_s: time-to-first-partial
+    # percentiles over completed requests that streamed at least one
+    # serve.partial (null in drain mode — no partials exist there).
     "loadgen.summary": ("seed", "offered_rps", "achieved_rps", "requests",
                         "completed", "errors", "duration_s",
                         "latency_p50_s", "latency_p95_s", "latency_p99_s",
-                        "queue_wait_p99_s", "execute_p99_s", "by_bucket",
-                        "by_scenario"),
+                        "queue_wait_p99_s", "execute_p99_s",
+                        "ttfp_p50_s", "ttfp_p95_s", "ttfp_p99_s",
+                        "by_bucket", "by_scenario"),
 }
 
 #: The runtime-assurance auditor's events (``cbf_tpu.rta.monitor``):

@@ -801,3 +801,49 @@ def lockstep_traced_rollout(static_cfg: swarm_scenario.Config,
         return jax.vmap(one)(states, traced, steps)
 
     return jax.jit(run, donate_argnums=(0,) if donate_states else ())
+
+
+def lockstep_traced_chunk(static_cfg: swarm_scenario.Config,
+                          chunk: int, *,
+                          cbf: CBFParams | None = None):
+    """The continuous-batching iteration hook: one CHUNK of the lockstep
+    executable above, with a per-lane local clock.
+
+    Where :func:`lockstep_traced_rollout` scans a bucket's full horizon
+    in one call, this program advances every lane ``chunk`` steps from
+    its own local time ``t0`` — the scan counter is ``t0_i + i``, a
+    traced per-lane offset, so ONE compiled program serves every chunk
+    boundary of every horizon of the bucket (the executable is keyed by
+    ``(static_cfg, chunk)`` alone; full-horizon mode needs one program
+    per horizon). The same per-lane horizon MASK applies: a lane whose
+    local time reaches its ``steps`` freezes (carry re-selected
+    unchanged), so lanes at different phases of different horizons — and
+    vacant lanes, encoded as ``steps = 0`` — coexist in one batch.
+    Because the scan body applies the identical per-lane step sequence
+    at the identical global step indices, a lane's outputs are
+    bit-identical whether it joined an in-flight batch at a chunk
+    boundary or ran the same chunks with every other lane vacant — the
+    join/leave correctness contract tests/test_serve_continuous.py pins.
+
+    Returns ``run(states, traced, steps, t0) -> (final_states, outs)``
+    with ``outs`` time axes of length ``chunk`` (the caller slices each
+    lane's live prefix). NOT donating: a failed chunk must be able to
+    retry from the same carry, so the scheduler keeps the input buffers.
+    """
+    step = swarm_scenario.make_step_traced(static_cfg, cbf)
+
+    def run(states, traced, steps, t0):
+        def one(state, traced_i, steps_i, t0_i):
+            def body(st, i):
+                t = t0_i + i
+                new_st, out = step(st, t, traced_i)
+                live = t < steps_i
+                new_st = jax.tree.map(
+                    lambda a, b: jnp.where(live, a, b), new_st, st)
+                return new_st, out
+
+            return lax.scan(body, state, jnp.arange(chunk))
+
+        return jax.vmap(one)(states, traced, steps, t0)
+
+    return jax.jit(run)

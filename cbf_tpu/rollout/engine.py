@@ -233,11 +233,9 @@ def rollout_chunked(step_fn: Callable, state0, steps: int, *,
         # goes through the one donating executable.
         state = jax.tree.map(jnp.copy, state)
     parts = []
-    t0 = start
     label = cost_label or f"rollout-c{chunk}-u{unroll}"
     try:
-        while t0 < steps:
-            n = min(chunk, steps - t0)
+        for t0, n in plan_chunks(start, steps, chunk):
             t_exec = time.perf_counter()
             if cost_model is not None:
                 compiled = cost_model.compile_and_record(
@@ -257,11 +255,11 @@ def rollout_chunked(step_fn: Callable, state0, steps: int, *,
                 cost_model.observe_execute(label,
                                            time.perf_counter() - t_exec)
             parts.append(outs_host)
-            t0 += n
+            t1 = t0 + n
             if durable_hook is not None:
-                durable_hook(t0, state, outs_host)
+                durable_hook(t1, state, outs_host)
             if writer is not None:
-                writer.save(t0, state)
+                writer.save(t1, state)
                 if donate_carry:
                     # Donation barrier: the next chunk donates the carry's
                     # buffers away, and the async save may still be
@@ -276,6 +274,29 @@ def rollout_chunked(step_fn: Callable, state0, steps: int, *,
     # Chunk outputs live on host; the stacked history stays there (a
     # 10k-step trajectory need not fit HBM).
     return state, stack_host_chunks(parts, axis=0), start
+
+
+def plan_chunks(start: int, steps: int, chunk: int,
+                *, pad: bool = False) -> list[tuple[int, int]]:
+    """The chunk-carry plan: ``(t0, n)`` spans covering ``[start,
+    steps)`` in ``chunk``-step segments — the ONE chunking convention,
+    shared by :func:`rollout_chunked` (host chunk loop) and the serving
+    engine's continuous-batching scheduler (`serve.engine`), so the two
+    layers cannot disagree about where chunk boundaries fall.
+
+    ``pad=False`` (the rollout default): the trailing span is trimmed to
+    the remaining steps (a partial final chunk compiles its own
+    executable). ``pad=True`` (the serving lane tables): every span is a
+    full ``chunk`` — the per-lane horizon mask freezes the overhang, so
+    one executable serves every span."""
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    spans = []
+    t0 = start
+    while t0 < steps:
+        spans.append((t0, chunk if pad else min(chunk, steps - t0)))
+        t0 += chunk
+    return spans
 
 
 def stack_host_chunks(parts, axis: int = 0):

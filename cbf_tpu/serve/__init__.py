@@ -14,7 +14,8 @@ from cbf_tpu.serve.buckets import (BucketKey, DEFAULT_BUCKET_SIZES,
                                    bucket_key, bucket_n)
 from cbf_tpu.serve.engine import (PendingRequest, RequestResult, ServeEngine,
                                   configure_compilation_cache)
-from cbf_tpu.serve.loadgen import LoadSpec, build_schedule, run_loadgen
+from cbf_tpu.serve.loadgen import (LoadSpec, build_schedule, parse_sweep,
+                                   run_loadgen, sweep_rps)
 from cbf_tpu.serve.resilience import (CircuitBreaker, DeadlineExceeded,
                                       FaultPolicy, FencedError,
                                       NonFiniteResult, QuarantinedError,
@@ -29,6 +30,6 @@ __all__ = [
     "QuarantinedError", "RecoveryError", "RequestCancelled", "RequestResult",
     "SchedulerCrashed", "ServeEngine", "ServeError", "ShedError",
     "bucket_horizon", "bucket_key", "bucket_n", "build_schedule",
-    "configure_compilation_cache", "is_retryable", "request_signature",
-    "run_loadgen",
+    "configure_compilation_cache", "is_retryable", "parse_sweep",
+    "request_signature", "run_loadgen", "sweep_rps",
 ]

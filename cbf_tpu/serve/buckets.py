@@ -72,6 +72,17 @@ class BucketKey(NamedTuple):
         return lab
 
 
+def chunk_label(static_cfg: swarm.Config, chunk: int) -> str:
+    """Label for a CHUNK executable (continuous batching): one program
+    per (static config, chunk length) shared across ALL horizons of that
+    config — per-lane remaining horizon rides as a traced mask, so the
+    chunk program never splits by horizon the way drain labels
+    (``-t{horizon}-``) do. ``-k{chunk}-`` marks the distinction in
+    counters/manifests."""
+    return BucketKey(static_cfg, chunk).label().replace(
+        f"-t{chunk}-", f"-k{chunk}-", 1)
+
+
 def bucket_n(n: int, sizes: tuple[int, ...] = DEFAULT_BUCKET_SIZES) -> int:
     """Smallest registered bucket size >= n."""
     for s in sorted(sizes):

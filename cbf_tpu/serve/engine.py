@@ -32,6 +32,22 @@ recompile). Every recovery decision emits a schema-versioned telemetry
 event (`serve.retry` / `serve.shed` / `serve.quarantine` /
 `serve.degrade` / `serve.scheduler_crash`) and a registry counter.
 
+Queue mode has two scheduling disciplines. DRAIN (default): a bucket
+flushes into a full-horizon executable and every batch member waits for
+the slowest mate. CONTINUOUS (``continuous=True``): the scheduler
+advances a per-static-config LANE TABLE one CHUNK at a time
+(`parallel.ensemble.lockstep_traced_chunk` — the vmapped twin of
+`rollout.engine.rollout_chunked`, carrying solver warm state across
+chunks), and at every chunk boundary newly-arrived same-config requests
+JOIN free lanes while finished/cancelled/deadline-expired requests
+LEAVE: per-lane remaining horizon rides the traced mask (no recompile —
+ONE chunk executable serves every horizon of a static config) and
+vacant lanes are inert pads (steps 0 freezes them — `serve.pack`).
+Completed lanes resolve immediately instead of waiting for batch-mates;
+in-flight lanes stream `serve.partial` progress events (and raw
+StepOutputs chunk slices via the ``partial_hook`` seam), so clients
+observe time-to-first-result (`RequestResult.ttfp_s`).
+
 The scheduler (queue, deadlines, host clocks) is host-side by
 construction — nothing here runs inside traced scope except the packed
 rollout itself, which is exactly what the TS007/RC003 lint rules assert
@@ -53,7 +69,8 @@ import jax
 
 from cbf_tpu.analysis import lockwitness
 from cbf_tpu.obs import trace as obs_trace
-from cbf_tpu.parallel.ensemble import lockstep_traced_rollout
+from cbf_tpu.parallel.ensemble import (lockstep_traced_chunk,
+                                       lockstep_traced_rollout)
 from cbf_tpu.scenarios import swarm
 from cbf_tpu.serve import buckets as _buckets
 from cbf_tpu.serve import pack as _pack
@@ -63,8 +80,9 @@ from cbf_tpu.utils import profiling
 #: Generic telemetry event types this module emits (AUD001: together
 #: with obs.trace's, must union to obs.schema.SERVE_EVENT_TYPES).
 EMITTED_EVENT_TYPES: tuple[str, ...] = (
-    "request", "serve.retry", "serve.shed", "serve.quarantine",
-    "serve.degrade", "serve.scheduler_crash", "serve.cost")
+    "request", "serve.partial", "serve.retry", "serve.shed",
+    "serve.quarantine", "serve.degrade", "serve.scheduler_crash",
+    "serve.cost")
 
 
 def configure_compilation_cache(cache_dir: str | None = None) -> str | None:
@@ -118,6 +136,107 @@ class RequestResult:
     # with rta_mode > 0) — the request completed, but degraded: some
     # agents rode a fallback rung rather than the nominal filter.
     rta_engaged: bool = False
+    # Time-to-first-partial: submit -> the first streamed serve.partial
+    # chunk. None in drain mode, and for continuous requests that
+    # completed within their first chunk advance (no partial streamed).
+    ttfp_s: float | None = None
+
+
+class _Lane:
+    """One occupied lane's host-side bookkeeping (scheduler-thread
+    state; the device half lives in the table's stacked arrays)."""
+
+    __slots__ = ("pending", "cfg", "traced", "t_enq", "deadline_t",
+                 "t_join", "eff_steps", "parts", "execute_s", "ttfp_s",
+                 "degraded")
+
+    def __init__(self, pending, cfg, traced, t_enq, deadline_t, t_join,
+                 eff_steps, degraded):
+        self.pending = pending
+        self.cfg = cfg
+        self.traced = traced
+        self.t_enq = t_enq
+        self.deadline_t = deadline_t
+        self.t_join = t_join
+        self.eff_steps = eff_steps
+        self.parts: list = []       # per-chunk host StepOutputs slices
+        self.execute_s = 0.0        # accumulated chunk device wall
+        self.ttfp_s: float | None = None
+        self.degraded = degraded
+
+
+class _LaneTable:
+    """One static config's continuous-batching lane table: ``max_batch``
+    device lanes advanced one chunk at a time by ONE shared executable
+    (`parallel.ensemble.lockstep_traced_chunk`). An occupied lane
+    carries a request's state plus its per-lane local clock (``t_np``)
+    and horizon-mask bound (``steps_np``); a vacant lane is an inert pad
+    (steps 0 freezes it at its local t=0 — the `serve.pack` contract),
+    overwritten in place by the next join. All mutation happens on the
+    scheduler thread (or stop()'s finish loop, which runs only after
+    that thread has exited) — the table itself needs no lock."""
+
+    def __init__(self, static_cfg: swarm.Config, chunk: int,
+                 max_batch: int):
+        self.static_cfg = static_cfg
+        self.chunk = chunk
+        self.max_batch = max_batch
+        self.label = _buckets.chunk_label(static_cfg, chunk)
+        self.states = None          # device pytree, batch axis first
+        self.traced: list = [None] * max_batch   # per-slot host dicts
+        self.lanes: list = [None] * max_batch    # per-slot _Lane | None
+        self.steps_np = np.zeros(max_batch, np.int32)
+        self.t_np = np.zeros(max_batch, np.int32)
+
+    def free_lanes(self) -> int:
+        return sum(1 for lane in self.lanes if lane is None)
+
+    def occupied(self) -> bool:
+        return any(lane is not None for lane in self.lanes)
+
+    def live_slots(self) -> list[int]:
+        return [i for i, lane in enumerate(self.lanes)
+                if lane is not None]
+
+    def join(self, key, pending, cfg, traced, t_enq, deadline_t, t_join,
+             eff_steps: int, degraded: bool) -> int:
+        """Scatter one request into the first free lane (chunk-boundary
+        JOIN). The lane's local clock starts at 0 regardless of how far
+        its batch-mates have advanced — vmapped lanes are data-
+        independent, so a joined request's rows are bit-identical to the
+        same config run solo (a tier-1 test pins it)."""
+        slot = self.lanes.index(None)
+        kb = _buckets.BucketKey(self.static_cfg, key.horizon)
+        if self.states is None:
+            self.states = _pack.seed_lane_table(kb, cfg, self.max_batch)
+        else:
+            self.states = _pack.join_lane(
+                self.states, slot, _pack.padded_initial_state(cfg, kb))
+        for i in range(self.max_batch):
+            if self.traced[i] is None:
+                self.traced[i] = dict(traced)
+        self.traced[slot] = dict(traced)
+        self.lanes[slot] = _Lane(pending, cfg, traced, t_enq, deadline_t,
+                                 t_join, eff_steps, degraded)
+        self.steps_np[slot] = eff_steps
+        self.t_np[slot] = 0
+        return slot
+
+    def vacate(self, slot: int) -> None:
+        """Free a lane (LEAVE): zeroing its mask bound makes the chunk
+        executable freeze it, so batch-mates' rows are untouched."""
+        self.lanes[slot] = None
+        self.steps_np[slot] = 0
+        self.t_np[slot] = 0
+
+    def stacked_traced(self) -> dict:
+        """Batched traced-scalar arrays for the chunk call (vacant slots
+        keep their last dict — their lanes are masked off anyway)."""
+        dtype = self.static_cfg.dtype
+        ref = next(t for t in self.traced if t is not None)
+        return {k: np.asarray([t[k] for t in self.traced],
+                              np.int32 if k == "n_active" else dtype)
+                for k in ref}
 
 
 class PendingRequest:
@@ -214,6 +333,15 @@ class ServeEngine:
     deadline-forced partial flush reuses the full-batch program instead
     of compiling a second one.
 
+    ``continuous=True`` switches queue mode to the continuous-batching
+    scheduler (see the module docstring): per-static-config lane tables
+    advance ``chunk_steps`` steps per pass with join/leave at chunk
+    boundaries, ONE chunk executable per static config regardless of
+    horizon, completions resolving immediately, `serve.partial` events
+    (+ the ``partial_hook`` seam) streaming in-flight progress, and
+    `RequestResult.ttfp_s` reporting time-to-first-partial. ``run()``
+    and recovery replay keep the drain discipline either way.
+
     Fault tolerance is governed by ``fault_policy``
     (`serve.resilience.FaultPolicy`; the default is always-on: retries,
     bisection and finite-checking active, admission control and
@@ -230,11 +358,20 @@ class ServeEngine:
                  horizon_quantum: int = _buckets.DEFAULT_HORIZON_QUANTUM,
                  cache_dir: str | None = None, telemetry=None, tracer=None,
                  fault_policy: resilience.FaultPolicy | None = None,
-                 journal=None, cost_model=None, flight=None):
+                 journal=None, cost_model=None, flight=None,
+                 continuous: bool = False, chunk_steps: int = 16):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if chunk_steps < 1:
+            raise ValueError(f"chunk_steps must be >= 1, got {chunk_steps}")
         self.max_batch = max_batch
         self.flush_deadline_s = flush_deadline_s
+        # Continuous batching (queue mode only): advance per-static-
+        # config lane tables one chunk_steps-long chunk at a time with
+        # join/leave at every chunk boundary, instead of draining full-
+        # horizon batches. run() always drains (the caller IS the queue).
+        self.continuous = continuous
+        self.chunk_steps = chunk_steps
         self.bucket_sizes = tuple(bucket_sizes)
         self.horizon_quantum = horizon_quantum
         self.cache_dir = configure_compilation_cache(cache_dir)
@@ -250,6 +387,13 @@ class ServeEngine:
             else resilience.FaultPolicy()
         self.fault_hook = None
         self.degrade_hook = None
+        # Streaming seam (continuous mode): called as
+        # ``partial_hook(request_id, steps_done, outs_slice)`` with each
+        # in-flight lane's raw host StepOutputs chunk slice — the rows a
+        # websocket/grpc streaming layer would forward. The serve.partial
+        # telemetry event carries aggregates of the SAME slice, so the
+        # two views cannot diverge. A raising hook is detached.
+        self.partial_hook = None
         # Write-ahead request journal (durable execution): a path string
         # opens/appends a `durable.journal.RequestJournal` there; a
         # ready-made journal object is used as-is; None (default)
@@ -277,8 +421,16 @@ class ServeEngine:
                       "cancelled": 0, "degraded_requests": 0,
                       "scheduler_crashes": 0, "rta_rescued": 0,
                       "background_requests": 0, "background_batches": 0,
-                      "background_shed": 0, "background_yields": 0}
+                      "background_shed": 0, "background_yields": 0,
+                      "chunks_executed": 0, "lanes_joined": 0,
+                      "lanes_vacated": 0}
         self._execs: dict[_buckets.BucketKey, Any] = {}
+        # Continuous-mode state: chunk executables and lane tables are
+        # keyed by STATIC CONFIG (one chunk program serves every horizon
+        # of it); tables are scheduler-thread-only.
+        self._chunk_execs: dict[swarm.Config, Any] = {}
+        self._tables: dict[swarm.Config, _LaneTable] = {}
+        self._bg_tables: dict[swarm.Config, _LaneTable] = {}
         self._ids = itertools.count()
         self._batch_ids = itertools.count()
         self._lock = lockwitness.make_lock("ServeEngine._lock")
@@ -394,15 +546,89 @@ class ServeEngine:
             record_exec(label, _resource.analyze_compiled(compiled))
         return compiled
 
-    def prewarm(self, configs) -> float:
-        """AOT-compile every bucket the given request configs map to
-        (startup cost paid before traffic; with the persistent cache
-        configured, a later process's prewarm deserializes instead of
-        compiling). Returns — and records — the total prewarm wall."""
+    def _chunk_executable(self, static_cfg: swarm.Config):
+        """Get-or-AOT-compile the static config's CHUNK executable
+        (continuous mode): `lockstep_traced_chunk` at this engine's
+        ``chunk_steps``, shared across every horizon of the config (the
+        per-lane horizon bound is a traced mask). NOT donating — a
+        failed chunk retries from the same carry."""
+        compiled = self._chunk_execs.get(static_cfg)
+        label = _buckets.chunk_label(static_cfg, self.chunk_steps)
+        if compiled is not None:
+            self._bump("compile_hit")
+            profiling.add_event_count(f"serve.executable_hit[{label}]")
+            return compiled
+        self._bump("compile_miss")
+        profiling.add_event_count(f"serve.executable_miss[{label}]")
         t0 = time.perf_counter()
+        fn = lockstep_traced_chunk(static_cfg, self.chunk_steps)
+        key = _buckets.BucketKey(static_cfg, self.chunk_steps)
+        states, traced_b, steps_b = _pack.dummy_batch(key, self.max_batch)
+        t0_b = np.zeros(self.max_batch, np.int32)
+        compiled = fn.lower(states, traced_b, steps_b, t0_b).compile()
+        wall = time.perf_counter() - t0
+        profiling.add_event_count(f"serve.compile_ms[{label}]",
+                                  int(wall * 1000))
+        self._chunk_execs[static_cfg] = compiled
+        if self.cost_model is not None:
+            self.cost_model.record_compile(label, compiled, wall)
+        record_exec = getattr(self.telemetry, "record_executable", None)
+        if record_exec is not None:
+            from cbf_tpu.obs import resource as _resource
+
+            record_exec(label, _resource.analyze_compiled(compiled))
+        return compiled
+
+    def prewarm(self, configs) -> float:
+        """AOT-compile every bucket the given request configs map to AND
+        execute each distinct executable once on a dummy batch (startup
+        cost paid before traffic; with the persistent cache configured,
+        a later process's prewarm deserializes instead of compiling).
+        The dummy execution matters as much as the compile: the first
+        run of a compiled executable pays one-time backend setup
+        (thread-pool spin-up, allocator growth) that, at offered-rate ≈
+        capacity, seeds a backlog the run never drains — prewarm's
+        contract is that the first TRAFFIC request runs at steady-state
+        cost. A continuous engine prewarms CHUNK executables — one per
+        distinct static config, not per horizon. Returns — and
+        records — the total prewarm wall."""
+        t0 = time.perf_counter()
+        warmed: set = set()
         for cfg in configs:
             key, _ = self.bucket_of(cfg)
-            self._executable(key)
+            if self.continuous:
+                compiled = self._chunk_executable(key.static_cfg)
+                exec_key: Any = key.static_cfg
+            else:
+                compiled = self._executable(key)
+                exec_key = key
+            # Warm the per-request PACK path with this exact config:
+            # initial-state construction (spawn, parked pads, structural
+            # carries) and the stack/scatter ops run op-by-op on the
+            # scheduler thread at join/flush time, and their first
+            # execution per shape pays op tracing the executables' AOT
+            # compile never touches — measured as seconds of scheduler
+            # stall on a fresh engine (docs/BENCH_LOG.md Round 16).
+            _, traced = swarm.split_static_traced(cfg)
+            if self.continuous:
+                table = _pack.seed_lane_table(key, cfg, self.max_batch)
+                jax.block_until_ready(_pack.join_lane(
+                    table, 0, _pack.padded_initial_state(cfg, key)))
+            else:
+                jax.block_until_ready(_pack.stack_batch(
+                    key, [cfg], [traced], self.max_batch))
+            if exec_key in warmed:
+                continue
+            warmed.add(exec_key)
+            if self.continuous:
+                ckey = _buckets.BucketKey(key.static_cfg, self.chunk_steps)
+                states, traced_b, steps_b = _pack.dummy_batch(
+                    ckey, self.max_batch)
+                out = compiled(states, traced_b, steps_b,
+                               np.zeros(self.max_batch, np.int32))
+            else:
+                out = compiled(*_pack.dummy_batch(key, self.max_batch))
+            jax.block_until_ready(out)
         self.prewarm_s = round(time.perf_counter() - t0, 3)
         profiling.add_event_count("serve.prewarm_ms",
                                   int(self.prewarm_s * 1000))
@@ -422,7 +648,12 @@ class ServeEngine:
             "bucket_sizes": list(self.bucket_sizes),
             "horizon_quantum": self.horizon_quantum,
             "prewarm_s": self.prewarm_s,
+            "continuous": self.continuous,
+            "chunk_steps": self.chunk_steps,
             "buckets": sorted(k.label() for k in self._execs),
+            "chunk_buckets": sorted(
+                _buckets.chunk_label(c, self.chunk_steps)
+                for c in self._chunk_execs),
             "fault_policy": dataclasses.asdict(self.fault_policy),
             "fault_stats": {k: self.stats[k] for k in (
                 "retries", "bisects", "shed", "deadline_expired",
@@ -430,7 +661,8 @@ class ServeEngine:
                 "degraded_requests", "scheduler_crashes",
                 "rta_rescued", "background_requests",
                 "background_batches", "background_shed",
-                "background_yields")},
+                "background_yields", "chunks_executed",
+                "lanes_joined", "lanes_vacated")},
             "cost_model_drift": (self.cost_model.drift_summary()
                                  if self.cost_model is not None else None),
         }}
@@ -603,7 +835,8 @@ class ServeEngine:
                 self._count("deadline_expired")
                 self._emit("serve.shed", {
                     "request_id": pending.request_id, "bucket": label,
-                    "reason": "deadline", "queue_depth": self._queue_depth()})
+                    "reason": "deadline", "queue_depth": self._queue_depth(),
+                    "predicted_bytes": None})
                 pending._resolve(error=resilience.DeadlineExceeded(
                     f"request {pending.request_id} missed its deadline after "
                     f"{now - t_enq:.3f}s queued", request_id=pending.request_id,
@@ -777,6 +1010,7 @@ class ServeEngine:
                             np.min(outs_i.min_pairwise_distance)),
                         "infeasible_count": int(
                             np.sum(outs_i.infeasible_count)),
+                        "ttfp_s": None,
                     })
                 pending._resolve(result=result)
 
@@ -941,7 +1175,12 @@ class ServeEngine:
         (``fault_policy.queue_limit``) sheds per the policy —
         ``reject-newest`` raises `ShedError`, ``reject-oldest`` evicts
         the globally oldest queued request (ITS handle resolves with
-        `ShedError`) to admit this one. ``deadline_s`` (default: the
+        `ShedError`) to admit this one. With a cost model attached and
+        ``fault_policy.queue_bytes_budget`` set, admission is sized in
+        predicted device bytes instead of counts: the request sheds
+        (always reject-newest) when `CostModel.fits` says its predicted
+        peak bytes exceed the budget's remaining headroom — fail-open
+        when the shape is unpriced. ``deadline_s`` (default: the
         policy's) stamps a deadline after which the request fails fast
         with `DeadlineExceeded` instead of occupying an executor slot.
 
@@ -1009,7 +1248,8 @@ class ServeEngine:
                             "request_id": pending.request_id,
                             "bucket": label,
                             "reason": "background_queue_full",
-                            "queue_depth": depth}))
+                            "queue_depth": depth,
+                            "predicted_bytes": None}))
                         fail = resilience.ShedError(
                             f"queue full ({depth}/{policy.queue_limit}) "
                             f"— background request {pending.request_id} "
@@ -1030,14 +1270,16 @@ class ServeEngine:
                                 "request_id": evicted[0].request_id,
                                 "bucket": bg_key.label(),
                                 "reason": "background_evicted",
-                                "queue_depth": depth}))
+                                "queue_depth": depth,
+                                "predicted_bytes": None}))
                     elif depth >= policy.queue_limit:
                         if policy.shed_policy == "reject-newest":
                             self._count("shed")
                             post_events.append(("serve.shed", {
                                 "request_id": pending.request_id,
                                 "bucket": label, "reason": "queue_full",
-                                "queue_depth": depth}))
+                                "queue_depth": depth,
+                                "predicted_bytes": None}))
                             fail = resilience.ShedError(
                                 f"queue full ({depth}/{policy.queue_limit}) "
                                 f"— request {pending.request_id} shed",
@@ -1056,7 +1298,50 @@ class ServeEngine:
                                 "request_id": evicted[0].request_id,
                                 "bucket": oldest_key.label(),
                                 "reason": "oldest_evicted",
-                                "queue_depth": depth}))
+                                "queue_depth": depth,
+                                "predicted_bytes": None}))
+                if fail is None and policy.queue_bytes_budget is not None \
+                        and self.cost_model is not None:
+                    # Cost-model admission (the PR 11 sizing replacing a
+                    # hand-tuned count bound): shed when the request's
+                    # predicted device peak bytes would push the queued
+                    # total over the budget. FAIL-OPEN on unpriced
+                    # shapes — fits() admits anything the model cannot
+                    # price, and unpriced queued entries count 0 bytes.
+                    # Always reject-newest: eviction cannot free a
+                    # knowable number of bytes when entries may be
+                    # unpriced.
+                    memo: dict[int, int] = {}
+
+                    def _pred(nb: int) -> int:
+                        if nb not in memo:
+                            memo[nb] = self.cost_model.predict_peak_bytes(nb)
+                        return memo[nb]
+
+                    queued_bytes = sum(
+                        _pred(k.n) * len(es)
+                        for qm in (self._queue, self._bg_queue)
+                        for k, es in qm.items() if es)
+                    headroom = max(0, policy.queue_bytes_budget
+                                   - queued_bytes)
+                    if not self.cost_model.fits(key.n,
+                                                budget_bytes=headroom):
+                        depth = sum(len(v) for v in self._queue.values()) \
+                            + sum(len(v) for v in self._bg_queue.values())
+                        self._count("shed")
+                        if background:
+                            self._count("background_shed")
+                        post_events.append(("serve.shed", {
+                            "request_id": pending.request_id,
+                            "bucket": label, "reason": "bytes_budget",
+                            "queue_depth": depth,
+                            "predicted_bytes": _pred(key.n) or None}))
+                        fail = resilience.ShedError(
+                            f"queue bytes budget exhausted "
+                            f"({queued_bytes} + {_pred(key.n)} predicted "
+                            f"> {policy.queue_bytes_budget}) — request "
+                            f"{pending.request_id} shed",
+                            request_id=pending.request_id, bucket=label)
                 if fail is None:
                     pending._engine, pending._key = self, key
                     if self.journal is not None:
@@ -1103,7 +1388,13 @@ class ServeEngine:
             # Join OUTSIDE the lock — the scheduler needs it to exit.
             t.join()
         if drain:
-            self._drain_leftovers()
+            if self.continuous:
+                # Finish through the chunk machinery: a continuous stop
+                # must not compile full-horizon drain executables just
+                # to flush what the lane tables can already finish.
+                self._finish_continuous()
+            else:
+                self._drain_leftovers()
         if self.cost_model is not None:
             # Flush measured execute EWMAs/drift (record_compile saves at
             # compile time, but observations accrue between saves).
@@ -1281,10 +1572,14 @@ class ServeEngine:
 
     def _scheduler_loop(self) -> None:
         """Crash-guarded wrapper: any exception escaping the scheduler
-        body resolves every queued request with `SchedulerCrashed`
-        instead of stranding them forever on a silently dead thread."""
+        body resolves every queued request — and, in continuous mode,
+        every in-flight lane — with `SchedulerCrashed` instead of
+        stranding them forever on a silently dead thread."""
         try:
-            self._scheduler_body()
+            if self.continuous:
+                self._scheduler_body_continuous()
+            else:
+                self._scheduler_body()
         except BaseException as e:   # noqa: BLE001 — the guard IS the point
             self._on_scheduler_crash(e)
 
@@ -1345,6 +1640,445 @@ class ServeEngine:
             elif want_tenant:
                 self._run_tenant_unit()
 
+    # -- continuous batching ----------------------------------------------
+
+    def _scheduler_body_continuous(self) -> None:
+        """The continuous-batching loop. Each pass: (1) under the queue
+        lock, pop joinable foreground entries (deadline-expired ones
+        drop); (2) outside it, scatter the joins into lane tables and
+        advance every occupied foreground table ONE chunk — completions
+        resolve, in-flight lanes stream partials; (3) only when the
+        foreground tier is fully idle, give the background tier one
+        table-chunk or one tenant unit. Preemption granularity is thus
+        one CHUNK: a foreground arrival waits at most one chunk's device
+        wall, never a background rollout's full horizon."""
+        while True:
+            transition = None
+            preempted = False
+            joins, expired = [], []
+            bg_joins, bg_expired = [], []
+            want_tenant = False
+            bg_active = False
+            with self._cond:
+                if not self._running:
+                    return
+                preempted = self._preempt.is_set()
+                if not preempted:
+                    now = self.tracer.now()  # same clock as enqueue
+                    transition = self._update_degrade(now)
+                    joins, expired = self._pop_joinable(
+                        now, self._queue, self._tables)
+                    fg_active = bool(joins) or any(
+                        t.occupied() for t in self._tables.values())
+                    fg_idle = not fg_active \
+                        and not any(self._queue.values())
+                    if fg_idle and transition is None:
+                        bg_joins, bg_expired = self._pop_joinable(
+                            now, self._bg_queue, self._bg_tables)
+                        bg_active = bool(bg_joins) or any(
+                            t.occupied() for t in self._bg_tables.values())
+                        want_tenant = not bg_active \
+                            and self._bg_tenant is not None
+                    if not fg_active and not expired \
+                            and transition is None and not bg_active \
+                            and not bg_expired and not want_tenant:
+                        self._cond.wait(self._preempt_poll_s)
+                        continue
+            if preempted:
+                self._flight_trip(
+                    "sigterm.drain",
+                    "SIGTERM drain (continuous): joining and advancing "
+                    "lanes to resolution")
+                self._finish_continuous()
+                return
+            if transition is not None:
+                state, depth = transition
+                self._emit("serve.degrade", {
+                    "state": state, "queue_depth": depth,
+                    "steps_frac": self.fault_policy.degrade_steps_frac})
+            self._apply_joins(joins, expired, self._tables)
+            advanced = False
+            for scfg, table in list(self._tables.items()):
+                if table.occupied():
+                    self._advance_table(table)
+                    advanced = True
+                if not table.occupied():
+                    self._tables.pop(scfg, None)
+                # Refill between table chunks: lanes this advance just
+                # vacated — and arrivals that landed during its device
+                # wall — join NOW, not a full pass of every other
+                # table's chunk later. Join latency is one table-chunk,
+                # not one pass.
+                with self._cond:
+                    if not self._running:
+                        return
+                    j2, e2 = self._pop_joinable(
+                        self.tracer.now(), self._queue, self._tables)
+                self._apply_joins(j2, e2, self._tables)
+            if advanced:
+                continue
+            # Foreground fully idle this pass: the background tier gets
+            # at most ONE table-chunk (or one tenant unit) before the
+            # foreground queue is re-scanned.
+            self._apply_joins(bg_joins, bg_expired, self._bg_tables)
+            bg_ran = False
+            for scfg, table in list(self._bg_tables.items()):
+                if table.occupied() and not bg_ran:
+                    self._count("background_batches")
+                    self._advance_table(table, background=True)
+                    bg_ran = True
+                if not table.occupied():
+                    self._bg_tables.pop(scfg, None)
+            if not bg_ran and want_tenant:
+                self._run_tenant_unit()
+
+    def _pop_joinable(self, now: float, qmap, tables):
+        """Under ``self._lock``: pop queue entries that can JOIN a free
+        lane of their static config's table (capacity-bounded — an entry
+        with no free lane stays queued for the next chunk boundary).
+        Deadline-expired entries pop unconditionally. Returns
+        ``(joins, expired)``, both lists of ``(key, entry)``."""
+        joins, expired = [], []
+        free: dict = {}
+        for key in sorted(qmap, key=lambda k: k.label()):
+            entries = qmap[key]
+            scfg = key.static_cfg
+            if scfg not in free:
+                table = tables.get(scfg)
+                free[scfg] = self.max_batch if table is None \
+                    else table.free_lanes()
+            while entries:
+                entry = entries[0]
+                if entry[4] is not None and now >= entry[4]:
+                    expired.append((key, entries.pop(0)))
+                    continue
+                if free[scfg] <= 0:
+                    break
+                free[scfg] -= 1
+                joins.append((key, entries.pop(0)))
+            if not entries:
+                del qmap[key]
+        return joins, expired
+
+    def _apply_joins(self, joins, expired, tables) -> None:
+        """Resolve the deadline-expired pops and scatter the joinable
+        ones into lane tables. Device work and journal appends — runs
+        OUTSIDE the queue lock (tables are scheduler-thread state)."""
+        policy = self.fault_policy
+        for key, (pending, _cfg, _tr, t_enq, _d) in expired:
+            now = self.tracer.now()
+            self._count("deadline_expired")
+            self._emit("serve.shed", {
+                "request_id": pending.request_id, "bucket": key.label(),
+                "reason": "deadline", "queue_depth": self._queue_depth(),
+                "predicted_bytes": None})
+            pending._resolve(error=resilience.DeadlineExceeded(
+                f"request {pending.request_id} missed its deadline after "
+                f"{now - t_enq:.3f}s queued",
+                request_id=pending.request_id, bucket=key.label()))
+        if not joins:
+            return
+        by_scfg: dict = {}
+        for key, entry in joins:
+            by_scfg.setdefault(key.static_cfg, []).append((key, entry))
+        for scfg, items in by_scfg.items():
+            label = _buckets.chunk_label(scfg, self.chunk_steps)
+            if self.journal is not None:
+                try:
+                    # Breadcrumb, not a commit point (same as drain's
+                    # packed record): lane assignment is re-derivable.
+                    self.journal.packed(
+                        label, [it[1][0].request_id for it in items])
+                except resilience.FencedError as fe:
+                    # A takeover fenced this epoch mid-join: these
+                    # entries already left the queue, so resolve them
+                    # with the typed fence error (the new owner replays
+                    # them from its own journal epoch).
+                    self._note_fenced(fe)
+                    for _k, (pending, *_rest) in items:
+                        pending._resolve(error=fe)
+                    continue
+            table = tables.get(scfg)
+            if table is None:
+                table = _LaneTable(scfg, self.chunk_steps, self.max_batch)
+                tables[scfg] = table
+            now = self.tracer.now()
+            for key, (pending, cfg, traced, t_enq, deadline_t) in items:
+                eff = cfg.steps
+                degraded = self._degraded
+                if degraded:
+                    # Same lever as drain: the horizon cap rides the
+                    # traced mask, so degradation never recompiles.
+                    cap = max(1, int(round(
+                        key.horizon * policy.degrade_steps_frac)))
+                    eff = min(eff, cap)
+                table.join(key, pending, cfg, traced, t_enq, deadline_t,
+                           now, eff, degraded)
+                self._count("lanes_joined")
+                self.tracer.record("queue_wait", t0_s=t_enq,
+                                   dur_s=now - t_enq,
+                                   trace_id=pending.request_id,
+                                   bucket=label)
+
+    def _vacate(self, table: _LaneTable, slot: int) -> None:
+        table.vacate(slot)
+        self._bump("lanes_vacated")
+
+    def _advance_table(self, table: _LaneTable, *, background=False,
+                       attempt: int = 0) -> None:
+        """Advance one lane table by ONE chunk. Deadline-expired lanes
+        LEAVE first (vacating only zeroes their mask bound — batch-
+        mates' device rows are untouched); the chunk executable then
+        runs over all lanes (vacant ones frozen); each live lane's
+        slice of the chunk lands on host; completed lanes resolve
+        immediately and in-flight lanes stream ``serve.partial``.
+        Failure hands off to `_on_chunk_failure`."""
+        tracer = self.tracer
+        label = table.label
+        now0 = tracer.now()
+        for slot in table.live_slots():
+            lane = table.lanes[slot]
+            if lane.deadline_t is not None and now0 >= lane.deadline_t:
+                self._count("deadline_expired")
+                self._emit("serve.shed", {
+                    "request_id": lane.pending.request_id,
+                    "bucket": label, "reason": "deadline",
+                    "queue_depth": self._queue_depth(),
+                    "predicted_bytes": None})
+                lane.pending._resolve(error=resilience.DeadlineExceeded(
+                    f"request {lane.pending.request_id} missed its "
+                    f"deadline mid-flight after "
+                    f"{now0 - lane.t_enq:.3f}s",
+                    request_id=lane.pending.request_id, bucket=label))
+                self._vacate(table, slot)
+        live = table.live_slots()
+        if not live:
+            return
+        chunk_id = f"c{next(self._batch_ids)}"
+        hook = self.fault_hook
+        hook_key = _buckets.BucketKey(table.static_cfg, table.chunk)
+        hook_entries = [(table.lanes[i].pending, table.lanes[i].cfg,
+                         table.lanes[i].traced, table.lanes[i].t_enq,
+                         table.lanes[i].deadline_t) for i in live]
+        try:
+            if hook is not None:
+                hook(hook_key, hook_entries, attempt, "compile")
+            hit = table.static_cfg in self._chunk_execs
+            with tracer.span("executable_hit" if hit else "compile",
+                             trace_id=chunk_id, bucket=label):
+                compiled = self._chunk_executable(table.static_cfg)
+            with tracer.span("pack", trace_id=chunk_id, bucket=label):
+                traced_b = table.stacked_traced()
+                steps_b = np.array(table.steps_np)
+                t0_b = np.array(table.t_np)
+            if hook is not None:
+                hook(hook_key, hook_entries, attempt, "execute")
+            t0 = time.perf_counter()
+            with tracer.span("execute", trace_id=chunk_id, bucket=label):
+                final_states, outs = compiled(table.states, traced_b,
+                                              steps_b, t0_b)
+                jax.block_until_ready(final_states.x)
+            execute_s = time.perf_counter() - t0
+        except BaseException as e:   # noqa: BLE001 — ladder classifies
+            self._on_chunk_failure(table, attempt, e,
+                                   background=background)
+            return
+        with tracer.span("unpack", trace_id=chunk_id, bucket=label):
+            outs_host = jax.device_get(outs)
+        # The carry crosses the chunk boundary on device (solver warm
+        # state included); only the chunk's outputs come to host.
+        table.states = final_states
+        self._bump("chunks_executed")
+        if self.cost_model is not None:
+            obs = self.cost_model.observe_execute(label, execute_s)
+            cost = self.cost_model.cost_of(label)
+            if obs["drift"] is not None:
+                reg = getattr(self.telemetry, "registry", None)
+                if reg is not None:
+                    reg.gauge("serve.cost_model.drift").set(obs["drift"])
+            self._emit("serve.cost", {
+                "bucket": label, "batch_fill": len(live),
+                "execute_s": round(execute_s, 6),
+                "predicted_s": obs["predicted_s"],
+                "drift": (None if obs["drift"] is None
+                          else round(obs["drift"], 6)),
+                "flops": cost.get("flops", 0),
+                "bytes_accessed": cost.get("bytes_accessed", 0),
+                "peak_bytes": cost.get("peak_bytes", 0)})
+        now = tracer.now()
+        fill = len(live)
+        for slot in live:
+            lane = table.lanes[slot]
+            done_before = int(t0_b[slot])
+            k_i = max(0, min(table.chunk, lane.eff_steps - done_before))
+            part = _pack.slice_lane_chunk(outs_host, slot, k_i)
+            lane.parts.append(part)
+            lane.execute_s += execute_s
+            table.t_np[slot] = done_before + table.chunk
+            steps_done = done_before + k_i
+            if self.partial_hook is not None:
+                try:
+                    self.partial_hook(lane.pending.request_id,
+                                      steps_done, part)
+                except Exception:
+                    self.partial_hook = None
+            if steps_done >= lane.eff_steps:
+                self._resolve_lane(table, slot, final_states, fill, now)
+                self._vacate(table, slot)
+            else:
+                if lane.ttfp_s is None:
+                    lane.ttfp_s = round(now - lane.t_enq, 6)
+                self._emit("serve.partial", {
+                    "request_id": lane.pending.request_id,
+                    "bucket": label, "steps_done": steps_done,
+                    "steps_total": lane.eff_steps, "chunk": table.chunk,
+                    "min_pairwise_distance": float(
+                        np.min(part.min_pairwise_distance)),
+                    "infeasible_count": int(
+                        np.sum(part.infeasible_count))})
+
+    def _resolve_lane(self, table: _LaneTable, slot: int, final_states,
+                      fill: int, now: float) -> None:
+        """Resolve one COMPLETED lane: assemble its chunk slices into
+        the request-shaped result (`serve.pack.assemble_lane_result`),
+        finite-check, and resolve the handle — the continuous twin of
+        the drain path's per-slot resolve."""
+        lane = table.lanes[slot]
+        policy = self.fault_policy
+        label = table.label
+        cfg = lane.cfg
+        pending = lane.pending
+        with self.tracer.span("resolve", trace_id=pending.request_id,
+                              bucket=label):
+            final, outs_i = _pack.assemble_lane_result(
+                final_states, lane.parts, slot, cfg.n)
+            if policy.check_finite and not _all_finite(final, outs_i):
+                # Vmapped lanes are independent: only this lane fails.
+                self._count("nonfinite")
+                if policy.rta_fallback and not cfg.rta \
+                        and self._rta_rescue(pending, cfg, label,
+                                             lane.t_enq, lane.t_join):
+                    return
+                self._count("failed")
+                self._record_offender(cfg, label)
+                self._flight_trip(
+                    "serve.nonfinite",
+                    f"request {pending.request_id} unpacked non-finite "
+                    f"state/outputs in lane table {label}", cfg=cfg)
+                pending._resolve(error=resilience.NonFiniteResult(
+                    f"request {pending.request_id} unpacked non-finite "
+                    f"state/outputs in lane table {label}",
+                    request_id=pending.request_id, bucket=label))
+                return
+            self._record_signature_success(cfg, label)
+            rta_ch = outs_i.rta_mode
+            rta_engaged = not isinstance(rta_ch, tuple) \
+                and bool(np.max(np.asarray(rta_ch), initial=0) > 0)
+            result = RequestResult(
+                request_id=pending.request_id, bucket=label, n=cfg.n,
+                steps=lane.eff_steps, final_state=final, outputs=outs_i,
+                latency_s=round(now - lane.t_enq, 6),
+                queue_wait_s=round(lane.t_join - lane.t_enq, 6),
+                execute_s=round(lane.execute_s, 6), batch_fill=fill,
+                degraded=lane.degraded, rta_engaged=rta_engaged,
+                ttfp_s=lane.ttfp_s)
+            self._bump("requests")
+            if lane.degraded:
+                self._count("degraded_requests")
+            if self.telemetry is not None:
+                self.telemetry.event("request", {
+                    "request_id": result.request_id,
+                    "bucket": result.bucket, "n": cfg.n,
+                    "steps": lane.eff_steps,
+                    "latency_s": result.latency_s,
+                    "queue_wait_s": result.queue_wait_s,
+                    "execute_s": result.execute_s,
+                    "batch_fill": result.batch_fill,
+                    "degraded": int(lane.degraded),
+                    "rta_engaged": int(rta_engaged),
+                    "min_pairwise_distance": float(
+                        np.min(outs_i.min_pairwise_distance)),
+                    "infeasible_count": int(
+                        np.sum(outs_i.infeasible_count)),
+                    "ttfp_s": lane.ttfp_s,
+                })
+            pending._resolve(result=result)
+
+    def _on_chunk_failure(self, table: _LaneTable, attempt: int,
+                          error: BaseException, *,
+                          background=False) -> None:
+        """Per-chunk recovery ladder. Transient with budget left ->
+        backoff and re-run the SAME chunk (the executable does not
+        donate, so the carry is intact). Otherwise DEMOTE: every live
+        lane re-runs SOLO from step 0 through the drain path, which
+        owns the bisect-to-offender / quarantine / bucket-breaker
+        machinery — blast radius stays one request, and a poisoned lane
+        cannot wedge the whole table."""
+        policy = self.fault_policy
+        label = table.label
+        live = table.live_slots()
+        if resilience.is_retryable(error) and attempt < policy.max_retries:
+            backoff = policy.backoff_s(attempt, self._rng)
+            self._count("retries")
+            self._emit("serve.retry", {
+                "bucket": label, "action": "retry",
+                "attempt": attempt + 1, "batch_size": len(live),
+                "backoff_s": round(backoff, 4),
+                "error": type(error).__name__})
+            time.sleep(backoff)
+            self._advance_table(table, background=background,
+                                attempt=attempt + 1)
+            return
+        self._emit("serve.retry", {
+            "bucket": label, "action": "demote", "attempt": attempt,
+            "batch_size": len(live), "backoff_s": 0.0,
+            "error": type(error).__name__})
+        now = self.tracer.now()
+        for slot in live:
+            lane = table.lanes[slot]
+            self._vacate(table, slot)
+            try:
+                key, traced = self.bucket_of(lane.cfg)
+            except (ValueError, TypeError) as e:
+                self._count("failed")
+                lane.pending._resolve(error=e)
+                continue
+            self._run_batch(
+                key, [(lane.pending, lane.cfg, traced, lane.t_enq,
+                       lane.deadline_t)],
+                now, attempt=policy.max_retries)
+
+    def _finish_continuous(self) -> None:
+        """Run the continuous machinery to quiescence: keep joining
+        queued requests into lanes and advancing tables until every
+        queue and lane is empty. Normal control flow only — stop()'s
+        caller, or the scheduler thread after a SIGTERM notice. Uses
+        the same chunk executables as live traffic, so a graceful stop
+        never compiles a full-horizon drain program."""
+        while True:
+            with self._cond:
+                self._running = False
+                now = self.tracer.now()
+                joins, expired = self._pop_joinable(
+                    now, self._queue, self._tables)
+                bg_joins, bg_expired = self._pop_joinable(
+                    now, self._bg_queue, self._bg_tables)
+            self._apply_joins(joins, expired, self._tables)
+            self._apply_joins(bg_joins, bg_expired, self._bg_tables)
+            work = False
+            for tables in (self._tables, self._bg_tables):
+                for scfg, table in list(tables.items()):
+                    if table.occupied():
+                        self._advance_table(table)
+                        work = True
+                    if not table.occupied():
+                        tables.pop(scfg, None)
+            with self._lock:
+                queued = any(self._queue.values()) \
+                    or any(self._bg_queue.values())
+            if not work and not queued:
+                return
+
     def _run_tenant_unit(self) -> None:
         """Pull and run ONE unit of tenant work (scheduler thread,
         outside every engine lock — tenant code is foreign). The pull
@@ -1392,6 +2126,14 @@ class ServeEngine:
                           for entry in entries]
             self._queue.clear()
             self._bg_queue.clear()
+            # Continuous mode: in-flight lanes are as stranded as queued
+            # entries — resolve them too.
+            for tables in (self._tables, self._bg_tables):
+                for table in tables.values():
+                    leftovers += [(lane.pending,)
+                                  for lane in table.lanes
+                                  if lane is not None]
+                tables.clear()
         for pending, *_ in leftovers:
             pending._resolve(error=resilience.SchedulerCrashed(
                 f"scheduler thread crashed: {type(error).__name__}: {error}",

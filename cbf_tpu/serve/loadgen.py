@@ -305,6 +305,11 @@ def run_loadgen(engine, spec: LoadSpec, *, telemetry=None,
     lat = sorted(r.latency_s for r in results)
     qwait = sorted(r.queue_wait_s for r in results)
     execu = sorted(r.execute_s for r in results)
+    # Time-to-first-partial: only continuous-mode requests that streamed
+    # at least one serve.partial carry it — percentiles are over that
+    # subset, null in drain mode (no partials exist there).
+    ttfp = sorted(r.ttfp_s for r in results
+                  if getattr(r, "ttfp_s", None) is not None)
     completed = len(results)
     report = {
         "seed": spec.seed,
@@ -324,6 +329,9 @@ def run_loadgen(engine, spec: LoadSpec, *, telemetry=None,
         "queue_wait_p99_s": _quantile(qwait, 0.99),
         "execute_p50_s": _quantile(execu, 0.50),
         "execute_p99_s": _quantile(execu, 0.99),
+        "ttfp_p50_s": _quantile(ttfp, 0.50),
+        "ttfp_p95_s": _quantile(ttfp, 0.95),
+        "ttfp_p99_s": _quantile(ttfp, 0.99),
         "batch_fill_mean": (round(float(np.mean([r.batch_fill
                                                  for r in results])), 2)
                             if results else None),
@@ -346,5 +354,66 @@ def run_loadgen(engine, spec: LoadSpec, *, telemetry=None,
                 "seed", "offered_rps", "achieved_rps", "requests",
                 "completed", "errors", "duration_s", "latency_p50_s",
                 "latency_p95_s", "latency_p99_s", "queue_wait_p99_s",
-                "execute_p99_s", "by_bucket", "by_scenario")})
+                "execute_p99_s", "ttfp_p50_s", "ttfp_p95_s",
+                "ttfp_p99_s", "by_bucket", "by_scenario")})
     return report
+
+
+def parse_sweep(arg: str) -> list[float]:
+    """Parse a ``lo:hi:step`` sweep directive into the inclusive rps
+    grid it denotes (endpoint included when the step lands on it)."""
+    parts = arg.split(":")
+    if len(parts) != 3:
+        raise ValueError(f"sweep must be lo:hi:step, got {arg!r}")
+    lo, hi, step = (float(p) for p in parts)
+    if lo <= 0 or hi < lo or step <= 0:
+        raise ValueError(f"need 0 < lo <= hi and step > 0, got {arg!r}")
+    grid = []
+    r = lo
+    while r <= hi + 1e-9:
+        grid.append(round(r, 6))
+        r += step
+    return grid
+
+
+def sweep_rps(engine, spec: LoadSpec, rps_grid, *, slo_p99_s: float,
+              telemetry=None, result_timeout_s: float = 300.0) -> dict:
+    """Sweep offered rps over ``rps_grid`` (one :func:`run_loadgen` leg
+    per point, same seed/shape — only the rate varies) and find the
+    KNEE: the first offered rps whose end-to-end latency p99 exceeds
+    ``slo_p99_s``. ``knee_rps`` is the last rps BEFORE that point — the
+    highest swept rate still inside the SLO (0.0 when even the first
+    point violates; the top of the grid, censored, when none does —
+    ``knee_censored`` says which). Emits one ``loadgen.summary`` per
+    leg when ``telemetry`` is given; returns ``{legs, knee_rps,
+    knee_censored, slo_p99_s}`` with per-leg rows for the table."""
+    legs = []
+    knee_rps: float = 0.0
+    knee_censored = True
+    violated = False
+    for rps in rps_grid:
+        leg_spec = dataclasses.replace(spec, rps=float(rps))
+        report = run_loadgen(engine, leg_spec, telemetry=telemetry,
+                             result_timeout_s=result_timeout_s)
+        p99 = report["latency_p99_s"]
+        ok = p99 is not None and p99 <= slo_p99_s
+        legs.append({
+            "rps": float(rps),
+            "achieved_rps": report["achieved_rps"],
+            "completed": report["completed"],
+            "errors": report["errors"],
+            "latency_p50_s": report["latency_p50_s"],
+            "latency_p99_s": p99,
+            "queue_wait_p99_s": report["queue_wait_p99_s"],
+            "execute_p99_s": report["execute_p99_s"],
+            "ttfp_p99_s": report["ttfp_p99_s"],
+            "within_slo": ok,
+        })
+        if not violated:
+            if ok:
+                knee_rps = float(rps)
+            else:
+                violated = True
+                knee_censored = False
+    return {"slo_p99_s": slo_p99_s, "legs": legs,
+            "knee_rps": knee_rps, "knee_censored": knee_censored}

@@ -117,6 +117,59 @@ def dummy_batch(key: BucketKey, max_batch: int):
                        [traced] * max_batch, max_batch)
 
 
+def seed_lane_table(key: BucketKey, cfg: swarm.Config, max_batch: int):
+    """Device states for a fresh continuous-batching lane table: the
+    first joining request's padded initial state cloned across all
+    ``max_batch`` lanes. Clones beyond the joiner's slot are VACANT —
+    the scheduler hands them to the chunk executable with ``steps = 0``,
+    so the horizon mask freezes them at their local t=0 (the same
+    inert-pad contract `stack_batch` uses for partial drain batches);
+    a later join overwrites a vacant slot via :func:`join_lane`."""
+    state = padded_initial_state(cfg, key)
+    return jax.tree.map(
+        lambda a: jnp.stack([a] * max_batch), state)
+
+
+def join_lane(states, slot: int, state):
+    """Scatter one request's padded initial state into lane ``slot`` of
+    the table's stacked device states (chunk-boundary JOIN). Pure
+    functional update — the previous table states stay alive until the
+    next chunk consumes the new ones (the chunk executable does not
+    donate, so a failed chunk can retry from the same carry)."""
+    return jax.tree.map(lambda S, s: S.at[slot].set(s), states, state)
+
+
+def slice_lane_chunk(outs_host, slot: int, done: int):
+    """One lane's live rows of a host-offloaded chunk output pytree:
+    time axes cut to ``done`` (the steps this lane actually executed
+    this chunk — rows past it are frozen repeats), batch axis indexed
+    away. The streamed `serve.partial` aggregates and the final
+    assembled StepOutputs both come from these same slices, so they
+    bit-match by construction."""
+    return jax.tree.map(lambda a: np.asarray(a[slot][:done]), outs_host)
+
+
+def assemble_lane_result(final_states, parts, slot: int, n_active: int):
+    """One lane's (final_state, outputs) at request shapes: the per-chunk
+    host slices concatenated along the time axis (the ONE chunked
+    stacking convention — `rollout.engine.stack_host_chunks`), the
+    trajectory's agent axis trimmed to the request's true ``n_active``,
+    and the final state's agent rows likewise (structural carries are
+    internal and dropped). The chunked twin of :func:`trim_result`."""
+    from cbf_tpu.rollout.engine import stack_host_chunks
+
+    outs_b = stack_host_chunks(parts, axis=0)
+    if not isinstance(outs_b.trajectory, tuple):
+        outs_b = outs_b._replace(
+            trajectory=outs_b.trajectory[:, :n_active])
+    final_b = jax.tree.map(lambda a: np.asarray(a[slot]), final_states)
+    theta = (final_b.theta[:n_active]
+             if not isinstance(final_b.theta, tuple) else ())
+    final = swarm.State(x=final_b.x[:n_active], v=final_b.v[:n_active],
+                        theta=theta)
+    return final, outs_b
+
+
 def trim_result(final_states, outs, slot: int, n_active: int, steps: int):
     """Extract one request's (final_state, outputs) from the batch, on
     host, trimmed to its true agent count and horizon: StepOutputs time

@@ -175,9 +175,15 @@ class FaultPolicy:
     count across buckets; a submit beyond it sheds per ``shed_policy``
     (``reject-newest``: the new request is refused with
     :class:`ShedError`; ``reject-oldest``: the globally oldest queued
-    request is evicted to make room). ``deadline_s`` is the default
-    per-request deadline (None = none; ``submit(deadline_s=...)``
-    overrides per request).
+    request is evicted to make room). ``queue_bytes_budget`` is the
+    cost-model upgrade of the same bound: the engine predicts each
+    request's device peak bytes (``CostModel.predict_peak_bytes``) and
+    sheds when admitting would push the queue's predicted total over
+    the budget — FAIL-OPEN when the cost model has no priced ancestor
+    for the request's shape (an unpriced request counts 0 bytes), so a
+    cold ledger never blocks traffic. Both bounds may be active; either
+    sheds. ``deadline_s`` is the default per-request deadline (None =
+    none; ``submit(deadline_s=...)`` overrides per request).
 
     Quarantine: a request signature accumulating
     ``quarantine_threshold`` execution failures opens its breaker for
@@ -213,6 +219,7 @@ class FaultPolicy:
     backoff_jitter: float = 0.5
     seed: int = 0
     queue_limit: int | None = None
+    queue_bytes_budget: int | None = None
     shed_policy: str = "reject-newest"
     deadline_s: float | None = None
     quarantine_threshold: int = 3
@@ -235,6 +242,10 @@ class FaultPolicy:
         if self.queue_limit is not None and self.queue_limit < 1:
             raise ValueError(f"queue_limit must be >= 1 (or None), "
                              f"got {self.queue_limit}")
+        if self.queue_bytes_budget is not None \
+                and self.queue_bytes_budget < 1:
+            raise ValueError(f"queue_bytes_budget must be >= 1 (or None), "
+                             f"got {self.queue_bytes_budget}")
         if self.quarantine_threshold < 1 or self.breaker_threshold < 1:
             raise ValueError("quarantine_threshold and breaker_threshold "
                              "must be >= 1")

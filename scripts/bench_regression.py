@@ -19,7 +19,15 @@ flakiness, docs/BENCH_LOG.md):
   comparison endpoint (a wedged devserver is not a regression).
 
 All bench axes so far are higher-is-better (throughput); the audit
-treats them so. The comparison and parsing logic is pure and
+treats them so. That includes the BENCH_SLO_SWEEP capacity-knee axis
+(PR 16) — ``serve capacity knee, continuous batching (...)`` in
+requests/s, the highest swept offered rate whose end-to-end latency
+p99 still meets the SLO bound, with the drain-mode knee riding along
+in the record's ``knee_rps_drain`` field for the continuous-vs-drain
+comparison. Axes are auto-discovered from each round's ``parsed``
+records, so the sweep axis enrolls the first round it is run; a knee
+slide past tolerance then fails the audit like any throughput slide.
+The comparison and parsing logic is pure and
 unit-tested fast; the repo-level audit runs as a slow-tier test
 (tests/test_obs_resource.py) and ``--write-trajectory`` refreshes
 ``docs/BENCH_TRAJECTORY.json`` so reviews can see the series without

@@ -187,6 +187,12 @@ def test_restore_real_errors_not_masked_by_compat_retry(tmp_path):
     assert "MISSING" not in str(ei.value)
 
 
+# slow: ~13 s; warm-carry ACROSS step boundaries stays tier-1 via
+# test_chunked_matches_monolithic and the serve chunk-boundary
+# bit-identity tests in test_serve_continuous — this is the
+# save/restore round trip of the warm block specifically, and it rides
+# the slow tier with its ensemble twin in test_fused_batched.
+@pytest.mark.slow
 def test_resume_preserves_certificate_warm_state(tmp_path):
     """The warm-start solver carry (State.certificate_solver_state) must
     survive a checkpoint/resume round trip bit-exactly: a resume that

@@ -372,9 +372,11 @@ def test_ensemble_lockstep_fused_warm_adaptive():
     assert it.min() < 100
 
 
-# slow: ~8 s; warm-carry bit-exact resume stays tier-1 in
-# test_checkpoint's test_resume_preserves_certificate_warm_state, and
-# the carry-free legality half stays tier-1 below.
+# slow: ~8 s; warm-carry save/restore rides the slow tier with
+# test_checkpoint's test_resume_preserves_certificate_warm_state
+# (warm carry across step/chunk boundaries stays tier-1 via
+# test_chunked_matches_monolithic and test_serve_continuous), and the
+# carry-free legality half stays tier-1 below.
 @pytest.mark.slow
 def test_ensemble_warm_resume_round_trip():
     """ADVICE r5 #2: ensemble resume must carry the solver warm-start

@@ -225,6 +225,11 @@ def _double_controls(cfg, f, g, cbf, s, obs, mask, *, with_separation):
     return np.asarray(jnp.where(jnp.any(mask, 1)[:, None], u, a0))
 
 
+# slow: ~7 s trajectory sweep; double-mode truncation stays tier-1 via
+# test_double_mode_truncation_worst_case_is_actuator_bounded and the
+# single-state exactness tests above — this samples the same claim
+# along a full compression trajectory.
+@pytest.mark.slow
 def test_double_mode_truncation_exact_on_trajectory():
     """Double mode raises the truncation stakes: its k=1 velocity-weighted
     rows mean the BINDING row of a sign class could be a fast-approaching

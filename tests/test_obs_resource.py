@@ -179,6 +179,12 @@ def test_warm_path_drift_gate_under_50_percent():
 
 # --------------------------------------------- loadgen bucket pricing --
 
+# slow: ~8 s (a full loadgen sweep); cost-model recording at compile
+# and warm-drift tracking stay tier-1 in the model tests above, and
+# the serve-side pricing path is tier-1 via the queue-bytes-budget
+# admission test in test_serve_continuous — this is the every-bucket
+# end-to-end sweep soak.
+@pytest.mark.slow
 def test_loadgen_prices_every_bucket_and_reports_slo_split():
     """Acceptance: a loadgen sweep leaves a cost-model entry for every
     bucket its report saw, with the per-bucket SLO split populated."""

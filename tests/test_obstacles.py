@@ -54,6 +54,10 @@ def test_fast_obstacles_hold_full_floor():
     assert float(np.asarray(outs.max_relax_rounds).max()) >= 1.0
 
 
+# slow: ~7 s; the obstacle floor stays tier-1 via the
+# moderate-obstacles and sharded-parity tests in this file — this is
+# the same contract at ladder scale (more agents, not a distinct law).
+@pytest.mark.slow
 def test_obstacles_at_ladder_scale():
     """Ladder-scale obstacle run. Floor 0.019 = the r09 seeded verify
     sweep's worst perturbed margin (16 candidates in the 0.1 m attack
@@ -280,8 +284,9 @@ def test_checkpoint_resume_in_phase_with_obstacles(tmp_path):
 
 
 # slow: ~12 s 800-step soak; tier-1 keeps the obstacle floor via the
-# moderate-obstacles, ladder-scale, and sharded-parity tests in this file
-# (the soak adds horizon length, not a distinct contract).
+# moderate-obstacles and sharded-parity tests in this file (the
+# ladder-scale twin rides the slow tier above; the soak adds horizon
+# length, not a distinct contract).
 @pytest.mark.slow
 def test_long_horizon_steady_state_recovers_full_floor():
     """Obstacles lapping repeatedly through the packed crowd: after the

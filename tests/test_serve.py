@@ -96,6 +96,12 @@ def test_pack_radius_preserved_through_bucket_padding():
 
 # ------------------------------------------------- padded-bucket parity --
 
+# slow: ~8 s (three solo reference rollouts + the batched run);
+# pad-neutral padding stays tier-1 via test_pads_stay_parked and the
+# continuous-path parity tests in test_serve_continuous (join
+# bit-identity vs solo, vacant lanes inert) — this is the
+# drain-mode three-way heterogeneous parity soak.
+@pytest.mark.slow
 def test_padded_bucket_parity_mixed_batch():
     """Three heterogeneous requests (different n, steps, dt, radius,
     gains) served in ONE bucket executable each reproduce their own
@@ -154,9 +160,10 @@ def test_pads_stay_parked():
 
 
 # slow: ~12 s; pad-neutral bucket padding stays tier-1 in
-# test_padded_bucket_parity_mixed_batch and test_pads_stay_parked, and
-# the certificate residual gate at scale in test_sparse_certificate's
-# tier-1 parity tests — this is the padded joint-QP parity soak.
+# test_pads_stay_parked and test_serve_continuous's parity tests (the
+# mixed-batch soak rides the slow tier above), and the certificate
+# residual gate at scale in test_sparse_certificate's tier-1 parity
+# tests — this is the padded joint-QP parity soak.
 @pytest.mark.slow
 def test_padded_certificate_parity():
     """Certificate bucket: the padded joint QP (decoupled pad variables,

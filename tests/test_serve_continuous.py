@@ -1,0 +1,347 @@
+"""Continuous batching (cbf_tpu.serve.engine, PR 16): chunked lane-table
+scheduling correctness pins.
+
+The load-bearing pins:
+
+- JOIN BIT-IDENTITY: a request that joins a free lane mid-flight of an
+  already-running table resolves bit-identical to the same request run
+  solo — vmap lanes are data-independent and the lane-local clock
+  (t = t0 + i) makes the program invariant to when the lane joined.
+- PARTIAL STREAM FIDELITY: the StepOutputs chunk slices streamed through
+  the ``partial_hook`` seam, concatenated, bit-match the resolved
+  request's post-hoc outputs — clients can act on partials without a
+  reconciliation step.
+- LEAVE BLAST RADIUS: a lane that leaves on a mid-flight deadline frees
+  its slot without perturbing batch-mates — the survivor's result stays
+  bit-identical to its solo run.
+- BYTES-BUDGET ADMISSION (PR 11 cost model replacing the hand-tuned
+  queue count): predicted-peak-bytes sizing, fail-open on unpriced
+  shapes, shed events carrying the prediction.
+"""
+
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import jax  # noqa: E402
+
+from cbf_tpu import obs  # noqa: E402
+from cbf_tpu.obs import schema as obs_schema  # noqa: E402
+from cbf_tpu.scenarios import swarm  # noqa: E402
+from cbf_tpu.serve import (DeadlineExceeded, FaultPolicy,  # noqa: E402
+                           LoadSpec, ServeEngine, ShedError,
+                           build_schedule, parse_sweep, run_loadgen,
+                           sweep_rps)
+
+
+def _cfg(steps=24, seed=0, n=8):
+    return swarm.Config(n=n, steps=steps, seed=seed, gating="jnp")
+
+
+def _leaves(tree):
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
+
+
+def _tree_equal(a, b):
+    la, lb = _leaves(a), _leaves(b)
+    assert len(la) == len(lb)
+    return all(np.array_equal(x, y) for x, y in zip(la, lb))
+
+
+def _wait(predicate, timeout_s=60.0):
+    t0 = time.monotonic()
+    while not predicate():
+        if time.monotonic() - t0 > timeout_s:
+            raise AssertionError("timed out waiting for condition")
+        time.sleep(0.002)
+
+
+# ------------------------------------------------- join / partial pins --
+
+def test_join_midflight_bit_identical_and_partials_match():
+    engine = ServeEngine(max_batch=4, bucket_sizes=(16,),
+                         continuous=True, chunk_steps=8)
+    partials = []   # (request_id, steps_done, outs_slice)
+    plock = threading.Lock()
+
+    def hook(rid, done, sl):
+        with plock:
+            partials.append((rid, done, sl))
+
+    engine.partial_hook = hook
+    engine.prewarm([_cfg()])
+    engine.start()
+    try:
+        solo = engine.submit(_cfg(steps=24, seed=3)).result(timeout=180)
+        assert solo.steps == 24 and solo.n == 8
+        # Chunk labels replace the horizon segment: n16-k8-...
+        assert "-k8-" in solo.bucket
+
+        # A long runner occupies a lane; once its first chunk has
+        # streamed, the same request as `solo` joins a FREE lane of the
+        # live table.
+        p_long = engine.submit(_cfg(steps=512, seed=7))
+        _wait(lambda: any(r == p_long.request_id
+                          for r, _, _ in partials))
+        p_join = engine.submit(_cfg(steps=24, seed=3))
+        joined = p_join.result(timeout=180)
+        # The long runner is still mid-flight: the short request really
+        # did share chunks with it rather than waiting for a drain.
+        assert p_long._result is None
+        long_res = p_long.result(timeout=300)
+        assert long_res.steps == 512
+
+        # JOIN BIT-IDENTITY — not allclose: identical.
+        assert _tree_equal(joined.outputs, solo.outputs)
+        assert np.array_equal(np.asarray(joined.final_state.x),
+                              np.asarray(solo.final_state.x))
+
+        # PARTIAL STREAM FIDELITY for the joined request.
+        with plock:
+            mine = [(d, sl) for r, d, sl in partials
+                    if r == p_join.request_id]
+        assert [d for d, _ in mine] == [8, 16, 24]
+        stitched = [np.concatenate([np.asarray(leaf) for leaf in leaves])
+                    for leaves in zip(*[_leaves(sl) for _, sl in mine])]
+        resolved = _leaves(joined.outputs)
+        assert len(stitched) == len(resolved)
+        for s, r in zip(stitched, resolved):
+            assert np.array_equal(s, r)
+
+        # TTFP: multi-chunk requests carry submit->first-partial.
+        assert joined.ttfp_s is not None
+        assert 0 < joined.ttfp_s <= joined.latency_s
+
+        stats = engine.stats
+        assert stats["lanes_joined"] == 3
+        assert stats["lanes_vacated"] == 3
+        assert stats["chunks_executed"] >= 64    # 512/8 for the long one
+        extra = engine.manifest_extra()["serve"]
+        assert extra["continuous"] is True and extra["chunk_steps"] == 8
+        assert any("-k8-" in lbl for lbl in extra["chunk_buckets"])
+        for k in ("chunks_executed", "lanes_joined", "lanes_vacated"):
+            assert extra["fault_stats"][k] == stats[k]
+    finally:
+        engine.stop()
+
+
+def test_deadline_leave_does_not_perturb_batch_mates():
+    engine = ServeEngine(max_batch=4, bucket_sizes=(16,),
+                         continuous=True, chunk_steps=8)
+    partials = []
+    engine.partial_hook = lambda rid, done, sl: partials.append(rid)
+    engine.prewarm([_cfg()])
+    engine.start()
+    try:
+        solo = engine.submit(_cfg(steps=64, seed=5)).result(timeout=180)
+
+        # The survivor and a doomed lane join the same table; the doomed
+        # one has a horizon it cannot finish before its deadline.
+        p_survivor = engine.submit(_cfg(steps=64, seed=5))
+        p_doomed = engine.submit(_cfg(steps=4096, seed=9),
+                                 deadline_s=0.5)
+        _wait(lambda: p_doomed.request_id in partials)  # it DID fly
+        survivor = p_survivor.result(timeout=180)
+        with pytest.raises(DeadlineExceeded) as ei:
+            p_doomed.result(timeout=180)
+        assert "mid-flight" in str(ei.value)
+
+        # BLAST RADIUS: the batch-mate is untouched by the eviction.
+        assert _tree_equal(survivor.outputs, solo.outputs)
+        assert np.array_equal(np.asarray(survivor.final_state.x),
+                              np.asarray(solo.final_state.x))
+        assert engine.stats["deadline_expired"] >= 1
+        assert engine.stats["lanes_vacated"] == 3
+
+        # The freed lane is reusable: the engine still serves cleanly.
+        again = engine.submit(_cfg(steps=64, seed=5)).result(timeout=180)
+        assert _tree_equal(again.outputs, solo.outputs)
+    finally:
+        engine.stop()
+
+
+# ----------------------------------------------- events / TTFP / sweep --
+
+def test_partial_events_ttfp_report_and_sweep(tmp_path):
+    sink = obs.TelemetrySink(str(tmp_path / "run"))
+    spec = LoadSpec(rps=30.0, duration_s=0.4, seed=0, n_min=8, n_max=16,
+                    steps_choices=(24,))
+    engine = ServeEngine(max_batch=8, bucket_sizes=(16,), telemetry=sink,
+                         continuous=True, chunk_steps=8)
+    engine.prewarm([cfg for _, cfg in build_schedule(spec)])
+    report = run_loadgen(engine, spec, telemetry=sink)
+    assert report["completed"] == report["requests"] > 0
+    assert report["errors"] == 0
+    # 24-step requests advance in 3 chunks: every request streamed.
+    for k in ("ttfp_p50_s", "ttfp_p95_s", "ttfp_p99_s"):
+        assert report[k] is not None and report[k] > 0
+    assert report["ttfp_p50_s"] <= report["ttfp_p99_s"]
+    assert report["ttfp_p99_s"] <= report["latency_p99_s"]
+
+    # Knee sweep on the SAME prewarmed engine: a generous SLO censors
+    # at the grid top; an impossible SLO puts the knee at zero.
+    sweep = sweep_rps(engine, spec, [20.0, 30.0], slo_p99_s=1e9,
+                      telemetry=sink)
+    assert sweep["knee_rps"] == 30.0 and sweep["knee_censored"]
+    assert [leg["rps"] for leg in sweep["legs"]] == [20.0, 30.0]
+    assert all(leg["within_slo"] for leg in sweep["legs"])
+    assert all(leg["ttfp_p99_s"] is not None for leg in sweep["legs"])
+    tight = sweep_rps(engine, spec, [20.0], slo_p99_s=0.0)
+    assert tight["knee_rps"] == 0.0 and not tight["knee_censored"]
+    engine.stop()
+    sink.close()
+
+    events = obs.read_events(str(tmp_path / "run"))
+    meta = {"event", "schema", "t_wall"}
+    parts = [e for e in events if e["event"] == "serve.partial"]
+    assert parts
+    for ev in parts:
+        assert set(ev) - meta == set(
+            obs_schema.SERVE_EVENT_FIELDS["serve.partial"])
+        assert 0 < ev["steps_done"] < ev["steps_total"]
+        assert ev["chunk"] == 8 and "-k8-" in ev["bucket"]
+    reqs = [e for e in events if e["event"] == "request"]
+    assert reqs and all("ttfp_s" in e for e in reqs)
+    assert any(e["ttfp_s"] is not None for e in reqs)
+    summaries = [e for e in events if e["event"] == "loadgen.summary"]
+    # One per run_loadgen call: the direct run + 2 telemetry sweep legs.
+    assert len(summaries) == 3
+    for ev in summaries:
+        assert set(ev) - meta == set(
+            obs_schema.LOADGEN_EVENT_FIELDS["loadgen.summary"])
+    assert summaries[0]["ttfp_p99_s"] == report["ttfp_p99_s"]
+
+
+def test_drain_mode_has_no_ttfp():
+    engine = ServeEngine(max_batch=4, bucket_sizes=(16,))
+    results = engine.run([_cfg(steps=8, seed=1), _cfg(steps=8, seed=2)])
+    assert all(r.ttfp_s is None for r in results)
+
+
+def test_parse_sweep():
+    assert parse_sweep("2:8:2") == [2.0, 4.0, 6.0, 8.0]
+    assert parse_sweep("5:5:1") == [5.0]
+    assert parse_sweep("1:2:0.5") == [1.0, 1.5, 2.0]
+    for bad in ("2:8", "0:8:2", "8:2:2", "2:8:0", "a:b:c"):
+        with pytest.raises(ValueError):
+            parse_sweep(bad)
+
+
+# ------------------------------------------------ bytes-budget admission --
+
+class _StubCost:
+    """Deterministic cost model double: prices every shape at
+    ``per_agent * n`` bytes (0 = unpriced, the fail-open path)."""
+
+    def __init__(self, per_agent):
+        self.per_agent = per_agent
+
+    def predict_peak_bytes(self, n):
+        return self.per_agent * n
+
+    def fits(self, n, mesh=None, *, budget_bytes=None):
+        predicted = self.predict_peak_bytes(n)
+        if predicted == 0 or budget_bytes is None:
+            return True
+        return predicted <= budget_bytes
+
+    def save(self):   # engine.stop() flushes the attached model
+        pass
+
+    def record_compile(self, label, compiled, wall):   # prewarm feeds it
+        pass
+
+    def observe_execute(self, label, execute_s):
+        return {"drift": None, "predicted_s": None}
+
+    def cost_of(self, label):
+        return {}
+
+
+def test_bytes_budget_sheds_with_prediction_and_fails_open(tmp_path):
+    sink = obs.TelemetrySink(str(tmp_path / "run"))
+    engine = ServeEngine(
+        max_batch=1, bucket_sizes=(16,), telemetry=sink,
+        continuous=True, chunk_steps=8,
+        fault_policy=FaultPolicy(queue_bytes_budget=1000),
+        cost_model=_StubCost(50))   # n16 bucket -> 800 predicted bytes
+    engine.prewarm([_cfg()])
+    engine.start()
+    try:
+        # A long runner takes the table's only lane, so later submits
+        # stay QUEUED — that queue is what the bytes budget sizes.
+        p_long = engine.submit(_cfg(steps=2048, seed=1))
+        _wait(lambda: engine.stats["lanes_joined"] >= 1)
+        p_queued = engine.submit(_cfg(steps=8, seed=2))   # 800 committed
+        with pytest.raises(ShedError) as ei:
+            engine.submit(_cfg(steps=8, seed=3))   # headroom 200 < 800
+        assert "bytes" in str(ei.value)
+        assert engine.stats["shed"] == 1
+        # FAIL-OPEN: an unpriced shape admits even with zero headroom.
+        engine.cost_model = _StubCost(0)
+        p_open = engine.submit(_cfg(steps=8, seed=4))
+        assert engine.stats["shed"] == 1
+        assert p_queued.cancel() and p_open.cancel()
+        # p_long is mid-flight (cancel is queue-only): stop() finishes
+        # it through the chunk machinery.
+    finally:
+        engine.stop()
+    assert p_long.result(timeout=0).steps == 2048
+    sink.close()
+    sheds = [e for e in obs.read_events(str(tmp_path / "run"))
+             if e["event"] == "serve.shed"]
+    assert [e["reason"] for e in sheds] == ["bytes_budget"]
+    assert sheds[0]["predicted_bytes"] == 800
+    assert set(sheds[0]) - {"event", "schema", "t_wall"} == set(
+        obs_schema.SERVE_EVENT_FIELDS["serve.shed"])
+
+
+def test_fault_policy_validates_bytes_budget():
+    with pytest.raises(ValueError):
+        FaultPolicy(queue_bytes_budget=0)
+    with pytest.raises(ValueError):
+        FaultPolicy(queue_bytes_budget=-5)
+    assert FaultPolicy(queue_bytes_budget=None).queue_bytes_budget is None
+
+
+# -------------------------------------------------------------- CLI/docs --
+
+def test_loadgen_cli_sweep(capsys):
+    from cbf_tpu.__main__ import main as cli_main
+
+    rc = cli_main(["loadgen", "--rps", "20", "--duration", "0.3",
+                   "--n-min", "8", "--n-max", "16", "--steps", "8",
+                   "--continuous", "--chunk", "8",
+                   "--sweep-rps", "10:20:10", "--slo-p99", "1e9"])
+    assert rc == 0
+    record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    sweep = record["sweep"]
+    assert sweep["knee_rps"] == 20.0 and sweep["knee_censored"]
+    assert [leg["rps"] for leg in sweep["legs"]] == [10.0, 20.0]
+    assert record["stats"]["chunks_executed"] > 0
+    assert record["stats"]["lanes_joined"] > 0
+
+
+def test_continuous_batching_documented():
+    """docs/API.md 'Continuous batching' stays in lockstep with the
+    code — same audit-enforcement style as the Serving section."""
+    with open(os.path.join(ROOT, "docs", "API.md")) as fh:
+        text = fh.read()
+    assert "## Continuous batching" in text
+    for needle in ("lockstep_traced_chunk", "serve.partial", "ttfp_s",
+                   "ttfp_p99_s", "predicted_bytes", "queue_bytes_budget",
+                   "--continuous", "--chunk", "--sweep-rps", "--slo-p99",
+                   "--queue-bytes-budget", "BENCH_SLO_SWEEP", "knee",
+                   "chunks_executed", "lanes_joined", "lanes_vacated",
+                   "steps_done", "steps_total"):
+        assert needle in text, \
+            f"docs/API.md Continuous batching: missing {needle!r}"

@@ -284,10 +284,11 @@ def test_certificate_gradients_match_finite_differences(x64):
 
 
 # slow: ~21 s; sharded train-step descent stays tier-1 in
-# test_parallel's test_train_step_runs_and_descends, two-layer gradient
-# soundness in test_certificate_gradients_finite_in_f32_at_packed_density,
-# and the at-scale twin test_two_layer_training_descends_at_n512 shares
-# this slow tier.
+# test_parallel's test_train_step_runs_and_descends; the two-layer
+# gradient soundness soak
+# (test_certificate_gradients_finite_in_f32_at_packed_density) and the
+# at-scale twin test_two_layer_training_descends_at_n512 share this
+# slow tier.
 @pytest.mark.slow
 def test_two_layer_training_descends():
     """Training THROUGH the two-layer stack (per-agent filter + sparse
@@ -316,6 +317,12 @@ def test_two_layer_training_descends():
     assert float(params.gamma_raw) != float(tuning.init_params().gamma_raw)
 
 
+# slow: ~9 s (production-budget solve + finite differences); gradient
+# flow through the stack stays tier-1 via test_parallel's
+# test_train_step_runs_and_descends — this is the packed-density f32
+# NaN-regression soak, riding the slow tier with the two-layer
+# training descent twins below.
+@pytest.mark.slow
 def test_certificate_gradients_finite_in_f32_at_packed_density():
     """Regression for the f32 NaN: at packed density with active rows,
     reverse-mode through the production-budget solve must stay finite and

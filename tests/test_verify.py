@@ -214,6 +214,14 @@ def shrunk(marginal_counterexample):
         cbf=WEAK_CBF, settings=SMALL)
 
 
+# slow: the `shrunk` module fixture is a ~27 s budget-SMALL search +
+# shrink at MARGINAL_CFG, shared by the three tests below — demoting any
+# one alone just shifts the fixture onto the next, so the whole cluster
+# rides the slow tier (AUD005). Tier-1 keeps found-ness via
+# test_random_search_falsifies_weakened, corpus schema/replay machinery
+# via test_corpus_rejects_schema_drift and test_corpus_replay_gate; the
+# found -> shrink -> corpus pipeline runs here and in test_cli_exit_codes.
+@pytest.mark.slow
 def test_shrinker_minimality(shrunk):
     """Earliest-step minimality: the horizon one step short of the found
     earliest violating step does NOT violate; norm minimality: the
@@ -246,6 +254,8 @@ def test_shrinker_minimality(shrunk):
 
 # ---------------------------------------------------------------- corpus
 
+# slow: shares the ~27 s `shrunk` fixture (see note above).
+@pytest.mark.slow
 def test_corpus_roundtrip_bitexact(tmp_path, shrunk):
     _, sr = shrunk
     entry = corpus.entry_from("swarm", MARGINAL_CFG, sr, engine="random",
@@ -259,6 +269,8 @@ def test_corpus_roundtrip_bitexact(tmp_path, shrunk):
     assert not corpus.check_replay(loaded, replay)
 
 
+# slow: shares the ~27 s `shrunk` fixture (see note above).
+@pytest.mark.slow
 def test_corpus_gate_catches_reintroduction(shrunk):
     """A 'safe' entry built from the DEFAULT filter must pass; the same
     entry with the weakened filter smuggled in (simulating a change that
@@ -367,8 +379,8 @@ def _cli(*argv):
 # slow: ~21 s (two full budget-16 CLI searches + shrink + corpus); tier-1
 # keeps the verify CLI via test_cli_property_selection (exit 0, --json
 # record) and test_cli's fingerprint-mismatch exit-2 test; the found ->
-# shrink -> corpus pipeline itself stays tier-1 in-process via the
-# shrinker/corpus tests above.
+# shrink -> corpus pipeline rides the slow tier with the shrinker/corpus
+# cluster above (its tier-1 remainders are listed on that note).
 @pytest.mark.slow
 def test_cli_exit_codes(tmp_path, capsys):
     base = ["verify", "swarm", "--set", "n=16", "--set", "steps=140",
