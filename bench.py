@@ -132,6 +132,18 @@ Modes / env knobs:
     vs_baseline is continuous-over-drain. Knobs: BENCH_SLO_SWEEP_GRID
     ("8:56:8"), BENCH_SLO_SWEEP_P99 (0.4), BENCH_SLO_CHUNK (16) + the
     BENCH_SLO_* traffic-shape knobs. See docs/BENCH_LOG.md Round 16.
+  BENCH_OCCUPANCY=1 — scheduler-observatory occupancy mode
+    (cbf_tpu.obs.lanes): the same seeded open-loop traffic through one
+    prewarmed continuous engine with an armed LaneLedger at two offered
+    rates (below and past the capacity knee); reports exact per-leg
+    lane-time attribution (occupancy / bubble / dispatch-overhead %)
+    and FAILS unless the integer-ns identity busy+padding+vacancy+
+    dispatch == lanes x wall holds exactly on both legs. Primary metric
+    is occupancy % at the LO rate; occupancy@HI and dispatch efficiency
+    (100 - dispatch%) at both rates ride as extra_axes for AUD006.
+    Knobs: BENCH_OCC_RPS_LO (8.0), BENCH_OCC_RPS_HI (120.0) + the
+    BENCH_SLO_DURATION/SEED/NMIN/NMAX/ALPHA/MAX_BATCH/FLUSH/CHUNK
+    shape knobs.
   BENCH_CHAOS=1 — fault-tolerance goodput mode (serve.resilience +
     utils.faults): the SAME seeded loadgen traffic twice through one
     engine — a fault-free leg, then a chaos leg with a fixed injection
@@ -1381,7 +1393,11 @@ def _child_slo_sweep(steps: int) -> dict:
     end-to-end latency p99 still meets the SLO bound. Runs the sweep
     TWICE, drain mode then continuous mode, so the record carries both
     knees and the continuous-over-drain capacity gain is the axis
-    regressions are judged on (scripts/bench_regression.py).
+    regressions are judged on (scripts/bench_regression.py). The
+    continuous leg runs with the lane ledger ARMED (PR 17): the knee
+    must reproduce under observation, the round fails if the integer
+    lane-time identity breaks, and the derived cumulative accounting
+    ships in the record's ``lanes_continuous`` block.
 
     Knobs: BENCH_SLO_SWEEP_GRID ("8:56:8") — lo:hi:step inclusive rps
     grid; BENCH_SLO_SWEEP_P99 (0.4 s) — latency p99 SLO bound;
@@ -1415,10 +1431,18 @@ def _child_slo_sweep(steps: int) -> dict:
     # identical arrival schedule, so the knee delta is scheduling, not
     # traffic noise.
     sweeps = {}
+    lanes_continuous = None
     for mode in ("drain", "continuous"):
+        # The continuous leg runs with the lane ledger ARMED: the knee
+        # must reproduce under observation, and its exact accounting
+        # rides in the record.
+        from cbf_tpu.obs.lanes import LaneLedger
         engine = ServeEngine(max_batch=max_batch, flush_deadline_s=flush,
                              continuous=(mode == "continuous"),
-                             chunk_steps=chunk)
+                             chunk_steps=chunk,
+                             lane_ledger=(LaneLedger()
+                                          if mode == "continuous"
+                                          else False))
         # Prewarm against the TOP-of-grid schedule: higher-rps legs draw
         # deeper into the Pareto size tail, so the densest leg's bucket
         # set covers every sparser leg's.
@@ -1429,6 +1453,13 @@ def _child_slo_sweep(steps: int) -> dict:
               f"slo_p99={slo_p99}s prewarm={prewarm_s:.1f}s",
               file=sys.stderr)
         sweep = sweep_rps(engine, spec, grid, slo_p99_s=slo_p99)
+        if mode == "continuous" and getattr(engine, "lanes", None):
+            from cbf_tpu.obs import lanes as obs_lanes
+            lanes_continuous = obs_lanes.derive(engine.lanes.totals())
+            if not lanes_continuous["identity_ok"]:
+                return {"error": "slo-sweep continuous: lane-time "
+                                 "identity violated",
+                        "retryable": False}
         engine.stop()
         sweeps[mode] = sweep
         print(f"bench: slo-sweep mode={mode} knee={sweep['knee_rps']} "
@@ -1457,7 +1488,131 @@ def _child_slo_sweep(steps: int) -> dict:
         "knee_censored_continuous": sweeps["continuous"]["knee_censored"],
         "sweep_drain": sweeps["drain"],
         "sweep_continuous": sweeps["continuous"],
+        "lanes_continuous": lanes_continuous,
         "platform": jax.devices()[0].platform,
+    }
+
+
+def _child_occupancy(steps: int) -> dict:
+    """BENCH_OCCUPANCY mode: scheduler-observatory occupancy harness
+    (cbf_tpu.obs.lanes riding cbf_tpu.serve.loadgen). Runs the SAME
+    seeded open-loop traffic shape through ONE prewarmed continuous
+    engine with an armed LaneLedger, at two offered rates — below the
+    knee (BENCH_OCC_RPS_LO) and far past it (BENCH_OCC_RPS_HI) — and
+    reports the exact lane-time attribution per leg: occupancy %
+    (useful-step lane-time / total lane-time), bubble % (pad +
+    vacancy), and dispatch-overhead %. Each leg's accounting is a
+    ledger DELTA (loadgen captures before/after totals), and the round
+    FAILS unless the integer-nanosecond identity ``busy + padding +
+    vacancy + dispatch == lanes x wall`` holds EXACTLY on both legs —
+    the record doubles as a continuous check that the observatory's
+    arithmetic is sound on real hardware.
+
+    The primary metric is occupancy % at the LO rate; dispatch
+    efficiency (100 - dispatch %) at both rates and occupancy at the
+    HI rate ride along as ``extra_axes`` records so
+    scripts/bench_regression.py (AUD006) tracks the trajectory of all
+    four higher-is-better axes. Knobs: BENCH_OCC_RPS_LO (8.0),
+    BENCH_OCC_RPS_HI (120.0), plus the BENCH_SLO_DURATION/SEED/NMIN/
+    NMAX/ALPHA/MAX_BATCH/FLUSH traffic-shape knobs and BENCH_SLO_CHUNK
+    (16)."""
+    import dataclasses
+
+    import jax
+
+    from cbf_tpu.obs.lanes import LaneLedger
+    from cbf_tpu.serve import LoadSpec, ServeEngine, build_schedule, \
+        run_loadgen
+
+    rps_lo = _env_float("BENCH_OCC_RPS_LO", 8.0)
+    rps_hi = _env_float("BENCH_OCC_RPS_HI", 120.0)
+    duration = _env_float("BENCH_SLO_DURATION", 10.0)
+    seed = _env_int("BENCH_SLO_SEED", 0)
+    n_min = _env_int("BENCH_SLO_NMIN", 8)
+    n_max = _env_int("BENCH_SLO_NMAX", 96)
+    alpha = _env_float("BENCH_SLO_ALPHA", 1.3)
+    max_batch = _env_int("BENCH_SLO_MAX_BATCH", 8)
+    flush = _env_float("BENCH_SLO_FLUSH", 0.05)
+    chunk = _env_int("BENCH_SLO_CHUNK", 16)
+
+    spec = LoadSpec(rps=rps_lo, duration_s=duration, seed=seed,
+                    n_min=n_min, n_max=n_max, pareto_alpha=alpha)
+    engine = ServeEngine(max_batch=max_batch, flush_deadline_s=flush,
+                         continuous=True, chunk_steps=chunk,
+                         lane_ledger=LaneLedger())
+    # Prewarm against the HI-rate schedule (denser leg draws deeper into
+    # the Pareto tail, so its bucket set covers the LO leg's): compile
+    # must happen OUTSIDE the measured chunk walls or the first chunks
+    # book minutes of XLA time as dispatch overhead.
+    prewarm_s = engine.prewarm(
+        [cfg for _, cfg in build_schedule(
+            dataclasses.replace(spec, rps=rps_hi))])
+    print(f"bench: occupancy grid=[{rps_lo},{rps_hi}] rps "
+          f"duration={duration}s chunk={chunk} prewarm={prewarm_s:.1f}s",
+          file=sys.stderr)
+    legs = {}
+    for rps in (rps_lo, rps_hi):
+        report = run_loadgen(engine, dataclasses.replace(spec, rps=rps))
+        if report["errors"]:
+            return {"error": f"occupancy rps={rps}: {report['errors']}/"
+                             f"{report['requests']} requests failed",
+                    "retryable": False}
+        err = _check_safety(report["min_pairwise_distance"],
+                            report["infeasible_count"],
+                            floor=_dynamics_floor("single"))
+        if err:
+            return {"error": err, "retryable": False}
+        acct = report["lanes"]
+        if not acct or not acct["chunks"]:
+            return {"error": f"occupancy rps={rps}: armed ledger "
+                             f"recorded no chunks", "retryable": False}
+        if not acct["identity_ok"]:
+            return {"error": f"occupancy rps={rps}: lane-time identity "
+                             f"violated (busy+padding+vacancy+dispatch "
+                             f"!= lanes*wall)", "retryable": False}
+        legs[rps] = {"offered_rps": rps,
+                     "achieved_rps": report["achieved_rps"],
+                     "queue_wait_p99_s": report["queue_wait_p99_s"],
+                     "ttfp_p99_s": report["ttfp_p99_s"],
+                     "lanes": acct, "by_bucket": report["by_bucket"]}
+        print(f"bench: occupancy rps={rps} chunks={acct['chunks']} "
+              f"occ={acct['occupancy_pct']}% bubble={acct['bubble_pct']}% "
+              f"dispatch={acct['dispatch_pct']}% identity_ok="
+              f"{acct['identity_ok']}", file=sys.stderr)
+    engine.stop()
+    lo, hi = legs[rps_lo]["lanes"], legs[rps_hi]["lanes"]
+    return {
+        "metric": (f"serve lane occupancy, continuous batching "
+                   f"(open-loop {rps_lo:g} rps)"),
+        "value": lo["occupancy_pct"],
+        "unit": "percent",
+        "vs_baseline": 0,   # an attribution axis, not the headline rate
+        "occupancy": True,
+        "rps_lo": rps_lo,
+        "rps_hi": rps_hi,
+        "duration_s": duration,
+        "chunk_steps": chunk,
+        "max_batch": max_batch,
+        "prewarm_s": round(prewarm_s, 3),
+        "identity_ok": True,
+        "legs": {str(r): legs[r] for r in (rps_lo, rps_hi)},
+        "platform": jax.devices()[0].platform,
+        # Companion axes for scripts/bench_regression.py (AUD006): all
+        # higher-is-better, so dispatch overhead is encoded as its
+        # efficiency complement.
+        "extra_axes": [
+            {"metric": (f"serve lane occupancy, continuous batching "
+                        f"(open-loop {rps_hi:g} rps)"),
+             "value": hi["occupancy_pct"], "unit": "percent"},
+            {"metric": (f"serve dispatch efficiency, continuous batching "
+                        f"(100 - dispatch%, {rps_lo:g} rps)"),
+             "value": round(100.0 - lo["dispatch_pct"], 4),
+             "unit": "percent"},
+            {"metric": (f"serve dispatch efficiency, continuous batching "
+                        f"(100 - dispatch%, {rps_hi:g} rps)"),
+             "value": round(100.0 - hi["dispatch_pct"], 4),
+             "unit": "percent"},
+        ],
     }
 
 
@@ -2611,6 +2766,8 @@ def child_main(result_path: str, ensemble: bool) -> None:
             result = _child_rta(steps)
         elif os.environ.get("BENCH_CHAOS", "0") == "1":
             result = _child_chaos(steps)
+        elif os.environ.get("BENCH_OCCUPANCY", "0") == "1":
+            result = _child_occupancy(steps)
         elif os.environ.get("BENCH_SLO_SWEEP", "0") == "1":
             result = _child_slo_sweep(steps)
         elif os.environ.get("BENCH_SLO", "0") == "1":
@@ -2737,6 +2894,10 @@ def main() -> None:
         label = "rta N=%d" % _env_int("BENCH_RTA_N", 64)
     elif os.environ.get("BENCH_CHAOS", "0") == "1":
         label = "chaos rps=%g" % _env_float("BENCH_CHAOS_RPS", 8.0)
+    elif os.environ.get("BENCH_OCCUPANCY", "0") == "1":
+        label = "occupancy rps=[%g,%g]" % (
+            _env_float("BENCH_OCC_RPS_LO", 8.0),
+            _env_float("BENCH_OCC_RPS_HI", 120.0))
     elif os.environ.get("BENCH_SLO_SWEEP", "0") == "1":
         label = "slo-sweep grid=%s" % os.environ.get(
             "BENCH_SLO_SWEEP_GRID", "8:56:8")

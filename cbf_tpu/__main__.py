@@ -475,6 +475,160 @@ def cmd_obs_top(args) -> int:
         _time.sleep(args.every)
 
 
+def _render_lanes(doc: dict) -> str:
+    """One metrics.json snapshot as the lane-occupancy table: a global
+    row plus one row per bucket, fed by the ledger's ``serve.lanes.*``
+    registry twins."""
+    from cbf_tpu.obs.export import split_bucket
+
+    metrics = doc.get("metrics") or {}
+    per: dict = {}
+
+    def row(bucket):
+        key = bucket if bucket is not None else "(all)"
+        return per.setdefault(key, {})
+
+    for name, snap in metrics.items():
+        hist = name.endswith(".hist")
+        base, bucket = split_bucket(name[:-5] if hist else name)
+        if base == "serve.lanes.chunks":
+            row(bucket)["chunks"] = int(snap.get("total") or 0)
+        elif base == "serve.lanes.occupancy_pct":
+            row(bucket)["occ%"] = snap.get("last")
+        elif base == "serve.lanes.bubble_pct":
+            row(bucket)["bubble%"] = snap.get("last")
+        elif base == "serve.lanes.dispatch_pct":
+            row(bucket)["disp%"] = snap.get("last")
+        elif base == "serve.lanes.joins":
+            row(bucket)["joins"] = int(snap.get("total") or 0)
+        elif base == "serve.lanes.vacates":
+            row(bucket)["vacates"] = int(snap.get("total") or 0)
+        elif base == "serve.lanes.preempted":
+            row(bucket)["preempted"] = int(snap.get("total") or 0)
+        elif base == "serve.lanes.fill":
+            row(bucket)["fill_p50"] = snap.get("p50")
+        elif base == "serve.lanes.lane_age_s":
+            row(bucket)["age_p95_s"] = snap.get("p95")
+        elif base == "serve.ttfp_s":
+            row(bucket)["ttfp_p99_s"] = snap.get("p99")
+    if not per:
+        return ("no serve.lanes.* metrics in this snapshot — ledger "
+                "disarmed? (ServeEngine arms it when continuous=True "
+                "with a telemetry sink, or pass lane_ledger=True)")
+    cols = ("chunks", "occ%", "bubble%", "disp%", "joins", "vacates",
+            "preempted", "fill_p50", "age_p95_s", "ttfp_p99_s")
+    names = sorted(per, key=lambda b: (b != "(all)", b))
+    wb = max(len(b) for b in names + ["bucket"])
+    lines = ["  ".join(["bucket".ljust(wb)] + [c.rjust(9) for c in cols])]
+    for b in names:
+        vals = []
+        for c in cols:
+            v = per[b].get(c)
+            vals.append(("-" if v is None else str(v)).rjust(9))
+        lines.append("  ".join([b.ljust(wb)] + vals))
+    g = per.get("(all)", {})
+    for k in ("serve.chunks_executed", "serve.lanes_joined",
+              "serve.lanes_vacated"):
+        snap = metrics.get(k)
+        if snap is not None:
+            lines.append(f"{k}: total={int(snap.get('total') or 0)}")
+    if g.get("occ%") is not None and g.get("disp%") is not None:
+        lines.append(
+            f"identity: busy {g.get('occ%')}% + bubble {g.get('bubble%')}% "
+            f"+ dispatch {g.get('disp%')}% of lane-time (exact in ns — "
+            "see serve.lanes.window events)")
+    return "\n".join(lines)
+
+
+def _export_lane_timeline(run_dir: str, out_path: str) -> int:
+    """Rebuild the Perfetto timeline (per-lane tracks + flow links) from
+    a run directory's ``serve.span`` events and write it to
+    ``out_path``. Exit 2 when the run dir has no event stream."""
+    from cbf_tpu.obs import schema as obs_schema
+    from cbf_tpu.obs import trace as obs_trace
+    from cbf_tpu.obs.sink import read_events
+
+    # read_events tolerates a missing stream (live-tail semantics); a
+    # one-shot export over nothing is an operator error instead.
+    if not os.path.isfile(os.path.join(run_dir,
+                                       obs_schema.EVENTS_FILENAME)):
+        print(f"obs lanes: no {obs_schema.EVENTS_FILENAME} in {run_dir}",
+              file=sys.stderr)
+        return 2
+    events = read_events(run_dir)
+    spans = [e for e in events if e.get("event") == "serve.span"]
+    doc = obs_trace.build_chrome_trace(spans)
+    with open(out_path, "w") as fh:
+        json.dump(doc, fh)
+    print(json.dumps({"timeline": os.path.abspath(out_path),
+                      "spans": len(spans),
+                      "tracks": len({s.get('track') for s in spans
+                                     if s.get('track') is not None})}))
+    return 0
+
+
+def cmd_obs_lanes(args) -> int:
+    """Live lane-occupancy table over a ``--metrics-dir`` surface: the
+    scheduler observatory's ``serve.lanes.*`` registry twins rendered
+    per bucket (occupancy/bubble/dispatch %, join/vacate/preempt
+    totals, fill and lane-age percentiles). Same follow/stall contract
+    as ``obs top``: --follow re-renders at --every cadence, a
+    metrics.json that stops being rewritten past --stall-timeout emits
+    a synthetic stall alert and exits 3, a missing surface exits 2.
+    ``--export-timeline PATH`` instead rebuilds the Perfetto per-lane
+    timeline from the run directory's serve.span events."""
+    import time as _time
+
+    from cbf_tpu.obs import export as obs_export
+
+    if args.export_timeline is not None:
+        try:
+            run_dir = _resolve_run_dir(args.run_dir, args.latest)
+        except SystemExit:
+            run_dir = args.run_dir
+        return _export_lane_timeline(run_dir, args.export_timeline)
+    try:
+        mdir = _resolve_metrics_dir(args.run_dir, args.latest)
+    except FileNotFoundError as e:
+        print(f"obs lanes: {e}", file=sys.stderr)
+        return 2
+    path = os.path.join(mdir, obs_export.JSON_FILENAME)
+    t_start = _time.time()
+    while True:
+        if not os.path.isfile(path):
+            if not args.follow:
+                print(f"obs lanes: no {obs_export.JSON_FILENAME} in {mdir}",
+                      file=sys.stderr)
+                return 2
+            if args.stall_timeout is not None and \
+                    _time.time() - t_start > args.stall_timeout:
+                print(json.dumps({
+                    "event": "alert", "kind": "stall",
+                    "detail": f"{path} never appeared in "
+                              f"{args.stall_timeout}s"}), flush=True)
+                return 3
+            _time.sleep(min(args.every, 1.0))
+            continue
+        age = _time.time() - os.path.getmtime(path)
+        if args.stall_timeout is not None and age > args.stall_timeout:
+            print(json.dumps({
+                "event": "alert", "kind": "stall",
+                "detail": f"{path} not rewritten for {age:.1f}s "
+                          f"(> {args.stall_timeout}s)"}), flush=True)
+            return 3
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except ValueError:
+            doc = None                     # replaced mid-read: next tick
+        if doc is not None:
+            print(f"== lanes {path}  age={age:.1f}s ==", flush=True)
+            print(_render_lanes(doc), flush=True)
+        if not args.follow:
+            return 0
+        _time.sleep(args.every)
+
+
 def _resolve_capsule_dir(path: str, latest: bool) -> str:
     """``--latest``: treat ``path`` as a root (a flight recorder's
     out_dir) and pick the newest capsule-* directory by manifest
@@ -2095,6 +2249,29 @@ def main(argv=None) -> int:
     incp.add_argument("--json", action="store_true",
                       help="one-line machine-readable output")
     incp.set_defaults(fn=cmd_obs_incident)
+    lanesp = obs_sub.add_parser(
+        "lanes", help="scheduler-observatory lane occupancy table over a "
+                      "--metrics-dir surface (serve.lanes.* twins); "
+                      "--export-timeline rebuilds the Perfetto per-lane "
+                      "timeline from a run directory's serve.span events")
+    lanesp.add_argument("run_dir")
+    lanesp.add_argument("--follow", "-f", action="store_true",
+                        help="keep re-rendering at --every cadence")
+    lanesp.add_argument("--every", type=float, default=2.0,
+                        help="re-render cadence in seconds (default 2)")
+    lanesp.add_argument("--stall-timeout", type=float, default=None,
+                        help="emit a synthetic stall alert and exit 3 when "
+                             "metrics.json stops being rewritten for this "
+                             "many seconds")
+    lanesp.add_argument("--latest", action="store_true",
+                        help="run_dir is a root; watch the directory with "
+                             "the newest metrics.json")
+    lanesp.add_argument("--export-timeline", default=None, metavar="PATH",
+                        help="write the Chrome/Perfetto trace JSON "
+                             "(per-lane tracks + enqueue->lane flow "
+                             "links) rebuilt from run_dir's events.jsonl, "
+                             "then exit")
+    lanesp.set_defaults(fn=cmd_obs_lanes)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -206,6 +206,16 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
             f"{serve_ha.EMITTED_EVENT_TYPES!r} != "
             f"obs.schema.HA_EVENT_TYPES {schema.HA_EVENT_TYPES!r} "
             "— emitter and schema drifted")
+    # Scheduler-observatory event drift: the lane ledger's declared
+    # emissions must match the schema's lanes family exactly.
+    from cbf_tpu.obs import lanes as obs_lanes
+    if tuple(obs_lanes.EMITTED_EVENT_TYPES) != \
+            tuple(schema.LANES_EVENT_TYPES):
+        problems.append(
+            f"obs.lanes.EMITTED_EVENT_TYPES "
+            f"{obs_lanes.EMITTED_EVENT_TYPES!r} != "
+            f"obs.schema.LANES_EVENT_TYPES {schema.LANES_EVENT_TYPES!r} "
+            "— emitter and schema drifted")
     # Falsification-fleet event drift: the fleet's declared emissions
     # must match the schema's fleet family exactly.
     from cbf_tpu.verify import fleet as verify_fleet
@@ -231,6 +241,8 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
              schema.SCENARIO_EVENT_FIELDS, schema.SCENARIO_EVENT_TYPES),
             ("HA_EVENT_FIELDS", "HA_EVENT_TYPES",
              schema.HA_EVENT_FIELDS, schema.HA_EVENT_TYPES),
+            ("LANES_EVENT_FIELDS", "LANES_EVENT_TYPES",
+             schema.LANES_EVENT_FIELDS, schema.LANES_EVENT_TYPES),
             ("FLEET_EVENT_FIELDS", "FLEET_EVENT_TYPES",
              schema.FLEET_EVENT_FIELDS, schema.FLEET_EVENT_TYPES)):
         for etype in fields:
@@ -255,7 +267,7 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
     import inspect
     for mod in (verify_search, serve_engine, obs_trace, serve_loadgen,
                 durable_journal, durable_rollout, rta_monitor, obs_flight,
-                scen_dsl, serve_ha, verify_fleet):
+                obs_lanes, scen_dsl, serve_ha, verify_fleet):
         try:
             mod_tree = ast.parse(inspect.getsource(mod))
         except (OSError, TypeError):
@@ -307,6 +319,7 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
                 ("flight", schema.FLIGHT_EVENT_FIELDS),
                 ("scenario", schema.SCENARIO_EVENT_FIELDS),
                 ("ha", schema.HA_EVENT_FIELDS),
+                ("lanes", schema.LANES_EVENT_FIELDS),
                 ("fleet", schema.FLEET_EVENT_FIELDS)):
             for etype, fields in table.items():
                 if f"`{etype}`" not in api_text:

@@ -25,6 +25,12 @@ execute-time cost model, persisted to ``costmodel.json``),
 ``obs.flight`` dumps a replayable incident capsule when safety
 machinery fires, and ``obs.export`` rewrites ``metrics.prom`` /
 ``metrics.json`` atomically for scrapers and ``cbf_tpu obs top``.
+The scheduler observatory (``obs.lanes``) stamps the continuous-
+batching engine at every chunk boundary into an exact lane-time
+accounting (``serve.lanes.*`` metrics, ``serve.lanes.window`` events,
+per-lane Perfetto tracks, ``cbf_tpu obs lanes``), and the watchdog's
+``SLOTargets`` turn queue-wait/occupancy objectives into multi-window
+burn-rate alerts.
 
 Schema: ``obs.schema`` (versioned; drift against StepOutputs/
 EnsembleMetrics is a tier-1 failure via scripts/obs_schema_audit.py).
@@ -33,25 +39,30 @@ EnsembleMetrics is a tier-1 failure via scripts/obs_schema_audit.py).
 from cbf_tpu.obs.export import (MetricsExporter, render_prom, split_bucket,
                                 write_metrics)
 from cbf_tpu.obs.flight import FlightRecorder, read_capsule, request_stanza
+from cbf_tpu.obs.lanes import LANE_STATES, LaneLedger
 from cbf_tpu.obs.resource import CostModel, analyze_compiled, environment
 from cbf_tpu.obs.schema import SCHEMA_VERSION, HEARTBEAT_FIELDS
 from cbf_tpu.obs.sink import (Histogram, MetricsRegistry, TelemetrySink,
                               build_manifest, read_events, read_manifest,
                               summarize_run, tail_events)
 from cbf_tpu.obs.tap import emit_ensemble_chunk, instrument_step
-from cbf_tpu.obs.trace import LIFECYCLE_PHASES, Span, Tracer
+from cbf_tpu.obs.trace import (LIFECYCLE_PHASES, Span, Tracer,
+                               build_chrome_trace)
 from cbf_tpu.obs.watchdog import (ALERT_CERT_BLOWUP, ALERT_INFEASIBLE,
-                                  ALERT_KINDS, ALERT_NAN, ALERT_STALL, Alert,
-                                  Watchdog)
+                                  ALERT_KINDS, ALERT_LOW_OCCUPANCY,
+                                  ALERT_NAN, ALERT_SLO_BURN, ALERT_STALL,
+                                  Alert, SLOTargets, Watchdog)
 
 __all__ = [
     "SCHEMA_VERSION", "HEARTBEAT_FIELDS", "Histogram", "MetricsRegistry",
     "TelemetrySink", "build_manifest", "read_events", "read_manifest",
     "summarize_run", "tail_events", "emit_ensemble_chunk", "instrument_step",
-    "LIFECYCLE_PHASES", "Span", "Tracer", "Alert",
-    "Watchdog", "ALERT_KINDS", "ALERT_NAN", "ALERT_CERT_BLOWUP",
-    "ALERT_INFEASIBLE", "ALERT_STALL",
+    "LIFECYCLE_PHASES", "Span", "Tracer", "build_chrome_trace", "Alert",
+    "Watchdog", "SLOTargets", "ALERT_KINDS", "ALERT_NAN",
+    "ALERT_CERT_BLOWUP", "ALERT_INFEASIBLE", "ALERT_STALL",
+    "ALERT_SLO_BURN", "ALERT_LOW_OCCUPANCY",
     "CostModel", "analyze_compiled", "environment",
     "FlightRecorder", "read_capsule", "request_stanza",
+    "LaneLedger", "LANE_STATES",
     "MetricsExporter", "render_prom", "split_bucket", "write_metrics",
 ]

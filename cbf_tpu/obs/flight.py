@@ -19,7 +19,10 @@ writes one capsule directory:
 
 - ``capsule.json`` — trigger reason/detail, environment (backend,
   jaxlib, git SHA), registry metrics snapshot, recent request stanzas,
-  ring/trigger metadata.
+  ring/trigger metadata, and — when a ``context_fn`` seam is installed
+  (the serve engine wires its in-flight queue/lane-ledger snapshot) —
+  a ``context`` stanza answering "what was running" at trip time, for
+  EVERY trip reason.
 - ``ring.jsonl`` — the ring contents, oldest first.
 - ``costmodel.json`` — the :class:`~cbf_tpu.obs.resource.CostModel`
   snapshot, when the recorder carries one.
@@ -116,6 +119,14 @@ class FlightRecorder:
         self._lock = lockwitness.make_lock("FlightRecorder._lock")
         self._sink = None
         self._seq = 0
+        # "What was running" seam: a zero-arg callable returning a
+        # JSON-safe dict, evaluated at EVERY trip (any reason) and
+        # embedded as the capsule manifest's "context" key. The serve
+        # engine installs its in-flight snapshot (queue depth + lane
+        # ledger) here, so continuous-mode capsules are never stale.
+        # Must be lock-free/non-blocking; a raising context_fn is
+        # recorded as an error marker, never propagated.
+        self.context_fn = None
 
     # -- wiring ------------------------------------------------------------
 
@@ -197,9 +208,15 @@ class FlightRecorder:
                        for c in reason)
         capsule_dir = os.path.join(self.out_dir,
                                    f"capsule-{seq:03d}-{slug}")
+        context = None
+        if self.context_fn is not None:
+            try:
+                context = self.context_fn()
+            except Exception as e:
+                context = {"error": f"context_fn raised: {type(e).__name__}"}
         try:
             path = self._write(capsule_dir, reason, detail, ring, recent,
-                               request, trigger_event)
+                               request, trigger_event, context)
         except Exception as e:
             with self._lock:
                 self.write_failures += 1
@@ -222,7 +239,8 @@ class FlightRecorder:
 
     def _write(self, capsule_dir: str, reason: str, detail: str,
                ring: list, recent: list, request: dict | None,
-               trigger_event: dict | None) -> str:
+               trigger_event: dict | None,
+               context: dict | None = None) -> str:
         from cbf_tpu.obs import resource
 
         os.makedirs(capsule_dir, exist_ok=True)
@@ -244,6 +262,7 @@ class FlightRecorder:
                "ring_events": len(ring),
                "trigger_event": trigger_event,
                "recent_requests": recent,
+               "context": context,
                "has_request": request is not None,
                "metrics": (self.registry.snapshot()
                            if self.registry is not None else {})}

@@ -156,8 +156,12 @@ SERVE_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
                 "queue_wait_s", "execute_s", "batch_fill", "degraded",
                 "rta_engaged", "min_pairwise_distance", "infeasible_count",
                 "ttfp_s"),
+    # track: optional Perfetto lane-row assignment ("<bucket>/lane<slot>"
+    # for continuous-mode per-lane chunk spans; null for ordinary
+    # lifecycle spans). Spans sharing a track render as one timeline row
+    # in chrome_trace(), flow-linked back to the request's enqueue span.
     "serve.span": ("trace_id", "span_id", "parent_id", "name", "bucket",
-                   "t0_s", "dur_s"),
+                   "t0_s", "dur_s", "track"),
     # Continuous batching: one event per in-flight lane per chunk
     # boundary — the request's progress (steps done of steps total) and
     # the StepOutputs-slice aggregates of JUST this chunk's rows
@@ -327,6 +331,28 @@ HA_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
     "ha.fenced": ("epoch", "fence_epoch", "path"),
     "ha.restart": ("attempt", "exit_code", "backoff_s", "uptime_s"),
     "ha.crash_loop": ("restarts", "window_s"),
+}
+
+#: The scheduler observatory's event (``cbf_tpu.obs.lanes``):
+#: ``serve.lanes.window`` once every ``LaneLedger.emit_every`` executed
+#: chunks — the window's EXACT integer-nanosecond time accounting
+#: (``busy_ns + padding_ns + vacancy_ns + dispatch_ns == total_ns`` ==
+#: lanes x wall, ``identity_ok`` is that integer equality), the derived
+#: occupancy/bubble/dispatch-overhead percentages, the window's
+#: join/vacate/preempt counts and per-second rates, and a per-bucket
+#: ``by_bucket`` split ({bucket label: {chunks, occupancy_pct,
+#: dispatch_pct}}). Same AUD001 contract as the other tables:
+#: ``obs.lanes.EMITTED_EVENT_TYPES`` must equal this tuple, the type
+#: needs a literal emit site, and every type and field must be
+#: documented in docs/API.md.
+LANES_EVENT_TYPES: tuple[str, ...] = ("serve.lanes.window",)
+
+LANES_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
+    "serve.lanes.window": ("chunks", "busy_ns", "padding_ns", "vacancy_ns",
+                           "dispatch_ns", "total_ns", "occupancy_pct",
+                           "bubble_pct", "dispatch_pct", "identity_ok",
+                           "joins", "vacates", "preempted", "join_rate",
+                           "vacate_rate", "by_bucket"),
 }
 
 #: Falsification-fleet event contract (verify.fleet): the AUD001 audit

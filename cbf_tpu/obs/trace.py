@@ -57,11 +57,11 @@ class Span:
     offset/duration on the tracer's monotonic clock."""
 
     __slots__ = ("name", "trace_id", "span_id", "parent_id", "bucket",
-                 "t0_s", "dur_s", "thread")
+                 "t0_s", "dur_s", "thread", "track")
 
     def __init__(self, name: str, trace_id: str | None, span_id: int,
                  parent_id: int | None, bucket: str | None,
-                 t0_s: float, thread: int):
+                 t0_s: float, thread: int, track: str | None = None):
         self.name = name
         self.trace_id = trace_id
         self.span_id = span_id
@@ -70,6 +70,10 @@ class Span:
         self.t0_s = t0_s
         self.dur_s: float | None = None
         self.thread = thread
+        # Explicit timeline-row assignment ("<bucket>/lane<slot>" for
+        # continuous-mode chunk spans): spans sharing a track render on
+        # ONE named Perfetto row instead of their emitting thread's.
+        self.track = track
 
 
 class _SpanContext:
@@ -197,15 +201,17 @@ class Tracer:
 
     def record(self, name: str, *, t0_s: float, dur_s: float,
                trace_id: str | None = None, parent_id: int | None = None,
-               bucket: str | None = None) -> Span | None:
+               bucket: str | None = None,
+               track: str | None = None) -> Span | None:
         """Record a span with explicit timestamps (``t0_s`` from
         :meth:`now`) — for phases measured retroactively across threads,
         like queue wait (stamped at enqueue on the caller's thread,
-        closed at flush on the scheduler's)."""
+        closed at flush on the scheduler's). ``track`` pins the span to
+        a named Perfetto timeline row (per-lane chunk spans)."""
         if not self.sampled(trace_id):
             return None
         span = Span(name, trace_id, next(self._span_ids), parent_id,
-                    bucket, t0_s, threading.get_ident())
+                    bucket, t0_s, threading.get_ident(), track)
         span.dur_s = dur_s
         self._finish(span)
         return span
@@ -227,7 +233,11 @@ class Tracer:
                 self.spans.append(span)
             else:
                 self.dropped += 1
-        if self.registry is not None:
+        # Track spans (per-lane chunk rows) skip the phase histograms:
+        # chunk-time attribution is the lane ledger's job (serve.lanes.*)
+        # and lifecycle-phase latency percentiles must not be diluted by
+        # per-lane duplicates of the same chunk wall.
+        if self.registry is not None and span.track is None:
             self.registry.histogram(
                 f"serve.phase.{span.name}_s").observe(span.dur_s)
             if span.bucket is not None:
@@ -239,7 +249,7 @@ class Tracer:
                 "trace_id": span.trace_id, "span_id": span.span_id,
                 "parent_id": span.parent_id, "name": span.name,
                 "bucket": span.bucket, "t0_s": round(span.t0_s, 6),
-                "dur_s": round(span.dur_s, 6)})
+                "dur_s": round(span.dur_s, 6), "track": span.track})
 
     # -- exporters ---------------------------------------------------------
 
@@ -248,28 +258,96 @@ class Tracer:
         (``{"traceEvents": [...]}``, complete-event ``ph="X"``,
         microsecond timestamps) — loadable in Perfetto /
         ``chrome://tracing``. Thread ids are renumbered small so the
-        viewer's track names stay readable."""
+        viewer's track names stay readable; track-pinned spans get their
+        own NAMED rows, flow-linked back to their request's enqueue (see
+        :func:`build_chrome_trace`)."""
         with self._lock:
             spans = list(self.spans)
-        tids: dict[int, int] = {}
-        events = [{"name": "process_name", "ph": "M", "pid": os.getpid(),
-                   "tid": 0, "args": {"name": "cbf_tpu serve"}}]
-        for s in spans:
-            tid = tids.setdefault(s.thread, len(tids) + 1)
-            events.append({
-                "name": s.name, "cat": "serve", "ph": "X",
-                "ts": round(s.t0_s * 1e6, 3),
-                "dur": round((s.dur_s or 0.0) * 1e6, 3),
-                "pid": os.getpid(), "tid": tid,
-                "args": {"trace_id": s.trace_id, "span_id": s.span_id,
-                         "parent_id": s.parent_id, "bucket": s.bucket},
-            })
-        return {"traceEvents": events, "displayTimeUnit": "ms",
-                "otherData": {"epoch_wall": self._epoch_wall,
-                              "dropped_spans": self.dropped}}
+        records = [{"name": s.name, "trace_id": s.trace_id,
+                    "span_id": s.span_id, "parent_id": s.parent_id,
+                    "bucket": s.bucket, "t0_s": s.t0_s,
+                    "dur_s": s.dur_s or 0.0, "thread": s.thread,
+                    "track": s.track} for s in spans]
+        return build_chrome_trace(records, epoch_wall=self._epoch_wall,
+                                  dropped=self.dropped)
 
     def export_chrome_trace(self, path: str) -> str:
         """Write :meth:`chrome_trace` to ``path`` and return it."""
         with open(path, "w") as fh:
             json.dump(self.chrome_trace(), fh)
         return path
+
+
+def build_chrome_trace(records, *, epoch_wall: float | None = None,
+                       dropped: int = 0) -> dict:
+    """Chrome trace-event JSON from span RECORDS (dicts with the
+    ``serve.span`` event fields, plus an optional ``thread`` key) —
+    shared by :meth:`Tracer.chrome_trace` (live spans) and
+    ``cbf_tpu obs lanes --export-timeline`` (spans replayed from a run
+    directory's events.jsonl), so the two timelines cannot diverge.
+
+    Ordinary spans land on renumbered per-thread rows. Spans carrying a
+    ``track`` land on one named row per track (``thread_name`` metadata,
+    e.g. a continuous lane ``n8/s16/lane3``) so a request's
+    JOIN -> chunks -> LEAVE reads as one lane row; for each trace id
+    with track spans, a flow arrow (``ph="s"``/``ph="f"``) links its
+    earliest enqueue/queue_wait span to its first track span."""
+    pid = os.getpid()
+    tids: dict = {}
+    track_tids: dict[str, int] = {}
+    events = [{"name": "process_name", "ph": "M", "pid": pid,
+               "tid": 0, "args": {"name": "cbf_tpu serve"}}]
+
+    def _tid(rec) -> int:
+        track = rec.get("track")
+        if track is not None:
+            tid = track_tids.get(track)
+            if tid is None:
+                tid = track_tids[track] = 1000 + len(track_tids)
+                events.append({"name": "thread_name", "ph": "M",
+                               "pid": pid, "tid": tid,
+                               "args": {"name": f"lane {track}"}})
+            return tid
+        return tids.setdefault(rec.get("thread", 0), len(tids) + 1)
+
+    recs = sorted(records, key=lambda r: r.get("t0_s") or 0.0)
+    flow_src: dict = {}    # trace_id -> (end ts us, tid) of enqueue span
+    flow_dst: dict = {}    # trace_id -> (start ts us, tid) of 1st track
+    for r in recs:
+        tid = _tid(r)
+        t0_us = round(float(r.get("t0_s") or 0.0) * 1e6, 3)
+        dur_us = round(float(r.get("dur_s") or 0.0) * 1e6, 3)
+        events.append({
+            "name": r.get("name"), "cat": "serve", "ph": "X",
+            "ts": t0_us, "dur": dur_us, "pid": pid, "tid": tid,
+            "args": {"trace_id": r.get("trace_id"),
+                     "span_id": r.get("span_id"),
+                     "parent_id": r.get("parent_id"),
+                     "bucket": r.get("bucket")},
+        })
+        trace_id = r.get("trace_id")
+        if trace_id is None:
+            continue
+        if r.get("track") is not None:
+            flow_dst.setdefault(trace_id, (t0_us, tid))
+        elif r.get("name") in ("enqueue", "queue_wait") \
+                and trace_id not in flow_src:
+            flow_src[trace_id] = (t0_us + dur_us, tid)
+    flow_id = 0
+    for trace_id, (dst_ts, dst_tid) in flow_dst.items():
+        src = flow_src.get(trace_id)
+        if src is None:
+            continue
+        flow_id += 1
+        src_ts, src_tid = src
+        events.append({"name": "lane_join", "cat": "flow", "ph": "s",
+                       "id": flow_id, "ts": min(src_ts, dst_ts),
+                       "pid": pid, "tid": src_tid,
+                       "args": {"trace_id": trace_id}})
+        events.append({"name": "lane_join", "cat": "flow", "ph": "f",
+                       "bp": "e", "id": flow_id, "ts": dst_ts,
+                       "pid": pid, "tid": dst_tid,
+                       "args": {"trace_id": trace_id}})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"epoch_wall": epoch_wall,
+                          "dropped_spans": dropped}}

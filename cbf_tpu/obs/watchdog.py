@@ -21,6 +21,20 @@ tests/test_telemetry.py injects each fault and asserts the alert):
 - ``stall`` — no heartbeat for ``stall_timeout`` seconds while the run is
   live (``faults.stall_at_step`` blocks the compiled program on the host
   clock).
+- ``slo_burn`` — multi-window error-budget burn on the serving layer's
+  queue-wait SLO (pass ``slo=SLOTargets(queue_wait_p99_s=...)``): each
+  ``request`` event whose ``queue_wait_s`` exceeds the target spends
+  error budget; the alert trips only when the burn RATE (bad fraction /
+  ``error_budget``) exceeds ``fast_burn`` over the fast window (default
+  1 min) AND ``slow_burn`` over the slow window (default 10 min) — the
+  classic fast+slow pairing that pages on real budget exhaustion but
+  ignores one-off latency blips.
+- ``sustained_low_occupancy`` — the lane ledger's ``serve.lanes.window``
+  occupancy stream (``obs.lanes``) sat below
+  ``SLOTargets.occupancy_pct`` for every fast-window sample and at
+  least half the slow-window samples: the scheduler is burning device
+  time on bubbles/dispatch, not goodput. Severity ``warning`` — a
+  utilization regression, not a safety event.
 
 Alerts are appended to the run's JSONL stream (event "alert"), collected
 in ``Watchdog.alerts``, and forwarded to ``on_alert`` when given. Edge-
@@ -38,6 +52,7 @@ value that reaches the heartbeat escaped the ladder.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import Callable, NamedTuple
@@ -50,8 +65,36 @@ ALERT_NAN = "nan"
 ALERT_CERT_BLOWUP = "certificate_blowup"
 ALERT_INFEASIBLE = "sustained_infeasibility"
 ALERT_STALL = "stall"
+ALERT_SLO_BURN = "slo_burn"
+ALERT_LOW_OCCUPANCY = "sustained_low_occupancy"
 
-ALERT_KINDS = (ALERT_NAN, ALERT_CERT_BLOWUP, ALERT_INFEASIBLE, ALERT_STALL)
+ALERT_KINDS = (ALERT_NAN, ALERT_CERT_BLOWUP, ALERT_INFEASIBLE, ALERT_STALL,
+               ALERT_SLO_BURN, ALERT_LOW_OCCUPANCY)
+
+
+class SLOTargets(NamedTuple):
+    """Serving SLO targets for the burn-rate checks (pass to
+    ``Watchdog(slo=...)``; both checks are off with the default None
+    targets).
+
+    ``queue_wait_p99_s`` — the queue-wait objective: a request waiting
+    longer is an SLO-bad event. ``error_budget`` — allowed bad-request
+    fraction (0.01 = 99% of requests in target). ``occupancy_pct`` —
+    minimum acceptable ledger occupancy (busy / lane-time, percent).
+    ``fast_window_s``/``slow_window_s`` — the two burn windows;
+    ``fast_burn``/``slow_burn`` — burn-rate thresholds that must BOTH be
+    exceeded (Google SRE's 14.4x/2h + 6x/... pairing collapsed to our
+    1 min / 10 min horizons). ``min_requests`` — fast-window sample
+    floor before slo_burn may trip (no paging off two requests).
+    """
+    queue_wait_p99_s: float | None = None
+    error_budget: float = 0.01
+    occupancy_pct: float | None = None
+    fast_window_s: float = 60.0
+    slow_window_s: float = 600.0
+    fast_burn: float = 14.0
+    slow_burn: float = 2.0
+    min_requests: int = 10
 
 
 class Alert(NamedTuple):
@@ -77,7 +120,8 @@ class Watchdog:
                  residual_threshold: float = 1e-2,
                  infeasible_patience: int = 3,
                  stall_timeout: float | None = None,
-                 on_alert: Callable[[Alert], None] | None = None):
+                 on_alert: Callable[[Alert], None] | None = None,
+                 slo: SLOTargets | None = None):
         if infeasible_patience < 1:
             raise ValueError(
                 f"infeasible_patience must be >= 1, got {infeasible_patience}")
@@ -86,11 +130,22 @@ class Watchdog:
         self.infeasible_patience = int(infeasible_patience)
         self.stall_timeout = stall_timeout
         self.on_alert = on_alert
+        self.slo = slo
         self.alerts: list[Alert] = []
         self._lock = lockwitness.make_lock("Watchdog._lock")
         self._infeasible_streak = 0
         self._armed = {ALERT_NAN: True, ALERT_CERT_BLOWUP: True,
-                       ALERT_INFEASIBLE: True}
+                       ALERT_INFEASIBLE: True, ALERT_SLO_BURN: True,
+                       ALERT_LOW_OCCUPANCY: True}
+        # Burn-rate sample windows: (t_wall, bad) per request event and
+        # (t_wall, occupancy_pct) per serve.lanes.window event, evicted
+        # past the slow window. The sink fans subscriber callbacks out
+        # AFTER releasing its own lock, so two emitting threads can run
+        # _on_event concurrently — all check state (_armed, streaks,
+        # these windows) mutates under self._lock, with alerts raised
+        # after release (_raise_alert re-takes the same lock).
+        self._slo_requests: collections.deque = collections.deque()
+        self._occ_samples: collections.deque = collections.deque()
         self._stop = lockwitness.make_event("Watchdog._stop")
         self._started = time.time()
         self._thread = None
@@ -135,7 +190,17 @@ class Watchdog:
                 pass
 
     def _on_event(self, event: dict) -> None:
-        if event.get("event") != "heartbeat":
+        etype = event.get("event")
+        if etype == "request":
+            if self.slo is not None \
+                    and self.slo.queue_wait_p99_s is not None:
+                self._check_slo_burn(event)
+            return
+        if etype == "serve.lanes.window":
+            if self.slo is not None and self.slo.occupancy_pct is not None:
+                self._check_occupancy(event)
+            return
+        if etype != "heartbeat":
             return
         step = event.get("step")
         values = {f.name: schema.scalar_value(event[f.name])
@@ -153,53 +218,134 @@ class Watchdog:
         nsc = values.get("nonfinite_state_count")
         if nsc is not None and nsc == nsc and nsc > 0:
             bad.append(f"nonfinite_state_count={int(nsc)}")
-        if bad:
-            if self._armed[ALERT_NAN]:
-                self._armed[ALERT_NAN] = False
-                # Stays critical even while the ladder is engaged: a
-                # non-finite value on the stream escaped the ladder.
-                self._raise_alert(
-                    ALERT_NAN, step,
-                    f"non-finite heartbeat channel(s): {', '.join(bad)}",
-                    rta_mode=rta)
-        else:
-            self._armed[ALERT_NAN] = True
-
-        res = values.get("certificate_residual")
-        if res is not None:
-            if res == res and res > self.residual_threshold:
-                if self._armed[ALERT_CERT_BLOWUP]:
-                    self._armed[ALERT_CERT_BLOWUP] = False
-                    detail = (f"certificate residual {res:.3e} > threshold "
-                              f"{self.residual_threshold:.1e}")
-                    if absorbed:
-                        detail += f" (absorbed by RTA rung {int(rta)})"
-                    self._raise_alert(
-                        ALERT_CERT_BLOWUP, step, detail,
-                        severity="warning" if absorbed else "critical",
-                        rta_mode=rta)
+        raises: list[tuple[str, str, str]] = []
+        with self._lock:
+            if bad:
+                if self._armed[ALERT_NAN]:
+                    self._armed[ALERT_NAN] = False
+                    # Stays critical even while the ladder is engaged: a
+                    # non-finite value on the stream escaped the ladder.
+                    raises.append((
+                        ALERT_NAN,
+                        f"non-finite heartbeat channel(s): "
+                        f"{', '.join(bad)}", "critical"))
             else:
-                self._armed[ALERT_CERT_BLOWUP] = True
+                self._armed[ALERT_NAN] = True
 
-        inf = values.get("infeasible_count")
-        if inf is not None:
-            if inf == inf and inf > 0:
-                self._infeasible_streak += 1
-                if (self._infeasible_streak >= self.infeasible_patience
-                        and self._armed[ALERT_INFEASIBLE]):
-                    self._armed[ALERT_INFEASIBLE] = False
-                    detail = (f"infeasible QPs on {self._infeasible_streak} "
-                              "consecutive heartbeats "
-                              f"(last count {int(inf)})")
-                    if absorbed:
-                        detail += f" (absorbed by RTA rung {int(rta)})"
-                    self._raise_alert(
-                        ALERT_INFEASIBLE, step, detail,
-                        severity="warning" if absorbed else "critical",
-                        rta_mode=rta)
-            else:
-                self._infeasible_streak = 0
-                self._armed[ALERT_INFEASIBLE] = True
+            res = values.get("certificate_residual")
+            if res is not None:
+                if res == res and res > self.residual_threshold:
+                    if self._armed[ALERT_CERT_BLOWUP]:
+                        self._armed[ALERT_CERT_BLOWUP] = False
+                        detail = (f"certificate residual {res:.3e} > "
+                                  f"threshold {self.residual_threshold:.1e}")
+                        if absorbed:
+                            detail += f" (absorbed by RTA rung {int(rta)})"
+                        raises.append((
+                            ALERT_CERT_BLOWUP, detail,
+                            "warning" if absorbed else "critical"))
+                else:
+                    self._armed[ALERT_CERT_BLOWUP] = True
+
+            inf = values.get("infeasible_count")
+            if inf is not None:
+                if inf == inf and inf > 0:
+                    self._infeasible_streak += 1
+                    if (self._infeasible_streak >= self.infeasible_patience
+                            and self._armed[ALERT_INFEASIBLE]):
+                        self._armed[ALERT_INFEASIBLE] = False
+                        detail = (f"infeasible QPs on "
+                                  f"{self._infeasible_streak} consecutive "
+                                  f"heartbeats (last count {int(inf)})")
+                        if absorbed:
+                            detail += f" (absorbed by RTA rung {int(rta)})"
+                        raises.append((
+                            ALERT_INFEASIBLE, detail,
+                            "warning" if absorbed else "critical"))
+                else:
+                    self._infeasible_streak = 0
+                    self._armed[ALERT_INFEASIBLE] = True
+        for kind, detail, severity in raises:
+            self._raise_alert(kind, step, detail, severity=severity,
+                              rta_mode=rta)
+
+    def _check_slo_burn(self, event: dict) -> None:
+        """Multi-window error-budget burn on queue wait. Burn rate =
+        (bad-request fraction in window) / error_budget; trips only when
+        the FAST and SLOW windows both exceed their thresholds, re-arms
+        once the fast window drops back under 1x (budget no longer
+        burning)."""
+        slo = self.slo
+        try:
+            wait = schema.scalar_value(event.get("queue_wait_s"))
+        except (TypeError, ValueError):
+            return
+        now = float(event.get("t_wall") or time.time())
+        bad = wait == wait and wait > slo.queue_wait_p99_s
+        trip = False
+        with self._lock:
+            q = self._slo_requests
+            q.append((now, bad))
+            while q and q[0][0] < now - slo.slow_window_s:
+                q.popleft()
+            fast = [b for t, b in q if t >= now - slo.fast_window_s]
+            if len(fast) < slo.min_requests:
+                return
+            budget = max(slo.error_budget, 1e-9)
+            fast_burn = (sum(fast) / len(fast)) / budget
+            slow_burn = (sum(b for _, b in q) / len(q)) / budget
+            if fast_burn >= slo.fast_burn and slow_burn >= slo.slow_burn:
+                if self._armed[ALERT_SLO_BURN]:
+                    self._armed[ALERT_SLO_BURN] = False
+                    trip = True
+            elif fast_burn < 1.0:
+                self._armed[ALERT_SLO_BURN] = True
+        if trip:
+            self._raise_alert(
+                ALERT_SLO_BURN, None,
+                f"queue-wait SLO burning {fast_burn:.1f}x budget over "
+                f"{slo.fast_window_s:.0f}s and {slow_burn:.1f}x over "
+                f"{slo.slow_window_s:.0f}s (target "
+                f"{slo.queue_wait_p99_s:.3f}s, budget "
+                f"{slo.error_budget:.3f})")
+
+    def _check_occupancy(self, event: dict) -> None:
+        """Sustained-low-occupancy: every fast-window ledger sample
+        (>= 2) AND at least half the slow-window samples below target.
+        Re-arms on the first healthy sample."""
+        slo = self.slo
+        try:
+            occ = schema.scalar_value(event.get("occupancy_pct"))
+        except (TypeError, ValueError):
+            return
+        if occ != occ:
+            return
+        now = float(event.get("t_wall") or time.time())
+        trip = False
+        with self._lock:
+            q = self._occ_samples
+            q.append((now, occ))
+            while q and q[0][0] < now - slo.slow_window_s:
+                q.popleft()
+            if occ >= slo.occupancy_pct:
+                self._armed[ALERT_LOW_OCCUPANCY] = True
+                return
+            fast = [o for t, o in q if t >= now - slo.fast_window_s]
+            slow_low = sum(o < slo.occupancy_pct for _, o in q)
+            if (len(fast) >= 2
+                    and all(o < slo.occupancy_pct for o in fast)
+                    and slow_low * 2 >= len(q)
+                    and self._armed[ALERT_LOW_OCCUPANCY]):
+                self._armed[ALERT_LOW_OCCUPANCY] = False
+                trip = True
+        if trip:
+            self._raise_alert(
+                ALERT_LOW_OCCUPANCY, None,
+                f"lane occupancy {occ:.1f}% below target "
+                f"{slo.occupancy_pct:.1f}% across the last "
+                f"{len(fast)} ledger windows "
+                f"({slow_low}/{len(q)} slow-window samples low)",
+                severity="warning")
 
     def _stall_loop(self) -> None:
         # Re-arming: one alert per stall episode; a fresh heartbeat after

@@ -359,7 +359,8 @@ class ServeEngine:
                  cache_dir: str | None = None, telemetry=None, tracer=None,
                  fault_policy: resilience.FaultPolicy | None = None,
                  journal=None, cost_model=None, flight=None,
-                 continuous: bool = False, chunk_steps: int = 16):
+                 continuous: bool = False, chunk_steps: int = 16,
+                 lane_ledger=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if chunk_steps < 1:
@@ -413,6 +414,28 @@ class ServeEngine:
         # capsule on NonFiniteResult, quarantine/breaker opens, scheduler
         # crashes, and SIGTERM drains. None (default) disables.
         self.flight = flight
+        # Scheduler observatory (obs.lanes.LaneLedger): chunk-boundary
+        # occupancy/attribution ledger. None (default) auto-arms iff
+        # continuous AND a telemetry sink is attached; True forces a
+        # ledger (standalone, still readable via engine.lanes); False
+        # disables; a ready-made LaneLedger is used as-is. Off, the
+        # scheduler takes zero extra clock reads and stays bit-neutral.
+        if lane_ledger is None:
+            lane_ledger = bool(continuous and telemetry is not None)
+        if lane_ledger is True:
+            from cbf_tpu.obs.lanes import LaneLedger
+
+            self.lanes = LaneLedger(sink=telemetry)
+        elif lane_ledger is False:
+            self.lanes = None
+        else:
+            self.lanes = lane_ledger
+        # Every incident capsule embeds "what was running": unless the
+        # caller already installed a context seam, wire the recorder's
+        # context_fn to this engine's in-flight snapshot (queue depth +
+        # lane-ledger state) so capsule manifests are never stale.
+        if flight is not None and getattr(flight, "context_fn", None) is None:
+            flight.context_fn = self._flight_context
         self.prewarm_s: float | None = None
         self.stats = {"requests": 0, "batches": 0, "pad_slots": 0,
                       "compile_hit": 0, "compile_miss": 0, "retries": 0,
@@ -793,6 +816,23 @@ class ServeEngine:
             except Exception:
                 request = None
         self.flight.trip(reason, detail, request=request)
+
+    def _flight_context(self) -> dict:
+        """The "what was running" snapshot every flight capsule embeds
+        (`FlightRecorder.context_fn`): foreground queue depth plus the
+        lane ledger's in-flight table view and last-W chunk records.
+        Lock-free by design — it runs inside a trip, possibly on a
+        thread already deep in engine locks, so it must never block."""
+        try:
+            queue_depth = sum(len(v) for v in list(self._queue.values()))
+        except RuntimeError:
+            queue_depth = None
+        led = self.lanes
+        return {
+            "continuous": self.continuous,
+            "queue_depth": queue_depth,
+            "lane_ledger": led.snapshot() if led is not None else None,
+        }
 
     def _record_signature_success(self, cfg: swarm.Config,
                                   bucket_label: str) -> None:
@@ -1716,6 +1756,16 @@ class ServeEngine:
                         self.tracer.now(), self._queue, self._tables)
                 self._apply_joins(j2, e2, self._tables)
             if advanced:
+                # Foreground ran, so any background table holding live
+                # lanes was denied the device this pass — the ledger's
+                # preempted-lane accounting (`B` in the live bitmaps).
+                led = self.lanes
+                if led is not None:
+                    for btab in list(self._bg_tables.values()):
+                        slots = btab.live_slots()
+                        if slots:
+                            led.note_preempted(btab.label,
+                                               len(btab.lanes), slots)
                 continue
             # Foreground fully idle this pass: the background tier gets
             # at most ONE table-chunk (or one tenant unit) before the
@@ -1815,14 +1865,22 @@ class ServeEngine:
                 table.join(key, pending, cfg, traced, t_enq, deadline_t,
                            now, eff, degraded)
                 self._count("lanes_joined")
+                if self.lanes is not None:
+                    self.lanes.note_join(label)
                 self.tracer.record("queue_wait", t0_s=t_enq,
                                    dur_s=now - t_enq,
                                    trace_id=pending.request_id,
                                    bucket=label)
 
     def _vacate(self, table: _LaneTable, slot: int) -> None:
+        led = self.lanes
+        if led is not None:
+            lane = table.lanes[slot]
+            if lane is not None:
+                led.note_vacate(table.label,
+                                max(0.0, self.tracer.now() - lane.t_join))
         table.vacate(slot)
-        self._bump("lanes_vacated")
+        self._count("lanes_vacated")
 
     def _advance_table(self, table: _LaneTable, *, background=False,
                        attempt: int = 0) -> None:
@@ -1855,6 +1913,14 @@ class ServeEngine:
         if not live:
             return
         chunk_id = f"c{next(self._batch_ids)}"
+        # Lane-ledger chunk window: integer nanoseconds on the same
+        # monotonic clock family as the tracer, opened here (first
+        # device-touching work) and closed after the per-slot resolve
+        # loop so dispatch_ns captures ALL non-execute chunk cost.
+        led = self.lanes
+        if led is not None:
+            t_chunk0 = tracer.now()
+            w0 = time.perf_counter_ns()
         hook = self.fault_hook
         hook_key = _buckets.BucketKey(table.static_cfg, table.chunk)
         hook_entries = [(table.lanes[i].pending, table.lanes[i].cfg,
@@ -1867,10 +1933,13 @@ class ServeEngine:
             with tracer.span("executable_hit" if hit else "compile",
                              trace_id=chunk_id, bucket=label):
                 compiled = self._chunk_executable(table.static_cfg)
+            if led is not None:
+                p0 = time.perf_counter_ns()
             with tracer.span("pack", trace_id=chunk_id, bucket=label):
                 traced_b = table.stacked_traced()
                 steps_b = np.array(table.steps_np)
                 t0_b = np.array(table.t_np)
+            pack_ns = time.perf_counter_ns() - p0 if led is not None else 0
             if hook is not None:
                 hook(hook_key, hook_entries, attempt, "execute")
             t0 = time.perf_counter()
@@ -1883,12 +1952,15 @@ class ServeEngine:
             self._on_chunk_failure(table, attempt, e,
                                    background=background)
             return
+        if led is not None:
+            u0 = time.perf_counter_ns()
         with tracer.span("unpack", trace_id=chunk_id, bucket=label):
             outs_host = jax.device_get(outs)
+        unpack_ns = time.perf_counter_ns() - u0 if led is not None else 0
         # The carry crosses the chunk boundary on device (solver warm
         # state included); only the chunk's outputs come to host.
         table.states = final_states
-        self._bump("chunks_executed")
+        self._count("chunks_executed")
         if self.cost_model is not None:
             obs = self.cost_model.observe_execute(label, execute_s)
             cost = self.cost_model.cost_of(label)
@@ -1907,10 +1979,15 @@ class ServeEngine:
                 "peak_bytes": cost.get("peak_bytes", 0)})
         now = tracer.now()
         fill = len(live)
+        lane_rows = []
         for slot in live:
             lane = table.lanes[slot]
             done_before = int(t0_b[slot])
             k_i = max(0, min(table.chunk, lane.eff_steps - done_before))
+            if led is not None:
+                # Row captured BEFORE resolve/vacate clears the lane.
+                lane_rows.append((slot, lane.pending.request_id, k_i,
+                                  max(0.0, now - lane.t_join)))
             part = _pack.slice_lane_chunk(outs_host, slot, k_i)
             lane.parts.append(part)
             lane.execute_s += execute_s
@@ -1936,6 +2013,28 @@ class ServeEngine:
                         np.min(part.min_pairwise_distance)),
                     "infeasible_count": int(
                         np.sum(part.infeasible_count))})
+        if led is not None:
+            # Close the chunk window and stamp the ledger. execute_ns is
+            # clamped into the wall window so the dispatch complement
+            # (total - vacancy - live*execute) can never go negative and
+            # the integer accounting identity holds exactly.
+            wall_ns = max(time.perf_counter_ns() - w0, 1)
+            execute_ns = min(int(execute_s * 1e9), wall_ns)
+            led.note_chunk(
+                chunk_id, label, lanes=len(table.lanes),
+                chunk_steps=table.chunk, lane_rows=lane_rows,
+                wall_ns=wall_ns, execute_ns=execute_ns, pack_ns=pack_ns,
+                unpack_ns=unpack_ns, background=background, t_s=t_chunk0)
+            # Per-lane Perfetto tracks: one "chunk" span per live lane,
+            # keyed to a stable "<bucket>/lane<slot>" track so a
+            # request's JOIN -> chunks -> LEAVE renders as one timeline
+            # row, flow-linked back to its enqueue span by
+            # Tracer.chrome_trace().
+            dur_s = wall_ns / 1e9
+            for slot, request_id, _k, _age in lane_rows:
+                tracer.record("chunk", t0_s=t_chunk0, dur_s=dur_s,
+                              trace_id=request_id, bucket=label,
+                              track=f"{label}/lane{slot}")
 
     def _resolve_lane(self, table: _LaneTable, slot: int, final_states,
                       fill: int, now: float) -> None:
@@ -2002,6 +2101,12 @@ class ServeEngine:
                         np.sum(outs_i.infeasible_count)),
                     "ttfp_s": lane.ttfp_s,
                 })
+            # TTFP through the registry surface (metrics.prom/json), not
+            # just the per-request event stream / loadgen report.
+            reg = getattr(self.telemetry, "registry", None)
+            if reg is not None and lane.ttfp_s is not None:
+                reg.histogram("serve.ttfp_s").observe(lane.ttfp_s)
+                reg.histogram(f"serve.ttfp_s[{label}]").observe(lane.ttfp_s)
             pending._resolve(result=result)
 
     def _on_chunk_failure(self, table: _LaneTable, attempt: int,

@@ -206,6 +206,13 @@ def run_loadgen(engine, spec: LoadSpec, *, telemetry=None,
     started_here = not engine._running
     if started_here:
         engine.start()
+    # Scheduler-observatory split: when the engine carries an armed
+    # LaneLedger, snapshot its cumulative totals NOW and report this
+    # run's occupancy/dispatch attribution as exact deltas — repeated
+    # legs on one engine (sweep_rps) stay per-leg, not cumulative.
+    led = getattr(engine, "lanes", None)
+    led_before = (led.totals(), led.bucket_totals()) \
+        if led is not None else None
     pendings = []
     errors_by_type: dict[str, int] = {}
     scen_errors: dict[str, int] = {}
@@ -254,6 +261,20 @@ def run_loadgen(engine, spec: LoadSpec, *, telemetry=None,
         if started_here:
             engine.stop(drain=True)
 
+    lanes_report = None
+    lane_bucket: dict[str, dict] = {}
+    if led is not None:
+        from cbf_tpu.obs import lanes as obs_lanes
+        g = obs_lanes.derive(obs_lanes.subtract(led.totals(),
+                                                led_before[0]))
+        if g["chunks"]:
+            lanes_report = g
+        for b, acct in led.bucket_totals().items():
+            d = obs_lanes.derive(obs_lanes.subtract(
+                acct, led_before[1].get(b, {})))
+            if d["chunks"]:
+                lane_bucket[b] = d
+
     # Per-bucket SLO split: aggregate percentiles hide which leg of the
     # ladder is slow — a p99 blowup in one big bucket looks like uniform
     # degradation in the roll-up. Group by the served bucket label.
@@ -265,6 +286,8 @@ def run_loadgen(engine, spec: LoadSpec, *, telemetry=None,
         rs = groups.get(label, [])
         bq = sorted(r.queue_wait_s for r in rs)
         bx = sorted(r.execute_s for r in rs)
+        bt = sorted(r.ttfp_s for r in rs
+                    if getattr(r, "ttfp_s", None) is not None)
         by_bucket[label] = {
             "completed": len(rs),
             "errors": bucket_errors.get(label, 0),
@@ -274,7 +297,16 @@ def run_loadgen(engine, spec: LoadSpec, *, telemetry=None,
             "execute_p50_s": _quantile(bx, 0.50),
             "execute_p95_s": _quantile(bx, 0.95),
             "execute_p99_s": _quantile(bx, 0.99),
+            "ttfp_p50_s": _quantile(bt, 0.50),
+            "ttfp_p95_s": _quantile(bt, 0.95),
+            "ttfp_p99_s": _quantile(bt, 0.99),
         }
+        if label in lane_bucket:
+            by_bucket[label]["occupancy_pct"] = \
+                lane_bucket[label]["occupancy_pct"]
+            by_bucket[label]["dispatch_pct"] = \
+                lane_bucket[label]["dispatch_pct"]
+            by_bucket[label]["lane_chunks"] = lane_bucket[label]["chunks"]
         for k, v in list(by_bucket[label].items()):
             if isinstance(v, float):
                 by_bucket[label][k] = round(v, 6)
@@ -344,6 +376,12 @@ def run_loadgen(engine, spec: LoadSpec, *, telemetry=None,
                                  for r in results) if results else None),
         "by_bucket": by_bucket,
         "by_scenario": by_scenario,
+        # Exact lane-time attribution for THIS run (lane-ledger deltas;
+        # None when the engine has no armed ledger, e.g. drain mode).
+        # Rides the report only — the loadgen.summary event keeps its
+        # fixed field set, with the per-bucket occupancy split inside
+        # by_bucket.
+        "lanes": lanes_report,
     }
     for k, v in list(report.items()):
         if isinstance(v, float):

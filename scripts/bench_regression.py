@@ -27,6 +27,12 @@ in the record's ``knee_rps_drain`` field for the continuous-vs-drain
 comparison. Axes are auto-discovered from each round's ``parsed``
 records, so the sweep axis enrolls the first round it is run; a knee
 slide past tolerance then fails the audit like any throughput slide.
+PR 17's BENCH_OCCUPANCY record enrolls FOUR axes the same way: the
+primary ``serve lane occupancy, continuous batching (open-loop <lo>
+rps)`` plus its ``extra_axes`` companions — occupancy at the past-knee
+rate and ``serve dispatch efficiency`` (100 - dispatch-overhead %, so
+higher stays better) at both rates; ``collect_series`` flattens
+``extra_axes`` records into first-class axes.
 The comparison and parsing logic is pure and
 unit-tested fast; the repo-level audit runs as a slow-tier test
 (tests/test_obs_resource.py) and ``--write-trajectory`` refreshes
@@ -111,12 +117,19 @@ def collect_series(rounds: list[tuple[int, str]]) -> dict[str, list[dict]]:
         for rec in records:
             if not isinstance(rec, dict) or "metric" not in rec:
                 continue
-            axis = f"{rec['metric']} [{rec.get('unit', '')}]"
-            eff = effective(rec)
-            entry = {"round": rnd, "verified": eff is not None}
-            if eff is not None:
-                entry.update(eff)
-            series.setdefault(axis, []).append(entry)
+            # A record may carry companion axes (``extra_axes`` — e.g.
+            # BENCH_OCCUPANCY's occupancy@HI and dispatch-efficiency
+            # records): enroll each as its own axis, inheriting nothing
+            # from the primary.
+            subrecords = [rec] + [e for e in rec.get("extra_axes", [])
+                                  if isinstance(e, dict) and "metric" in e]
+            for sub in subrecords:
+                axis = f"{sub['metric']} [{sub.get('unit', '')}]"
+                eff = effective(sub)
+                entry = {"round": rnd, "verified": eff is not None}
+                if eff is not None:
+                    entry.update(eff)
+                series.setdefault(axis, []).append(entry)
     return series
 
 
