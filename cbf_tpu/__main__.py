@@ -1742,8 +1742,33 @@ def cmd_lint(args) -> int:
     failure (malformed baseline, unreadable path)."""
     from cbf_tpu.analysis import report
     from cbf_tpu.analysis.baseline import BaselineError
+    from cbf_tpu.analysis.mesh_budget import BudgetError
 
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if args.write_spmd_budget:
+        from cbf_tpu.analysis import mesh_budget, spmd_rules
+
+        if spmd_rules.device_capacity() < spmd_rules.VIRTUAL_DEVICES:
+            print("lint: cannot write the spmd budget with "
+                  f"{spmd_rules.device_capacity()} device(s) — the "
+                  "census needs the virtual "
+                  f"{spmd_rules.VIRTUAL_DEVICES}-device mesh",
+                  file=sys.stderr)
+            return 2
+        reports, findings = spmd_rules.entrypoint_reports(
+            args.entrypoint or None)
+        if findings:
+            for f in findings:
+                print(f"lint: {f.symbol}: {f.message}", file=sys.stderr)
+            return 2
+        try:
+            mesh_budget.write(reports, reason=args.reason)
+        except BudgetError as e:
+            print(f"lint: {e}", file=sys.stderr)
+            return 2
+        print(f"wrote {mesh_budget.DEFAULT_PATH} "
+              f"({len(reports)} entr{'ies' if len(reports) != 1 else 'y'})")
+        return 0
     # Default to the same path set the tier-1 gate lints, so "what the
     # gate enforces" and "what the terminal shows" cannot drift apart.
     paths = args.paths or [
@@ -1755,8 +1780,9 @@ def cmd_lint(args) -> int:
             paths, repo_root=repo_root, baseline_path=args.baseline,
             jaxpr=args.all or args.jaxpr, audits=args.all,
             concurrency=args.all or args.concurrency,
+            spmd=args.all or args.spmd,
             entrypoints=args.entrypoint or None)
-    except BaselineError as e:
+    except (BaselineError, BudgetError) as e:
         print(f"lint: {e}", file=sys.stderr)
         return 2
     except OSError as e:
@@ -1871,6 +1897,20 @@ def main(argv=None) -> int:
                        help="also run just the concurrency analyzer "
                             "(CC0xx: lock discipline, lock-order graph; "
                             "docs/API.md 'Concurrency analysis')")
+    lintp.add_argument("--spmd", action="store_true",
+                       help="also run just the SPMD sharding analyzer "
+                            "(SP0xx: collective census vs "
+                            "spmd_budget.toml, replication lint, "
+                            "shard_map/PartitionSpec hygiene; "
+                            "docs/API.md 'SPMD analysis')")
+    lintp.add_argument("--write-spmd-budget", action="store_true",
+                       help="regenerate cbf_tpu/analysis/spmd_budget.toml "
+                            "from a fresh census instead of linting "
+                            "(changed/new rows need --reason)")
+    lintp.add_argument("--reason", default=None, metavar="TEXT",
+                       help="with --write-spmd-budget: why the new "
+                            "census is the intended one (stamped on "
+                            "every changed/new budget row)")
     lintp.add_argument("--entrypoint", action="append", default=[],
                        metavar="NAME",
                        help="restrict the jaxpr checks to these entry "
@@ -2274,7 +2314,47 @@ def main(argv=None) -> int:
     lanesp.set_defaults(fn=cmd_obs_lanes)
 
     args = p.parse_args(argv)
+    if argv is None:
+        _maybe_spmd_reexec(args)
     return args.fn(args)
+
+
+def _spmd_wants_devices(args) -> bool:
+    """True when this lint invocation needs the virtual 8-device mesh."""
+    return args.command == "lint" and (
+        args.all or args.spmd or args.write_spmd_budget)
+
+
+def _maybe_spmd_reexec(args) -> None:
+    """Re-exec the CLI with the virtual-device XLA flag when the SPMD
+    pass needs more CPU devices than this process booted with.
+
+    Importing cbf_tpu imports jax, and jax 0.4.x fixes the CPU device
+    count at backend init — the flag cannot be applied in-process, so
+    the one clean path from a bare ``python -m cbf_tpu lint --all`` to
+    an 8-device mesh is replacing the process with itself, environment
+    amended. Guarded against loops (CBF_TPU_SPMD_REEXEC) and scoped to
+    the real CLI (``main(argv=...)`` callers never re-exec).
+    """
+    if not _spmd_wants_devices(args):
+        return
+    if os.environ.get("CBF_TPU_SPMD_REEXEC"):
+        return
+    import jax
+
+    from cbf_tpu.analysis import spmd_rules
+
+    if jax.default_backend() != "cpu":
+        return                 # real accelerators: use what exists
+    if len(jax.devices()) >= spmd_rules.VIRTUAL_DEVICES:
+        return
+    env = dict(os.environ)
+    env["CBF_TPU_SPMD_REEXEC"] = "1"
+    env["XLA_FLAGS"] = spmd_rules.spmd_xla_flags(env.get("XLA_FLAGS"))
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execve(sys.executable,
+              [sys.executable, "-m", "cbf_tpu"] + sys.argv[1:], env)
 
 
 if __name__ == "__main__":

@@ -26,6 +26,11 @@ existing tier-1 tests and operator muscle memory keep working.
   discovered lock/thread inventory vs the docs/API.md concurrency-map
   table (a new thread or lock without a doc row fails tier-1, and a
   map row for a primitive that no longer exists is stale).
+* AUD009 — spmd-budget liveness: every sharded entry point the SPMD
+  analyzer lowers has a committed spmd_budget.toml row, every row names
+  a live entry point, and the file itself is well-formed with a reason
+  per row (names only — the census-vs-budget comparison itself is the
+  lowering pass's SP001/SP002).
 """
 
 from __future__ import annotations
@@ -818,6 +823,25 @@ def concurrency_map_audit(repo_root: str | None = None) -> list[str]:
     return problems
 
 
+# -- AUD009: spmd-budget liveness ------------------------------------------
+
+
+def spmd_budget_audit(repo_root: str | None = None) -> list[str]:
+    """Both directions of the budget <-> entry-point mapping, plus file
+    well-formedness. Names only: no jax import, no lowering — the cheap
+    half of the SPMD gate that runs even where the census can't."""
+    from cbf_tpu.analysis import mesh_budget
+    from cbf_tpu.analysis.spmd_rules import spmd_entrypoint_names
+
+    path = os.path.join(repo_root or _REPO, "cbf_tpu", "analysis",
+                        "spmd_budget.toml")
+    try:
+        budget = mesh_budget.load(path)
+    except mesh_budget.BudgetError as e:
+        return [str(e)]
+    return mesh_budget.liveness_problems(budget, spmd_entrypoint_names())
+
+
 # -- runner ----------------------------------------------------------------
 
 def run_audits(repo_root: str | None = None) -> list[Finding]:
@@ -843,4 +867,8 @@ def run_audits(repo_root: str | None = None) -> list[Finding]:
         findings.append(Finding("AUD008",
                                 "cbf_tpu/analysis/concurrency.py",
                                 0, 0, "<concurrency>", msg))
+    for msg in spmd_budget_audit(repo_root):
+        findings.append(Finding("AUD009",
+                                "cbf_tpu/analysis/spmd_budget.toml",
+                                0, 0, "<spmd-budget>", msg))
     return findings

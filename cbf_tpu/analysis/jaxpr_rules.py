@@ -30,8 +30,9 @@ invariants:
 ``check_jaxpr`` is the reusable core (the tests aim it at
 fault-injected step functions); ``run_entrypoint_checks`` traces the
 repo's production surface: ``rollout`` (shared compiled unit of
-``rollout_chunked``), ``sharded_swarm_rollout``, and the fused/batched
-certificate solves.
+``rollout_chunked``), the mixed-dynamics swarm step,
+``sharded_swarm_rollout``, the fused/batched certificate solves, and
+the serve engine's continuous-batching ``lockstep_traced_chunk``.
 """
 
 from __future__ import annotations
@@ -317,12 +318,56 @@ def entrypoint_specs() -> dict[str, Callable[[], list[Finding]]]:
             entry="sharded_swarm_rollout",
             carry_argnum=0, carry_out=lambda out: out[0][0])
 
+    def _rollout_mixed() -> list[Finding]:
+        """The heterogeneous (mixed-dynamics) swarm step: PR 12's
+        branch-free double-integrator + single-integrator split serves
+        scenario traffic and must hold the same JX invariants."""
+        from cbf_tpu.rollout.engine import rollout
+        from cbf_tpu.scenarios import swarm
+
+        cfg = swarm.Config(n=8, steps=4, k_neighbors=4,
+                           dynamics="mixed", n_double=4)
+        state0, step = swarm.make(cfg)
+        return trace_and_check(
+            lambda s: rollout(step, s, 4), (state0,),
+            entry="rollout[swarm+mixed]",
+            carry_argnum=0, carry_out=lambda out: out[0])
+
+    def _lockstep_chunk() -> list[Finding]:
+        """The continuous-batching hot path (serve engine's per-chunk
+        executable): lane states are the carry — JX003 drift here means
+        every chunk boundary recompiles the shared program."""
+        import jax
+        import jax.numpy as jnp
+
+        from cbf_tpu.parallel.ensemble import lockstep_traced_chunk
+        from cbf_tpu.scenarios import swarm
+
+        cfg = swarm.Config(n=8, steps=4, k_neighbors=4)
+        static_cfg, traced0 = swarm.split_static_traced(cfg)
+        fn = lockstep_traced_chunk(static_cfg, 4)
+        B = 2
+        state0, _step = swarm.make(static_cfg)
+        states = jax.tree.map(
+            lambda a: jnp.broadcast_to(a[None], (B,) + a.shape), state0)
+        traced = {k: jnp.full((B,), float(v), jnp.float32)
+                  for k, v in traced0.items() if k != "n_active"}
+        traced["n_active"] = jnp.full((B,), cfg.n, jnp.int32)
+        steps = jnp.full((B,), 4, jnp.int32)
+        t0 = jnp.zeros((B,), jnp.int32)
+        return trace_and_check(
+            fn, (states, traced, steps, t0),
+            entry="lockstep_traced_chunk",
+            carry_argnum=0, carry_out=lambda out: out[0])
+
     return {
         "rollout": _rollout,
         "rollout_certificate_fused": _rollout_certificate_fused,
         "rollout_telemetry": _rollout_telemetry,
+        "rollout_mixed": _rollout_mixed,
         "certificate_batched": _certificate_batched,
         "sharded_rollout": _sharded_rollout,
+        "lockstep_chunk": _lockstep_chunk,
     }
 
 

@@ -170,6 +170,36 @@ _RULES = [
          "concurrency-map drift: a discovered lock/condition/event/"
          "thread/handler has no row in the docs/API.md concurrency map "
          "(or the map names a primitive that no longer exists)"),
+    # -- SPMD sharding (collective census + replication) -------------------
+    Rule("SP001", ERROR,
+         "collective-census regression: a sharded entry point's "
+         "optimized module gained a collective kind or count over its "
+         "committed spmd_budget.toml row (or has no row / a row whose "
+         "mesh no longer matches)"),
+    Rule("SP002", ERROR,
+         "per-device peak-bytes regression: analyzed peak (argument + "
+         "output + temp) exceeds the budget row past its tolerance"),
+    Rule("SP003", ERROR,
+         "replicated large intermediate: per-device peak under the "
+         "full virtual mesh fails to shrink vs the 1-device compile of "
+         "the same global problem — sharding is not reducing the "
+         "footprint"),
+    Rule("SP004", ERROR,
+         "shard_map in_specs arity mismatch (literal spec tuple vs the "
+         "wrapped function's positional arity), or a sharded entry "
+         "point that fails to lower under the virtual mesh at all"),
+    Rule("SP005", ERROR,
+         "PartitionSpec literal outside the canonical partition-rule "
+         "table (analysis.spmd_rules.CANONICAL_PARTITION_SPECS): new "
+         "axis layouts land in the table, not inline"),
+    Rule("SP006", WARNING,
+         "raw jax shard_map import outside the parallel/ensemble.py "
+         "compat wrapper: forks the centralized check_rep policy and "
+         "the jax-version shim"),
+    Rule("AUD009", ERROR,
+         "spmd-budget liveness: a sharded entry point with no "
+         "spmd_budget.toml row, a stale row naming no live entry "
+         "point, or a malformed/reason-less budget file"),
 ]
 
 RULES: dict[str, Rule] = {r.id: r for r in _RULES}

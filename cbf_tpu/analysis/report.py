@@ -26,7 +26,8 @@ from cbf_tpu.analysis.registry import RULES, Finding
 
 
 class LintResult:
-    def __init__(self, active, suppressed, stale, lock_graph=None):
+    def __init__(self, active, suppressed, stale, lock_graph=None,
+                 spmd_census=None):
         self.active: list[Finding] = active
         self.suppressed: list[tuple[Finding,
                                     baseline_mod.Suppression]] = suppressed
@@ -35,6 +36,9 @@ class LintResult:
         # when the concurrency pass did not run (keeps the JSON contract
         # for plain lint runs byte-identical to before).
         self.lock_graph: list[dict] | None = lock_graph
+        # Per-entrypoint collective census from the SPMD pass; None when
+        # the pass did not run (same key contract as lock_order_graph).
+        self.spmd_census: dict | None = spmd_census
 
     @property
     def exit_code(self) -> int:
@@ -54,17 +58,20 @@ class LintResult:
         }
         if self.lock_graph is not None:
             d["lock_order_graph"] = self.lock_graph
+        if self.spmd_census is not None:
+            d["spmd_census"] = self.spmd_census
         return d
 
 
 def run_lint(paths: Iterable[str], *, repo_root: str | None = None,
              baseline_path: str | None = None,
              jaxpr: bool = False, audits: bool = False,
-             concurrency: bool = False,
+             concurrency: bool = False, spmd: bool = False,
              entrypoints: Iterable[str] | None = None) -> LintResult:
     """Lint ``paths`` (AST rules), optionally adding the jaxpr
-    entry-point checks, the consolidated repo audits and the
-    concurrency analyzer, and fold the result through the baseline."""
+    entry-point checks, the consolidated repo audits, the concurrency
+    analyzer and the SPMD sharding analyzer, and fold the result
+    through the baseline."""
     findings = ast_rules.lint_paths(paths, repo_root=repo_root)
     if jaxpr:
         from cbf_tpu.analysis import jaxpr_rules
@@ -81,6 +88,13 @@ def run_lint(paths: Iterable[str], *, repo_root: str | None = None,
         conc = conc_mod.analyze_paths(paths, repo_root=repo_root)
         findings.extend(conc.findings)
         lock_graph = [e._asdict() for e in conc.edges]
+    spmd_census = None
+    if spmd:
+        from cbf_tpu.analysis import spmd_rules
+
+        sp_findings, spmd_census = spmd_rules.run_spmd_checks(
+            paths, repo_root=repo_root, entrypoints=entrypoints)
+        findings.extend(sp_findings)
     sups = baseline_mod.load(baseline_path)
     active, suppressed, stale = baseline_mod.split(findings, sups)
     # A suppression is only judged stale by a run that could have
@@ -93,8 +107,11 @@ def run_lint(paths: Iterable[str], *, repo_root: str | None = None,
         ran += ("AUD",)
     if concurrency:
         ran += ("CC",)
+    if spmd:
+        ran += ("SP",)
     stale = [s for s in stale if s.rule.startswith(ran)]
-    return LintResult(active, suppressed, stale, lock_graph=lock_graph)
+    return LintResult(active, suppressed, stale, lock_graph=lock_graph,
+                      spmd_census=spmd_census)
 
 
 def _fmt(f: Finding, suffix: str = "") -> str:

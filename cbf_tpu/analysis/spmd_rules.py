@@ -1,0 +1,572 @@
+"""SPMD sharding analyzer: collective census + replication lint over the
+ABSTRACT lowering of every sharded entry point — no device execution.
+
+Where jaxpr_rules polices the traced program (callbacks, dtypes, carry
+avals), these rules police what XLA's SPMD partitioner actually emits:
+each sharded entry point is ``jit(...).lower(ShapeDtypeStruct...)
+.compile()``d under a virtual 8-device mesh (lower+compile is host-side
+codegen — nothing dispatches), and the optimized HLO module is walked
+for its **collective census** (all_reduce / all_gather / reduce_scatter
+/ ppermute / all_to_all counts + result-operand byte estimates) and its
+per-device memory footprint (``obs.resource.analyze_compiled``). A
+second compile of the same GLOBAL problem on a 1-device mesh gives the
+replication baseline: per-device peak bytes that don't shrink with the
+mesh betray a replicated intermediate (an accidentally-captured full
+array, a spec that replicates what should shard) — the exact failure
+that is invisible at toy scale and an OOM at N >= 100k.
+
+The census is pinned by ``spmd_budget.toml`` (analysis.mesh_budget): a
+new collective kind, a count increase, or a peak-bytes regression past
+the row's tolerance is a finding, and every intended change needs a
+rewritten row with a reason — the same committed-baseline discipline
+TS/CC findings already live under.
+
+Rules:
+
+* **SP001 — collective-census regression.** An entry point's optimized
+  module gained a collective kind or count over its committed budget
+  row (or has no row / a row whose mesh no longer matches). A halo
+  exchange silently upgraded to an all_gather is this finding.
+* **SP002 — per-device peak-bytes regression.** Analyzed peak bytes
+  (argument + output + temp) exceed the budget row past its tolerance.
+* **SP003 — replicated large intermediate.** Per-device peak under the
+  full mesh fails to shrink vs the 1-device compile of the same global
+  problem (shrink < :data:`MIN_SHRINK`) while the per-device peak is
+  big enough to matter (> :data:`REPLICATION_FLOOR_BYTES`).
+* **SP004 — in_specs arity mismatch.** A ``shard_map`` call whose
+  literal ``in_specs`` tuple length cannot match the wrapped function's
+  positional arity (AST-side), or a sharded entry point that fails to
+  lower at all under the virtual mesh.
+* **SP005 — PartitionSpec outside the partition-rule table.** A literal
+  ``P(...)`` drifting from :data:`CANONICAL_PARTITION_SPECS` — the one
+  table of axis layouts this repo shards by. New layouts land in the
+  table (here + docs), not inline.
+* **SP006 — raw shard_map import outside the compat wrapper.**
+  ``parallel/ensemble.py`` owns the one shard_map import and pins the
+  ``check_rep`` policy; a second import forks that policy.
+
+``python -m cbf_tpu lint --spmd`` (in ``--all``) runs both layers; the
+lowering layer degrades to a skipped census (no findings) when fewer
+than :data:`VIRTUAL_DEVICES` devices exist and jax is already imported
+— the CLI re-execs itself with ``XLA_FLAGS`` set so that path only
+arises in programmatic use (see ``__main__._spmd_reexec_env``).
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import os
+import re
+import sys
+from typing import Callable, Iterable, NamedTuple
+
+from cbf_tpu.analysis.registry import Finding
+
+#: Mesh capacity the lowering layer needs: every entry point's mesh
+#: (dp=2 x sp=4, dp=8 x sp=1, dp=8 eval sharding) fits exactly in 8.
+VIRTUAL_DEVICES = 8
+
+#: Census keys (stable JSON/budget names) -> optimized-HLO op names.
+COLLECTIVE_KINDS: dict[str, str] = {
+    "all_reduce": "all-reduce",
+    "all_gather": "all-gather",
+    "reduce_scatter": "reduce-scatter",
+    "ppermute": "collective-permute",
+    "all_to_all": "all-to-all",
+}
+
+#: SP003 thresholds: per-device peak must shrink at least this factor
+#: from the 1-device compile of the same global problem...
+MIN_SHRINK = 2.0
+#: ...but only once the per-device peak is big enough to matter — below
+#: this, fixed per-program overheads dominate and shrink is meaningless.
+REPLICATION_FLOOR_BYTES = 1 << 20
+
+#: The partition-rule table: every literal PartitionSpec the repo shards
+#: by (SP005). Tuples of axis names/None as they appear in ``P(...)``
+#: literals; non-literal specs (``P("dp", *pads)``) are out of scope.
+CANONICAL_PARTITION_SPECS: frozenset[tuple] = frozenset({
+    (),                        # fully replicated (scalars, t0, cbf)
+    ("dp",),                   # member-major pytree prefix / (E,) leaves
+    ("dp", None),              # per-member metrics (E, steps)
+    ("dp", "sp"),              # member x agent-row (E, N)
+    ("dp", "sp", None),        # member x agent-row state (E, N, 2)
+})
+
+#: The one module allowed to import jax's shard_map directly: the compat
+#: wrapper that pins the check_rep policy (SP006).
+SHARD_MAP_OWNER = "cbf_tpu/parallel/ensemble.py"
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2,
+    "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+    "f64": 8, "c64": 8, "c128": 16,
+}
+
+_SHAPE_RE = re.compile(r"\b(pred|[a-z]\d+)\[([\d,]*)\]")
+_COLL_RE = re.compile(
+    r"=\s+(?P<ty>[^=]*?)\s*\b(?P<op>"
+    + "|".join(sorted(COLLECTIVE_KINDS.values(), key=len, reverse=True))
+    + r")(?:-start)?\(")
+
+
+# -- environment ----------------------------------------------------------
+
+def spmd_xla_flags(existing: str | None) -> str:
+    """The XLA_FLAGS value that exposes :data:`VIRTUAL_DEVICES` virtual
+    CPU devices, appended to whatever flags are already set."""
+    flag = f"--xla_force_host_platform_device_count={VIRTUAL_DEVICES}"
+    if existing and "xla_force_host_platform_device_count" in existing:
+        return existing
+    return f"{existing} {flag}".strip() if existing else flag
+
+
+def ensure_spmd_env() -> None:
+    """Arrange for the virtual-device mesh BEFORE jax's first import.
+
+    A no-op once jax is imported (device count is fixed at backend init
+    — jax 0.4.x has no post-hoc CPU device-count config), which is why
+    the CLI applies this via re-exec rather than in-process.
+    """
+    if "jax" not in sys.modules:
+        os.environ["XLA_FLAGS"] = spmd_xla_flags(
+            os.environ.get("XLA_FLAGS"))
+
+
+def device_capacity() -> int:
+    import jax
+
+    return len(jax.devices())
+
+
+# -- collective census ----------------------------------------------------
+
+def _type_bytes(type_text: str) -> int:
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(type_text):
+        n = 1
+        for d in filter(None, dims.split(",")):
+            n *= int(d)
+        total += n * _DTYPE_BYTES.get(dtype, 0)
+    return total
+
+
+def collective_census(hlo_text: str) -> dict[str, dict[str, int]]:
+    """Count collectives in one optimized-HLO module and estimate their
+    result bytes from the printed result types. Returns
+    ``{kind: {"count": n, "bytes": b}}`` over every census kind (zeros
+    included, so absence is an explicit 0 the budget can pin)."""
+    by_op = {op: kind for kind, op in COLLECTIVE_KINDS.items()}
+    census = {k: {"count": 0, "bytes": 0} for k in COLLECTIVE_KINDS}
+    for m in _COLL_RE.finditer(hlo_text):
+        kind = by_op[m.group("op")]
+        census[kind]["count"] += 1
+        census[kind]["bytes"] += _type_bytes(m.group("ty"))
+    return census
+
+
+def census_counts(census: dict) -> dict[str, int]:
+    return {k: v["count"] for k, v in census.items()}
+
+
+# -- abstract lowering ----------------------------------------------------
+
+class SpmdEntry(NamedTuple):
+    """One sharded entry point the analyzer lowers: ``build(devices)``
+    returns ``(jitted, args)`` for a mesh over ``devices`` (``None`` for
+    the meshless entries, which compile once and skip the replication
+    baseline); ``mesh`` is the human/budget label."""
+    name: str
+    mesh: str                  # "dp=2,sp=4" | "unsharded"
+    build: Callable            # (devices | None) -> (jitted, args)
+
+
+def _abstract(tree):
+    """Pytree -> matching ShapeDtypeStructs (weak-typed leaves land as
+    the f32/i32 a concrete call would pass)."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(leaf):
+        a = jnp.asarray(leaf)
+        return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+    return jax.tree.map(one, tree)
+
+
+def spmd_entrypoints() -> list[SpmdEntry]:
+    """The analyzed production surface. Small problem sizes: the census
+    counts and the shard/replicate structure are decided by the program
+    and the specs, not the array extents."""
+    def _sharded_rollout(devices):
+        import jax
+        import jax.numpy as jnp
+
+        from cbf_tpu.parallel.ensemble import _rollout_executable
+        from cbf_tpu.parallel.mesh import make_mesh
+        from cbf_tpu.scenarios import swarm
+
+        cfg = swarm.Config(n=8, steps=3, k_neighbors=4)
+        E = 2
+        if len(devices) == 1:
+            mesh = make_mesh(n_dp=1, n_sp=1, devices=devices)
+        else:
+            mesh = make_mesh(n_dp=2, n_sp=4, devices=devices)
+        fn = _rollout_executable(cfg, mesh, E, 3)
+        state = jax.ShapeDtypeStruct((E, cfg.n, 2), jnp.float32)
+        t0 = jax.ShapeDtypeStruct((), jnp.int32)
+        cbf = _abstract(swarm.default_cbf(cfg))
+        return fn, (t0, cbf, state, state)
+
+    def _dp_certificate(devices):
+        import jax
+        import jax.numpy as jnp
+
+        from cbf_tpu.parallel.ensemble import _rollout_executable
+        from cbf_tpu.parallel.mesh import make_mesh
+        from cbf_tpu.scenarios import swarm
+        from cbf_tpu.sim.certificates import certificate_solver_seed
+
+        cfg = swarm.Config(n=8, steps=3, k_neighbors=4, certificate=True,
+                           certificate_backend="sparse",
+                           certificate_warm_start=True,
+                           certificate_iters=4, certificate_cg_iters=2)
+        E = 16                  # E_local > 1: the batched-cert solve
+        mesh = make_mesh(n_dp=len(devices), n_sp=1, devices=devices)
+        fn = _rollout_executable(cfg, mesh, E, 3)
+        state = jax.ShapeDtypeStruct((E, cfg.n, 2), jnp.float32)
+        t0 = jax.ShapeDtypeStruct((), jnp.int32)
+        cbf = _abstract(swarm.default_cbf(cfg))
+        seed = certificate_solver_seed(cfg.n, cfg.certificate_k, cfg.dtype)
+        carry = tuple(jax.ShapeDtypeStruct((E,) + a.shape, a.dtype)
+                      for a in seed)
+        return fn, (t0, cbf, state, state, carry)
+
+    def _verify_eval(devices):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        from cbf_tpu.verify.search import (SearchSettings, make_adapter,
+                                           make_eval_one)
+        from cbf_tpu.scenarios import swarm
+
+        adapter = make_adapter(
+            "swarm", cfg=swarm.Config(n=8, steps=3, k_neighbors=4))
+        eval_b = jax.jit(jax.vmap(make_eval_one(adapter, SearchSettings())))
+        shape = (8,) + adapter.delta_shape
+        if len(devices) == 1:
+            deltas = jax.ShapeDtypeStruct(shape, jnp.float32)
+        else:
+            import numpy as np
+
+            mesh = Mesh(np.asarray(devices), ("dp",))
+            spec = PartitionSpec(
+                "dp", *([None] * len(adapter.delta_shape)))
+            deltas = jax.ShapeDtypeStruct(
+                shape, jnp.float32,
+                sharding=NamedSharding(mesh, spec))
+        return eval_b, (deltas,)
+
+    def _lockstep_chunk(_devices):
+        import jax
+        import jax.numpy as jnp
+
+        from cbf_tpu.parallel.ensemble import lockstep_traced_chunk
+        from cbf_tpu.scenarios import swarm
+
+        cfg = swarm.Config(n=8, steps=4, k_neighbors=4)
+        static_cfg, traced0 = swarm.split_static_traced(cfg)
+        fn = lockstep_traced_chunk(static_cfg, 4)
+        B = 4
+        state0, _step = swarm.make(static_cfg)
+        states = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct((B,) + a.shape, a.dtype),
+            state0)
+        traced = {k: jax.ShapeDtypeStruct((B,), jnp.float32)
+                  for k in traced0}
+        traced["n_active"] = jax.ShapeDtypeStruct((B,), jnp.int32)
+        lanes = jax.ShapeDtypeStruct((B,), jnp.int32)
+        return fn, (states, traced, lanes, lanes)
+
+    return [
+        SpmdEntry("sharded_rollout", "dp=2,sp=4", _sharded_rollout),
+        SpmdEntry("dp_certificate_ensemble", "dp=8,sp=1", _dp_certificate),
+        SpmdEntry("verify_eval_batch", "dp=8", _verify_eval),
+        # The serve hot path compiles meshless: its standing census
+        # invariant is ZERO collectives (any nonzero count is a new
+        # kind over the committed all-zero row -> SP001).
+        SpmdEntry("lockstep_chunk", "unsharded", _lockstep_chunk),
+    ]
+
+
+def spmd_entrypoint_names() -> list[str]:
+    """Budget-liveness surface (AUD009) — no jax import, no lowering."""
+    return [e.name for e in spmd_entrypoints()]
+
+
+def analyze_entry(entry: SpmdEntry) -> tuple[dict, list[Finding]]:
+    """Lower+compile one entry under the full virtual mesh (and, for
+    mesh entries, the 1-device baseline), producing its census report
+    and any SP003/SP004 findings. No device execution."""
+    import jax
+
+    from cbf_tpu.obs.resource import analyze_compiled
+
+    def compile_for(devices):
+        fn, args = entry.build(devices)
+        return fn.lower(*args).compile()
+
+    path = "cbf_tpu/analysis/spmd_rules.py"
+    try:
+        compiled = compile_for(jax.devices()[:VIRTUAL_DEVICES])
+    except Exception as e:                     # noqa: BLE001
+        return {}, [Finding(
+            "SP004", path, 0, 0, entry.name,
+            f"entry point failed to lower under the virtual "
+            f"{entry.mesh} mesh: {type(e).__name__}: {e}")]
+    census = collective_census(compiled.as_text())
+    cost = analyze_compiled(compiled)
+    report = {
+        "mesh": entry.mesh,
+        "devices": (1 if entry.mesh == "unsharded" else VIRTUAL_DEVICES),
+        "collectives": census_counts(census),
+        "collective_bytes": {k: v["bytes"] for k, v in census.items()},
+        "peak_bytes": cost["peak_bytes"],
+        "argument_bytes": cost["argument_bytes"],
+        "output_bytes": cost["output_bytes"],
+        "temp_bytes": cost["temp_bytes"],
+        "flops": cost["flops"],
+        "baseline_peak_bytes": None,
+        "shrink": None,
+    }
+    findings: list[Finding] = []
+    if entry.mesh != "unsharded":
+        try:
+            base = analyze_compiled(compile_for(jax.devices()[:1]))
+        except Exception as e:                 # noqa: BLE001
+            return report, [Finding(
+                "SP004", path, 0, 0, entry.name,
+                f"replication baseline (1-device mesh) failed to lower: "
+                f"{type(e).__name__}: {e}")]
+        peak, base_peak = cost["peak_bytes"], base["peak_bytes"]
+        shrink = base_peak / peak if peak else float("inf")
+        report["baseline_peak_bytes"] = base_peak
+        report["shrink"] = round(shrink, 3)
+        if peak > REPLICATION_FLOOR_BYTES and shrink < MIN_SHRINK:
+            findings.append(Finding(
+                "SP003", path, 0, 0, entry.name,
+                f"replicated large intermediate: per-device peak "
+                f"{peak} B under the {entry.mesh} mesh shrinks only "
+                f"{shrink:.2f}x from the 1-device compile ({base_peak} "
+                f"B) — sharding is not reducing the footprint "
+                f"(threshold {MIN_SHRINK}x above "
+                f"{REPLICATION_FLOOR_BYTES} B)"))
+    return report, findings
+
+
+@functools.lru_cache(maxsize=4)
+def _cached_reports(names: tuple[str, ...] | None
+                    ) -> tuple[dict, tuple[Finding, ...]]:
+    """Reports are deterministic per process and lowering is the whole
+    cost of this pass — every caller (lint runs, budget writer, tests)
+    shares one computation."""
+    reports: dict[str, dict] = {}
+    findings: list[Finding] = []
+    for entry in spmd_entrypoints():
+        if names is not None and entry.name not in names:
+            continue
+        rep, fs = analyze_entry(entry)
+        if rep:
+            reports[entry.name] = rep
+        findings.extend(fs)
+    return reports, tuple(findings)
+
+
+def entrypoint_reports(only: Iterable[str] | None = None
+                       ) -> tuple[dict[str, dict], list[Finding]]:
+    reports, findings = _cached_reports(
+        tuple(only) if only is not None else None)
+    return dict(reports), list(findings)
+
+
+# -- AST rules (SP004/SP005/SP006) ----------------------------------------
+
+def _spec_literal(call: ast.Call) -> tuple | None:
+    """``P("dp", None)`` -> ("dp", None); None when any arg is
+    non-literal (starred/computed specs are out of SP005's scope)."""
+    out = []
+    for a in call.args:
+        if isinstance(a, ast.Constant) and (
+                a.value is None or isinstance(a.value, str)):
+            out.append(a.value)
+        else:
+            return None
+    return tuple(out)
+
+
+class _SpmdVisitor(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: list[Finding] = []
+        self.partition_alias: set[str] = set()
+        self.func_arity: dict[str, int | None] = {}  # None = varargs
+        self.scope: list[str] = []
+
+    def _symbol(self) -> str:
+        return ".".join(self.scope) or "<module>"
+
+    # imports: which local names mean PartitionSpec / raw shard_map
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        mod = node.module or ""
+        for alias in node.names:
+            if alias.name == "PartitionSpec" and mod.startswith("jax"):
+                self.partition_alias.add(alias.asname or alias.name)
+            if alias.name == "shard_map" and mod.startswith(
+                    "jax.experimental"):
+                self._sp006(node)
+        self.generic_visit(node)
+
+    def visit_Import(self, node: ast.Import):
+        for alias in node.names:
+            if alias.name.startswith("jax.experimental.shard_map"):
+                self._sp006(node)
+        self.generic_visit(node)
+
+    def _sp006(self, node):
+        if self.path.replace(os.sep, "/").endswith(SHARD_MAP_OWNER):
+            return
+        self.findings.append(Finding(
+            "SP006", self.path, node.lineno, node.col_offset,
+            self._symbol(),
+            "raw jax shard_map import outside the compat wrapper — "
+            "import it from cbf_tpu.parallel.ensemble so the one "
+            "check_rep policy (and the jax-version shim) stays "
+            "centralized"))
+
+    def _visit_func(self, node):
+        arity: int | None = len(node.args.posonlyargs) + len(node.args.args)
+        if (node.args.vararg is not None or node.args.kwonlyargs
+                or node.args.defaults):
+            arity = None       # flexible signature: arity is not fixed
+        self.func_arity[node.name] = arity
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Call(self, node: ast.Call):
+        name = None
+        if isinstance(node.func, ast.Name):
+            name = node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            name = node.func.attr
+        if name in self.partition_alias:
+            spec = _spec_literal(node)
+            if spec is not None and spec not in CANONICAL_PARTITION_SPECS:
+                self.findings.append(Finding(
+                    "SP005", self.path, node.lineno, node.col_offset,
+                    self._symbol(),
+                    f"PartitionSpec{spec!r} is not in the canonical "
+                    "partition-rule table "
+                    "(analysis.spmd_rules.CANONICAL_PARTITION_SPECS) — "
+                    "add the new layout to the table (and docs) or use "
+                    "a canonical spec"))
+        if name == "shard_map":
+            self._check_shard_map(node)
+        self.generic_visit(node)
+
+    def _check_shard_map(self, node: ast.Call):
+        if not node.args:
+            return
+        target = node.args[0]
+        if not isinstance(target, ast.Name):
+            return
+        arity = self.func_arity.get(target.id)
+        in_specs = next((kw.value for kw in node.keywords
+                         if kw.arg == "in_specs"), None)
+        if arity is None or not isinstance(in_specs, ast.Tuple):
+            return
+        if any(isinstance(e, ast.Starred) for e in in_specs.elts):
+            return
+        n_specs = len(in_specs.elts)
+        if n_specs != arity:
+            self.findings.append(Finding(
+                "SP004", self.path, node.lineno, node.col_offset,
+                self._symbol(),
+                f"shard_map in_specs arity mismatch: {n_specs} spec"
+                f"{'s' if n_specs != 1 else ''} for "
+                f"`{target.id}`'s {arity} positional parameter"
+                f"{'s' if arity != 1 else ''} — every argument needs "
+                "exactly one spec"))
+
+
+def lint_spmd_source(source: str, path: str) -> list[Finding]:
+    """SP004/SP005/SP006 over one module's source text."""
+    v = _SpmdVisitor(path)
+    v.visit(ast.parse(source))
+    return v.findings
+
+
+def lint_spmd_paths(paths: Iterable[str], repo_root: str | None = None
+                    ) -> list[Finding]:
+    files: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git",
+                                            "analysis_fixtures")]
+                files.extend(os.path.join(dirpath, f)
+                             for f in filenames if f.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    findings: list[Finding] = []
+    for f in sorted(set(files)):
+        rel = os.path.relpath(f, repo_root) if repo_root else f
+        with open(f, encoding="utf-8") as fh:
+            try:
+                findings.extend(lint_spmd_source(fh.read(), rel))
+            except SyntaxError:
+                continue       # ast_rules already reports broken files
+    return findings
+
+
+# -- pass entry point -----------------------------------------------------
+
+def run_spmd_checks(paths: Iterable[str], *,
+                    repo_root: str | None = None,
+                    entrypoints: Iterable[str] | None = None,
+                    budget_path: str | None = None
+                    ) -> tuple[list[Finding], dict]:
+    """The full SPMD pass: AST hygiene over ``paths``, abstract lowering
+    of every sharded entry point, and the census-vs-budget comparison.
+
+    Returns ``(findings, census)`` — ``census`` is the JSON-able
+    per-entrypoint report the CLI attaches to ``lint --json`` (schema
+    below), or ``{"schema": 1, "skipped": reason}`` when the process has
+    too few devices for the virtual mesh (jax already imported: the
+    env-based device count is fixed; AST findings still run).
+    """
+    from cbf_tpu.analysis import mesh_budget
+
+    findings = lint_spmd_paths(paths, repo_root=repo_root)
+    if device_capacity() < VIRTUAL_DEVICES:
+        return findings, {
+            "schema": 1,
+            "skipped": (
+                f"{device_capacity()} device(s) < {VIRTUAL_DEVICES}: "
+                "jax was imported without the virtual-device flag — "
+                "run via the CLI, or set XLA_FLAGS="
+                f"{spmd_xla_flags(None)!r} before importing jax")}
+    reports, lower_findings = entrypoint_reports(entrypoints)
+    findings.extend(lower_findings)
+    budget = mesh_budget.load(budget_path)
+    for name, report in reports.items():
+        findings.extend(mesh_budget.compare(name, report,
+                                            budget.entries.get(name)))
+    return findings, {"schema": 1, "devices": VIRTUAL_DEVICES,
+                      "entrypoints": reports}

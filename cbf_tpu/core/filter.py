@@ -130,6 +130,13 @@ def safe_controls(robot_states, obs_states, obs_mask, f, g, u0,
     is solved against ITS OWN family's rows and box, branch-free.
     """
     if f.ndim == 3:
+        # Pin params to the compute dtype BEFORE vmap: vmap materializes
+        # Python-float leaves as weak scalar arrays, which under x64 are
+        # weak f64 and would promote the whole row assembly (the single-
+        # dynamics path below never vmaps params, so its weak scalars
+        # adopt the state's f32 — this keeps both paths dtype-identical).
+        params = CBFParams(*(jnp.asarray(l, robot_states.dtype)
+                             for l in params))
         p_ax = CBFParams(*(0 if jnp.ndim(l) == 1 else None
                            for l in params))
         fn = functools.partial(

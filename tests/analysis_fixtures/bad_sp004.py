@@ -1,0 +1,17 @@
+"""Known-bad fixture for SP004: a shard_map call whose literal
+in_specs tuple cannot match the wrapped function's positional arity
+(one spec for a two-argument body)."""
+from jax.sharding import PartitionSpec as P
+
+from cbf_tpu.parallel.ensemble import shard_map
+
+
+def local_step(state, metrics):
+    return state + metrics
+
+
+def launch(mesh, state, metrics):
+    fn = shard_map(local_step, mesh,
+                   in_specs=(P("dp", "sp"),),
+                   out_specs=P("dp", "sp"))
+    return fn(state, metrics)

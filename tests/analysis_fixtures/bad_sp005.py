@@ -1,0 +1,5 @@
+"""Known-bad fixture for SP005: a literal PartitionSpec outside the
+canonical partition-rule table (axes transposed)."""
+from jax.sharding import PartitionSpec as P
+
+MEMBER_ROW_SPEC = P("sp", "dp")

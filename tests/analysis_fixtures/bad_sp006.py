@@ -1,0 +1,7 @@
+"""Known-bad fixture for SP006: raw jax shard_map import outside the
+compat wrapper (parallel/ensemble.py owns the check_rep policy)."""
+from jax.experimental.shard_map import shard_map
+
+
+def launch(fn, mesh, specs):
+    return shard_map(fn, mesh, in_specs=specs, out_specs=specs)

@@ -1,0 +1,16 @@
+"""Known-clean counterpart to bad_sp004: one spec per positional
+parameter, shard_map imported from the compat wrapper."""
+from jax.sharding import PartitionSpec as P
+
+from cbf_tpu.parallel.ensemble import shard_map
+
+
+def local_step(state, metrics):
+    return state + metrics
+
+
+def launch(mesh, state, metrics):
+    fn = shard_map(local_step, mesh,
+                   in_specs=(P("dp", "sp"), P("dp", "sp")),
+                   out_specs=P("dp", "sp"))
+    return fn(state, metrics)

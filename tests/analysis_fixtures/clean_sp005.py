@@ -1,0 +1,11 @@
+"""Known-clean counterpart to bad_sp005: only canonical-table specs
+(plus a starred form, which SP005 deliberately leaves alone)."""
+from jax.sharding import PartitionSpec as P
+
+MEMBER_ROW_SPEC = P("dp", "sp")
+STATE_SPEC = P("dp", "sp", None)
+REPLICATED = P()
+
+
+def padded(rank):
+    return P("dp", *([None] * rank))

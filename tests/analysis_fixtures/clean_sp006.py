@@ -1,0 +1,7 @@
+"""Known-clean counterpart to bad_sp006: shard_map comes from the
+compat wrapper, which pins the one check_rep policy."""
+from cbf_tpu.parallel.ensemble import shard_map
+
+
+def launch(fn, mesh, specs):
+    return shard_map(fn, mesh, in_specs=specs, out_specs=specs)

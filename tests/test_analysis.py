@@ -315,7 +315,8 @@ def test_repo_is_lint_clean():
     res = run_lint(
         [os.path.join(_ROOT, p)
          for p in ("cbf_tpu", "scripts", "examples", "bench.py")],
-        repo_root=_ROOT, jaxpr=True, audits=True, concurrency=True)
+        repo_root=_ROOT, jaxpr=True, audits=True, concurrency=True,
+        spmd=True)
     assert res.exit_code == 0, "\n" + render_text(res)
 
 

@@ -144,6 +144,11 @@ time.sleep(60)   # hung release tail; parent kills us at the deadline
     assert retryable is False
 
 
+# slow: ~6 s (sleeps to the attempt deadline); the salvage mechanism is
+# identical to test_run_attempt_timeout_salvages_written_result, which
+# stays tier-1 — only the written-error payload variant rides the slow
+# tier.
+@pytest.mark.slow
 def test_run_attempt_timeout_salvages_written_error(tmp_path, monkeypatch):
     """Same salvage for a written safety verdict: permanent, not retried."""
     _stub_child(tmp_path, monkeypatch, """

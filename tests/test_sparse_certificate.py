@@ -514,6 +514,13 @@ def test_two_layer_training_descends_at_n512():
     assert min(losses[1:]) < losses[0], losses
 
 
+# slow: ~9 s; the certificate builders declare agent_k always, so every
+# tier-1 certificate parity test (test_sparse_matches_dense_solution,
+# test_batched_matches_single_member_solves, the sp-sharded ensemble
+# pin) already exercises the agent-major path end to end — the direct
+# generic-vs-agent_k equivalence and its gradient twin ride the slow
+# tier.
+@pytest.mark.slow
 def test_solver_agent_major_transpose_matches_generic():
     """The agent-major transpose fast path (agent_k: I-side as a dense
     reshape-sum + contiguous slice update, no scatter) must reproduce the

@@ -1,0 +1,445 @@
+"""SPMD sharding analyzer (cbf_tpu.analysis.spmd_rules + mesh_budget).
+
+Four layers, mirroring the subsystem:
+
+* fixture snippets per AST rule (SP004/SP005/SP006) pin true-positive
+  AND false-positive behavior, like the TS/RC/CC corpora;
+* the budget gate (mesh_budget) is exercised pure — load validation,
+  asymmetric compare, liveness, writer round-trip — no lowering;
+* the lowering layer is proven against the live repo (every entry point
+  compiles clean under the virtual mesh, the committed budget matches
+  the fresh census at 0 findings) AND against injected regressions: a
+  deliberately-replicated closure capture must trip SP003, and a
+  hand-bumped budget row must fail the full ``run_lint`` with a typed
+  finding and a nonzero exit;
+* the census rides ``lint --json`` only when the pass ran — the same
+  key contract ``lock_order_graph`` established.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from cbf_tpu.analysis import mesh_budget, spmd_rules
+from cbf_tpu.analysis.report import run_lint
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "analysis_fixtures")
+
+_SP_AST_RULES = ["SP004", "SP005", "SP006"]
+
+
+def _lint_fixture(name: str):
+    with open(os.path.join(_FIXTURES, name)) as fh:
+        return spmd_rules.lint_spmd_source(fh.read(), name)
+
+
+# -- AST rules: one bad + one clean fixture each --------------------------
+
+@pytest.mark.parametrize("rule", _SP_AST_RULES)
+def test_sp_rule_fires_on_bad_fixture(rule):
+    findings = _lint_fixture(f"bad_{rule.lower()}.py")
+    assert rule in {f.rule for f in findings}, (
+        f"{rule} did not fire on its known-bad fixture: {findings}")
+
+
+@pytest.mark.parametrize("rule", _SP_AST_RULES)
+def test_sp_rule_silent_on_clean_fixture(rule):
+    findings = _lint_fixture(f"clean_{rule.lower()}.py")
+    assert findings == [], (
+        f"clean fixture for {rule} produced findings: {findings}")
+
+
+def test_shard_map_owner_keeps_its_import():
+    """The compat wrapper itself is the one file allowed the raw
+    import — the path-suffix exemption must hold for the real file."""
+    owner = os.path.join(_ROOT, "cbf_tpu", "parallel", "ensemble.py")
+    with open(owner) as fh:
+        findings = spmd_rules.lint_spmd_source(
+            fh.read(), "cbf_tpu/parallel/ensemble.py")
+    assert [f for f in findings if f.rule == "SP006"] == []
+
+
+def test_flexible_arity_targets_are_skipped():
+    """Varargs / defaulted signatures have no fixed arity — SP004 must
+    stay silent rather than guess (ensemble's ``local_rollout(t0, cbf,
+    *args)`` is the live case)."""
+    src = """
+def flexible(a, *rest):
+    return a
+
+def defaulted(a, b=1):
+    return a
+
+def launch(mesh, spec):
+    shard_map(flexible, mesh, in_specs=(spec,), out_specs=spec)
+    shard_map(defaulted, mesh, in_specs=(spec,), out_specs=spec)
+"""
+    assert spmd_rules.lint_spmd_source(src, "flex.py") == []
+
+
+# -- census parsing --------------------------------------------------------
+
+def test_collective_census_counts_and_bytes():
+    hlo = """
+  %ar = f32[4,8]{1,0} all-reduce(%x), replica_groups={}
+  %ag.1 = f32[64]{0} all-gather-start(%y), dimensions={0}
+  %ag.d = f32[64]{0} all-gather-done(%ag.1)
+  %cp = (f32[2,2], f32[2,2]) collective-permute(%z)
+"""
+    census = spmd_rules.collective_census(hlo)
+    assert census["all_reduce"] == {"count": 1, "bytes": 4 * 8 * 4}
+    # -start counted once, -done not double-counted
+    assert census["all_gather"]["count"] == 1
+    assert census["ppermute"]["count"] == 1
+    assert census["reduce_scatter"] == {"count": 0, "bytes": 0}
+    assert set(census) == set(spmd_rules.COLLECTIVE_KINDS)
+
+
+# -- budget gate: pure (no lowering) ---------------------------------------
+
+def _report(mesh="dp=8", peak=1000, **counts):
+    colls = {k: 0 for k in spmd_rules.COLLECTIVE_KINDS}
+    colls.update(counts)
+    return {"mesh": mesh, "peak_bytes": peak, "collectives": colls,
+            "collective_bytes": {k: 64 * c for k, c in colls.items()}}
+
+
+def _row(mesh="dp=8", peak=1000, tolerance=0.5, **counts):
+    return mesh_budget.BudgetRow("e", mesh, dict(counts), peak,
+                                 tolerance, "pinned by test")
+
+
+def test_compare_clean_and_cheaper_pass_silently():
+    row = _row(all_reduce=3, peak=1000)
+    assert mesh_budget.compare("e", _report(all_reduce=3), row) == []
+    # fewer collectives / smaller peak: silent (asymmetric gate)
+    assert mesh_budget.compare(
+        "e", _report(all_reduce=1, peak=10), row) == []
+
+
+def test_compare_missing_row_is_sp001():
+    (f,) = mesh_budget.compare("e", _report(), None)
+    assert f.rule == "SP001" and "no budget row" in f.message
+
+
+def test_compare_mesh_mismatch_is_sp001():
+    findings = mesh_budget.compare("e", _report(mesh="dp=2,sp=4"),
+                                   _row(mesh="dp=8"))
+    assert [f.rule for f in findings] == ["SP001"]
+    assert "census basis changed" in findings[0].message
+
+
+def test_compare_new_kind_and_count_increase_are_sp001():
+    row = _row(all_reduce=2)
+    (f,) = mesh_budget.compare("e", _report(all_reduce=3), row)
+    assert f.rule == "SP001" and "count increase" in f.message
+    (f,) = mesh_budget.compare("e", _report(all_reduce=2, all_gather=1),
+                               row)
+    assert f.rule == "SP001" and "new collective kind" in f.message
+
+
+def test_compare_peak_regression_is_sp002():
+    row = _row(peak=1000, tolerance=0.5)
+    assert mesh_budget.compare("e", _report(peak=1500), row) == []
+    (f,) = mesh_budget.compare("e", _report(peak=1501), row)
+    assert f.rule == "SP002" and "1500 B" in f.message
+
+
+def test_budget_requires_reason(tmp_path):
+    p = tmp_path / "b.toml"
+    p.write_text('schema = 1\n\n[[entry]]\nname = "x"\nmesh = "dp=8"\n'
+                 'peak_bytes = 1\ntolerance = 0.0\nreason = ""\n')
+    with pytest.raises(mesh_budget.BudgetError, match="no reason"):
+        mesh_budget.load(str(p))
+
+
+def test_budget_rejects_unknown_kind_and_schema(tmp_path):
+    p = tmp_path / "b.toml"
+    p.write_text('schema = 1\n\n[[entry]]\nname = "x"\nmesh = "dp=8"\n'
+                 'peak_bytes = 1\nreason = "r"\n\n[entry.collectives]\n'
+                 'broadcast = 2\n')
+    with pytest.raises(mesh_budget.BudgetError, match="unknown collective"):
+        mesh_budget.load(str(p))
+    p.write_text("schema = 2\n")
+    with pytest.raises(mesh_budget.BudgetError, match="schema"):
+        mesh_budget.load(str(p))
+
+
+def test_budget_liveness_both_directions():
+    budget = mesh_budget.Budget(1, {"stale_row": _row()._replace(
+        name="stale_row")})
+    problems = mesh_budget.liveness_problems(budget, ["live_entry"])
+    assert len(problems) == 2
+    assert any("live_entry" in p and "no spmd_budget" in p
+               for p in problems)
+    assert any("stale_row" in p and "stale budget row" in p
+               for p in problems)
+
+
+def test_budget_writer_roundtrip(tmp_path):
+    reports = {"a": _report(all_reduce=2, peak=500),
+               "b": _report(mesh="unsharded", peak=100)}
+    p = str(tmp_path / "budget.toml")
+    mesh_budget.write(reports, p, reason="seeded by test")
+    budget = mesh_budget.load(p)
+    assert set(budget.entries) == {"a", "b"}
+    for name, rep in reports.items():
+        assert mesh_budget.compare(name, rep, budget.entries[name]) == []
+    # unchanged rows keep their reason without a fresh one...
+    mesh_budget.write(reports, p)
+    assert mesh_budget.load(p).entries["a"].reason == "seeded by test"
+    # ...changed rows demand one...
+    reports["a"]["collectives"]["all_gather"] = 1
+    with pytest.raises(mesh_budget.BudgetError, match="new or changed"):
+        mesh_budget.write(reports, p)
+    mesh_budget.write(reports, p, reason="gather added deliberately")
+    row = mesh_budget.load(p).entries["a"]
+    assert row.reason == "gather added deliberately"
+    assert row.collectives == {"all_reduce": 2, "all_gather": 1}
+    # ...and dropped entry points drop their rows (AUD009's stale case)
+    del reports["b"]
+    mesh_budget.write(reports, p, reason="b retired")
+    assert set(mesh_budget.load(p).entries) == {"a"}
+
+
+def test_budget_fallback_parser_matches_tomli():
+    """The no-tomli fallback reader must parse what render() writes."""
+    rows = [_row(all_reduce=9, all_gather=1)._replace(name="a"),
+            _row(mesh="unsharded", peak=7)._replace(name="b")]
+    parsed = mesh_budget._parse_toml(mesh_budget.render(rows))
+    assert parsed["schema"] == 1
+    by_name = {e["name"]: e for e in parsed["entry"]}
+    assert by_name["a"]["collectives"] == {"all_gather": 1,
+                                           "all_reduce": 9}
+    assert by_name["b"]["peak_bytes"] == 7
+    assert by_name["b"]["tolerance"] == 0.5
+
+
+# -- lowering layer: live repo ---------------------------------------------
+
+def test_entrypoint_reports_complete_and_clean():
+    """Every sharded entry point lowers under the virtual mesh with no
+    findings, healthy shrink, and (serve hot path) zero collectives."""
+    reports, findings = spmd_rules.entrypoint_reports()
+    assert findings == []
+    assert set(reports) == set(spmd_rules.spmd_entrypoint_names())
+    for name, rep in reports.items():
+        if rep["mesh"] == "unsharded":
+            assert rep["shrink"] is None
+        else:
+            assert rep["shrink"] >= spmd_rules.MIN_SHRINK, (name, rep)
+    lockstep = reports["lockstep_chunk"]["collectives"]
+    assert all(c == 0 for c in lockstep.values()), lockstep
+
+
+def test_committed_budget_matches_live_census():
+    """The acceptance bar: fresh census vs the checked-in
+    spmd_budget.toml at 0 findings, row per entry point."""
+    reports, _ = spmd_rules.entrypoint_reports()
+    budget = mesh_budget.load()
+    assert set(budget.entries) == set(reports)
+    for name, rep in reports.items():
+        assert mesh_budget.compare(
+            name, rep, budget.entries[name]) == [], name
+
+
+def test_replicated_intermediate_trips_sp003():
+    """A spec that replicates a full 512x512 operand onto every device
+    must be caught by the shrink check; a well-sharded compile of the
+    same-scale problem must pass. This is the failure mode that is
+    invisible at toy scale and an OOM at N >= 100k."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    def build(replicate_weights):
+        def b(devices):
+            n = 512
+            if len(devices) == 1:
+                def sds(shape, spec):
+                    return jax.ShapeDtypeStruct(shape, jnp.float32)
+            else:
+                mesh = Mesh(np.asarray(devices), ("dp",))
+
+                def sds(shape, spec):
+                    return jax.ShapeDtypeStruct(
+                        shape, jnp.float32,
+                        sharding=NamedSharding(mesh, spec))
+            if replicate_weights:
+                fn = jax.jit(lambda x, w: jnp.tanh(x @ w))
+                # x row-sharded, but w = P(): a full MiB on EVERY device
+                return fn, (sds((64, n), PartitionSpec("dp", None)),
+                            sds((n, n), PartitionSpec()))
+            fn = jax.jit(lambda x: jnp.tanh(x * 2.0))
+            return fn, (sds((n, n), PartitionSpec("dp", None)),)
+        return b
+
+    bad = spmd_rules.SpmdEntry("probe_bad", "dp=8", build(True))
+    rep, findings = spmd_rules.analyze_entry(bad)
+    assert [f.rule for f in findings] == ["SP003"], (rep, findings)
+    assert rep["shrink"] < spmd_rules.MIN_SHRINK
+
+    good = spmd_rules.SpmdEntry("probe_good", "dp=8", build(False))
+    rep, findings = spmd_rules.analyze_entry(good)
+    assert findings == [], (rep, findings)
+    assert rep["shrink"] >= spmd_rules.MIN_SHRINK
+
+
+def test_failed_lowering_is_sp004_not_a_crash():
+    def broken(devices):
+        raise ValueError("no such entry")
+
+    rep, findings = spmd_rules.analyze_entry(
+        spmd_rules.SpmdEntry("probe_broken", "dp=8", broken))
+    assert rep == {}
+    assert [f.rule for f in findings] == ["SP004"]
+    assert "failed to lower" in findings[0].message
+
+
+def test_hand_bumped_budget_fails_lint(tmp_path, monkeypatch):
+    """The injected-regression gate: tighten one committed row below
+    the measured census and the full runner must exit nonzero with
+    typed SP001 + SP002 findings."""
+    reports, _ = spmd_rules.entrypoint_reports()
+    rows = [r for r in mesh_budget.load().entries.values()]
+    bumped = [(r._replace(collectives={}, peak_bytes=1, tolerance=0.0)
+               if r.name == "sharded_rollout" else r) for r in rows]
+    p = tmp_path / "budget.toml"
+    p.write_text(mesh_budget.render(bumped))
+    monkeypatch.setattr(mesh_budget, "DEFAULT_PATH", str(p))
+
+    res = run_lint([os.path.join(_FIXTURES, "clean_sp005.py")],
+                   repo_root=_ROOT, spmd=True)
+    assert res.exit_code == 1
+    rules = {f.rule for f in res.active
+             if f.symbol == "sharded_rollout"}
+    assert rules == {"SP001", "SP002"}
+    # the regression is localized: other rows still pass
+    assert all(f.symbol == "sharded_rollout" for f in res.active)
+
+
+# -- JSON / CLI contract ---------------------------------------------------
+
+def test_census_key_only_when_pass_ran():
+    """Same contract as lock_order_graph: the JSON key exists iff the
+    pass ran, so plain-lint payloads stay byte-identical."""
+    target = [os.path.join(_FIXTURES, "clean_sp005.py")]
+    assert "spmd_census" not in run_lint(target).as_dict()
+    census = run_lint(target, spmd=True).as_dict()["spmd_census"]
+    assert census["schema"] == 1
+    assert set(census["entrypoints"]) == set(
+        spmd_rules.spmd_entrypoint_names())
+
+
+def test_cli_lint_spmd_json(capsys):
+    from cbf_tpu.__main__ import main
+
+    rc = main(["lint", "--spmd", "--json",
+               os.path.join(_FIXTURES, "clean_sp005.py")])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    census = payload["spmd_census"]
+    assert census["devices"] == spmd_rules.VIRTUAL_DEVICES
+    rollout = census["entrypoints"]["sharded_rollout"]
+    assert rollout["mesh"] == "dp=2,sp=4"
+    assert rollout["shrink"] >= spmd_rules.MIN_SHRINK
+
+
+def test_spmd_xla_flags_and_env_guard(monkeypatch):
+    """The flag builder composes with existing flags and never doubles
+    up; ensure_spmd_env is a deliberate no-op once jax is imported
+    (device count is fixed at backend init — the reason the CLI
+    re-execs instead of calling it in-process)."""
+    flag = f"--xla_force_host_platform_device_count={8}"
+    assert spmd_rules.spmd_xla_flags(None) == flag
+    assert spmd_rules.spmd_xla_flags("--other") == f"--other {flag}"
+    already = "--xla_force_host_platform_device_count=4"
+    assert spmd_rules.spmd_xla_flags(already) == already
+    monkeypatch.delenv("XLA_FLAGS", raising=False)
+    spmd_rules.ensure_spmd_env()       # jax imported: must not touch env
+    assert "XLA_FLAGS" not in os.environ
+
+
+def test_xla_flag_yields_virtual_mesh_subprocess():
+    """Set BEFORE jax's first import, the flag yields the 8-device
+    virtual mesh — the substrate conftest and the CLI re-exec share."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=spmd_rules.spmd_xla_flags(None))
+    out = subprocess.run(
+        [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+        capture_output=True, text=True, cwd=_ROOT, env=env, check=True)
+    assert out.stdout.strip() == str(spmd_rules.VIRTUAL_DEVICES)
+
+
+# slow: ~26 s (a fresh process re-lowers every entry point with no
+# cache). The census surface stays tier-1 in-process via
+# test_cli_lint_spmd_json + test_census_key_only_when_pass_ran, the
+# flag substrate via test_xla_flag_yields_virtual_mesh_subprocess, and
+# the re-exec guard logic via test_spmd_xla_flags_and_env_guard; only
+# the exec() plumbing itself rides the slow tier.
+@pytest.mark.slow
+def test_cli_reexec_gains_devices_subprocess():
+    """End-to-end re-exec: a bare ``python -m cbf_tpu lint --spmd``
+    with NO device flag must re-exec itself, run the lowering pass
+    (census not skipped), and exit 0 on a clean target."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("CBF_TPU_SPMD_REEXEC", None)
+    out = subprocess.run(
+        [sys.executable, "-m", "cbf_tpu", "lint", "--spmd", "--json",
+         os.path.join(_FIXTURES, "clean_sp005.py")],
+        capture_output=True, text=True, cwd=_ROOT, env=env)
+    assert out.returncode == 0, out.stdout + out.stderr
+    census = json.loads(out.stdout)["spmd_census"]
+    assert "skipped" not in census
+    assert census["devices"] == spmd_rules.VIRTUAL_DEVICES
+
+
+def test_degraded_census_when_too_few_devices(monkeypatch):
+    """Programmatic use without the env flag degrades to a skipped
+    census — AST findings still flow, no lowering findings invented."""
+    monkeypatch.setattr(spmd_rules, "device_capacity", lambda: 1)
+    findings, census = spmd_rules.run_spmd_checks(
+        [os.path.join(_FIXTURES, "bad_sp005.py")])
+    assert {f.rule for f in findings} == {"SP005"}
+    assert census["schema"] == 1 and "skipped" in census
+    assert "entrypoints" not in census
+
+
+# -- audits + docs ---------------------------------------------------------
+
+def test_aud009_flags_stale_and_missing_rows(tmp_path):
+    from cbf_tpu.analysis.audits import spmd_budget_audit
+
+    d = tmp_path / "cbf_tpu" / "analysis"
+    d.mkdir(parents=True)
+    live = spmd_rules.spmd_entrypoint_names()
+    rows = [mesh_budget.BudgetRow(live[0], "dp=8", {}, 1, 0.0, "r"),
+            mesh_budget.BudgetRow("retired_entry", "dp=8", {}, 1, 0.0,
+                                  "r")]
+    (d / "spmd_budget.toml").write_text(mesh_budget.render(rows))
+    problems = spmd_budget_audit(str(tmp_path))
+    assert any("retired_entry" in p for p in problems)
+    assert all(name in " ".join(problems) for name in live[1:])
+    # malformed file is one problem, not a crash
+    (d / "spmd_budget.toml").write_text("schema = 99\n")
+    (problem,) = spmd_budget_audit(str(tmp_path))
+    assert "schema" in problem
+
+
+def test_spmd_docs_sections_exist():
+    with open(os.path.join(_ROOT, "docs", "API.md")) as fh:
+        api = fh.read()
+    assert "## SPMD analysis" in api
+    assert "spmd_budget.toml" in api
+    assert "--write-spmd-budget" in api
+    assert "`AUD009`" in api
+    with open(os.path.join(_ROOT, "docs", "DESIGN.md")) as fh:
+        design = fh.read()
+    assert "abstract lowering" in design.lower()

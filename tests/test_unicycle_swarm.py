@@ -184,6 +184,12 @@ def test_unicycle_initial_state_laws_match():
                                atol=1e-6)
 
 
+# slow: ~6 s; the unicycle safety floor and family mechanics stay
+# tier-1 at small n (test_unicycle_floor_and_convergence,
+# test_unicycle_wheel_saturation_bounds_motion); this n=1024 pin
+# calibrates the bench floor, and the bench legs it feeds are
+# themselves slow-gated.
+@pytest.mark.slow
 def test_unicycle_bench_floor_calibration_n1024():
     """Regression pin for bench.SAFETY_FLOOR_UNICYCLE (0.11): the N=1024
     floor does not decay with scale the way the double family's does
