@@ -131,7 +131,22 @@ Modes / env knobs:
     the bound). The metric is the continuous knee in requests/s;
     vs_baseline is continuous-over-drain. Knobs: BENCH_SLO_SWEEP_GRID
     ("8:56:8"), BENCH_SLO_SWEEP_P99 (0.4), BENCH_SLO_CHUNK (16) + the
-    BENCH_SLO_* traffic-shape knobs. See docs/BENCH_LOG.md Round 16.
+    BENCH_SLO_* traffic-shape knobs. A deep-backlog leg then runs both
+    schedulers at BENCH_SLO_BACKLOG_RPS (120, far past the knee) with
+    multi-chunk bursting armed on the continuous engine
+    (BENCH_SLO_BACKLOG_CHUNKS, 4); the record's ``backlog`` block
+    carries achieved rps + honest p99 per mode and gates continuous
+    >= 0.80x drain. See docs/BENCH_LOG.md Rounds 16/19.
+  BENCH_MEGA=1 — spatially-tiled mega-swarm mode
+    (cbf_tpu.parallel.spatial): one N=131072 single-swarm rollout
+    domain-decomposed over 8 spatial tiles of the (virtual) mesh.
+    The record's headline is the memory proof: per-device peak bytes
+    of the compiled epoch executable vs the 1-device compile of the
+    largest unsharded-fittable flat rollout (vs_baseline is that
+    shrink), plus halo bytes/step vs all-gather bytes/step; the rate
+    is evidence the rollout completes end to end. Knobs: BENCH_MEGA_N
+    (131072), BENCH_MEGA_TILES (8), BENCH_MEGA_STEPS (1),
+    BENCH_MEGA_BASELINE_N (16384).
   BENCH_OCCUPANCY=1 — scheduler-observatory occupancy mode
     (cbf_tpu.obs.lanes): the same seeded open-loop traffic through one
     prewarmed continuous engine with an armed LaneLedger at two offered
@@ -1405,13 +1420,32 @@ def _child_slo_sweep(steps: int) -> dict:
     BENCH_SLO_SEED/NMIN/NMAX/ALPHA/MAX_BATCH/FLUSH traffic-shape knobs
     and BENCH_SLO_CHUNK (16) for the continuous leg. A censored knee
     (no swept rate violated the bound) reports the grid top and
-    knee_censored=true."""
+    knee_censored=true.
+
+    After the knee sweeps, one DEEP-BACKLOG leg (PR 19): both
+    schedulers run the same far-past-the-knee offered rate
+    (BENCH_SLO_BACKLOG_RPS, 120) so the record carries drain vs
+    continuous throughput where the continuous scheduler's per-chunk
+    dispatch overhead used to cost ~20% (Round 16: 82 vs 105 achieved
+    rps). The continuous engine runs with deep-backlog bursting armed
+    (BENCH_SLO_BACKLOG_CHUNKS, 4 — ``ServeEngine(backlog_chunks=)``,
+    watermark 2*max_batch with degrade sustain pinned past the leg so
+    horizons are NEVER cut: throughput parity must come from fewer
+    dispatches, not shorter work). p99 is reported as measured — at
+    2x+ past the knee it is far outside the SLO bound by construction
+    and the record says so; the gate is the throughput ratio
+    (``backlog.gate_ok``: continuous >= 0.80x drain — the measured
+    run-to-run band on the 1-core host is 0.83-0.95, so the floor sits
+    below the noise, not inside it; a chunks=1 control measures the
+    same band, i.e. on THIS host the chunk executable is the
+    bottleneck and bursting is amortization insurance, engaged and
+    counted but not a throughput win)."""
     import dataclasses
 
     import jax
 
-    from cbf_tpu.serve import LoadSpec, ServeEngine, build_schedule, \
-        parse_sweep, sweep_rps
+    from cbf_tpu.serve import FaultPolicy, LoadSpec, ServeEngine, \
+        build_schedule, parse_sweep, run_loadgen, sweep_rps
 
     grid_arg = os.environ.get("BENCH_SLO_SWEEP_GRID", "8:56:8")
     slo_p99 = _env_float("BENCH_SLO_SWEEP_P99", 0.4)
@@ -1469,6 +1503,57 @@ def _child_slo_sweep(steps: int) -> dict:
                 return {"error": f"slo-sweep {mode} rps={leg['rps']}: "
                                  f"{leg['errors']} requests failed",
                         "retryable": False}
+
+    # Deep-backlog leg: same offered rate far past the knee through both
+    # schedulers. Continuous runs with multi-chunk bursting armed; the
+    # degrade sustain is pinned past the leg so the watermark only
+    # classifies depth (bursting) and never cuts horizons — achieved
+    # rps is over FULL-length requests in both modes.
+    backlog_rps = _env_float("BENCH_SLO_BACKLOG_RPS", 120.0)
+    backlog_chunks = _env_int("BENCH_SLO_BACKLOG_CHUNKS", 4)
+    backlog = {"offered_rps": backlog_rps,
+               "backlog_chunks": backlog_chunks}
+    for mode in ("drain", "continuous"):
+        policy = FaultPolicy(degrade_high_watermark=2 * max_batch,
+                             degrade_sustain_s=1e9)
+        engine = ServeEngine(max_batch=max_batch, flush_deadline_s=flush,
+                             continuous=(mode == "continuous"),
+                             chunk_steps=chunk,
+                             backlog_chunks=backlog_chunks,
+                             fault_policy=policy, lane_ledger=False)
+        leg_spec = dataclasses.replace(spec, rps=backlog_rps)
+        engine.prewarm([cfg for _, cfg in build_schedule(leg_spec)])
+        report = run_loadgen(engine, leg_spec)
+        stats = dict(engine.stats)
+        engine.stop()
+        if report["errors"]:
+            return {"error": f"slo-sweep backlog {mode} "
+                             f"rps={backlog_rps}: {report['errors']} "
+                             f"requests failed", "retryable": False}
+        backlog[mode] = {
+            "achieved_rps": report["achieved_rps"],
+            "completed": report["completed"],
+            "latency_p50_s": report["latency_p50_s"],
+            "latency_p99_s": report["latency_p99_s"],
+            "queue_wait_p99_s": report["queue_wait_p99_s"],
+            "chunks_executed": stats.get("chunks_executed", 0),
+            "backlog_extra_chunks": stats.get("backlog_extra_chunks", 0),
+        }
+        print(f"bench: slo-sweep backlog mode={mode} "
+              f"achieved={report['achieved_rps']} rps "
+              f"p99={report['latency_p99_s']}s "
+              f"extra_chunks={stats.get('backlog_extra_chunks', 0)}",
+              file=sys.stderr)
+    backlog["continuous_over_drain"] = round(
+        backlog["continuous"]["achieved_rps"]
+        / max(backlog["drain"]["achieved_rps"], 1e-9), 4)
+    backlog["gate_ok"] = backlog["continuous_over_drain"] >= 0.80
+    if not backlog["gate_ok"]:
+        return {"error": f"slo-sweep backlog: continuous achieved only "
+                         f"{backlog['continuous_over_drain']:.2f}x drain "
+                         f"at {backlog_rps} offered rps (floor 0.80)",
+                "retryable": False}
+
     return {
         "metric": (f"serve capacity knee, continuous batching "
                    f"(p99<={slo_p99}s, grid {grid_arg})"),
@@ -1488,8 +1573,139 @@ def _child_slo_sweep(steps: int) -> dict:
         "knee_censored_continuous": sweeps["continuous"]["knee_censored"],
         "sweep_drain": sweeps["drain"],
         "sweep_continuous": sweeps["continuous"],
+        "backlog": backlog,
         "lanes_continuous": lanes_continuous,
         "platform": jax.devices()[0].platform,
+    }
+
+
+def _child_mega(steps: int) -> dict:
+    """BENCH_MEGA mode: spatially-tiled mega-swarm axis
+    (cbf_tpu.parallel.spatial). ONE single-swarm rollout at
+    BENCH_MEGA_N (131072) agents, domain-decomposed over
+    BENCH_MEGA_TILES (8) spatial tiles of the mesh — the regime the
+    flat sp-sharded step cannot reach: its all-gathered candidate set
+    is O(N) per device, the tiled step's is O(capacity + halo). The
+    record carries the memory proof, not just the rate: per-device
+    peak bytes of the compiled epoch executable
+    (obs.resource.analyze_compiled) vs the 1-device compile of the
+    largest unsharded-fittable flat rollout (BENCH_MEGA_BASELINE_N,
+    16384), plus halo bytes/step vs the flat path's all-gather
+    bytes/step. vs_baseline is the peak SHRINK (flat 1-device peak /
+    spatial per-device peak): the axis's headline claim is memory;
+    the rate is the evidence it still runs end to end. The wall is a
+    COLD run (one jit compile included — at this scale a warm second
+    pass would double a multi-minute round for a rate nobody gates
+    on); compile_s from the separately-timed AOT compile bounds the
+    overhead. Knobs: BENCH_MEGA_N, BENCH_MEGA_TILES,
+    BENCH_MEGA_STEPS (1), BENCH_MEGA_BASELINE_N."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cbf_tpu.obs.resource import analyze_compiled
+    from cbf_tpu.parallel import spatial
+    from cbf_tpu.parallel.ensemble import _rollout_executable
+    from cbf_tpu.parallel.mesh import make_mesh
+    from cbf_tpu.scenarios import swarm
+
+    n = _env_int("BENCH_MEGA_N", 131072)
+    tiles = _env_int("BENCH_MEGA_TILES", 8)
+    msteps = _env_int("BENCH_MEGA_STEPS", 1)
+    baseline_n = _env_int("BENCH_MEGA_BASELINE_N", 16384)
+    devices = jax.devices()
+    if len(devices) < tiles:
+        return {"error": f"mega: need {tiles} devices, have "
+                         f"{len(devices)}", "retryable": False}
+
+    cfg = swarm.Config(n=n, steps=msteps)
+    mesh = make_mesh(n_dp=1, n_sp=tiles, devices=devices[:tiles])
+    spec = spatial.plan_tiles(cfg, tiles, rebin_every=msteps)
+    print(f"bench: mega N={n} tiles={tiles} steps={msteps} "
+          f"capacity={spec.capacity} halo={spec.halo_capacity} "
+          f"band={spec.band:.3f}", file=sys.stderr)
+
+    # Per-device peak: AOT-compile the epoch executable the rollout
+    # will run and read the SPMD memory census off it.
+    fn = spatial._epoch_executable(cfg, mesh, spec, msteps)
+    slab = (tiles * spec.capacity,)
+    s2 = jax.ShapeDtypeStruct(slab + (2,), jnp.float32)
+    vb = jax.ShapeDtypeStruct(slab, jnp.bool_)
+    t0s = jax.ShapeDtypeStruct((), jnp.int32)
+    cbf = jax.tree.map(
+        lambda leaf: jax.ShapeDtypeStruct(jnp.asarray(leaf).shape,
+                                          jnp.asarray(leaf).dtype),
+        swarm.default_cbf(cfg))
+    t0 = time.time()
+    compiled = fn.lower(t0s, cbf, s2, s2, vb, s2).compile()
+    compile_s = time.time() - t0
+    peak = int(analyze_compiled(compiled)["peak_bytes"])
+
+    # 1-device baseline: the largest flat rollout that still FITS
+    # unsharded — its (N, N) pairwise slab is the wall the spatial
+    # path removes. Compile-only (the peak is a compile-time fact).
+    cfg_b = swarm.Config(n=baseline_n, steps=msteps)
+    mesh_b = make_mesh(n_dp=1, n_sp=1, devices=devices[:1])
+    fn_b = _rollout_executable(cfg_b, mesh_b, 1, msteps)
+    state_b = jax.ShapeDtypeStruct((1, baseline_n, 2), jnp.float32)
+    cbf_b = jax.tree.map(
+        lambda leaf: jax.ShapeDtypeStruct(jnp.asarray(leaf).shape,
+                                          jnp.asarray(leaf).dtype),
+        swarm.default_cbf(cfg_b))
+    peak_b = int(analyze_compiled(
+        fn_b.lower(t0s, cbf_b, state_b, state_b).compile())["peak_bytes"])
+    if peak >= peak_b:
+        return {"error": f"mega: spatial per-device peak {peak} B is "
+                         f"NOT below the 1-device flat peak {peak_b} B "
+                         f"at N={baseline_n}", "retryable": False}
+
+    # The measured run: the production spatial_swarm_rollout path
+    # (bin -> epoch -> unscatter), cold.
+    t0 = time.time()
+    (x, v), mets, report = spatial.spatial_swarm_rollout(
+        cfg, mesh, steps=msteps, spec=spec, seed=cfg.seed)
+    wall = time.time() - t0
+    nearest = float(np.min(np.asarray(mets.nearest_distance)))
+    infeasible = int(np.sum(np.asarray(mets.infeasible_count)))
+    if not np.all(np.isfinite(np.asarray(x))):
+        return {"error": "mega: non-finite final state",
+                "retryable": False}
+    err = _check_safety(nearest, infeasible)
+    if err:
+        return {"error": err, "retryable": False}
+
+    # Wire-traffic comparison, per device per step: the halo ships two
+    # fixed (halo_capacity, 6)-float payloads; flat sp-sharding
+    # all-gathers every agent's states4 row.
+    halo_bytes = 2 * spec.halo_capacity * 6 * 4
+    allgather_bytes = n * 4 * 4
+    return {
+        "metric": f"agent-QP-steps/sec/chip (mega N={n} tiles={tiles})",
+        "value": round(n * msteps / wall, 2),
+        "unit": "agent_qp_steps_per_sec_per_chip",
+        # The headline claim: per-device peak shrink vs the largest
+        # flat-fittable 1-device compile.
+        "vs_baseline": round(peak_b / peak, 2),
+        "n": n,
+        "steps": msteps,
+        "tiles": tiles,
+        "capacity": spec.capacity,
+        "halo_capacity": spec.halo_capacity,
+        "rebin_every": spec.rebin_every,
+        "wall_s": round(wall, 2),
+        "compile_s": round(compile_s, 2),
+        "per_device_peak_bytes": peak,
+        "baseline_n": baseline_n,
+        "baseline_1device_peak_bytes": peak_b,
+        "halo_bytes_per_step": halo_bytes,
+        "allgather_bytes_per_step": allgather_bytes,
+        "overflow_total": report.overflow_total,
+        "halo_dropped_total": report.halo_dropped_total,
+        "occupancy_max": report.occupancy_max,
+        "halo_used_max": report.halo_used_max,
+        "min_pairwise_distance": nearest,
+        "infeasible_count": infeasible,
+        "platform": devices[0].platform,
     }
 
 
@@ -2730,6 +2946,18 @@ def _is_permanent_error(e: BaseException) -> bool:
 
 
 def child_main(result_path: str, ensemble: bool) -> None:
+    if os.environ.get("BENCH_MEGA", "0") == "1":
+        # The mega axis needs the virtual tile mesh. XLA_FLAGS is read
+        # at backend INIT, not at jax import, so setting it here (the
+        # health check below triggers the first init) is still early
+        # enough — unlike spmd_rules.ensure_spmd_env, which guards on
+        # the import and would no-op under bench's import graph.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            tiles = _env_int("BENCH_MEGA_TILES", 8)
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{tiles}").strip()
     forced = os.environ.get("BENCH_FORCE_PLATFORM")
     if forced:
         # The JAX_PLATFORMS *env var* is not honored in this environment
@@ -2766,6 +2994,8 @@ def child_main(result_path: str, ensemble: bool) -> None:
             result = _child_rta(steps)
         elif os.environ.get("BENCH_CHAOS", "0") == "1":
             result = _child_chaos(steps)
+        elif os.environ.get("BENCH_MEGA", "0") == "1":
+            result = _child_mega(steps)
         elif os.environ.get("BENCH_OCCUPANCY", "0") == "1":
             result = _child_occupancy(steps)
         elif os.environ.get("BENCH_SLO_SWEEP", "0") == "1":
@@ -2894,6 +3124,9 @@ def main() -> None:
         label = "rta N=%d" % _env_int("BENCH_RTA_N", 64)
     elif os.environ.get("BENCH_CHAOS", "0") == "1":
         label = "chaos rps=%g" % _env_float("BENCH_CHAOS_RPS", 8.0)
+    elif os.environ.get("BENCH_MEGA", "0") == "1":
+        label = "mega N=%d tiles=%d" % (_env_int("BENCH_MEGA_N", 131072),
+                                        _env_int("BENCH_MEGA_TILES", 8))
     elif os.environ.get("BENCH_OCCUPANCY", "0") == "1":
         label = "occupancy rps=[%g,%g]" % (
             _env_float("BENCH_OCC_RPS_LO", 8.0),

@@ -1709,6 +1709,11 @@ def cmd_scenario(args) -> int:
               "(swarm.Config) scenario — use `python -m cbf_tpu run "
               f"{args.name}`", file=sys.stderr)
         return 2
+    if getattr(args, "tiles", None) is not None \
+            and getattr(args, "partition", "flat") != "spatial":
+        print("scenario run: --tiles needs --partition spatial",
+              file=sys.stderr)
+        return 2
     cfg = _apply_overrides(entry.make_config(), args.set, args.steps,
                            entry.steps_field, need_trajectory=False)
     sink = None
@@ -1720,12 +1725,49 @@ def cmd_scenario(args) -> int:
             manifest=obs.build_manifest(cfg, extra={
                 "scenario": args.name, "steps": cfg.steps}))
     import jax.numpy as jnp
-    _final, outs = dsl.run_config(args.name, cfg, telemetry=sink)
-    record = {"scenario": args.name, "n": cfg.n, "steps": cfg.steps,
-              "dynamics": cfg.dynamics,
-              "min_pairwise_distance": round(float(
-                  jnp.min(outs.min_pairwise_distance)), 6),
-              "infeasible_count": int(jnp.sum(outs.infeasible_count))}
+    if getattr(args, "partition", "flat") == "spatial":
+        # Spatially-tiled single-swarm path (parallel.spatial): the
+        # whole mesh becomes tiles (dp=1, sp=n_tiles), halo exchange
+        # ships boundary candidates between neighbors. The record
+        # keeps the flat run's safety keys and adds the tile ledger.
+        import jax
+
+        from cbf_tpu.parallel import make_mesh
+        from cbf_tpu.parallel.spatial import (plan_tiles,
+                                              spatial_swarm_rollout)
+
+        tiles = args.tiles or len(jax.devices())
+        try:
+            mesh = make_mesh(n_dp=1, n_sp=tiles,
+                             devices=jax.devices()[:tiles])
+            spec = plan_tiles(cfg, tiles)
+            _final, mets, rep = spatial_swarm_rollout(
+                cfg, mesh, spec=spec, telemetry=sink)
+        except ValueError as e:
+            print(f"scenario run --partition spatial: {e}",
+                  file=sys.stderr)
+            return 2
+        import numpy as np
+        record = {"scenario": args.name, "n": cfg.n, "steps": cfg.steps,
+                  "dynamics": cfg.dynamics, "partition": "spatial",
+                  "tiles": tiles, "capacity": spec.capacity,
+                  "halo_capacity": spec.halo_capacity,
+                  "rebin_every": spec.rebin_every,
+                  "epochs": rep.epochs,
+                  "overflow_total": rep.overflow_total,
+                  "halo_dropped_total": rep.halo_dropped_total,
+                  "occupancy_max": rep.occupancy_max,
+                  "min_pairwise_distance": round(float(
+                      np.min(mets.nearest_distance)), 6),
+                  "infeasible_count": int(
+                      np.sum(mets.infeasible_count))}
+    else:
+        _final, outs = dsl.run_config(args.name, cfg, telemetry=sink)
+        record = {"scenario": args.name, "n": cfg.n, "steps": cfg.steps,
+                  "dynamics": cfg.dynamics,
+                  "min_pairwise_distance": round(float(
+                      jnp.min(outs.min_pairwise_distance)), 6),
+                  "infeasible_count": int(jnp.sum(outs.infeasible_count))}
     if sink is not None:
         sink.summary()
         sink.close()
@@ -2230,6 +2272,15 @@ def main(argv=None) -> int:
     srunp.add_argument("--telemetry-dir", default=None,
                        help="write a run directory with a scenario.run "
                             "event")
+    srunp.add_argument("--partition", default="flat",
+                       choices=("flat", "spatial"),
+                       help="rollout decomposition: flat (default; the "
+                            "dsl/ensemble path) or spatial (domain-"
+                            "decomposed tiles with halo exchange — "
+                            "docs/API.md 'Spatial sharding')")
+    srunp.add_argument("--tiles", type=int, default=None,
+                       help="spatial tile count (default: every "
+                            "device); only with --partition spatial")
     srunp.set_defaults(fn=cmd_scenario)
 
     sub.add_parser("list", help="list scenarios + config knobs") \
@@ -2320,9 +2371,15 @@ def main(argv=None) -> int:
 
 
 def _spmd_wants_devices(args) -> bool:
-    """True when this lint invocation needs the virtual 8-device mesh."""
-    return args.command == "lint" and (
-        args.all or args.spmd or args.write_spmd_budget)
+    """True when this invocation needs the virtual 8-device mesh: the
+    SPMD lint passes, and spatial-partition scenario runs (the tile
+    mesh IS the decomposition — one device means one tile)."""
+    if args.command == "lint" and (
+            args.all or args.spmd or args.write_spmd_budget):
+        return True
+    return (args.command == "scenario"
+            and getattr(args, "scenario_command", None) == "run"
+            and getattr(args, "partition", "flat") == "spatial")
 
 
 def _maybe_spmd_reexec(args) -> None:

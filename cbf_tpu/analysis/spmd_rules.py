@@ -92,6 +92,8 @@ CANONICAL_PARTITION_SPECS: frozenset[tuple] = frozenset({
     ("dp", None),              # per-member metrics (E, steps)
     ("dp", "sp"),              # member x agent-row (E, N)
     ("dp", "sp", None),        # member x agent-row state (E, N, 2)
+    ("sp",),                   # spatial tile slab validity (T*C,)
+    ("sp", None),              # spatial tile slab state (T*C, 2)
 })
 
 #: The one module allowed to import jax's shard_map directly: the compat
@@ -269,6 +271,33 @@ def spmd_entrypoints() -> list[SpmdEntry]:
                 sharding=NamedSharding(mesh, spec))
         return eval_b, (deltas,)
 
+    def _spatial_rollout(devices):
+        import jax
+        import jax.numpy as jnp
+
+        from cbf_tpu.parallel import spatial
+        from cbf_tpu.parallel.mesh import make_mesh
+        from cbf_tpu.scenarios import swarm
+
+        # Certificate on so the census commits the full spatial surface:
+        # the halo collective-permute ring, the slab all-gathers feeding
+        # the shard-local sparse certificate, and the metric all-reduces.
+        cfg = swarm.Config(n=2048, steps=2, certificate=True,
+                           certificate_backend="sparse",
+                           certificate_iters=2, certificate_cg_iters=2)
+        T = len(devices)
+        mesh = make_mesh(n_dp=1, n_sp=T, devices=devices)
+        # Unblocked rows: the per-device peak IS the candidate slab, the
+        # quantity the decomposition shrinks (SP003 compares vs 1 tile).
+        spec = spatial.plan_tiles(cfg, T, block_rows=1 << 20)
+        fn = spatial._epoch_executable(cfg, mesh, spec, 2)
+        slab = (T * spec.capacity,)
+        slab2 = jax.ShapeDtypeStruct(slab + (2,), jnp.float32)
+        valid = jax.ShapeDtypeStruct(slab, jnp.bool_)
+        t0 = jax.ShapeDtypeStruct((), jnp.int32)
+        cbf = _abstract(swarm.default_cbf(cfg))
+        return fn, (t0, cbf, slab2, slab2, valid, slab2)
+
     def _lockstep_chunk(_devices):
         import jax
         import jax.numpy as jnp
@@ -294,6 +323,7 @@ def spmd_entrypoints() -> list[SpmdEntry]:
         SpmdEntry("sharded_rollout", "dp=2,sp=4", _sharded_rollout),
         SpmdEntry("dp_certificate_ensemble", "dp=8,sp=1", _dp_certificate),
         SpmdEntry("verify_eval_batch", "dp=8", _verify_eval),
+        SpmdEntry("spatial_rollout", "dp=1,sp=8", _spatial_rollout),
         # The serve hot path compiles meshless: its standing census
         # invariant is ZERO collectives (any nonzero count is a new
         # kind over the committed all-zero row -> SP001).

@@ -2,4 +2,6 @@ from cbf_tpu.parallel.mesh import make_mesh  # noqa: F401
 from cbf_tpu.parallel.ring import ring_knn  # noqa: F401
 from cbf_tpu.parallel.alltoall import all_gather_knn, exchange_knn  # noqa: F401
 from cbf_tpu.parallel.ensemble import sharded_swarm_rollout  # noqa: F401
+from cbf_tpu.parallel.spatial import (  # noqa: F401
+    SpatialOverflowError, SpatialSpec, plan_tiles, spatial_swarm_rollout)
 from cbf_tpu.parallel import multihost  # noqa: F401

@@ -397,8 +397,18 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
                           initial_state=None, t0: int = 0,
                           chunk: int | None = None,
                           with_solver_state: bool = False,
-                          telemetry=None, telemetry_every: int = 50):
+                          telemetry=None, telemetry_every: int = 50,
+                          partition: str = "flat"):
     """Run len(seeds) independent swarms over the (dp, sp) mesh.
+
+    ``partition``: ``"flat"`` (default) shards each swarm's agents by row
+    range over ``sp`` (the exchange_knn path below); ``"spatial"``
+    domain-decomposes ONE swarm (len(seeds) == 1, dp == 1) into x-strip
+    tiles with per-step halo exchange — O(band) per-device traffic
+    instead of the O(N) all-gather, the mega-swarm regime
+    (parallel.spatial; single-integrator, obstacle-free swarms only, and
+    the chunk/warm-start knobs below stay flat-path-only — the spatial
+    epoch loop host-offloads per rebin epoch already).
 
     ``initial_state``: optional (x0, v0) pair — (x0, v0, theta0) in
     unicycle mode — of (E, N, 2) / (E, N) arrays to start from (e.g. a
@@ -440,6 +450,19 @@ def sharded_swarm_rollout(cfg: swarm_scenario.Config, mesh, seeds,
     the final solver carry when ``with_solver_state=True`` — with
     (E, N, 2) / (E, N) global shapes, EnsembleMetrics).
     """
+    if partition not in ("flat", "spatial"):
+        raise ValueError(
+            f"partition must be 'flat' or 'spatial', got {partition!r}")
+    if partition == "spatial":
+        if chunk is not None or with_solver_state:
+            raise ValueError(
+                "chunk/with_solver_state are flat-partition knobs — the "
+                "spatial epoch loop host-offloads per rebin epoch and "
+                "carries no solver state")
+        from cbf_tpu.parallel import spatial
+        return spatial.ensemble_adapter(cfg, mesh, list(seeds), steps,
+                                        cbf, initial_state, t0,
+                                        telemetry=telemetry)
     steps = cfg.steps if steps is None else steps
     if cbf is None:
         cbf = swarm_scenario.default_cbf(cfg)

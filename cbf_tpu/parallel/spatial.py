@@ -1,0 +1,831 @@
+"""Spatially-tiled single-swarm decomposition: domain-decomposed step with
+halo exchange — N >= 100k on the mesh.
+
+The flat ``sp`` sharding (parallel.ensemble + parallel.alltoall) splits a
+swarm by ROW RANGE: every device still sees all N candidate states each
+step (one all_gather or a full ppermute ring), so per-device memory and
+gating compute stay O(N) / O(N^2 / sp) — the wall that caps a single
+swarm near what one chip holds. This module decomposes by SPACE instead:
+
+- **Tiles.** The arena (the certificate's box: ``arena_half_override`` or
+  ``1.5 * spawn_half_width``) is cut into ``n_tiles`` equal x-strips, one
+  per ``sp`` mesh slot. Each tile owns a fixed-capacity slab of
+  ``capacity`` agent slots — fixed so ONE executable serves every epoch —
+  with unoccupied slots parked at a far coordinate and masked out of every
+  reduction (the branch-free jnp.where discipline of the flat step).
+- **Binning.** A jitted O(N) pass (argsort + cumsum ranks, no host loop)
+  assigns agents to tiles by x-coordinate every ``rebin_every`` steps.
+  Deterministic in the (seeded) positions. Agents beyond a tile's capacity
+  either raise a typed :class:`SpatialOverflowError` (``on_overflow=
+  "raise"``, the default) or spill branch-free into free slots of other
+  tiles — a COUNTED quality fallback (their neighbor search degrades to
+  the wrong tile's candidates; ``SpatialReport.overflow_total`` and the
+  ``spatial.overflow_fallback`` telemetry counter surface every spill) —
+  never a silent drop: every agent keeps exactly one slot either way.
+- **Halo exchange.** Only agents binned within ``band`` of a tile face are
+  shipped to the adjacent tile, via two ``lax.ppermute`` neighbor chains
+  (the alltoall/ring machinery's collective, linear here instead of
+  periodic — the arena does not wrap). ``band = radius + 2 * drift`` with
+  ``radius`` the larger of the gating radius and the certificate's binding
+  pair radius and ``drift`` the worst-case per-epoch travel
+  (sqrt(2) * speed_limit * dt * rebin_every — the QP's component box caps
+  each step), so the local tile + halos provably contain every in-radius
+  partner of every locally-binned agent for the whole epoch. Membership is
+  computed ONCE per epoch from bin-time positions; each step ships only
+  current states of those members. Per-device traffic is O(band density),
+  not O(N) — the all_gather this replaces ships 16 B x N per device per
+  step. Band members beyond ``halo_capacity`` are counted
+  (``halo_dropped``) and, under ``on_overflow="raise"``, raise.
+- **Sharded certificate.** The joint layer (Config.certificate) reuses the
+  row-partitioned ADMM solve (solvers.sparse_admm ``axis_name`` contract)
+  with the SLAB ordering as the global variable ordering: each tile's rows
+  are contiguous (``rows_start = tile * capacity``, the solver's dense
+  I-side fast path), pair rows are searched over local + halo candidates
+  only, and the (n_tiles * capacity, 2) iterate is the ONLY globally
+  materialized object — the O(N^2) pairwise structure of
+  certificates.si_barrier_certificate_sparse_sharded's (n_local, N) slab
+  never exists. Parked slots are provably inert in the solve: zero
+  nominal, +-inf box, no pair rows (eligibility requires validity on both
+  endpoints), so every ADMM/CG component of a parked slot stays exactly
+  zero and the padded solve equals the valid-restricted problem modulo
+  f32 summation order. Row geometry and arena box come from the shared
+  derivations (certificates._pair_row_geometry / _arena_box) so the
+  constraint set cannot drift from the flat paths.
+
+Gating parity: within an epoch the local + halo candidate set contains
+every global candidate within the gating radius of a local agent
+(band >= radius + both-endpoint drift), and selection keys on exact
+distances, so the per-agent kNN set — and hence the filtered control —
+matches the flat step's up to float summation order
+(tests/test_spatial.py pins this at N in {256, 1024} and at a
+tile-boundary crossing).
+
+Single-integrator swarms only (the mega regime ISSUE 19 targets);
+double / unicycle / mixed dynamics, obstacles, Verlet caches, warm-start
+/ adaptive-tol / fused certificates, and explicit gating backends are
+rejected up front — honored-or-rejected, never silently approximated.
+
+Entry points: :func:`plan_tiles` -> :class:`SpatialSpec`,
+:func:`spatial_swarm_rollout` (epoch loop), and
+``sharded_swarm_rollout(partition="spatial")`` (parallel.ensemble) as the
+ensemble-compatible wrapper. :func:`spatial_knn_sets` is the debug/parity
+surface for the neighbor sets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from cbf_tpu.core.filter import CBFParams, safe_controls
+from cbf_tpu.ops.pairwise import pairwise_distances
+from cbf_tpu.parallel.ensemble import EnsembleMetrics, shard_map
+from cbf_tpu.scenarios import swarm as swarm_scenario
+from cbf_tpu.utils.math import match_vma, safe_norm
+
+# Unoccupied slab slots park here — far outside any arena, so even before
+# the validity masks are consulted no parked coordinate can fall inside a
+# gating or certificate radius of a real agent.
+PARK = 1.0e6
+
+
+class SpatialOverflowError(RuntimeError):
+    """A tile's slab (or a halo band) exceeded its fixed capacity under
+    ``on_overflow="raise"`` — the typed signal that the planned density
+    assumption broke. Re-plan with a larger ``slack`` / ``halo_capacity``
+    or opt into the counted ``on_overflow="fallback"`` degradation."""
+
+
+class SpatialSpec(NamedTuple):
+    """The static tiling plan — hashable, so it keys the compiled-epoch
+    cache. Build with :func:`plan_tiles` (the constructor enforces none of
+    the coverage invariants)."""
+    n_tiles: int        # sp mesh extent; 1D x-strips
+    capacity: int       # slab slots per tile (multiple of block_rows)
+    halo_capacity: int  # shipped slots per face per step
+    band: float         # face band width (bin coordinates) shipped as halo
+    half: float         # arena half-width the strips partition
+    rebin_every: int    # steps per epoch between re-binning passes
+    block_rows: int     # gating/certificate row-block size (lax.map)
+    pair_radius: float  # certificate binding radius (0.0: certificate off)
+
+
+class SpatialMetrics(NamedTuple):
+    """Per-step host metrics of a spatial rollout, (steps,) leaves. The
+    first eight channels mirror parallel.ensemble.EnsembleMetrics (same
+    semantics, one swarm); the tail is the decomposition's own honesty
+    surface."""
+    nearest_distance: np.ndarray
+    engaged_count: np.ndarray
+    infeasible_count: np.ndarray
+    dropped_count: np.ndarray
+    certificate_residual: np.ndarray
+    certificate_dropped: np.ndarray
+    saturation_deficit: np.ndarray
+    certificate_iterations: np.ndarray
+    # Valid agents whose travel since the epoch's bin pass exceeded the
+    # planned drift allowance — the one way the halo coverage proof can be
+    # violated at runtime (e.g. a custom CBF box wider than speed_limit).
+    # Must be 0 for the parity guarantee to hold; surfaced, never assumed.
+    drift_violations: np.ndarray
+
+
+@dataclasses.dataclass(frozen=True)
+class SpatialReport:
+    """Epoch-level accounting of one spatial rollout (host ints)."""
+    epochs: int
+    overflow_total: int      # agents spilled to out-of-tile slots (fallback)
+    halo_dropped_total: int  # band members beyond halo_capacity, all epochs
+    occupancy_max: int       # max agents binned into any tile
+    halo_used_max: int       # max shipped halo slots in use on any tile
+
+
+def _round_up(v: int, m: int) -> int:
+    return -(-v // m) * m
+
+
+def plan_tiles(cfg: swarm_scenario.Config, n_tiles: int, *,
+               slack: float = 1.3, rebin_every: int = 8,
+               halo_capacity: int | None = None,
+               block_rows: int | None = None) -> SpatialSpec:
+    """Derive the static tiling plan for ``cfg`` over ``n_tiles`` strips.
+
+    ``slack``: per-tile capacity headroom over the uniform share
+    ``ceil(N / n_tiles)`` — binned occupancy fluctuates with the swarm's
+    motion, and capacity is static so one executable serves every epoch.
+    ``rebin_every``: steps per epoch; larger amortizes the binning pass
+    and the epoch-boundary host sync but widens ``band`` (drift margin)
+    and so the halo traffic. ``halo_capacity``: shipped slots per face
+    (default: 2.2x the uniform-density expectation, min 16).
+    ``block_rows``: gating/certificate row-block size — per-device peak
+    scales with ``block_rows * (capacity + 2 * halo_capacity)`` instead
+    of ``capacity^2`` (default 512, clamped to capacity).
+
+    Raises when a tile strip is narrower than the halo band: adjacent-tile
+    halos would no longer cover the interaction radius and the
+    decomposition would be silently wrong — use fewer tiles or a smaller
+    ``rebin_every``.
+    """
+    if n_tiles < 1:
+        raise ValueError(f"n_tiles must be >= 1, got {n_tiles}")
+    if rebin_every < 1:
+        raise ValueError(f"rebin_every must be >= 1, got {rebin_every}")
+    if slack < 1.0:
+        raise ValueError(f"slack must be >= 1.0, got {slack}")
+    params, _ = swarm_scenario._certificate_problem(cfg)
+    half = (cfg.arena_half_override if cfg.arena_half_override is not None
+            else cfg.spawn_half_width * 1.5)
+    radius = float(cfg.safety_distance)
+    pair_radius = 0.0
+    if cfg.certificate:
+        from cbf_tpu.sim.certificates import binding_pair_radius
+        pair_radius = binding_pair_radius(params)
+        radius = max(radius, pair_radius)
+    # Worst-case travel of ONE agent over an epoch: the QP's component box
+    # caps |u_i| at speed_limit, so |u|_2 <= sqrt(2) * speed_limit per
+    # step. Both pair endpoints move, hence 2 * drift in the band; 1.05
+    # covers f32 edge arithmetic.
+    drift = math.sqrt(2.0) * float(cfg.speed_limit) * float(cfg.dt) \
+        * rebin_every
+    band = 1.05 * (radius + 2.0 * drift)
+    width = 2.0 * half / n_tiles
+    if n_tiles > 1 and width < band:
+        raise ValueError(
+            f"tile width {width:.3f} < halo band {band:.3f} "
+            f"(radius {radius:.3f} + 2x epoch drift {drift:.3f}): "
+            f"adjacent halos cannot cover the interaction radius — use "
+            f"fewer tiles than {n_tiles} or a smaller rebin_every than "
+            f"{rebin_every}")
+    # Capacity: NOT the uniform share — the arena is wider than the spawn
+    # box (1.5x) and the consensus law contracts the pack toward
+    # pack_radius, so interior tiles durably hold more than N / n_tiles.
+    # The tightest configuration the nominal law drives toward spreads the
+    # swarm over ~2 * pack_radius, giving a worst per-tile share of
+    # N * width / (2 * pack_radius) (all of N when a tile is wider than
+    # the packed swarm); ``slack`` rides on top of that.
+    extent = min(half, max(float(cfg.pack_radius), 1e-6))
+    share = cfg.n * min(1.0, width / (2.0 * extent))
+    cap0 = max(8, int(math.ceil(max(share, cfg.n / n_tiles) * slack)))
+    block = block_rows if block_rows is not None else 512
+    if block < 1:
+        raise ValueError(f"block_rows must be >= 1, got {block}")
+    block = min(block, _round_up(cap0, 8))
+    capacity = _round_up(cap0, block)
+    if n_tiles * capacity < cfg.n:
+        raise ValueError(
+            f"n_tiles * capacity = {n_tiles * capacity} < N = {cfg.n}")
+    if halo_capacity is None:
+        expected = capacity * min(1.0, band / max(width, band))
+        halo_capacity = min(capacity,
+                            _round_up(max(16, int(math.ceil(2.2 * expected))),
+                                      8))
+    if not 1 <= halo_capacity <= capacity:
+        raise ValueError(
+            f"halo_capacity must be in [1, capacity={capacity}], got "
+            f"{halo_capacity}")
+    return SpatialSpec(n_tiles=n_tiles, capacity=capacity,
+                       halo_capacity=int(halo_capacity), band=float(band),
+                       half=float(half), rebin_every=int(rebin_every),
+                       block_rows=int(block), pair_radius=float(pair_radius))
+
+
+# ------------------------------------------------------------ binning ----
+
+@functools.lru_cache(maxsize=16)
+def _bin_executable(n: int, n_tiles: int, capacity: int):
+    """Jitted global binning pass: (x, v, half) -> slabs.
+
+    O(N) arrays + one argsort; branch-free. Returns
+    (x_slab (T*C, 2) with parked slots at PARK, v_slab (T*C, 2),
+    valid (T*C,) bool, slot_of_agent (N,) int32, overflow int32 — agents
+    whose tile was full, spilled into free slots of OTHER tiles —
+    counts (T,) int32 binned occupancy)."""
+    T, C = n_tiles, capacity
+
+    def bin_fn(x, v, half):
+        width = 2.0 * half / T
+        tile = jnp.clip(jnp.floor((x[:, 0] + half) / width),
+                        0, T - 1).astype(jnp.int32)
+        order = jnp.argsort(tile, stable=True)
+        tile_s = tile[order]
+        counts = jnp.bincount(tile, length=T).astype(jnp.int32)
+        starts = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
+        rank = jnp.arange(n, dtype=jnp.int32) - starts[tile_s]
+        fits = rank < C
+        slot_primary = tile_s * C + rank
+        occupied = jnp.zeros((T * C,), jnp.int32).at[
+            jnp.where(fits, slot_primary, 0)].add(fits.astype(jnp.int32))
+        # Spill: the j-th overflowing agent (sorted order) takes the j-th
+        # free slot (ascending slot id — stable argsort of the occupancy
+        # bits puts free slots first). T*C >= N guarantees enough.
+        free_slots = jnp.argsort(occupied, stable=True).astype(jnp.int32)
+        ov_rank = jnp.cumsum((~fits).astype(jnp.int32)) - 1
+        slot_s = jnp.where(fits, slot_primary,
+                           free_slots[jnp.clip(ov_rank, 0, T * C - 1)])
+        slot_of_agent = jnp.zeros((n,), jnp.int32).at[order].set(slot_s)
+        x_slab = jnp.full((T * C, 2), PARK, x.dtype).at[slot_of_agent].set(x)
+        v_slab = jnp.zeros((T * C, 2), v.dtype).at[slot_of_agent].set(v)
+        valid = jnp.zeros((T * C,), bool).at[slot_of_agent].set(True)
+        overflow = jnp.sum(~fits, dtype=jnp.int32)
+        return x_slab, v_slab, valid, slot_of_agent, overflow, counts
+
+    return jax.jit(bin_fn)
+
+
+# ------------------------------------------------------- halo exchange ----
+
+class _HaloPlan(NamedTuple):
+    """Per-epoch (bin-time) halo membership of one tile: which local slots
+    ship to each face, fixed for the whole epoch (band covers the drift)."""
+    sel_l: jax.Array    # (H,) local slots shipped to tile - 1
+    flag_l: jax.Array   # (H,) bool — slot actually in the left band
+    sel_r: jax.Array
+    flag_r: jax.Array
+    dropped: jax.Array  # scalar int32: band members beyond H, both faces
+    used: jax.Array     # scalar int32: shipped slots in use, both faces
+
+
+def _halo_plan(xb, valid, spec: SpatialSpec, tile):
+    T, H = spec.n_tiles, spec.halo_capacity
+    width = 2.0 * spec.half / T
+    left_edge = -spec.half + tile.astype(xb.dtype) * width
+    in_l = valid & (xb[:, 0] < left_edge + spec.band) & (tile > 0)
+    in_r = valid & (xb[:, 0] >= left_edge + width - spec.band) \
+        & (tile < T - 1)
+
+    def select(in_band):
+        vals, idx = lax.top_k(in_band.astype(jnp.float32), H)
+        return idx.astype(jnp.int32), vals > 0.5
+
+    sel_l, flag_l = select(in_l)
+    sel_r, flag_r = select(in_r)
+    n_l = jnp.sum(in_l, dtype=jnp.int32)
+    n_r = jnp.sum(in_r, dtype=jnp.int32)
+    dropped = (jnp.maximum(n_l - H, 0) + jnp.maximum(n_r - H, 0))
+    used = jnp.minimum(n_l, H) + jnp.minimum(n_r, H)
+    return _HaloPlan(sel_l, flag_l, sel_r, flag_r, dropped, used)
+
+
+def _halo_candidates(states4, valid, plan: _HaloPlan, spec: SpatialSpec,
+                     tile):
+    """Ship this step's states of the planned band members to the adjacent
+    tiles (two linear ppermute chains — the arena does not wrap; edge
+    receivers get zero payloads whose flag channel masks them) and return
+    the tile's full candidate set (C + 2H rows):
+    (cand_states4, cand_gid — slab-global slot ids — cand_ok)."""
+    T, C, H = spec.n_tiles, spec.capacity, spec.halo_capacity
+    dt_ = states4.dtype
+
+    def pack(sel, flag):
+        pay = jnp.concatenate(
+            [states4[sel], sel[:, None].astype(dt_),
+             flag[:, None].astype(dt_)], axis=1)
+        # Zero non-member rows entirely: a received zero payload (edge
+        # tiles, masked slots) then decodes identically to "no candidate".
+        return pay * flag[:, None].astype(dt_)
+
+    if T > 1:
+        # Left bands flow leftward (j -> j - 1), so each tile RECEIVES its
+        # right neighbor's left band, and symmetrically for the right.
+        from_right = lax.ppermute(pack(plan.sel_l, plan.flag_l), "sp",
+                                  [(j, j - 1) for j in range(1, T)])
+        from_left = lax.ppermute(pack(plan.sel_r, plan.flag_r), "sp",
+                                 [(j, j + 1) for j in range(T - 1)])
+    else:
+        from_right = from_left = jnp.zeros((H, 6), dt_)
+
+    def decode(pay, src_tile):
+        ok = pay[:, 5] > 0.5
+        slot = pay[:, 4].astype(jnp.int32)
+        gid = jnp.clip(src_tile * C + slot, 0, T * C - 1)
+        return pay[:, :4], gid, ok
+
+    s4_r, gid_r, ok_r = decode(from_right, tile + 1)
+    s4_l, gid_l, ok_l = decode(from_left, tile - 1)
+    cand_s4 = jnp.concatenate([states4, s4_l, s4_r], axis=0)
+    cand_gid = jnp.concatenate(
+        [tile * C + jnp.arange(C, dtype=jnp.int32), gid_l, gid_r])
+    cand_ok = jnp.concatenate([valid, ok_l, ok_r])
+    return cand_s4, cand_gid, cand_ok
+
+
+# ------------------------------------------------- blocked neighbor ops ----
+
+def _blocked_select(xq, q_gid, q_valid, xc, c_gid, c_ok, k: int,
+                    radius, block: int, by_gid: bool):
+    """Masked radius-limited k-nearest over the candidate set, row-blocked
+    so the distance slab peaks at (block, C + 2H) instead of
+    (C, C + 2H). ``by_gid=False`` excludes self (and exact-coincident
+    candidates) by ``dist > 0`` — the gating rule, matching
+    parallel.alltoall — while ``by_gid=True`` excludes by slot identity
+    only — the certificate rule, matching
+    certificates.si_barrier_certificate_sparse_sharded, where coincident
+    DISTINCT agents must stay eligible. Returns (idx (Q, k) into the
+    candidate axis, mask, dist, count — eligible candidates per row)."""
+    Q = xq.shape[0]
+    if Q % block:
+        raise ValueError(f"capacity {Q} must divide by block_rows {block}")
+    nb = Q // block
+
+    def one(args):
+        xqb, gqb, vqb = args
+        d = pairwise_distances(xqb, xc)
+        elig = (d < radius) & c_ok[None, :] & vqb[:, None]
+        if by_gid:
+            elig &= c_gid[None, :] != gqb[:, None]
+        else:
+            elig &= d > 0
+        keyed = jnp.where(elig, d, jnp.inf)
+        neg, idx = lax.top_k(-keyed, k)
+        return (idx, jnp.isfinite(neg), -neg,
+                jnp.sum(elig, axis=1, dtype=jnp.int32))
+
+    out = lax.map(one, (xq.reshape(nb, block, xq.shape[1]),
+                        q_gid.reshape(nb, block),
+                        q_valid.reshape(nb, block)))
+    return tuple(o.reshape((Q,) + o.shape[2:]) for o in out)
+
+
+# -------------------------------------------------- sharded certificate ----
+
+def _apply_certificate_spatial(cfg: swarm_scenario.Config,
+                               spec: SpatialSpec, u, x, valid, row_gid,
+                               cand_xy, cand_gid, cand_ok, tile):
+    """The joint second layer over the SLAB ordering: the concatenated
+    tile slabs (T*C rows, parked slots included) are the solve's variable
+    vector, so each tile's rows are contiguous (the solver's agent_k /
+    rows_start fast path) and the replicated iterate all_gathers straight
+    from the local slabs with no permutation. Pair rows are searched over
+    local + halo candidates only — the (n_local, N) slab of the flat
+    row-partitioned path never exists; the per-device footprint here is
+    the (T*C, 2) iterate + the blocked (block_rows, C + 2H) search.
+    Parked slots: zero nominal, +-inf box, no pair rows touch them
+    (eligibility requires validity on both endpoints), so their ADMM/CG
+    components stay exactly zero and the padded solve equals the
+    valid-restricted problem up to f32 summation order."""
+    from cbf_tpu.sim.certificates import _arena_box, _pair_row_geometry
+    from cbf_tpu.solvers.sparse_admm import solve_pair_box_qp_admm
+
+    params, arena = swarm_scenario._certificate_problem(cfg)
+    settings = swarm_scenario._certificate_settings(cfg)
+    T, C = spec.n_tiles, spec.capacity
+    kc = min(cfg.certificate_k, cfg.n - 1)
+    dtype = x.dtype
+
+    # Magnitude pre-limit — per-row, so limiting the local slab equals the
+    # replicated path's full-vector limit row-for-row.
+    norms = safe_norm(u, axis=1)
+    scale = jnp.maximum(1.0, norms / params.magnitude_limit)
+    u_nom = jnp.where(valid[:, None], u / scale[:, None], 0.0)
+
+    # Slab-global (T*C, 2) gathers: the ONE globally materialized object.
+    xt_g = lax.all_gather(x, "sp", axis=0, tiled=True)
+    un_g = lax.all_gather(u_nom, "sp", axis=0, tiled=True)
+    valid_g = lax.all_gather(valid, "sp", axis=0, tiled=True)
+
+    idx, maskk, _, count = _blocked_select(
+        x, row_gid, valid, cand_xy, cand_gid, cand_ok, kc,
+        spec.pair_radius, spec.block_rows, by_gid=True)
+    I = jnp.broadcast_to(row_gid[:, None], (C, kc)).reshape(-1)
+    J = cand_gid[idx].reshape(-1)
+    maskf = maskk.reshape(-1)
+
+    # Symmetric coverage accounting (the flat row-partitioned path's
+    # formula): the reverse lookup needs every tile's kept slots — gather
+    # the (T*C, kc) gid/mask tables once (bounded: 8 B/slot/neighbor).
+    kept = jnp.where(maskk, cand_gid[idx], -1)
+    idx_g = lax.all_gather(kept, "sp", axis=0, tiled=True)
+    mask_g = lax.all_gather(maskk, "sp", axis=0, tiled=True)
+    mutual = maskf & jnp.any(
+        (idx_g[J] == I[:, None]) & mask_g[J], axis=1)
+    D = lax.psum(jnp.sum(jnp.where(valid, count, 0)), "sp")
+    S = lax.psum(jnp.sum(maskk, dtype=jnp.int32), "sp")
+    M = lax.psum(jnp.sum(mutual, dtype=jnp.int32), "sp")
+    dropped = D // 2 - (S - M // 2)
+
+    coef, b_pair = _pair_row_geometry(xt_g, I, J, maskf, params, dtype)
+    lo, hi = _arena_box(xt_g, params, arena, dtype)
+    # Parked slots sit at PARK, far outside the arena — their cubic wall
+    # rows would otherwise inject huge bounds. +-inf deactivates the box,
+    # keeping their components exactly zero through every update.
+    big = jnp.full_like(hi, jnp.inf)
+    lo = jnp.where(valid_g[:, None], lo, -big)
+    hi = jnp.where(valid_g[:, None], hi, big)
+
+    u_sol, sinfo = solve_pair_box_qp_admm(
+        un_g, I, J, coef, b_pair, lo, hi, settings, axis_name="sp",
+        agent_k=kc, rows_start=tile * C)
+    # Re-assert replication (cf. the flat sharded certificate) then slice
+    # this tile's block back out of the slab ordering.
+    u_rep = lax.pmax(u_sol, "sp")
+    u_local = lax.dynamic_slice_in_dim(u_rep, tile * C, C, axis=0)
+    return (u_local, lax.pmax(sinfo.primal_residual, "sp"), dropped,
+            sinfo.iterations)
+
+
+# ----------------------------------------------------------- tile step ----
+
+def _tile_step(cfg: swarm_scenario.Config, cbf: CBFParams,
+               spec: SpatialSpec, t, x, v, valid, xb, plan: _HaloPlan,
+               tile):
+    """One spatially-decomposed swarm step on this tile's slab — the
+    masked mirror of parallel.ensemble._local_swarm_step's single-
+    integrator path, with the halo candidate set standing in for the
+    all-gathered swarm. x, v: (C, 2) slabs; xb the epoch's bin-time
+    positions (drift accounting). Returns (x', v', metrics 9-tuple)."""
+    dt_ = x.dtype
+    T, C = spec.n_tiles, spec.capacity
+    f, g, discrete = swarm_scenario.barrier_dynamics(cfg, dt_)
+    K = min(cfg.k_neighbors, cfg.n - 1)
+
+    mean = lax.psum(jnp.sum(jnp.where(valid[:, None], x, 0.0), axis=0),
+                    "sp") / cfg.n
+    to_c = mean[None] - x
+    d_c = safe_norm(to_c, keepdims=True)
+    pull = jnp.maximum(d_c - cfg.pack_radius, 0.0)
+    u0 = cfg.consensus_gain * pull * to_c / jnp.maximum(d_c, 1e-9)
+
+    vslots = v if not discrete else jnp.zeros_like(v)
+    states4 = jnp.concatenate([x, vslots], axis=1)
+    row_gid = tile * C + jnp.arange(C, dtype=jnp.int32)
+    cand_s4, cand_gid, cand_ok = _halo_candidates(states4, valid, plan,
+                                                  spec, tile)
+
+    idx, mask, dist, count = _blocked_select(
+        x, row_gid, valid, cand_s4[:, :2], cand_gid, cand_ok, K,
+        cfg.safety_distance, spec.block_rows, by_gid=False)
+    obs_slab = cand_s4[idx]                               # (C, K, 4)
+    nearest1 = jnp.where(mask[:, 0], dist[:, 0], jnp.inf)
+    dropped_rows = jnp.maximum(count - K, 0)
+
+    u0 = swarm_scenario.complete_nominal(cfg, u0, x, v, obs_slab, mask)
+    priority, cap = swarm_scenario.relax_tiers(cfg, mask, None)
+    u_safe, info = safe_controls(
+        states4, obs_slab, mask, f, g, u0, cbf,
+        priority_mask=priority, relax_cap=cap,
+        reference_layout=True, vel_box_rows=True)
+    engaged = jnp.any(mask, axis=1) & valid
+    u = jnp.where(engaged[:, None], u_safe, u0)
+
+    cert_res = jnp.zeros((), dt_)
+    cert_dropped = jnp.zeros((), jnp.int32)
+    cert_iters = jnp.zeros((), jnp.int32)
+    if cfg.certificate:
+        u, cert_res, cert_dropped, cert_iters = _apply_certificate_spatial(
+            cfg, spec, u, x, valid, row_gid, cand_s4[:, :2], cand_gid,
+            cand_ok, tile)
+
+    u = jnp.where(valid[:, None], u, 0.0)
+    u = match_vma(u, x)
+    cert_res = match_vma(cert_res, x)
+    x_new, v_new = swarm_scenario.integrate(cfg, x, v, u)
+    x_new = jnp.where(valid[:, None], x_new, x)
+    v_new = jnp.where(valid[:, None], v_new, 0.0)
+
+    # Drift accounting: the halo coverage proof budgets each agent
+    # sqrt(2) * speed_limit * dt * rebin_every of travel per epoch.
+    allow = 1.05 * math.sqrt(2.0) * float(cfg.speed_limit) \
+        * float(cfg.dt) * spec.rebin_every
+    drifted = valid & (jnp.sum((x_new - xb) ** 2, axis=1) > allow * allow)
+
+    metrics = (
+        lax.pmin(jnp.min(jnp.where(valid, nearest1, jnp.inf)), "sp"),
+        lax.psum(jnp.sum(engaged), "sp"),
+        lax.psum(jnp.sum(~info.feasible & engaged), "sp"),
+        lax.psum(jnp.sum(jnp.where(valid, dropped_rows, 0)), "sp"),
+        lax.pmax(cert_res, "sp"),
+        lax.pmax(match_vma(cert_dropped, x), "sp"),
+        jnp.zeros((), dt_),                 # saturation_deficit: single only
+        lax.pmax(match_vma(cert_iters, x), "sp"),
+        lax.psum(jnp.sum(drifted), "sp"),
+    )
+    return x_new, v_new, metrics
+
+
+N_STEP_METRICS = len(SpatialMetrics._fields)
+
+
+@functools.lru_cache(maxsize=32)
+def _epoch_executable(cfg: swarm_scenario.Config, mesh,
+                      spec: SpatialSpec, steps: int):
+    """The jitted one-epoch program for (cfg, mesh, spec, steps): halo
+    plan from bin-time positions, then a ``steps``-long scan of the tile
+    step. Cached — the epoch loop reuses at most two step counts
+    (rebin_every and the final remainder), so the executable is stable
+    across the whole rollout."""
+
+    def local_epoch(t0, cbf, x, v, valid, xb):
+        tile = lax.axis_index("sp")
+        plan = _halo_plan(xb, valid, spec, tile)
+
+        def body(carry, t):
+            x_c, v_c = carry
+            x2, v2, met = _tile_step(cfg, cbf, spec, t, x_c, v_c, valid,
+                                     xb, plan, tile)
+            return (x2, v2), met
+
+        (xf, vf), mets = lax.scan(body, (x, v),
+                                  t0 + jnp.arange(steps))
+        occ_max = lax.pmax(jnp.sum(valid, dtype=jnp.int32), "sp")
+        halo_used = lax.pmax(plan.used, "sp")
+        halo_dropped = lax.psum(plan.dropped, "sp")
+        return (xf, vf) + tuple(mets) + (occ_max, halo_used, halo_dropped)
+
+    slab2 = P("sp", None)
+    fn = shard_map(
+        local_epoch, mesh,
+        in_specs=(P(), P(), slab2, slab2, P("sp"), slab2),
+        out_specs=(slab2, slab2) + (P(),) * (N_STEP_METRICS + 3),
+        check_rep=False,   # scan + blocked lax.map bodies
+    )
+    return jax.jit(fn)
+
+
+# -------------------------------------------------------------- rollout ----
+
+def _validate_spatial(cfg: swarm_scenario.Config, mesh):
+    """Honored-or-rejected: every knob the spatial step does not implement
+    raises up front instead of being silently approximated."""
+    if cfg.dynamics != "single":
+        raise ValueError(
+            f"partition='spatial' supports single-integrator swarms only "
+            f"(got dynamics={cfg.dynamics!r})")
+    if cfg.n_obstacles:
+        raise ValueError(
+            "partition='spatial' does not support moving obstacles yet — "
+            "the obstacle ring is untested against parked slab slots")
+    if cfg.gating != "auto":
+        raise ValueError(
+            f"partition='spatial' runs its own halo-tiled jnp gating; an "
+            f"explicit gating={cfg.gating!r} label would be dishonored")
+    if cfg.gating_rebuild_skin or cfg.certificate_rebuild_skin:
+        raise ValueError(
+            "Verlet skins are whole-swarm-per-device paths — unset "
+            "gating_rebuild_skin/certificate_rebuild_skin for "
+            "partition='spatial'")
+    if cfg.certificate:
+        if swarm_scenario.certificate_backend(cfg) != "sparse":
+            raise ValueError(
+                "partition='spatial' needs the sparse certificate backend "
+                "(the dense solver factorizes the full system and cannot "
+                "row-partition)")
+        if cfg.certificate_warm_start or cfg.certificate_tol is not None:
+            raise ValueError(
+                "certificate_warm_start/certificate_tol are whole-swarm-"
+                "per-device modes (the row-partitioned solve rejects "
+                "adaptive exits and cross-step carries)")
+        if cfg.certificate_fused:
+            raise ValueError(
+                "certificate_fused requires sp == 1 — the row-partitioned "
+                "solve keeps the CG path")
+        if cfg.certificate_partition not in ("auto",):
+            raise ValueError(
+                "partition='spatial' is always row-partitioned; "
+                f"certificate_partition={cfg.certificate_partition!r} "
+                "would be dishonored")
+    if "sp" not in mesh.shape or "dp" not in mesh.shape:
+        raise ValueError("spatial rollouts need a (dp, sp) mesh "
+                         "(parallel.mesh.make_mesh)")
+    if mesh.shape["dp"] != 1:
+        raise ValueError(
+            f"partition='spatial' decomposes ONE swarm over sp — build "
+            f"the mesh with n_dp=1 (got dp={mesh.shape['dp']})")
+
+
+def spatial_swarm_rollout(cfg: swarm_scenario.Config, mesh, *,
+                          steps: int | None = None,
+                          cbf: CBFParams | None = None,
+                          initial_state=None, t0: int = 0,
+                          seed: int | None = None,
+                          spec: SpatialSpec | None = None,
+                          on_overflow: str = "raise",
+                          telemetry=None):
+    """Run one swarm spatially decomposed over the mesh's ``sp`` axis.
+
+    Epoch loop: every ``spec.rebin_every`` steps a jitted global binning
+    pass re-assigns agents to tiles, then one compiled shard_map epoch
+    advances the slabs with per-step halo exchange. The two host sync
+    points per epoch (bin + overflow check) are where ``on_overflow``
+    fires: ``"raise"`` (default) raises :class:`SpatialOverflowError` on
+    any tile-capacity or halo-capacity saturation; ``"fallback"`` counts
+    and continues (spilled agents land in out-of-tile slots — their
+    neighbor search degrades to the wrong tile's candidates, surfaced via
+    :class:`SpatialReport` and the ``spatial.*`` telemetry counters).
+
+    ``initial_state``: optional (x0, v0) of (N, 2) arrays (resume path);
+    otherwise the scenario's seeded spawn at ``seed`` (default
+    ``cfg.seed``). ``telemetry``: optional obs.TelemetrySink — one
+    ``spatial_epoch`` event + gauge/counter updates per epoch.
+
+    Returns ((x, v) global (N, 2) arrays in agent order,
+    :class:`SpatialMetrics` (steps,) host leaves, :class:`SpatialReport`).
+    """
+    _validate_spatial(cfg, mesh)
+    if on_overflow not in ("raise", "fallback"):
+        raise ValueError(
+            f"on_overflow must be 'raise' or 'fallback', got "
+            f"{on_overflow!r}")
+    T = mesh.shape["sp"]
+    if spec is None:
+        spec = plan_tiles(cfg, T)
+    if spec.n_tiles != T:
+        raise ValueError(
+            f"spec.n_tiles={spec.n_tiles} != mesh sp extent {T}")
+    steps = cfg.steps if steps is None else steps
+    if cbf is None:
+        cbf = swarm_scenario.default_cbf(cfg)
+    if initial_state is not None:
+        x, v = initial_state
+        if x.shape != (cfg.n, 2) or v.shape != (cfg.n, 2):
+            raise ValueError(
+                f"initial_state needs (x, v) of shape {(cfg.n, 2)}, got "
+                f"{x.shape} / {v.shape}")
+    else:
+        key = jax.random.PRNGKey(cfg.seed if seed is None else int(seed))
+        x = swarm_scenario.clear_obstacle_spawn(
+            cfg, swarm_scenario.spawn_positions(cfg, key))
+        v = jnp.zeros_like(x)
+
+    bin_fn = _bin_executable(cfg.n, T, spec.capacity)
+    half = jnp.asarray(spec.half, x.dtype)
+    chunks: list[tuple] = []
+    overflow_total = halo_dropped_total = 0
+    occupancy_max = halo_used_max = epochs = 0
+    t = t0
+    while t < t0 + steps:
+        n = min(spec.rebin_every, t0 + steps - t)
+        x_slab, v_slab, valid, slot_of_agent, overflow, counts = bin_fn(
+            x, v, half)
+        overflow = int(overflow)
+        if overflow and on_overflow == "raise":
+            raise SpatialOverflowError(
+                f"{overflow} agents exceeded tile capacity "
+                f"{spec.capacity} at step {t} (occupancy "
+                f"{[int(c) for c in counts]}) — raise plan_tiles slack "
+                f"or use on_overflow='fallback'")
+        out = _epoch_executable(cfg, mesh, spec, n)(
+            jnp.asarray(t, jnp.int32), cbf, x_slab, v_slab, valid, x_slab)
+        xf, vf = out[0], out[1]
+        mets = out[2:2 + N_STEP_METRICS]
+        occ_max, halo_used, halo_dropped = (int(out[-3]), int(out[-2]),
+                                            int(out[-1]))
+        if halo_dropped and on_overflow == "raise":
+            raise SpatialOverflowError(
+                f"{halo_dropped} halo band members exceeded halo_capacity "
+                f"{spec.halo_capacity} in the epoch at step {t} — raise "
+                f"plan_tiles halo_capacity or use on_overflow='fallback'")
+        x = xf[slot_of_agent]
+        v = vf[slot_of_agent]
+        chunks.append(tuple(np.asarray(m) for m in mets))
+        epochs += 1
+        overflow_total += overflow
+        halo_dropped_total += halo_dropped
+        occupancy_max = max(occupancy_max, occ_max)
+        halo_used_max = max(halo_used_max, halo_used)
+        if telemetry is not None:
+            telemetry.event("spatial_epoch", {
+                "t": int(t), "steps": int(n), "tiles": T,
+                "overflow": overflow, "halo_dropped": halo_dropped,
+                "occupancy_max": occ_max, "halo_used_max": halo_used,
+                "capacity": spec.capacity,
+                "halo_capacity": spec.halo_capacity})
+            reg = telemetry.registry
+            reg.gauge("spatial.tile_occupancy_max").set(occ_max)
+            reg.gauge("spatial.halo_used_max").set(halo_used)
+            reg.counter("spatial.overflow_fallback").add(overflow)
+            reg.counter("spatial.halo_dropped").add(halo_dropped)
+        t += n
+
+    metrics = SpatialMetrics(*(
+        np.concatenate([c[i] for c in chunks])
+        for i in range(N_STEP_METRICS)))
+    report = SpatialReport(
+        epochs=epochs, overflow_total=overflow_total,
+        halo_dropped_total=halo_dropped_total,
+        occupancy_max=occupancy_max, halo_used_max=halo_used_max)
+    return (x, v), metrics, report
+
+
+def ensemble_adapter(cfg: swarm_scenario.Config, mesh, seeds,
+                     steps: int | None, cbf, initial_state, t0: int,
+                     telemetry=None, spec: SpatialSpec | None = None,
+                     on_overflow: str = "raise"):
+    """``sharded_swarm_rollout(partition="spatial")``'s delegate: one
+    swarm (len(seeds) == 1, dp == 1), ensemble-shaped returns — (x, v)
+    as (1, N, 2) arrays and the first eight metric channels as a
+    (1, steps)-leaved EnsembleMetrics (the spatial extras ride the
+    telemetry sink / SpatialReport surface; callers needing them use
+    :func:`spatial_swarm_rollout` directly)."""
+    if len(seeds) != 1:
+        raise ValueError(
+            f"partition='spatial' decomposes ONE swarm — pass exactly one "
+            f"seed (got {len(seeds)}); Monte-Carlo ensembles use the flat "
+            f"dp partition")
+    if initial_state is not None:
+        x0, v0 = initial_state[0], initial_state[1]
+        if x0.shape != (1, cfg.n, 2):
+            raise ValueError(
+                f"initial_state x0 shape {x0.shape} != {(1, cfg.n, 2)}")
+        initial_state = (x0[0], v0[0])
+    (x, v), m, _report = spatial_swarm_rollout(
+        cfg, mesh, steps=steps, cbf=cbf, initial_state=initial_state,
+        t0=t0, seed=seeds[0], spec=spec, on_overflow=on_overflow,
+        telemetry=telemetry)
+    em = EnsembleMetrics(*(np.asarray(getattr(m, f))[None]
+                           for f in EnsembleMetrics._fields))
+    return (x[None], v[None]), em
+
+
+# ------------------------------------------------------- debug surface ----
+
+def spatial_knn_sets(cfg: swarm_scenario.Config, mesh, x, *,
+                     spec: SpatialSpec | None = None):
+    """The spatial gating's per-agent neighbor sets at positions ``x``
+    (N, 2), as a list of N sets of GLOBAL agent ids — the parity surface
+    tests compare against the dense reference at a tile-boundary
+    crossing. Runs one bin pass + one halo-tiled selection (no dynamics).
+    """
+    _validate_spatial(cfg, mesh)
+    T = mesh.shape["sp"]
+    if spec is None:
+        spec = plan_tiles(cfg, T)
+    x = jnp.asarray(x, cfg.dtype)
+    v = jnp.zeros_like(x)
+    x_slab, _, valid, slot_of_agent, _, _ = _bin_executable(
+        cfg.n, T, spec.capacity)(x, v, jnp.asarray(spec.half, x.dtype))
+    K = min(cfg.k_neighbors, cfg.n - 1)
+    C = spec.capacity
+
+    def local(xs, vs):
+        tile = lax.axis_index("sp")
+        plan = _halo_plan(xs, vs, spec, tile)
+        states4 = jnp.concatenate([xs, jnp.zeros_like(xs)], axis=1)
+        cand_s4, cand_gid, cand_ok = _halo_candidates(states4, vs, plan,
+                                                      spec, tile)
+        row_gid = tile * C + jnp.arange(C, dtype=jnp.int32)
+        idx, mask, _, _ = _blocked_select(
+            xs, row_gid, vs, cand_s4[:, :2], cand_gid, cand_ok, K,
+            cfg.safety_distance, spec.block_rows, by_gid=False)
+        return jnp.where(mask, cand_gid[idx], -1)
+
+    slab2 = P("sp", None)
+    kept = jax.jit(shard_map(
+        local, mesh, in_specs=(slab2, P("sp")),
+        out_specs=slab2, check_rep=False))(x_slab, valid)
+    kept = np.asarray(kept)                              # (T*C, K) slab gids
+    agent_of_slot = np.full((T * C,), -1, np.int64)
+    agent_of_slot[np.asarray(slot_of_agent)] = np.arange(cfg.n)
+    sets = []
+    for a in range(cfg.n):
+        gids = kept[int(slot_of_agent[a])]
+        sets.append({int(agent_of_slot[g]) for g in gids if g >= 0})
+    return sets

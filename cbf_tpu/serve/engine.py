@@ -360,11 +360,14 @@ class ServeEngine:
                  fault_policy: resilience.FaultPolicy | None = None,
                  journal=None, cost_model=None, flight=None,
                  continuous: bool = False, chunk_steps: int = 16,
-                 lane_ledger=None):
+                 backlog_chunks: int = 4, lane_ledger=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if chunk_steps < 1:
             raise ValueError(f"chunk_steps must be >= 1, got {chunk_steps}")
+        if backlog_chunks < 1:
+            raise ValueError(
+                f"backlog_chunks must be >= 1, got {backlog_chunks}")
         self.max_batch = max_batch
         self.flush_deadline_s = flush_deadline_s
         # Continuous batching (queue mode only): advance per-static-
@@ -373,6 +376,14 @@ class ServeEngine:
         # horizon batches. run() always drains (the caller IS the queue).
         self.continuous = continuous
         self.chunk_steps = chunk_steps
+        # Deep-backlog burst: with the foreground queue past the degrade
+        # high watermark, each occupied table advances up to this many
+        # chunks per scheduler pass before joins are re-checked (every
+        # joinable request is already behind a full table there, so the
+        # re-scan buys nothing and per-chunk dispatch overhead is pure
+        # loss). 1 disables bursting; join latency in the normal regime
+        # is unaffected either way.
+        self.backlog_chunks = backlog_chunks
         self.bucket_sizes = tuple(bucket_sizes)
         self.horizon_quantum = horizon_quantum
         self.cache_dir = configure_compilation_cache(cache_dir)
@@ -446,7 +457,7 @@ class ServeEngine:
                       "background_requests": 0, "background_batches": 0,
                       "background_shed": 0, "background_yields": 0,
                       "chunks_executed": 0, "lanes_joined": 0,
-                      "lanes_vacated": 0}
+                      "lanes_vacated": 0, "backlog_extra_chunks": 0}
         self._execs: dict[_buckets.BucketKey, Any] = {}
         # Continuous-mode state: chunk executables and lane tables are
         # keyed by STATIC CONFIG (one chunk program serves every horizon
@@ -1699,6 +1710,7 @@ class ServeEngine:
             bg_joins, bg_expired = [], []
             want_tenant = False
             bg_active = False
+            deep = False
             with self._cond:
                 if not self._running:
                     return
@@ -1708,6 +1720,13 @@ class ServeEngine:
                     transition = self._update_degrade(now)
                     joins, expired = self._pop_joinable(
                         now, self._queue, self._tables)
+                    # Deep backlog: requests STILL queued after the join
+                    # scan (tables full) past the high watermark — the
+                    # regime where multi-chunk bursts pay.
+                    hw = self.fault_policy.degrade_high_watermark
+                    fg_depth = sum(len(v) for v in self._queue.values())
+                    deep = (hw is not None and self.backlog_chunks > 1
+                            and fg_depth > hw)
                     fg_active = bool(joins) or any(
                         t.occupied() for t in self._tables.values())
                     fg_idle = not fg_active \
@@ -1740,7 +1759,9 @@ class ServeEngine:
             advanced = False
             for scfg, table in list(self._tables.items()):
                 if table.occupied():
-                    self._advance_table(table)
+                    self._advance_table(
+                        table,
+                        chunks=self.backlog_chunks if deep else 1)
                     advanced = True
                 if not table.occupied():
                     self._tables.pop(scfg, None)
@@ -1883,14 +1904,39 @@ class ServeEngine:
         self._count("lanes_vacated")
 
     def _advance_table(self, table: _LaneTable, *, background=False,
-                       attempt: int = 0) -> None:
+                       attempt: int = 0, chunks: int = 1) -> None:
+        """Advance one lane table by up to ``chunks`` chunks.
+
+        The scheduler passes ``chunks=1`` in the normal regime — join
+        latency stays one chunk. Under deep backlog (foreground queue
+        depth past the degrade high watermark) it passes
+        ``backlog_chunks``: every joinable request is already queued
+        behind a full table, so re-scanning joins between chunks buys
+        nothing and the per-chunk dispatch overhead (~20% past the
+        knee, Round 16) is pure loss. The burst stops early the moment
+        the table drains or a chunk fails, so no lane is ever held
+        past resolution. Extra chunks run under
+        ``stats["backlog_extra_chunks"]``."""
+        for i in range(max(1, chunks)):
+            ok = self._advance_table_once(
+                table, background=background,
+                attempt=attempt if i == 0 else 0)
+            if i and ok:
+                self._count("backlog_extra_chunks")
+            if not ok or not table.occupied():
+                return
+
+    def _advance_table_once(self, table: _LaneTable, *, background=False,
+                            attempt: int = 0) -> bool:
         """Advance one lane table by ONE chunk. Deadline-expired lanes
         LEAVE first (vacating only zeroes their mask bound — batch-
         mates' device rows are untouched); the chunk executable then
         runs over all lanes (vacant ones frozen); each live lane's
         slice of the chunk lands on host; completed lanes resolve
         immediately and in-flight lanes stream ``serve.partial``.
-        Failure hands off to `_on_chunk_failure`."""
+        Failure hands off to `_on_chunk_failure` and returns False (a
+        retried-then-successful chunk also returns False: after any
+        failure the caller's burst yields back to the scheduler)."""
         tracer = self.tracer
         label = table.label
         now0 = tracer.now()
@@ -1911,7 +1957,7 @@ class ServeEngine:
                 self._vacate(table, slot)
         live = table.live_slots()
         if not live:
-            return
+            return False
         chunk_id = f"c{next(self._batch_ids)}"
         # Lane-ledger chunk window: integer nanoseconds on the same
         # monotonic clock family as the tracer, opened here (first
@@ -1951,7 +1997,7 @@ class ServeEngine:
         except BaseException as e:   # noqa: BLE001 — ladder classifies
             self._on_chunk_failure(table, attempt, e,
                                    background=background)
-            return
+            return False
         if led is not None:
             u0 = time.perf_counter_ns()
         with tracer.span("unpack", trace_id=chunk_id, bucket=label):
@@ -2035,6 +2081,7 @@ class ServeEngine:
                 tracer.record("chunk", t0_s=t_chunk0, dur_s=dur_s,
                               trace_id=request_id, bucket=label,
                               track=f"{label}/lane{slot}")
+        return True
 
     def _resolve_lane(self, table: _LaneTable, slot: int, final_states,
                       fill: int, now: float) -> None:

@@ -33,6 +33,12 @@ rps)`` plus its ``extra_axes`` companions — occupancy at the past-knee
 rate and ``serve dispatch efficiency`` (100 - dispatch-overhead %, so
 higher stays better) at both rates; ``collect_series`` flattens
 ``extra_axes`` records into first-class axes.
+PR 19's BENCH_MEGA record (spatially-tiled mega-swarm, N=131072 over 8
+tiles) rides the MULTICHIP_r*.json round family instead of BENCH_r*:
+``discover_multichip_rounds`` enrolls it with the same effective-
+measurement rules, so a wedged mega round still resolves through its
+``last_verified`` stanza and a rate slide past tolerance fails the
+audit like any other axis.
 The comparison and parsing logic is pure and
 unit-tested fast; the repo-level audit runs as a slow-tier test
 (tests/test_obs_resource.py) and ``--write-trajectory`` refreshes
@@ -66,6 +72,7 @@ TOLERANCE = 0.15
 TRAJECTORY_PATH = os.path.join("docs", "BENCH_TRAJECTORY.json")
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+_MULTICHIP_RE = re.compile(r"MULTICHIP_r(\d+)\.json$")
 
 
 def discover_rounds(repo: str = _REPO) -> list[tuple[int, str]]:
@@ -73,6 +80,21 @@ def discover_rounds(repo: str = _REPO) -> list[tuple[int, str]]:
     out = []
     for path in glob.glob(os.path.join(repo, "BENCH_r*.json")):
         m = _ROUND_RE.search(os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def discover_multichip_rounds(repo: str = _REPO) -> list[tuple[int, str]]:
+    """Sorted rounds of the MULTICHIP trajectory family — the
+    BENCH_MEGA spatially-tiled axis lands here (PR 19). Early rounds
+    (r01-r05) are bare smoke verdicts with no ``parsed`` block;
+    ``collect_series`` skips them, so the axis enrolls from the first
+    mega round onward with the same wedged-round ``last_verified``
+    fallback as every BENCH axis."""
+    out = []
+    for path in glob.glob(os.path.join(repo, "MULTICHIP_r*.json")):
+        m = _MULTICHIP_RE.search(os.path.basename(path))
         if m:
             out.append((int(m.group(1)), path))
     return sorted(out)
@@ -184,6 +206,12 @@ def main() -> int:
     args = p.parse_args()
     rounds = discover_rounds()
     series = collect_series(rounds)
+    # The MULTICHIP family is a separate round sequence (its round
+    # numbers count MULTICHIP runs); its axes (mega N=... tiles=...)
+    # never collide with a BENCH axis, so merging the per-axis series
+    # keeps every axis's round numbering internally consistent.
+    for axis, entries in collect_series(discover_multichip_rounds()).items():
+        series.setdefault(axis, []).extend(entries)
     if args.write_trajectory:
         write_trajectory(series)
     verdict = compare(series, args.tolerance)

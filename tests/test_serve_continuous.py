@@ -313,6 +313,57 @@ def test_fault_policy_validates_bytes_budget():
     assert FaultPolicy(queue_bytes_budget=None).queue_bytes_budget is None
 
 
+# ------------------------------------------------- deep-backlog bursting --
+
+def _backlog_engine(backlog_chunks):
+    # The watermark classifies the foreground queue as deep (depth > 2)
+    # but the huge sustain keeps `_degraded` from ever flipping — exactly
+    # the BENCH_SLO_SWEEP backlog-leg configuration, so horizons are
+    # never cut and every result is full-length.
+    return ServeEngine(
+        max_batch=2, bucket_sizes=(16,), continuous=True, chunk_steps=4,
+        backlog_chunks=backlog_chunks,
+        fault_policy=FaultPolicy(degrade_high_watermark=2,
+                                 degrade_sustain_s=1e9))
+
+
+def test_deep_backlog_bursts_extra_chunks():
+    """Under a queue deeper than the watermark, the scheduler advances a
+    live table multiple chunks per scan (counted in
+    ``backlog_extra_chunks``) without shortening any request."""
+    engine = _backlog_engine(backlog_chunks=4)
+    engine.prewarm([_cfg(steps=16)])
+    engine.start()
+    try:
+        pending = [engine.submit(_cfg(steps=16, seed=s))
+                   for s in range(10)]
+        for p in pending:
+            res = p.result(timeout=300)
+            assert res.steps == 16          # full horizon — no degrade cut
+        assert engine.stats["backlog_extra_chunks"] > 0
+    finally:
+        engine.stop()
+
+
+def test_backlog_chunks_one_never_bursts():
+    engine = _backlog_engine(backlog_chunks=1)
+    engine.prewarm([_cfg(steps=16)])
+    engine.start()
+    try:
+        pending = [engine.submit(_cfg(steps=16, seed=s))
+                   for s in range(6)]
+        for p in pending:
+            assert p.result(timeout=300).steps == 16
+        assert engine.stats["backlog_extra_chunks"] == 0
+    finally:
+        engine.stop()
+
+
+def test_backlog_chunks_validated():
+    with pytest.raises(ValueError):
+        ServeEngine(continuous=True, backlog_chunks=0)
+
+
 # -------------------------------------------------------------- CLI/docs --
 
 def test_loadgen_cli_sweep(capsys):

@@ -1,0 +1,229 @@
+"""Spatial decomposition (cbf_tpu.parallel.spatial, PR 19) pins.
+
+The load-bearing pins:
+
+- PARITY: ``partition="spatial"`` over 4 tiles matches the flat 1-device
+  rollout at N in {256, 1024} to a PINNED atol (1e-5; measured diffs are
+  ~2e-7 — pure f32 summation-order noise from the blocked/halo'd
+  reductions). Certificate-on parity pins the sharded joint solve too.
+- BOUNDARY CROSSING: an agent that crosses a tile boundary mid-rollout
+  keeps a kNN set IDENTICAL to the dense all-pairs reference at the
+  crossing step — the halo band provably covers the interaction radius,
+  so re-binning can never change which neighbors an agent sees.
+- OVERFLOW HONESTY: tile/halo capacity saturation raises a typed
+  :class:`SpatialOverflowError` under the default ``on_overflow="raise"``
+  and degrades to a COUNTED fallback under ``"fallback"`` — every agent
+  keeps a slot, nothing is silently dropped.
+- DOCS LOCKSTEP: docs/API.md 'Spatial sharding' names the public surface.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import jax  # noqa: E402
+
+from cbf_tpu.parallel import spatial  # noqa: E402
+from cbf_tpu.parallel.ensemble import sharded_swarm_rollout  # noqa: E402
+from cbf_tpu.parallel.mesh import make_mesh  # noqa: E402
+from cbf_tpu.parallel.spatial import (SpatialOverflowError,  # noqa: E402
+                                      plan_tiles, spatial_swarm_rollout)
+from cbf_tpu.scenarios import swarm  # noqa: E402
+
+
+def _tile_mesh(tiles):
+    return make_mesh(n_dp=1, n_sp=tiles, devices=jax.devices()[:tiles])
+
+
+def _flat_mesh():
+    return make_mesh(n_dp=1, n_sp=1, devices=jax.devices()[:1])
+
+
+def _spawn(cfg):
+    x = swarm.clear_obstacle_spawn(
+        cfg, swarm.spawn_positions(cfg, jax.random.PRNGKey(cfg.seed)))
+    return np.asarray(x)
+
+
+def _dense_knn_sets(cfg, x):
+    """All-pairs reference for the gating rule: eligible iff
+    0 < dist < safety_distance, keep the k_neighbors nearest."""
+    x = np.asarray(x, np.float32)
+    d = np.linalg.norm(x[:, None, :] - x[None, :, :], axis=-1)
+    k = min(cfg.k_neighbors, cfg.n - 1)
+    sets = []
+    for a in range(cfg.n):
+        elig = np.where((d[a] < cfg.safety_distance) & (d[a] > 0))[0]
+        order = elig[np.argsort(d[a][elig], kind="stable")]
+        sets.append(set(int(i) for i in order[:k]))
+    return sets
+
+
+# ------------------------------------------------------------- parity ----
+
+@pytest.mark.parametrize("n", [256, 1024])
+def test_spatial_parity_vs_flat(n):
+    """Tiled rollout == flat rollout at pinned atol — the decomposition
+    is a performance transform, not an approximation."""
+    cfg = swarm.Config(n=n, steps=4, k_neighbors=4)
+    (xr, vr), mr = sharded_swarm_rollout(cfg, _flat_mesh(), [0])
+    (xs, vs), ms = sharded_swarm_rollout(cfg, _tile_mesh(4), [0],
+                                         partition="spatial")
+    assert xs.shape == xr.shape == (1, n, 2)
+    np.testing.assert_allclose(np.asarray(xs), np.asarray(xr), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(vs), np.asarray(vr), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ms.nearest_distance),
+                               np.asarray(mr.nearest_distance), atol=1e-5)
+    assert np.array_equal(np.asarray(ms.engaged_count),
+                          np.asarray(mr.engaged_count))
+
+
+def test_spatial_parity_with_certificate():
+    """The slab-ordered sharded ADMM certificate matches the flat joint
+    solve — same residual trajectory, same states, at the same atol."""
+    cfg = swarm.Config(n=256, steps=3, k_neighbors=4, certificate=True,
+                      certificate_backend="sparse", certificate_iters=4,
+                      certificate_cg_iters=4)
+    (xr, _), mr = sharded_swarm_rollout(cfg, _flat_mesh(), [0])
+    (xs, _), ms = sharded_swarm_rollout(cfg, _tile_mesh(4), [0],
+                                        partition="spatial")
+    np.testing.assert_allclose(np.asarray(xs), np.asarray(xr), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ms.certificate_residual),
+                               np.asarray(mr.certificate_residual),
+                               atol=1e-5)
+
+
+# -------------------------------------------------- boundary crossing ----
+
+def test_boundary_crossing_keeps_knn_identical():
+    """Find a real mid-rollout tile crossing, then pin that the spatial
+    neighbor sets at the crossing step equal the dense reference for
+    EVERY agent — especially the ones that just changed tiles."""
+    tiles = 4
+    cfg = swarm.Config(n=64, steps=1, k_neighbors=4)
+    spec = plan_tiles(cfg, tiles, rebin_every=1)
+    mesh = _tile_mesh(tiles)
+    width = 2.0 * spec.half / tiles
+
+    def tile_of(x):
+        return np.clip(np.floor((x[:, 0] + spec.half) / width),
+                       0, tiles - 1).astype(int)
+
+    x = _spawn(cfg)
+    v = np.zeros_like(x)
+    crossed = None
+    for k in range(40):
+        before = tile_of(x)
+        (xn, vn), _, rep = spatial_swarm_rollout(
+            cfg, mesh, steps=1, initial_state=(x, v), t0=k, spec=spec)
+        assert rep.overflow_total == 0 and rep.halo_dropped_total == 0
+        x, v = np.asarray(xn), np.asarray(vn)
+        moved = np.where(tile_of(x) != before)[0]
+        if moved.size:
+            crossed = (k, moved, x.copy())
+            break
+    assert crossed is not None, \
+        "no agent crossed a tile boundary in 40 steps — test is vacuous"
+    _, moved, x_k = crossed
+
+    sets = spatial.spatial_knn_sets(cfg, mesh, x_k, spec=spec)
+    ref = _dense_knn_sets(cfg, x_k)
+    assert sets == ref, (
+        f"kNN sets diverged from the dense reference at the crossing "
+        f"step (crossing agents: {moved.tolist()})")
+
+
+# ------------------------------------------------------------ overflow ----
+
+def _packed_cfg():
+    # Spawn box (|x| <= 0.5) astride the tile-1|tile-2 face of an 8 m
+    # arena cut into 4 strips: every agent lands in the two middle tiles,
+    # so a hand-shrunk capacity saturates deterministically.
+    return swarm.Config(n=32, steps=2, k_neighbors=4,
+                        spawn_half_width_override=0.5,
+                        arena_half_override=8.0)
+
+
+def test_overflow_raises_typed():
+    cfg = _packed_cfg()
+    spec = plan_tiles(cfg, 4, rebin_every=1)._replace(
+        capacity=8, block_rows=8, halo_capacity=8)
+    with pytest.raises(SpatialOverflowError, match="tile capacity"):
+        spatial_swarm_rollout(cfg, _tile_mesh(4), spec=spec)
+
+
+def test_overflow_fallback_counts_and_keeps_every_agent():
+    cfg = _packed_cfg()
+    spec = plan_tiles(cfg, 4, rebin_every=1)._replace(
+        capacity=8, block_rows=8, halo_capacity=8)
+    (x, v), _, report = spatial_swarm_rollout(
+        cfg, _tile_mesh(4), spec=spec, on_overflow="fallback")
+    assert report.overflow_total > 0          # counted, never silent
+    x = np.asarray(x)
+    assert x.shape == (cfg.n, 2)
+    assert np.all(np.isfinite(x))
+    # Every agent was integrated from a REAL slot, not left parked.
+    assert np.all(np.abs(x) < spatial.PARK / 2)
+
+
+def test_halo_saturation_raises_and_counts():
+    """The packed spawn puts ~half the swarm within the band of the
+    middle face — an 8-slot halo must saturate, typed under "raise",
+    counted under "fallback"."""
+    cfg = _packed_cfg()
+    spec = plan_tiles(cfg, 4, rebin_every=1)._replace(halo_capacity=8)
+    with pytest.raises(SpatialOverflowError, match="halo"):
+        spatial_swarm_rollout(cfg, _tile_mesh(4), spec=spec)
+    _, _, report = spatial_swarm_rollout(
+        cfg, _tile_mesh(4), spec=spec, on_overflow="fallback")
+    assert report.halo_dropped_total > 0
+
+
+# -------------------------------------------------- contract rejections ----
+
+def test_plan_tiles_rejects_thin_strips():
+    cfg = swarm.Config(n=256, steps=2)
+    with pytest.raises(ValueError, match="halo band"):
+        plan_tiles(cfg, 64)
+
+
+def test_rollout_rejects_unknown_overflow_policy():
+    cfg = swarm.Config(n=32, steps=2)
+    with pytest.raises(ValueError, match="on_overflow"):
+        spatial_swarm_rollout(cfg, _tile_mesh(2), on_overflow="ignore")
+
+
+def test_spatial_partition_rejects_ensembles():
+    cfg = swarm.Config(n=32, steps=2)
+    with pytest.raises(ValueError, match="exactly one"):
+        sharded_swarm_rollout(cfg, _tile_mesh(2), [0, 1],
+                              partition="spatial")
+
+
+def test_spatial_partition_rejects_dp_meshes():
+    cfg = swarm.Config(n=32, steps=2)
+    mesh = make_mesh(n_dp=2, n_sp=2, devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="n_dp=1"):
+        sharded_swarm_rollout(cfg, mesh, [0], partition="spatial")
+
+
+# ---------------------------------------------------------- docs needle ----
+
+def test_docs_api_spatial_section():
+    """docs/API.md 'Spatial sharding' stays in lockstep with the code —
+    the section and its load-bearing needles must survive edits."""
+    with open(os.path.join(ROOT, "docs", "API.md")) as fh:
+        text = fh.read()
+    assert "## Spatial sharding" in text
+    for needle in ("plan_tiles", "SpatialOverflowError",
+                   "spatial_swarm_rollout", 'partition="spatial"',
+                   "--partition spatial", "--tiles", "rebin_every",
+                   "halo_capacity", "overflow_total",
+                   "spatial.overflow_fallback", "collective_permute"):
+        assert needle in text, \
+            f"docs/API.md Spatial sharding: missing {needle!r}"
