@@ -215,6 +215,23 @@ Modes / env knobs:
     BENCH_FAILOVER_KILL_TMIN (0.5) / _TMAX (2.5),
     BENCH_FAILOVER_MTTR_BOUND (5 s). Subprocesses run on CPU (the
     axis is availability, not rate).
+  BENCH_CLUSTER=1 — routed multi-engine cluster mode (cbf_tpu.cluster):
+    capacity-knee sweeps through the router at M=1 and M=BENCH_CLUSTER_M
+    engines (fresh roots, one shared CBF_TPU_CACHE_DIR — the value is
+    the M-engine knee, vs_baseline the M-over-1 scaling ratio), then a
+    chaos phase: BENCH_CLUSTER_KILLS seeded SIGKILLs on live engine
+    processes under a paced stream (membership failover + journal
+    replay + respawn, every MTTR <= BENCH_CLUSTER_MTTR_BOUND) and one
+    FULL rolling restart under a second stream. Terminal gates: the
+    cluster-wide journal census shows zero lost acknowledged requests
+    and zero duplicate executions, and the armed lock witness saw no
+    inversions. Knobs: BENCH_CLUSTER_M (4), BENCH_CLUSTER_GRID
+    ("2:8:2"), BENCH_CLUSTER_P99 (1.0), BENCH_CLUSTER_DURATION (5),
+    BENCH_CLUSTER_KILLS (2), BENCH_CLUSTER_REQUESTS (24),
+    BENCH_CLUSTER_PACE_S (0.25), BENCH_CLUSTER_TTL_S (1.0),
+    BENCH_CLUSTER_KILL_TMIN (1.0) / _TMAX (4.0),
+    BENCH_CLUSTER_MTTR_BOUND (5 s), BENCH_CLUSTER_SEED (0).
+    Subprocesses run on CPU (the axis is cluster semantics, not rate).
   BENCH_ENSEMBLE=1 (or --ensemble) — dp-sharded ensemble of independent
     swarms over all available devices (the multi-chip measurement path for
     the v4-8 ladder rung); adds "chips" + "scaling_efficiency" fields.
@@ -379,6 +396,29 @@ def _env_float(name: str, default: float) -> float:
 
 def _env_int(name: str, default: int) -> int:
     return int(os.environ.get(name, default))
+
+
+def _host_block() -> dict:
+    """Host-pressure honesty stamp captured at leg start. A latency knee
+    (or a chaos MTTR) measured on an already-loaded shared host says
+    nothing about the code — ``degraded_host`` flags 1-minute load per
+    core above BENCH_HOST_LOAD_THRESHOLD (1.5), and AUD006
+    (scripts/bench_regression.py) treats a flagged measured record as
+    unverified for knee-regression verdicts instead of flaking on it."""
+    try:
+        load1, load5, _ = os.getloadavg()
+    except OSError:
+        load1 = load5 = 0.0
+    cpus = os.cpu_count() or 1
+    per_core = load1 / cpus
+    return {
+        "loadavg_1m": round(load1, 3),
+        "loadavg_5m": round(load5, 3),
+        "cpus": cpus,
+        "load_per_core": round(per_core, 3),
+        "degraded_host": per_core > _env_float(
+            "BENCH_HOST_LOAD_THRESHOLD", 1.5),
+    }
 
 
 # ----------------------------------------------------------------- child --
@@ -1459,6 +1499,7 @@ def _child_slo_sweep(steps: int) -> dict:
     chunk = _env_int("BENCH_SLO_CHUNK", 16)
 
     grid = parse_sweep(grid_arg)
+    host = _host_block()   # stamped at leg start: pre-existing pressure
     spec = LoadSpec(rps=grid[0], duration_s=duration, seed=seed,
                     n_min=n_min, n_max=n_max, pareto_alpha=alpha)
     # Same seed and spec shape for both modes: each leg replays the
@@ -1575,6 +1616,7 @@ def _child_slo_sweep(steps: int) -> dict:
         "sweep_continuous": sweeps["continuous"],
         "backlog": backlog,
         "lanes_continuous": lanes_continuous,
+        "host": host,
         "platform": jax.devices()[0].platform,
     }
 
@@ -2935,6 +2977,322 @@ def _child_failover(steps: int) -> dict:
     }
 
 
+def _child_cluster(steps: int) -> dict:
+    """BENCH_CLUSTER mode: routed multi-engine cluster harness
+    (cbf_tpu.cluster). Three phases, all on CPU (the axis is cluster
+    semantics and the M-scaling knee, not device rate):
+
+    1. Capacity knees THROUGH the router: the same seeded loadgen knee
+       sweep (serve.loadgen.sweep_rps) against an M=1 cluster and an
+       M=BENCH_CLUSTER_M cluster — fresh roots, one SHARED
+       CBF_TPU_CACHE_DIR so every boot after the first is a warm
+       start. The record's value is the M-engine knee; vs_baseline is
+       knee(M)/knee(1) — the AUD006-enrolled scaling axis.
+    2. Chaos: a paced request stream through an M-engine cluster with
+       work stealing armed while BENCH_CLUSTER_KILLS seeded SIGKILLs
+       land on live engine processes. The membership plane must detect
+       each death (lease TTL), fail the victim's journal over onto
+       survivors (request-id dedupe), and respawn it — every failover
+       MTTR <= BENCH_CLUSTER_MTTR_BOUND, zero request errors.
+    3. One FULL rolling restart (every engine drained, restarted,
+       re-enrolled) while a second paced stream keeps arriving.
+
+    Terminal gate: the cluster-wide journal census
+    (cluster.membership.cluster_census over every active + archived
+    WAL) shows ZERO lost acknowledged requests and ZERO duplicate
+    executions, and the armed lock witness saw no inversions and no
+    acquisition edge outside the static lock-order graph. Knobs:
+    BENCH_CLUSTER_M (4), BENCH_CLUSTER_GRID ("2:8:2"),
+    BENCH_CLUSTER_P99 (1.0 s), BENCH_CLUSTER_DURATION (5 s),
+    BENCH_CLUSTER_KILLS (2), BENCH_CLUSTER_REQUESTS (24),
+    BENCH_CLUSTER_PACE_S (0.25), BENCH_CLUSTER_TTL_S (1.0),
+    BENCH_CLUSTER_KILL_TMIN (1.0) / _TMAX (4.0),
+    BENCH_CLUSTER_MTTR_BOUND (5 s), plus the BENCH_SLO_NMIN/NMAX/ALPHA
+    traffic-shape knobs."""
+    import dataclasses
+    import shutil
+    import signal as _signal
+    import subprocess
+    import tempfile as _tempfile
+    import threading as _threading
+    import time as _time
+
+    from cbf_tpu.analysis import concurrency, lockwitness
+    from cbf_tpu.cluster import (ClusterRouter, Membership,
+                                 cluster_census)
+    from cbf_tpu.cluster import transport as ctransport
+    from cbf_tpu.durable.rollout import config_to_json
+    from cbf_tpu.scenarios import swarm
+    from cbf_tpu.serve import LoadSpec, build_schedule, parse_sweep, \
+        sweep_rps
+    from cbf_tpu.utils import faults
+
+    m_hi = _env_int("BENCH_CLUSTER_M", 4)
+    grid_arg = os.environ.get("BENCH_CLUSTER_GRID", "2:8:2")
+    slo_p99 = _env_float("BENCH_CLUSTER_P99", 1.0)
+    duration = _env_float("BENCH_CLUSTER_DURATION", 5.0)
+    kills = _env_int("BENCH_CLUSTER_KILLS", 2)
+    requests = _env_int("BENCH_CLUSTER_REQUESTS", 24)
+    pace_s = _env_float("BENCH_CLUSTER_PACE_S", 0.25)
+    ttl_s = _env_float("BENCH_CLUSTER_TTL_S", 1.0)
+    t_min = _env_float("BENCH_CLUSTER_KILL_TMIN", 1.0)
+    t_max = _env_float("BENCH_CLUSTER_KILL_TMAX", 4.0)
+    mttr_bound = _env_float("BENCH_CLUSTER_MTTR_BOUND", 5.0)
+    seed = _env_int("BENCH_CLUSTER_SEED", 0)
+    n_min = _env_int("BENCH_SLO_NMIN", 8)
+    n_max = _env_int("BENCH_SLO_NMAX", 32)
+    alpha = _env_float("BENCH_SLO_ALPHA", 1.3)
+
+    host = _host_block()   # stamped at leg start: pre-existing pressure
+    grid = parse_sweep(grid_arg)
+    spec = LoadSpec(rps=grid[0], duration_s=duration, seed=seed,
+                    n_min=n_min, n_max=n_max, pareto_alpha=alpha)
+    sweep_cfgs = [cfg for _, cfg in build_schedule(
+        dataclasses.replace(spec, rps=grid[-1]))]
+    chaos_cfg = swarm.Config(n=8, steps=6, seed=1, gating="jnp")
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    work = _tempfile.mkdtemp(prefix="bench_cluster_")
+    # One shared compilation cache across every phase and every engine:
+    # after the M=1 sweep compiles the bucket set, each of the M-engine
+    # boots (and every chaos respawn) is a deserialization warm start.
+    env["CBF_TPU_CACHE_DIR"] = os.path.join(work, "cache")
+
+    # Armed lock-order witness across the whole leg: every router/
+    # membership/ring lock is wrapped from construction, so the chaos
+    # phases double as a runtime lock-order check.
+    lockwitness.arm()
+    lockwitness.reset()
+
+    def boot(tag, names, prewarm_cfgs, **router_kw):
+        """Spawn worker processes under a fresh root, wait for every
+        ready file, return (root, router, procs, spawn)."""
+        root = os.path.join(work, tag)
+        os.makedirs(root, exist_ok=True)
+        ctransport.write_json_atomic(
+            os.path.join(root, "prewarm.json"),
+            [config_to_json(c) for c in prewarm_cfgs])
+        procs = {}
+
+        def spawn(name):
+            procs[name] = subprocess.Popen(
+                [sys.executable, "-m", "cbf_tpu", "cluster", "worker",
+                 "--root", root, "--name", name, "--platform", "cpu",
+                 "--heartbeat-s", "0.1"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL)
+
+        for name in names:
+            spawn(name)
+        for name in names:
+            dirs = ctransport.EngineDirs(root, name)
+            if not faults.wait_for_file(dirs.ready, 180):
+                for pr in procs.values():
+                    pr.kill()
+                raise RuntimeError(f"{tag}: engine {name} never ready")
+        router = ClusterRouter(root, names, **router_kw)
+        return root, router, procs, spawn
+
+    def shutdown(router, procs):
+        router.stop(drain=True)
+        for pr in procs.values():
+            pr.terminate()
+        for pr in procs.values():
+            try:
+                pr.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                pr.kill()
+
+    # ---- phase 1: M=1 vs M=m_hi capacity knees through the router ----
+    knees, sweeps, roots = {}, {}, []
+    for m in (1, m_hi):
+        names = [f"e{i}" for i in range(m)]
+        root, router, procs, _ = boot(f"sweep_m{m}", names, sweep_cfgs)
+        roots.append(root)
+        print(f"bench: cluster sweep M={m} grid={grid_arg} "
+              f"p99<={slo_p99}s", file=sys.stderr)
+        sweep = sweep_rps(router, spec, grid, slo_p99_s=slo_p99)
+        shutdown(router, procs)
+        for leg in sweep["legs"]:
+            if leg["errors"]:
+                return {"error": f"cluster sweep M={m} rps={leg['rps']}:"
+                                 f" {leg['errors']} requests failed",
+                        "retryable": False}
+        knees[m], sweeps[m] = sweep["knee_rps"], sweep
+        print(f"bench: cluster sweep M={m} knee={sweep['knee_rps']} rps "
+              f"censored={sweep['knee_censored']}", file=sys.stderr)
+
+    # ---- phase 2 + 3: chaos kills, then a rolling restart, one root --
+    names = [f"e{i}" for i in range(m_hi)]
+    root, router, procs, spawn = boot(
+        "chaos", names, [chaos_cfg], steal=True, steal_threshold=4)
+    roots.append(root)
+    router.start()
+    membership = Membership(router, ttl_s=ttl_s, respawn=spawn).start()
+
+    def paced_stream(prefix, kill_offsets=None):
+        """Submit ``requests`` paced configs; SIGKILL a live engine at
+        each offset (seconds after stream start). Returns pendings."""
+        offsets = sorted(kill_offsets or [])
+        ki, killed = 0, []
+        pend, t0 = [], _time.monotonic()
+        for i in range(requests):
+            while _time.monotonic() - t0 < i * pace_s:
+                _time.sleep(0.01)
+            elapsed = _time.monotonic() - t0
+            if ki < len(offsets) and elapsed >= offsets[ki]:
+                live = router.ring.engines()
+                victim = live[ki % len(live)] if live else None
+                if victim is not None:
+                    rec = ctransport.read_json(
+                        ctransport.EngineDirs(root, victim).pid)
+                    if rec and rec.get("pid"):
+                        try:
+                            os.kill(int(rec["pid"]), _signal.SIGKILL)
+                            killed.append(victim)
+                            print(f"bench: cluster SIGKILL {victim} at "
+                                  f"+{elapsed:.1f}s", file=sys.stderr)
+                        except ProcessLookupError:
+                            pass
+                ki += 1
+            pend.append(router.submit(
+                chaos_cfg, request_id=f"{prefix}{i}"))
+        return pend, killed
+
+    try:
+        offsets = faults.kill_schedule(seed, kills, t_min, t_max)
+        pend, killed = paced_stream("k", offsets)
+        errors = 0
+        for p in pend:
+            try:
+                p.result(timeout=240)
+            except Exception as e:
+                errors += 1
+                print(f"bench: cluster chaos error {type(e).__name__}: "
+                      f"{e}", file=sys.stderr)
+        if errors or len(killed) != kills:
+            return {"error": f"cluster chaos: {errors} request errors, "
+                             f"{len(killed)}/{kills} kills landed",
+                    "retryable": False}
+        # Heal gate: every killed engine respawned and re-enrolled
+        # before the rolling restart begins.
+        t0 = _time.monotonic()
+        while len(router.ring) < m_hi and _time.monotonic() - t0 < 120:
+            _time.sleep(0.05)
+        if len(router.ring) < m_hi:
+            return {"error": "cluster chaos: membership never healed to "
+                             f"M={m_hi} after the kills",
+                    "retryable": False}
+        mttrs = list(membership.mttr_s)
+        if len(mttrs) != kills or max(mttrs) > mttr_bound:
+            return {"error": f"cluster chaos: failover MTTRs {mttrs} "
+                             f"(need {kills} kills all <= "
+                             f"{mttr_bound:.0f}s)", "retryable": False}
+
+        # Rolling restart UNDER TRAFFIC: restart every engine while the
+        # second paced stream arrives.
+        roll_box = {}
+
+        def _roll():
+            try:
+                roll_box["reports"] = membership.rolling_restart()
+            except Exception as e:
+                roll_box["error"] = f"{type(e).__name__}: {e}"
+
+        roller = _threading.Thread(target=_roll, name="bench-roll")
+        roller.start()
+        pend, _ = paced_stream("r")
+        roller.join(timeout=300)
+        errors = sum(1 for p in pend
+                     if not _result_ok(p, timeout=240))
+        if roll_box.get("error") or roller.is_alive():
+            return {"error": f"cluster roll failed: "
+                             f"{roll_box.get('error', 'timed out')}",
+                    "retryable": False}
+        if errors:
+            return {"error": f"cluster roll: {errors} request errors "
+                             "during the rolling restart",
+                    "retryable": False}
+    finally:
+        # Membership FIRST: a live monitor would respawn the workers the
+        # shutdown is killing.
+        membership.stop()
+        shutdown(router, procs)
+
+    # ---- terminal gates: census + lock witness --------------------------
+    censuses = {r: cluster_census(r) for r in roots}
+    bad = {r: c for r, c in censuses.items() if not c["ok"]}
+    if bad:
+        return {"error": f"cluster census: lost/duplicate acknowledged "
+                         f"requests: {bad}", "retryable": False}
+    lockwitness.disarm()
+    witness_snap = lockwitness.snapshot()
+    witness_inversions = lockwitness.inversions()
+    static_edges = concurrency.static_edge_set(concurrency.analyze_paths(
+        [os.path.join(repo, "cbf_tpu")], repo_root=repo))
+    unexplained = lockwitness.check_subgraph(static_edges)
+    if witness_inversions or unexplained:
+        return {"error": f"cluster lock witness: inversions="
+                         f"{witness_inversions} unexplained={unexplained}",
+                "retryable": False}
+
+    total = {"submitted": sum(c["submitted"] for c in censuses.values()),
+             "resolved": sum(c["resolved"] for c in censuses.values())}
+    print(f"bench: cluster knees M=1:{knees[1]} M={m_hi}:{knees[m_hi]} "
+          f"rps, {kills} kills mttr={[round(m, 3) for m in mttrs]}, "
+          f"roll={len(roll_box['reports'])} engines, census "
+          f"{total['resolved']}/{total['submitted']}", file=sys.stderr)
+    shutil.rmtree(work, ignore_errors=True)
+    return {
+        "metric": (f"cluster capacity knee M={m_hi} vs M=1 through the "
+                   f"router (p99<={slo_p99}s, grid {grid_arg}, "
+                   f"{kills} SIGKILLs + 1 rolling restart, zero lost "
+                   "acks, zero duplicate executions)"),
+        "value": knees[m_hi],
+        "unit": "requests_per_sec",
+        "vs_baseline": round(knees[m_hi] / max(knees[1], 1e-9), 4),
+        "cluster": True,
+        "engines": m_hi,
+        "grid": grid_arg,
+        "slo_p99_s": slo_p99,
+        "knee_rps_m1": knees[1],
+        "knee_rps_m": knees[m_hi],
+        "knee_censored_m1": sweeps[1]["knee_censored"],
+        "knee_censored_m": sweeps[m_hi]["knee_censored"],
+        "sweep_m1": sweeps[1],
+        "sweep_m": sweeps[m_hi],
+        "kills": kills,
+        "killed": killed,
+        "mttr_s": [round(m, 4) for m in mttrs],
+        "mttr_bound_s": mttr_bound,
+        "stolen": router.stolen,
+        "roll": roll_box.get("reports"),
+        "census": {"submitted": total["submitted"],
+                   "resolved": total["resolved"],
+                   "lost": 0, "duplicate_executions": 0},
+        "lock_witness": {
+            "acquisitions": witness_snap["acquisitions"],
+            "edges": len(witness_snap["edges"]),
+            "inversions": len(witness_inversions),
+        },
+        "host": host,
+        "platform": "cpu",
+    }
+
+
+def _result_ok(pending, timeout: float) -> bool:
+    try:
+        pending.result(timeout=timeout)
+        return True
+    except Exception as e:
+        print(f"bench: cluster roll error {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return False
+
+
 def _is_permanent_error(e: BaseException) -> bool:
     """Transient device/tunnel deaths raise (XlaRuntimeError: connection
     reset / DEADLINE_EXCEEDED / UNAVAILABLE) rather than hang — those must
@@ -2982,6 +3340,8 @@ def child_main(result_path: str, ensemble: bool) -> None:
     try:
         if os.environ.get("BENCH_FAILOVER", "0") == "1":
             result = _child_failover(steps)
+        elif os.environ.get("BENCH_CLUSTER", "0") == "1":
+            result = _child_cluster(steps)
         elif os.environ.get("BENCH_PREEMPT", "0") == "1":
             result = _child_preempt(steps)
         elif os.environ.get("BENCH_SCEN", "0") == "1":
@@ -3112,6 +3472,10 @@ def main() -> None:
 
     if os.environ.get("BENCH_FAILOVER", "0") == "1":
         label = "failover rounds=%d" % _env_int("BENCH_FAILOVER_ROUNDS", 3)
+    elif os.environ.get("BENCH_CLUSTER", "0") == "1":
+        label = "cluster M=%d kills=%d" % (_env_int("BENCH_CLUSTER_M", 4),
+                                           _env_int("BENCH_CLUSTER_KILLS",
+                                                    2))
     elif os.environ.get("BENCH_PREEMPT", "0") == "1":
         label = "preempt rounds=%d" % _env_int("BENCH_PREEMPT_ROUNDS", 3)
     elif os.environ.get("BENCH_SCEN", "0") == "1":

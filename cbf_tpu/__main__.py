@@ -420,17 +420,94 @@ def _render_top(doc: dict) -> str:
     return "\n".join(lines)
 
 
+def _obs_top_merge(args) -> int:
+    """``obs top --merge DIR... / --glob PATTERN``: fold several
+    engines' metrics.json surfaces into ONE table through
+    `MetricsRegistry.merge` (counters and histograms add, gauges
+    min/max-merge — the same reduction multi-host runs use). The stall
+    contract stays per-dir: each dir's metrics.json age is judged
+    against --stall-timeout independently, and any stalled dir emits
+    its own alert and exits 3 — a merged table must never average away
+    one dead engine."""
+    import glob as _glob
+    import time as _time
+
+    from cbf_tpu.obs import export as obs_export
+    from cbf_tpu.obs.sink import MetricsRegistry
+
+    dirs = list(args.merge or [])
+    if args.glob:
+        dirs.extend(sorted(d for d in _glob.glob(args.glob)
+                           if os.path.isdir(d)))
+    dirs = list(dict.fromkeys(dirs))      # dedupe, keep order
+    if not dirs:
+        print("obs top: --merge/--glob matched no directories",
+              file=sys.stderr)
+        return 2
+    t_start = _time.time()
+    while True:
+        reg = MetricsRegistry()
+        ages, missing, stalled = {}, [], []
+        for d in dirs:
+            path = os.path.join(d, obs_export.JSON_FILENAME)
+            if not os.path.isfile(path):
+                missing.append(d)
+                if args.stall_timeout is not None and \
+                        _time.time() - t_start > args.stall_timeout:
+                    stalled.append((d, f"{path} never appeared in "
+                                       f"{args.stall_timeout}s"))
+                continue
+            age = _time.time() - os.path.getmtime(path)
+            ages[d] = age
+            if args.stall_timeout is not None \
+                    and age > args.stall_timeout:
+                stalled.append((d, f"{path} not rewritten for "
+                                   f"{age:.1f}s "
+                                   f"(> {args.stall_timeout}s)"))
+            try:
+                with open(path) as fh:
+                    doc = json.load(fh)
+            except ValueError:
+                continue               # replaced mid-read: next tick
+            reg.merge(doc.get("metrics") or {})
+        for d, detail in stalled:
+            print(json.dumps({"event": "alert", "kind": "stall",
+                              "dir": d, "detail": detail}), flush=True)
+        if stalled:
+            return 3
+        if not ages and not args.follow:
+            print(f"obs top: no {obs_export.JSON_FILENAME} under any "
+                  f"of {dirs}", file=sys.stderr)
+            return 2
+        if ages:
+            head = "  ".join(f"{d} age={ages[d]:.1f}s" for d in ages)
+            print(f"== merged {len(ages)}/{len(dirs)} dirs  {head} ==",
+                  flush=True)
+            print(_render_top({"metrics": reg.snapshot()}), flush=True)
+        if not args.follow:
+            return 0
+        _time.sleep(args.every)
+
+
 def cmd_obs_top(args) -> int:
     """Live terminal view over the metrics surface: renders the
     metrics.json twin that ``MetricsExporter`` (serve/loadgen
     ``--metrics-dir``) rewrites atomically. --follow re-renders at
     --every cadence; --stall-timeout turns a metrics file that stops
     being rewritten into a synthetic stall alert and exit 3 (the
-    tpu_watch.sh contract, mirroring ``obs tail``)."""
+    tpu_watch.sh contract, mirroring ``obs tail``). With --merge/--glob
+    the table aggregates MULTIPLE metrics dirs (see
+    :func:`_obs_top_merge`)."""
     import time as _time
 
     from cbf_tpu.obs import export as obs_export
 
+    if getattr(args, "merge", None) or getattr(args, "glob", None):
+        return _obs_top_merge(args)
+    if args.run_dir is None:
+        print("obs top: a run_dir (or --merge/--glob) is required",
+              file=sys.stderr)
+        return 2
     try:
         mdir = _resolve_metrics_dir(args.run_dir, args.latest)
     except FileNotFoundError as e:
@@ -1839,6 +1916,175 @@ def cmd_lint(args) -> int:
     return result.exit_code
 
 
+def cmd_cluster_worker(args) -> int:
+    """One cluster engine process (spawned by ``cluster serve``, or by
+    hand): claim routed requests from this engine's inbox, acknowledge
+    them through a fenced WAL, respond through the outbox. SIGTERM
+    drains (exit 0); a newer lease epoch fences this process (exit 4).
+    With --metrics, a `MetricsExporter` rewrites this engine's
+    ``metrics/`` surface — aggregate M of them with
+    ``obs top --merge`` (docs/API.md 'Cluster serving')."""
+    import signal
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    from cbf_tpu.cluster import transport as ctransport
+    from cbf_tpu.cluster.worker import Worker
+
+    dirs = ctransport.EngineDirs(args.root, args.name)
+    sink = None
+    if args.metrics or args.telemetry:
+        from cbf_tpu import obs
+
+        sink = obs.TelemetrySink(os.path.join(dirs.base, "telemetry"))
+    w = Worker(args.root, args.name, max_batch=args.max_batch,
+               flush_deadline_s=args.flush_deadline,
+               heartbeat_s=args.heartbeat_s, cache_dir=args.cache_dir,
+               telemetry=sink, poll_s=args.poll_s)
+    w.boot()
+    exporter = None
+    if args.metrics:
+        from cbf_tpu.obs import export as obs_export
+
+        exporter = obs_export.MetricsExporter(
+            sink.registry, dirs.metrics, every_s=args.metrics_every,
+            extra_fn=lambda: {"engine": args.name,
+                              "stats": dict(w.engine.stats)}).start()
+
+    def _term(signum, frame):
+        w._stop.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _term)
+    except ValueError:
+        pass
+    rc = w.run_loop()
+    if exporter is not None:
+        exporter.stop()
+    if sink is not None:
+        sink.write_manifest()
+        sink.close()
+    return rc
+
+
+def cmd_cluster_serve(args) -> int:
+    """Serve a request file through a routed M-engine cluster: spawn M
+    ``cluster worker`` processes, route every request by bucket
+    signature over the consistent-hash ring (cost-model admission when
+    a costmodel.json is present; work stealing with --steal), watch
+    every worker's lease and fail dead ones over onto survivors, and
+    with --roll run one full zero-loss rolling restart while the
+    requests drain. Prints one JSON record ending in the cluster-wide
+    exactly-once census; exit 0 iff the census is clean (docs/API.md
+    'Cluster serving')."""
+    import subprocess
+    import tempfile
+    import time as _time
+
+    from cbf_tpu.cluster import (ClusterRouter, Membership,
+                                 cluster_census)
+    from cbf_tpu.cluster import transport as ctransport
+    from cbf_tpu.serve.resilience import ServeError
+    from cbf_tpu.utils.faults import wait_for_file
+
+    if args.engines < 1:
+        print(f"cluster serve: --engines must be >= 1, "
+              f"got {args.engines}", file=sys.stderr)
+        return 2
+    cfgs = _load_requests(args.requests)
+    root = args.root or tempfile.mkdtemp(prefix="cbf_cluster_")
+    names = [f"e{i}" for i in range(args.engines)]
+    sink = cost_model = None
+    if args.telemetry_dir:
+        from cbf_tpu import obs
+        from cbf_tpu.obs import resource as obs_resource
+
+        sink = obs.TelemetrySink(args.telemetry_dir)
+        cost_model = obs_resource.CostModel(os.path.join(
+            sink.run_dir, obs_resource.COSTMODEL_FILENAME))
+    router = ClusterRouter(root, names, telemetry=sink,
+                           cost_model=cost_model,
+                           budget_bytes=args.budget_bytes,
+                           steal=args.steal,
+                           steal_threshold=args.steal_threshold)
+    if args.prewarm:
+        # Written BEFORE the workers spawn: each engine AOT-compiles the
+        # request file's buckets at boot, so first traffic is warm.
+        router.prewarm(cfgs)
+    procs: dict = {}
+
+    def spawn(name: str) -> None:
+        argv = [sys.executable, "-m", "cbf_tpu", "cluster", "worker",
+                "--root", root, "--name", name,
+                "--max-batch", str(args.max_batch),
+                "--flush-deadline", str(args.flush_deadline),
+                "--heartbeat-s", str(args.heartbeat_s)]
+        if args.platform:
+            argv += ["--platform", args.platform]
+        if args.cache_dir:
+            argv += ["--cache-dir", args.cache_dir]
+        if args.worker_metrics:
+            argv += ["--metrics"]
+        procs[name] = subprocess.Popen(argv)
+
+    t0 = _time.monotonic()
+    for name in names:
+        spawn(name)
+    for name in names:
+        dirs = ctransport.EngineDirs(root, name)
+        if not wait_for_file(dirs.ready, args.ready_timeout):
+            for pr in procs.values():
+                pr.terminate()
+            print(f"cluster serve: engine {name} not ready within "
+                  f"{args.ready_timeout}s", file=sys.stderr)
+            return 2
+    router.start()
+    membership = Membership(router, ttl_s=args.lease_ttl_s,
+                            telemetry=sink, respawn=spawn).start()
+    pendings, errors = [], {}
+    for cfg in cfgs:
+        try:
+            pendings.append(router.submit(cfg))
+        except ServeError as e:
+            errors[type(e).__name__] = errors.get(type(e).__name__,
+                                                  0) + 1
+    roll = None
+    if args.roll:
+        roll = membership.rolling_restart()
+    completed = 0
+    for pnd in pendings:
+        try:
+            pnd.result(timeout=args.result_timeout)
+            completed += 1
+        except Exception as e:
+            errors[type(e).__name__] = errors.get(type(e).__name__,
+                                                  0) + 1
+    router.stop(drain=True)
+    membership.stop()
+    for name, pr in procs.items():
+        pr.terminate()
+    for name, pr in procs.items():
+        try:
+            pr.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            pr.kill()
+    census = cluster_census(root)
+    record = {"engines": args.engines, "root": root,
+              "requests": len(cfgs), "completed": completed,
+              "errors": errors, "stolen": router.stolen,
+              "failovers": membership.failovers,
+              "mttr_s": membership.mttr_s, "roll": roll,
+              "census": census,
+              "wall_s": round(_time.monotonic() - t0, 3)}
+    if sink is not None:
+        sink.write_manifest()
+        sink.close()
+    print(json.dumps(record))
+    return 0 if census["ok"] else 1
+
+
 def cmd_list(_args) -> int:
     for name, (module, steps_field, *_rest) in sorted(_scenarios().items()):
         cfg = module.Config()
@@ -2313,7 +2559,17 @@ def main(argv=None) -> int:
     topp = obs_sub.add_parser(
         "top", help="live terminal view over a --metrics-dir surface "
                     "(reads the metrics.json twin of metrics.prom)")
-    topp.add_argument("run_dir")
+    topp.add_argument("run_dir", nargs="?", default=None)
+    topp.add_argument("--merge", nargs="+", default=None, metavar="DIR",
+                      help="aggregate MULTIPLE metrics dirs (e.g. M "
+                           "cluster engines) into one merged table; "
+                           "counters/histograms add, gauges min/max-"
+                           "merge; the stall contract is judged PER "
+                           "dir (any stalled dir exits 3)")
+    topp.add_argument("--glob", default=None, metavar="PATTERN",
+                      help="like --merge with the dir list expanded "
+                           "from a shell glob pattern (quote it), e.g. "
+                           "'ROOT/engines/*/metrics'")
     topp.add_argument("--follow", "-f", action="store_true",
                       help="keep re-rendering at --every cadence")
     topp.add_argument("--every", type=float, default=2.0,
@@ -2363,6 +2619,113 @@ def main(argv=None) -> int:
                              "links) rebuilt from run_dir's events.jsonl, "
                              "then exit")
     lanesp.set_defaults(fn=cmd_obs_lanes)
+
+    clup = sub.add_parser(
+        "cluster", help="routed multi-engine serve cluster: consistent-"
+                        "hash placement, cost-model admission, work "
+                        "stealing, zero-loss rolling restarts "
+                        "(docs/API.md 'Cluster serving')")
+    clu_sub = clup.add_subparsers(dest="cluster_command", required=True)
+    cwp = clu_sub.add_parser(
+        "worker", help="one cluster engine process: claim/ack/respond "
+                       "loop over this engine's transport directories")
+    cwp.add_argument("--root", required=True,
+                     help="cluster root directory (shared with the "
+                          "router)")
+    cwp.add_argument("--name", required=True,
+                     help="engine name (its transport subtree is "
+                          "<root>/engines/<name>)")
+    cwp.add_argument("--platform", default=None, choices=("cpu", "tpu"),
+                     help="force a JAX backend before first use")
+    cwp.add_argument("--max-batch", type=int, default=8,
+                     help="engine micro-batch size (default 8)")
+    cwp.add_argument("--flush-deadline", type=float, default=0.05,
+                     help="engine queue flush deadline in seconds "
+                          "(default 0.05)")
+    cwp.add_argument("--heartbeat-s", type=float, default=0.2,
+                     help="lease heartbeat interval in seconds "
+                          "(default 0.2)")
+    cwp.add_argument("--cache-dir", default=None,
+                     help="persistent compilation cache directory "
+                          "(overrides CBF_TPU_CACHE_DIR; share one "
+                          "across engines for warm starts)")
+    cwp.add_argument("--poll-s", type=float, default=0.005,
+                     help="inbox poll interval in seconds "
+                          "(default 0.005)")
+    cwp.add_argument("--telemetry", action="store_true",
+                     help="write this engine's JSONL run directory "
+                          "under <root>/engines/<name>/telemetry")
+    cwp.add_argument("--metrics", action="store_true",
+                     help="rewrite this engine's metrics surface under "
+                          "<root>/engines/<name>/metrics at --metrics-"
+                          "every cadence; aggregate M engines with "
+                          "`obs top --merge`")
+    cwp.add_argument("--metrics-every", type=float, default=2.0,
+                     help="metrics rewrite cadence in seconds "
+                          "(default 2)")
+    cwp.set_defaults(fn=cmd_cluster_worker)
+    csp = clu_sub.add_parser(
+        "serve", help="serve a request file through a routed M-engine "
+                      "cluster; exit 0 iff the cluster-wide exactly-"
+                      "once census is clean")
+    csp.add_argument("requests",
+                     help="JSON request file (same format as `serve`)")
+    csp.add_argument("--engines", type=int, default=2,
+                     help="number of worker engines to spawn "
+                          "(default 2)")
+    csp.add_argument("--root", default=None,
+                     help="cluster root directory (default: a fresh "
+                          "temp dir)")
+    csp.add_argument("--platform", default=None, choices=("cpu", "tpu"),
+                     help="backend for the WORKER processes (the "
+                          "router itself never touches a device)")
+    csp.add_argument("--max-batch", type=int, default=8,
+                     help="per-engine micro-batch size (default 8)")
+    csp.add_argument("--flush-deadline", type=float, default=0.05,
+                     help="per-engine flush deadline in seconds "
+                          "(default 0.05)")
+    csp.add_argument("--heartbeat-s", type=float, default=0.2,
+                     help="worker lease heartbeat interval in seconds "
+                          "(default 0.2)")
+    csp.add_argument("--lease-ttl-s", type=float, default=2.0,
+                     help="declare an engine dead after this many "
+                          "seconds without a heartbeat change "
+                          "(default 2)")
+    csp.add_argument("--cache-dir", default=None,
+                     help="shared persistent compilation cache for all "
+                          "engines (overrides CBF_TPU_CACHE_DIR)")
+    csp.add_argument("--steal", action="store_true",
+                     help="enable work stealing: re-route queued-but-"
+                          "unacknowledged requests from a hotspotted "
+                          "engine to an idle one")
+    csp.add_argument("--steal-threshold", type=int, default=4,
+                     help="unclaimed inbox depth that marks an engine "
+                          "hotspotted (default 4)")
+    csp.add_argument("--roll", action="store_true",
+                     help="run one full rolling restart (drain-then-"
+                          "restart each engine) while the requests "
+                          "drain; gated on zero lost acks")
+    csp.add_argument("--prewarm", action="store_true",
+                     help="publish the request file's buckets as the "
+                          "cluster prewarm set before the engines boot")
+    csp.add_argument("--worker-metrics", action="store_true",
+                     help="pass --metrics to every worker (per-engine "
+                          "metrics/ surfaces for `obs top --merge`)")
+    csp.add_argument("--telemetry-dir", default=None,
+                     help="router-side run directory: cluster.route/"
+                          "steal/member/roll events (+ costmodel.json "
+                          "admission when present)")
+    csp.add_argument("--budget-bytes", type=int, default=None,
+                     help="per-request device-memory admission budget "
+                          "(needs a costmodel.json in --telemetry-dir; "
+                          "unpriced shapes fail open)")
+    csp.add_argument("--ready-timeout", type=float, default=180.0,
+                     help="seconds to wait for each engine's ready "
+                          "file at boot (default 180)")
+    csp.add_argument("--result-timeout", type=float, default=300.0,
+                     help="seconds to wait for each routed result "
+                          "(default 300)")
+    csp.set_defaults(fn=cmd_cluster_serve)
 
     args = p.parse_args(argv)
     if argv is None:

@@ -231,6 +231,19 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
             f"{verify_fleet.EMITTED_EVENT_TYPES!r} != "
             f"obs.schema.FLEET_EVENT_TYPES {schema.FLEET_EVENT_TYPES!r} "
             "— emitter and schema drifted")
+    # Cluster event drift: the router and the membership plane each
+    # declare what they emit; together they must cover the schema's
+    # cluster family exactly (same multi-module union as durable).
+    from cbf_tpu.cluster import membership as cluster_membership
+    from cbf_tpu.cluster import router as cluster_router
+    cluster_emitted = tuple(cluster_router.EMITTED_EVENT_TYPES) + \
+        tuple(cluster_membership.EMITTED_EVENT_TYPES)
+    if tuple(sorted(cluster_emitted)) != \
+            tuple(sorted(schema.CLUSTER_EVENT_TYPES)):
+        problems.append(
+            f"cluster emitters (router+membership) {cluster_emitted!r} != "
+            f"obs.schema.CLUSTER_EVENT_TYPES "
+            f"{schema.CLUSTER_EVENT_TYPES!r} — emitter and schema drifted")
     for table_name, types_name, fields, types in (
             ("SERVE_EVENT_FIELDS", "SERVE_EVENT_TYPES",
              schema.SERVE_EVENT_FIELDS, schema.SERVE_EVENT_TYPES),
@@ -249,7 +262,9 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
             ("LANES_EVENT_FIELDS", "LANES_EVENT_TYPES",
              schema.LANES_EVENT_FIELDS, schema.LANES_EVENT_TYPES),
             ("FLEET_EVENT_FIELDS", "FLEET_EVENT_TYPES",
-             schema.FLEET_EVENT_FIELDS, schema.FLEET_EVENT_TYPES)):
+             schema.FLEET_EVENT_FIELDS, schema.FLEET_EVENT_TYPES),
+            ("CLUSTER_EVENT_FIELDS", "CLUSTER_EVENT_TYPES",
+             schema.CLUSTER_EVENT_FIELDS, schema.CLUSTER_EVENT_TYPES)):
         for etype in fields:
             if etype not in types:
                 problems.append(
@@ -272,7 +287,8 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
     import inspect
     for mod in (verify_search, serve_engine, obs_trace, serve_loadgen,
                 durable_journal, durable_rollout, rta_monitor, obs_flight,
-                obs_lanes, scen_dsl, serve_ha, verify_fleet):
+                obs_lanes, scen_dsl, serve_ha, verify_fleet,
+                cluster_router, cluster_membership):
         try:
             mod_tree = ast.parse(inspect.getsource(mod))
         except (OSError, TypeError):
@@ -325,7 +341,8 @@ def obs_schema_audit(repo_root: str | None = None) -> list[str]:
                 ("scenario", schema.SCENARIO_EVENT_FIELDS),
                 ("ha", schema.HA_EVENT_FIELDS),
                 ("lanes", schema.LANES_EVENT_FIELDS),
-                ("fleet", schema.FLEET_EVENT_FIELDS)):
+                ("fleet", schema.FLEET_EVENT_FIELDS),
+                ("cluster", schema.CLUSTER_EVENT_FIELDS)):
             for etype, fields in table.items():
                 if f"`{etype}`" not in api_text:
                     problems.append(

@@ -1,0 +1,36 @@
+"""Routed multi-engine serve cluster.
+
+A router process fronts M `~cbf_tpu.serve.engine.ServeEngine` workers
+over a file-IPC transport:
+
+- `cluster.ring` — consistent-hash placement by PR 4 bucket signature
+  (cache/prewarm affinity; minimal disruption when the ring changes).
+- `cluster.transport` — per-engine inbox/claimed/outbox directories;
+  atomic renames arbitrate every claim-vs-steal race, making the
+  never-steal-acked invariant structural.
+- `cluster.router` — `ClusterRouter`: cost-model admission (PR 11,
+  fail-open), placement, work stealing, and the engine-shaped client
+  surface `run_loadgen` drives unmodified.
+- `cluster.worker` — `Worker` / `run_worker`: the claim/ack/respond
+  loop around one engine, fenced lease + WAL, drain-on-SIGTERM.
+- `cluster.membership` — `Membership`: lease monitoring, dead-engine
+  failover with journal replay + request-id dedupe, rolling restarts,
+  and `cluster_census` (the cluster-wide zero-lost-acks /
+  zero-duplicates verdict).
+
+CLI: ``python -m cbf_tpu cluster serve --engines M [--steal] [--roll]``
+and ``python -m cbf_tpu cluster worker --root R --name E``. Chaos leg:
+``BENCH_CLUSTER=1 python -m cbf_tpu.bench``.
+"""
+
+from cbf_tpu.cluster.membership import Membership, cluster_census
+from cbf_tpu.cluster.ring import HashRing, ring_hash
+from cbf_tpu.cluster.router import ClusterRouter, RoutedPending
+from cbf_tpu.cluster.transport import EngineDirs
+from cbf_tpu.cluster.worker import Worker, run_worker
+
+__all__ = [
+    "ClusterRouter", "EngineDirs", "HashRing", "Membership",
+    "RoutedPending", "Worker", "cluster_census", "ring_hash",
+    "run_worker",
+]

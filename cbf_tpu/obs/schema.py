@@ -362,6 +362,36 @@ LANES_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
 FLEET_EVENT_TYPES: tuple[str, ...] = (
     "fleet.round", "fleet.violation", "fleet.preempt")
 
+#: The multi-engine cluster's events (``cbf_tpu.cluster``):
+#: ``cluster.route`` once per request the router admits and places (the
+#: consistent-hash engine choice, the bucket label that drove it, the
+#: target inbox depth at placement, and the cost model's predicted
+#: footprint — 0 for an unpriced shape, fail-open); ``cluster.steal``
+#: once per queued-but-unacked request file the steal sweep renames from
+#: a hotspotted engine's inbox to an idle one's (an acked WAL entry is
+#: never stolen — claims and steals are both atomic renames OUT of the
+#: inbox, so exactly one side wins); ``cluster.member`` once per
+#: membership transition (``state`` up/dead/failover — a failover
+#: carries the dead engine's replay census and the measured MTTR from
+#: expiry detection to every orphan re-routed); ``cluster.roll`` once
+#: per rolling-restart phase (``phase`` drain/restart/done) per engine.
+#: Same AUD001 contract as the other tables: the union of
+#: ``cluster.router`` + ``cluster.membership`` ``EMITTED_EVENT_TYPES``
+#: must equal this tuple, every type needs a literal emit site, and
+#: every type and field must be documented in docs/API.md.
+CLUSTER_EVENT_TYPES: tuple[str, ...] = (
+    "cluster.route", "cluster.steal", "cluster.member", "cluster.roll")
+
+CLUSTER_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
+    "cluster.route": ("request_id", "bucket", "engine", "inbox_depth",
+                      "predicted_bytes"),
+    "cluster.steal": ("request_id", "bucket", "from_engine", "to_engine",
+                      "inbox_depth"),
+    "cluster.member": ("engine", "state", "epoch", "reenqueued",
+                       "deduped", "mttr_s"),
+    "cluster.roll": ("engine", "phase", "drained", "restart_s"),
+}
+
 FLEET_EVENT_FIELDS: dict[str, tuple[str, ...]] = {
     "fleet.round": ("round", "candidates", "evaluated", "best_margin",
                     "violations", "near_misses", "cells_visited",

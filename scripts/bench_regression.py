@@ -11,7 +11,14 @@ against the previous verified one.
 Effective measurement rules (matching how bench.py records hardware
 flakiness, docs/BENCH_LOG.md):
 
-- a record with ``value > 0`` and no ``error`` is verified as-is;
+- a record with ``value > 0`` and no ``error`` is verified as-is —
+  UNLESS its ``host`` block says ``degraded_host`` (bench.py stamps
+  ``os.getloadavg()``/core count at leg start; load per core above the
+  threshold means the knee was measured on an already-loaded shared
+  host): a degraded measured record is treated exactly like a wedged
+  one — fall through to ``last_verified``, else unverified — so a busy
+  neighbor can neither fail the audit nor launder a real regression
+  into the verified series;
 - a record with ``value == 0`` + ``error`` falls back to its embedded
   ``last_verified`` stanza when present (bench.py writes one after the
   first successful run — Round 5 onward);
@@ -108,8 +115,10 @@ def effective(parsed: dict) -> dict | None:
     if not isinstance(parsed, dict) or "value" not in parsed:
         return None
     value = parsed.get("value")
+    host = parsed.get("host")
+    degraded = isinstance(host, dict) and bool(host.get("degraded_host"))
     if isinstance(value, (int, float)) and value > 0 \
-            and not parsed.get("error"):
+            and not parsed.get("error") and not degraded:
         return {"value": float(value), "source": "measured",
                 "vs_baseline": parsed.get("vs_baseline")}
     fallback = parsed.get("last_verified")

@@ -398,6 +398,58 @@ def test_persistent_cache_speeds_up_second_process(tmp_path):
         f"{cold:.2f}s")
 
 
+@pytest.mark.slow
+def test_persistent_cache_shared_by_three_concurrent_engines(tmp_path):
+    """Cluster-scale extension of the cache gate: M=3 engine processes
+    share one CBF_TPU_CACHE_DIR *concurrently*. After one cold process
+    populates the cache, the three warm siblings TOGETHER beat three
+    cold boots by >= 30% wall (per-process walls are inflated by CPU
+    contention on small hosts — the aggregate is the honest concurrent
+    gate), every process exits clean, and a fourth sequential run
+    preserves the original per-process >= 30% gate, proving the
+    concurrent readers corrupted nothing."""
+    reqs = tmp_path / "reqs.json"
+    reqs.write_text(json.dumps([
+        {"steps": 100, "seed": 1, "overrides": {"n": 100,
+                                                "gating": "jnp"}},
+        {"steps": 100, "seed": 2, "overrides": {"n": 64, "gating": "jnp"}},
+    ]))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["CBF_TPU_CACHE_DIR"] = str(tmp_path / "cache")
+    env.pop("XLA_FLAGS", None)
+
+    argv = [sys.executable, "-m", "cbf_tpu", "serve", str(reqs),
+            "--prewarm-only"]
+
+    def prewarm_s(out):
+        assert out.returncode == 0, out.stderr[-2000:]
+        return json.loads(out.stdout.strip().splitlines()[-1])["prewarm_s"]
+
+    cold = prewarm_s(subprocess.run(argv, capture_output=True, text=True,
+                                    timeout=500, cwd=ROOT, env=env))
+    t0 = time.perf_counter()
+    procs = [subprocess.Popen(argv, stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True,
+                              cwd=ROOT, env=env) for _ in range(3)]
+    warms = []
+    for p in procs:
+        stdout, stderr = p.communicate(timeout=500)
+        assert p.returncode == 0, stderr[-2000:]
+        warms.append(json.loads(stdout.strip().splitlines()[-1])
+                     ["prewarm_s"])
+    concurrent_wall = time.perf_counter() - t0
+    assert concurrent_wall <= 0.7 * 3 * cold, (
+        f"3 concurrent warm engines took {concurrent_wall:.2f}s "
+        f"(per-process {warms}) — not >=30% under 3x cold "
+        f"({cold:.2f}s each)")
+    after = prewarm_s(subprocess.run(argv, capture_output=True, text=True,
+                                     timeout=500, cwd=ROOT, env=env))
+    assert after <= 0.7 * cold, (
+        f"post-concurrency prewarm {after:.2f}s regressed vs cold "
+        f"{cold:.2f}s — concurrent sharing corrupted the cache")
+
+
 # ------------------------------------------------------------------ docs --
 
 def test_serving_documented():
